@@ -1,0 +1,4 @@
+from .elasticity import (compute_elastic_config, elasticity_enabled,
+                         ensure_immutable_elastic_config, _get_compatible_gpus_v01)
+from .config import (ElasticityConfig, ElasticityError, ElasticityConfigError,
+                     ElasticityIncompatibleWorldSize)

@@ -1,0 +1,71 @@
+"""Elasticity config object. Parity: reference ``deepspeed/elasticity/config.py``."""
+
+import json
+
+from . import constants as EC
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """Parsed ``elasticity`` section with the v0.1 schema."""
+
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get(EC.ENABLED, EC.ENABLED_DEFAULT)
+        if self.enabled:
+            if EC.MAX_ACCEPTABLE_BATCH_SIZE in param_dict:
+                self.max_acceptable_batch_size = param_dict[EC.MAX_ACCEPTABLE_BATCH_SIZE]
+            else:
+                raise ElasticityConfigError(
+                    f"Elasticity config missing {EC.MAX_ACCEPTABLE_BATCH_SIZE}")
+            if EC.MICRO_BATCHES in param_dict:
+                self.micro_batches = param_dict[EC.MICRO_BATCHES]
+            else:
+                raise ElasticityConfigError(f"Elasticity config missing {EC.MICRO_BATCHES}")
+        else:
+            self.max_acceptable_batch_size = param_dict.get(
+                EC.MAX_ACCEPTABLE_BATCH_SIZE, EC.MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT)
+            self.micro_batches = param_dict.get(EC.MICRO_BATCHES, EC.MICRO_BATCHES_DEFAULT)
+
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"{EC.MICRO_BATCHES} must be a list of ints, got {self.micro_batches}")
+        if not all(map(lambda m: isinstance(m, int), self.micro_batches)):
+            raise ElasticityConfigError(
+                f"{EC.MICRO_BATCHES} must contain only ints, got {self.micro_batches}")
+        if not all(map(lambda m: m > 0, self.micro_batches)):
+            raise ElasticityConfigError(
+                f"{EC.MICRO_BATCHES} must contain only positive ints, got {self.micro_batches}")
+
+        self.min_gpus = param_dict.get(EC.MIN_GPUS, EC.MIN_GPUS_DEFAULT)
+        self.max_gpus = param_dict.get(EC.MAX_GPUS, EC.MAX_GPUS_DEFAULT)
+        if self.min_gpus < 1 or self.max_gpus < 1:
+            raise ElasticityConfigError("Elasticity min/max gpus must be > 0")
+        if self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError("Elasticity min_gpus cannot be greater than max_gpus")
+
+        self.min_time = param_dict.get(EC.MIN_TIME, EC.MIN_TIME_DEFAULT)
+        if self.min_time < 0:
+            raise ElasticityConfigError(f"Elasticity min time needs to be >= 0")
+
+        self.version = param_dict.get(EC.VERSION, EC.VERSION_DEFAULT)
+        self.prefer_larger_batch_size = param_dict.get(EC.PREFER_LARGER_BATCH,
+                                                       EC.PREFER_LARGER_BATCH_DEFAULT)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            EC.IGNORE_NON_ELASTIC_BATCH_INFO, EC.IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+
+    def repr_dict(self):
+        return self.__dict__
+
+    def __repr__(self):
+        return json.dumps(self.__dict__, sort_keys=True, indent=4)

@@ -1,0 +1,172 @@
+"""Batch-size elasticity (v0.1 algorithm).
+
+Parity: reference ``deepspeed/elasticity/elasticity.py:128 _get_compatible_gpus_v01``
+and ``:226 compute_elastic_config``.  Pure arithmetic, no accelerator involvement:
+choose a global ``train_batch_size`` that remains valid (divisible into
+micro_batch × gas × world_size) across many possible world sizes, so a job
+restarted with a different chip count keeps the same global batch.
+
+The candidate batch sizes are micro_batch × highly-composite multipliers; among
+candidates within ``max_acceptable_batch_size`` we pick the one valid for the
+greatest number of world sizes (tie-broken by ``prefer_larger_batch``).
+"""
+
+import os
+import json
+
+from .config import (ElasticityConfig, ElasticityError, ElasticityConfigError,
+                     ElasticityIncompatibleWorldSize)
+from . import constants as EC
+from ..utils.logging import logger
+
+# Highly composite numbers — many divisors per magnitude, so batch sizes built
+# from them divide evenly across many world sizes.
+HCN_LIST = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+            1260, 1680, 2520, 5040, 7560, 10080]
+
+
+def _get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size):
+    """All micro_batch × HCN products within the cap, deduped + sorted."""
+    candidates = set()
+    for micro in micro_batches:
+        for hcn in HCN_LIST:
+            if micro * hcn <= max_acceptable_batch_size:
+                candidates.add(micro * hcn)
+    return sorted(candidates)
+
+
+def _get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
+    """World sizes w for which batch_size == micro * gas * w has an integer solution."""
+    valid_gpus = set()
+    for micro in micro_batches:
+        if batch_size % micro != 0:
+            continue
+        total_steps = batch_size // micro  # gas * world_size
+        for w in range(1, total_steps + 1):
+            if total_steps % w == 0 and min_valid_gpus <= w <= max_valid_gpus:
+                valid_gpus.add(w)
+    return sorted(valid_gpus)
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
+                             min_gpus=None, max_gpus=None, prefer_larger=True):
+    """Pick (final_batch_size, valid_gpus) maximizing the number of valid world sizes.
+
+    Parity: reference ``elasticity/elasticity.py:128``.
+    """
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(f"All micro batches must be less than or equal to "
+                         f"max_acceptable_batch_size: {max_acceptable_batch_size}")
+
+    final_batch_size = int(min(micro_batches))
+    valid_gpus = _get_valid_gpus(final_batch_size, micro_batches, min_gpus, max_gpus)
+
+    for candidate in _get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size):
+        candidate_valid = _get_valid_gpus(candidate, micro_batches, min_gpus, max_gpus)
+        better = len(candidate_valid) > len(valid_gpus)
+        tie = len(candidate_valid) == len(valid_gpus) and len(valid_gpus) > 0
+        if better or (tie and ((prefer_larger and candidate > final_batch_size) or
+                               (not prefer_larger and candidate < final_batch_size))):
+            final_batch_size = candidate
+            valid_gpus = candidate_valid
+
+    return final_batch_size, valid_gpus
+
+
+def _compatible_ds_version_check(target_deepspeed_version):
+    # All versions of this framework support elasticity v0.1.
+    return True
+
+
+def elasticity_enabled(ds_config):
+    if EC.ELASTICITY not in ds_config:
+        return False
+    return ds_config[EC.ELASTICITY].get(EC.ENABLED, EC.ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict):
+    """Assert the elastic config hasn't changed across restarts.
+
+    Parity: reference ``elasticity.py:193``.  The scheduler records the config
+    in the DEEPSPEED_ELASTICITY_CONFIG env var; later runs must match it.
+    """
+    if EC.DEEPSPEED_ELASTICITY_CONFIG in os.environ:
+        scheduler_elastic_config_dict = json.loads(os.environ[EC.DEEPSPEED_ELASTICITY_CONFIG])
+        scheduler_elastic_config = ElasticityConfig(scheduler_elastic_config_dict)
+        runtime_elastic_config = ElasticityConfig(runtime_elastic_config_dict)
+        err_str = ("Elastic config '{}={}' seen by scheduler does not match config "
+                   "passed in at runtime '{}={}'")
+        if runtime_elastic_config.max_acceptable_batch_size != \
+                scheduler_elastic_config.max_acceptable_batch_size:
+            raise ElasticityConfigError(
+                err_str.format("max_acceptable_batch_size",
+                               scheduler_elastic_config.max_acceptable_batch_size,
+                               "max_acceptable_batch_size",
+                               runtime_elastic_config.max_acceptable_batch_size))
+        if runtime_elastic_config.micro_batches != scheduler_elastic_config.micro_batches:
+            raise ElasticityConfigError(
+                err_str.format("micro_batches", scheduler_elastic_config.micro_batches,
+                               "micro_batches", runtime_elastic_config.micro_batches))
+        if runtime_elastic_config.version != scheduler_elastic_config.version:
+            raise ElasticityConfigError(
+                err_str.format("version", scheduler_elastic_config.version,
+                               "version", runtime_elastic_config.version))
+    else:
+        os.environ[EC.DEEPSPEED_ELASTICITY_CONFIG] = json.dumps(runtime_elastic_config_dict)
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version, world_size=0,
+                           return_microbatch=False):
+    """Core entry: (final_batch_size, valid_gpus[, micro_batch]).
+
+    Parity: reference ``elasticity/elasticity.py:226``.  With ``world_size > 0``
+    also picks the micro-batch (largest feasible if ``prefer_larger_batch``).
+    """
+    if isinstance(ds_config, str):
+        ds_config = json.loads(ds_config)
+    if not isinstance(ds_config, dict):
+        raise ValueError("Expected ds_config to be a dict or json string")
+
+    if EC.ELASTICITY not in ds_config:
+        raise ElasticityError(f"'{EC.ELASTICITY}' is missing from config json, "
+                              f"please add it if running an elastic training job.")
+    elastic_config = ElasticityConfig(ds_config[EC.ELASTICITY])
+
+    if float(elastic_config.version) > EC.LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"Unsupported elasticity version {elastic_config.version}, "
+            f"latest is {EC.LATEST_ELASTICITY_VERSION}")
+
+    if float(elastic_config.version) == 0.1:
+        final_batch_size, valid_gpus = _get_compatible_gpus_v01(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=elastic_config.prefer_larger_batch_size)
+        final_batch_size = int(final_batch_size)
+    else:
+        raise NotImplementedError(
+            f"Unable to find elastic logic for version: {elastic_config.version}")
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"World size ({world_size}) is not valid with the current list of "
+                f"valid GPU counts: {valid_gpus}")
+        # Pick the micro batch: prefer the largest micro batch that divides evenly.
+        candidate_microbatch = None
+        for micro in sorted(elastic_config.micro_batches, reverse=True):
+            if final_batch_size // world_size % micro == 0:
+                candidate_microbatch = micro
+                if elastic_config.prefer_larger_batch_size:
+                    break
+        if candidate_microbatch is None:
+            raise ElasticityError(f"Unable to find appropriate micro batch size for "
+                                  f"world size {world_size} and batch {final_batch_size}")
+        return final_batch_size, valid_gpus, candidate_microbatch
+
+    return final_batch_size, valid_gpus, None

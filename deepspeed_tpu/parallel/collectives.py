@@ -1,0 +1,113 @@
+"""Named-axis collectives layer.
+
+TPU-native replacement for the reference's communication layer: NCCL/MPI
+primitives used throughout the reference —
+
+  allreduce        (``runtime/engine.py:2107 allreduce_bucket``)        → psum
+  reduce-scatter   (``runtime/comm/coalesced_collectives.py:16``)       → psum_scatter
+  allgather        (``runtime/zero/partition_parameters.py:47,65``)     → all_gather
+  alltoall         (``deepspeed/moe/sharded_moe.py:85 _AllToAll``)      → all_to_all
+  send/recv p2p    (``runtime/pipe/p2p.py:48,69``)                      → ppermute
+
+These wrappers are meaningful ONLY inside ``shard_map``/``pmap`` regions where
+the named axis is bound.  Under plain ``jit`` with sharding constraints, XLA's
+SPMD partitioner inserts the equivalent collectives automatically — that is the
+preferred path for ZeRO (SURVEY.md §7 "sharding, not hooks"); use these for the
+explicitly scheduled paths (pipeline, ring attention, MoE dispatch, 1-bit).
+"""
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def axis_size(axis_name: AxisName) -> int:
+    return lax.axis_size(axis_name)
+
+
+def axis_index(axis_name: AxisName):
+    return lax.axis_index(axis_name)
+
+
+def all_reduce_sum(x, axis_name: AxisName):
+    """Parity: torch.distributed.all_reduce(SUM) over a process group."""
+    return lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x, axis_name: AxisName):
+    """Parity: allreduce + divide-by-world-size (grad averaging,
+    reference ``stage_1_and_2.py:883 average_tensor``)."""
+    return lax.pmean(x, axis_name)
+
+
+def all_reduce_max(x, axis_name: AxisName):
+    """Parity: allreduce(MAX) — used for overflow checks
+    (``stage_1_and_2.py:1660``) and MoE no-drop capacity
+    (``sharded_moe.py:213-217``)."""
+    return lax.pmax(x, axis_name)
+
+
+def reduce_scatter_sum(x, axis_name: AxisName, scatter_dimension: int = 0,
+                       tiled: bool = True):
+    """Parity: ``reduce_scatter_coalesced`` (``coalesced_collectives.py:43``).
+
+    With ``tiled=True`` the input's scatter dimension must be divisible by the
+    axis size and each shard keeps ``dim/axis_size`` (the reference pads uneven
+    tensors — callers here pre-pad via :func:`pad_to_multiple`).
+    """
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def all_gather(x, axis_name: AxisName, axis: int = 0, tiled: bool = True):
+    """Parity: ``_all_gather_base`` fast path (``partition_parameters.py:47,65``)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def all_to_all(x, axis_name: AxisName, split_axis: int, concat_axis: int):
+    """Parity: MoE ``_AllToAll`` autograd op (``moe/sharded_moe.py:85``).
+
+    jax.lax.all_to_all is already differentiable — the reference needed a
+    custom autograd.Function; here the transpose rule comes for free.
+    """
+    return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+                          tiled=True)
+
+
+def ppermute_next(x, axis_name: AxisName):
+    """Rotate shards to the next rank on the axis ring (pipeline send-forward,
+    ring-attention KV rotation).  Parity: ``pipe/p2p.py:48 send`` to stage+1."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ppermute_prev(x, axis_name: AxisName):
+    """Parity: ``pipe/p2p.py`` send to stage-1 (backward grad transfer)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def broadcast_from(x, axis_name: AxisName, src_index: int = 0):
+    """Parity: ``_broadcast_model`` (``engine.py:958``) / loss broadcast from the
+    last pipeline stage (``pipe/engine.py:552``).  Implemented as select+psum —
+    one collective, no host round-trip."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src_index, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def pad_to_multiple(x, multiple: int, axis: int = 0, value=0):
+    """Pad ``axis`` up to a multiple (reference pads uneven partitions with a
+    dummy tail, ``stage_1_and_2.py`` flat-group padding)."""
+    size = x.shape[axis]
+    rem = (-size) % multiple
+    if rem == 0:
+        return x
+    pad_widths = [(0, 0)] * x.ndim
+    pad_widths[axis] = (0, rem)
+    return jnp.pad(x, pad_widths, constant_values=value)

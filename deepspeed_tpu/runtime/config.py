@@ -1,0 +1,454 @@
+"""DeepSpeed-compatible JSON config system.
+
+Parity: reference ``deepspeed/runtime/config.py:791`` (``DeepSpeedConfig``) — same
+JSON document schema (SURVEY.md §8.1), same batch-size arithmetic invariant
+``train_batch_size == micro_batch * gradient_accumulation_steps * dp_world_size``
+(reference ``config.py:980 _batch_assertion``).
+
+TPU-native differences:
+- ``world_size`` means the data-parallel extent of the device mesh
+  (``data * fsdp`` axes), not an NCCL process count.
+- New optional ``mesh`` section declares mesh axis sizes
+  ``{"data": -1, "fsdp": 1, "tensor": 1, "expert": 1, "pipe": 1, "seq": 1}``;
+  ``-1`` means "absorb remaining devices".
+- ``fp16`` on TPU is honored (loss scaling + overflow skip implemented), but the
+  recommended precision is ``bf16`` which needs no scaler.
+"""
+
+import logging
+
+from . import constants as C
+from .config_utils import get_scalar_param, get_dict_param, load_config_dict
+from .zero.config import DeepSpeedZeroConfig
+from ..utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class DeepSpeedConfigWriter:
+    """Minimal .load/.data holder used by autotuner experiments."""
+
+    def __init__(self, data=None):
+        self.data = {} if data is None else data
+
+    def add_config(self, key, value):
+        self.data[key] = value
+
+    def load_config(self, filename):
+        self.data = load_config_dict(filename)
+
+    def write_config(self, filename):
+        import json
+        with open(filename, "w") as f:
+            json.dump(self.data, f, indent=4)
+
+
+class DeepSpeedFP16Config:
+    def __init__(self, param_dict):
+        fp16_dict = get_dict_param(param_dict, C.FP16, {})
+        self.enabled = get_scalar_param(fp16_dict, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT)
+        self.loss_scale = get_scalar_param(fp16_dict, C.FP16_LOSS_SCALE,
+                                           C.FP16_LOSS_SCALE_DEFAULT)
+        self.initial_scale_power = get_scalar_param(fp16_dict, C.FP16_INITIAL_SCALE_POWER,
+                                                    C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+        self.loss_scale_window = get_scalar_param(fp16_dict, C.FP16_LOSS_SCALE_WINDOW,
+                                                  C.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+        self.hysteresis = get_scalar_param(fp16_dict, C.FP16_HYSTERESIS,
+                                           C.FP16_HYSTERESIS_DEFAULT)
+        self.min_loss_scale = get_scalar_param(fp16_dict, C.FP16_MIN_LOSS_SCALE,
+                                               C.FP16_MIN_LOSS_SCALE_DEFAULT)
+        self.master_weights_and_grads = get_scalar_param(
+            fp16_dict, "master_weights_and_grads",
+            get_scalar_param(param_dict, C.FP16_MASTER_WEIGHTS_AND_GRADS,
+                             C.FP16_MASTER_WEIGHTS_AND_GRADS_DEFAULT))
+
+    @property
+    def dynamic_loss_scale(self):
+        return self.loss_scale == 0
+
+
+class DeepSpeedBF16Config:
+    def __init__(self, param_dict):
+        bf16_dict = get_dict_param(param_dict, C.BFLOAT16,
+                                   get_dict_param(param_dict, C.BFLOAT16_OLD, {}))
+        self.enabled = get_scalar_param(bf16_dict, C.BFLOAT16_ENABLED,
+                                        C.BFLOAT16_ENABLED_DEFAULT)
+
+
+class DeepSpeedActivationCheckpointingConfig:
+    """Parity: reference ``runtime/activation_checkpointing/config.py``.
+
+    TPU mapping: ``partition_activations`` → shard the remat'd residual stream on
+    the tensor axis; ``cpu_checkpointing`` → host offload of checkpoints via
+    ``jax.device_put`` donation; contiguous-memory keys accepted as no-ops (XLA
+    owns layout).
+    """
+
+    def __init__(self, param_dict):
+        act_dict = get_dict_param(param_dict, C.ACTIVATION_CHECKPOINTING, {})
+        self.partition_activations = get_scalar_param(act_dict, "partition_activations", False)
+        self.contiguous_memory_optimization = get_scalar_param(
+            act_dict, "contiguous_memory_optimization", False)
+        self.cpu_checkpointing = get_scalar_param(act_dict, "cpu_checkpointing", False)
+        self.number_checkpoints = get_scalar_param(act_dict, "number_checkpoints", None)
+        self.synchronize_checkpoint_boundary = get_scalar_param(
+            act_dict, "synchronize_checkpoint_boundary", False)
+        self.profile = get_scalar_param(act_dict, "profile", False)
+
+
+class DeepSpeedFlopsProfilerConfig:
+    def __init__(self, param_dict):
+        prof_dict = get_dict_param(param_dict, C.FLOPS_PROFILER, {})
+        self.enabled = get_scalar_param(prof_dict, C.FLOPS_PROFILER_ENABLED,
+                                        C.FLOPS_PROFILER_ENABLED_DEFAULT)
+        self.profile_step = get_scalar_param(prof_dict, C.FLOPS_PROFILER_PROFILE_STEP,
+                                             C.FLOPS_PROFILER_PROFILE_STEP_DEFAULT)
+        self.module_depth = get_scalar_param(prof_dict, C.FLOPS_PROFILER_MODULE_DEPTH,
+                                             C.FLOPS_PROFILER_MODULE_DEPTH_DEFAULT)
+        self.top_modules = get_scalar_param(prof_dict, C.FLOPS_PROFILER_TOP_MODULES,
+                                            C.FLOPS_PROFILER_TOP_MODULES_DEFAULT)
+        self.detailed = get_scalar_param(prof_dict, C.FLOPS_PROFILER_DETAILED,
+                                         C.FLOPS_PROFILER_DETAILED_DEFAULT)
+        self.output_file = get_scalar_param(prof_dict, C.FLOPS_PROFILER_OUTPUT_FILE,
+                                            C.FLOPS_PROFILER_OUTPUT_FILE_DEFAULT)
+
+
+class DeepSpeedTensorboardConfig:
+    def __init__(self, param_dict):
+        tb_dict = get_dict_param(param_dict, C.TENSORBOARD, {})
+        self.enabled = get_scalar_param(tb_dict, C.TENSORBOARD_ENABLED,
+                                        C.TENSORBOARD_ENABLED_DEFAULT)
+        self.output_path = get_scalar_param(tb_dict, C.TENSORBOARD_OUTPUT_PATH,
+                                            C.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+        self.job_name = get_scalar_param(tb_dict, C.TENSORBOARD_JOB_NAME,
+                                         C.TENSORBOARD_JOB_NAME_DEFAULT)
+
+
+class DeepSpeedPipelineConfig:
+    def __init__(self, param_dict):
+        pipe_dict = get_dict_param(param_dict, C.PIPELINE, {})
+        self.stages = get_scalar_param(pipe_dict, C.PIPELINE_STAGES, C.PIPELINE_STAGES_DEFAULT)
+        self.partition = get_scalar_param(pipe_dict, C.PIPELINE_PARTITION,
+                                          C.PIPELINE_PARTITION_DEFAULT)
+        self.seed_layers = get_scalar_param(pipe_dict, C.PIPELINE_SEED_LAYERS,
+                                            C.PIPELINE_SEED_LAYERS_DEFAULT)
+        self.activation_checkpoint_interval = get_scalar_param(
+            pipe_dict, C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL,
+            C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT)
+
+
+class DeepSpeedCurriculumConfig:
+    def __init__(self, param_dict):
+        cl_dict = get_dict_param(param_dict, C.CURRICULUM_LEARNING, {})
+        self.enabled = get_scalar_param(cl_dict, C.CURRICULUM_ENABLED,
+                                        C.CURRICULUM_ENABLED_DEFAULT)
+        self.params = {k: v for k, v in cl_dict.items()}
+
+
+class DeepSpeedPLDConfig:
+    def __init__(self, param_dict):
+        pld_dict = get_dict_param(param_dict, C.PROGRESSIVE_LAYER_DROP, {})
+        self.enabled = get_scalar_param(pld_dict, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)
+        self.theta = get_scalar_param(pld_dict, C.PLD_THETA, C.PLD_THETA_DEFAULT)
+        self.gamma = get_scalar_param(pld_dict, C.PLD_GAMMA, C.PLD_GAMMA_DEFAULT)
+
+
+class DeepSpeedEigenvalueConfig:
+    def __init__(self, param_dict):
+        ev = get_dict_param(param_dict, C.EIGENVALUE, {})
+        self.enabled = get_scalar_param(ev, C.EIGENVALUE_ENABLED, C.EIGENVALUE_ENABLED_DEFAULT)
+        self.verbose = get_scalar_param(ev, C.EIGENVALUE_VERBOSE, C.EIGENVALUE_VERBOSE_DEFAULT)
+        self.max_iter = get_scalar_param(ev, C.EIGENVALUE_MAX_ITER, C.EIGENVALUE_MAX_ITER_DEFAULT)
+        self.tol = get_scalar_param(ev, C.EIGENVALUE_TOL, C.EIGENVALUE_TOL_DEFAULT)
+        self.stability = get_scalar_param(ev, C.EIGENVALUE_STABILITY,
+                                          C.EIGENVALUE_STABILITY_DEFAULT)
+        self.gas_boundary_resolution = get_scalar_param(
+            ev, C.EIGENVALUE_GAS_BOUNDARY_RESOLUTION,
+            C.EIGENVALUE_GAS_BOUNDARY_RESOLUTION_DEFAULT)
+        self.layer_name = get_scalar_param(ev, C.EIGENVALUE_LAYER_NAME,
+                                           C.EIGENVALUE_LAYER_NAME_DEFAULT)
+        self.layer_num = get_scalar_param(ev, C.EIGENVALUE_LAYER_NUM,
+                                          C.EIGENVALUE_LAYER_NUM_DEFAULT)
+
+
+class DeepSpeedQuantizeTrainingConfig:
+    """MoQ quantize-aware training knobs (reference ``config.py:275-330``)."""
+
+    def __init__(self, param_dict):
+        q = get_dict_param(param_dict, C.QUANTIZE_TRAINING, {})
+        self.enabled = get_scalar_param(q, "enabled", False)
+        groups = get_dict_param(q, "quantize_groups", {})
+        self.quantize_groups = groups if isinstance(groups, int) else \
+            get_scalar_param(q, "quantize_groups", 1)
+        self.quantize_weight_in_forward = get_scalar_param(q, "quantize_weight_in_forward", False)
+        self.quantize_verbose = get_scalar_param(q, "quantize_verbose", False)
+        self.quantizer_kernel = get_scalar_param(q, "quantizer_kernel", False)
+        sched = get_dict_param(q, "quantize_schedule", {})
+        self.quantize_period = get_scalar_param(sched, "quantize_period", 1000)
+        sched_offset = get_dict_param(sched, "schedule_offset", 1000)
+        self.schedule_offset = sched_offset if isinstance(sched_offset, int) else 1000
+        algo = get_dict_param(q, "quantize_algo", {})
+        self.quantize_type = get_scalar_param(algo, "q_type", "symmetric")
+        self.rounding = get_scalar_param(algo, "rounding", "nearest")
+        self.fp16_mixed_quantize = get_scalar_param(
+            get_dict_param(q, "fp16_mixed_quantize", {}), "enabled", False)
+        self.quantize_change_ratio = get_scalar_param(
+            get_dict_param(q, "fp16_mixed_quantize", {}), "quantize_change_ratio", 0.001)
+        self.target_bits = get_scalar_param(q, "quantize_bits",
+                                            {}).get("target_bits", 8) if isinstance(
+                                                get_scalar_param(q, "quantize_bits", {}),
+                                                dict) else 8
+        bits = get_dict_param(q, "quantize_bits", {})
+        self.start_bits = get_scalar_param(bits, "start_bits", 16)
+
+
+class DeepSpeedCheckpointConfig:
+    def __init__(self, param_dict):
+        ckpt_dict = get_dict_param(param_dict, C.CHECKPOINT, {})
+        self.tag_validation = get_scalar_param(ckpt_dict, C.CHECKPOINT_TAG_VALIDATION,
+                                               C.CHECKPOINT_TAG_VALIDATION_DEFAULT)
+        if self.tag_validation not in C.CHECKPOINT_TAG_VALIDATION_MODES:
+            raise DeepSpeedConfigError(
+                f"checkpoint.tag_validation must be one of {C.CHECKPOINT_TAG_VALIDATION_MODES}")
+        self.load_universal = get_scalar_param(ckpt_dict, C.LOAD_UNIVERSAL_CHECKPOINT,
+                                               C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT)
+
+
+class DeepSpeedMeshConfig:
+    """TPU-native extension: declared mesh axis sizes.
+
+    ``{"axes": {"data": -1, "fsdp": 1, "tensor": 1, "expert": 1, "pipe": 1, "seq": 1}}``
+    ``-1`` absorbs remaining devices. Replaces the reference's NCCL process-group
+    construction (``deepspeed/utils/groups.py``, ``pipe/topology.py``).
+    """
+
+    AXES = ("data", "fsdp", "tensor", "expert", "pipe", "seq")
+
+    def __init__(self, param_dict):
+        mesh_dict = get_dict_param(param_dict, C.MESH, {})
+        axes = get_dict_param(mesh_dict, "axes", {})
+        self.axes = {name: axes.get(name, -1 if name == "data" else 1) for name in self.AXES}
+        unknown = set(axes) - set(self.AXES)
+        if unknown:
+            raise DeepSpeedConfigError(f"Unknown mesh axes {unknown}; valid: {self.AXES}")
+
+
+class DeepSpeedSequenceParallelConfig:
+    """TPU-native extension (reference vintage has no SP — SURVEY.md §2.2)."""
+
+    def __init__(self, param_dict):
+        sp_dict = get_dict_param(param_dict, C.SEQUENCE_PARALLEL, {})
+        self.enabled = get_scalar_param(sp_dict, "enabled", False)
+        self.mode = get_scalar_param(sp_dict, "mode", "ring")  # "ring" | "ulysses"
+        if self.mode not in ("ring", "ulysses"):
+            raise DeepSpeedConfigError(f"sequence_parallel.mode must be ring|ulysses")
+
+
+class DeepSpeedConfig:
+    """Parse + validate the full JSON config document.
+
+    Parity: reference ``runtime/config.py:791``. ``world_size`` here is the
+    data-parallel extent (data×fsdp mesh axes product).
+    """
+
+    def __init__(self, config, world_size=None, mesh=None):
+        self._param_dict = load_config_dict(config)
+
+        if world_size is None:
+            if mesh is not None:
+                import numpy as _np
+                world_size = int(_np.prod([mesh.shape.get("data", 1),
+                                           mesh.shape.get("fsdp", 1)]))
+            else:
+                world_size = 1
+        self.world_size = world_size
+
+        # Elasticity may overwrite batch keys pre-parse (reference config.py:815-830)
+        self.elasticity_enabled = False
+        if C.ELASTICITY in self._param_dict and \
+                self._param_dict[C.ELASTICITY].get("enabled", False):
+            self._apply_elasticity()
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # -- elasticity hook ---------------------------------------------------
+    def _apply_elasticity(self):
+        from ..elasticity import compute_elastic_config
+        from ..elasticity.constants import ELASTICITY
+        final_batch_size, valid_gpus, micro_batch_size = compute_elastic_config(
+            ds_config=self._param_dict,
+            target_deepspeed_version="any",
+            world_size=self.world_size)
+        self.elasticity_enabled = True
+        ignore = self._param_dict[ELASTICITY].get("ignore_non_elastic_batch_info", False)
+        if not ignore:
+            for key in (C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                        C.GRADIENT_ACCUMULATION_STEPS):
+                if key in self._param_dict:
+                    raise DeepSpeedConfigError(
+                        f"Elasticity is enabled, but {key} is also set; set "
+                        f"elasticity.ignore_non_elastic_batch_info to override.")
+        self._param_dict[C.TRAIN_BATCH_SIZE] = final_batch_size
+        if micro_batch_size is not None:
+            self._param_dict[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+            self._param_dict[C.GRADIENT_ACCUMULATION_STEPS] = \
+                final_batch_size // (micro_batch_size * self.world_size)
+
+    # -- param init --------------------------------------------------------
+    def _initialize_params(self, pd):
+        self.train_batch_size = get_scalar_param(pd, C.TRAIN_BATCH_SIZE,
+                                                 C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            pd, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_scalar_param(
+            pd, C.GRADIENT_ACCUMULATION_STEPS, C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = get_scalar_param(pd, C.STEPS_PER_PRINT,
+                                                C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(pd, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.disable_allgather = get_scalar_param(pd, C.DISABLE_ALLGATHER,
+                                                  C.DISABLE_ALLGATHER_DEFAULT)
+        self.communication_data_type = get_scalar_param(pd, C.COMMUNICATION_DATA_TYPE,
+                                                        C.COMMUNICATION_DATA_TYPE_DEFAULT)
+        self.prescale_gradients = get_scalar_param(pd, C.PRESCALE_GRADIENTS,
+                                                   C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(pd, C.SPARSE_GRADIENTS,
+                                                         C.SPARSE_GRADIENTS_DEFAULT)
+        self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING,
+                                                  C.GRADIENT_CLIPPING_DEFAULT)
+
+        optimizer_dict = get_dict_param(pd, C.OPTIMIZER, None)
+        self.optimizer_name = None
+        self.optimizer_params = None
+        self.optimizer_legacy_fusion = C.LEGACY_FUSION_DEFAULT
+        if optimizer_dict is not None:
+            self.optimizer_name = get_scalar_param(optimizer_dict, C.TYPE, None)
+            if self.optimizer_name is not None:
+                self.optimizer_name = self.optimizer_name.lower()
+            self.optimizer_params = get_dict_param(optimizer_dict, C.OPTIMIZER_PARAMS, {})
+            self.optimizer_legacy_fusion = get_scalar_param(optimizer_dict, C.LEGACY_FUSION,
+                                                            C.LEGACY_FUSION_DEFAULT)
+
+        scheduler_dict = get_dict_param(pd, C.SCHEDULER, None)
+        self.scheduler_name = None
+        self.scheduler_params = None
+        if scheduler_dict is not None:
+            self.scheduler_name = get_scalar_param(scheduler_dict, C.TYPE, None)
+            self.scheduler_params = get_dict_param(scheduler_dict, C.SCHEDULER_PARAMS, {})
+
+        self.zero_config = DeepSpeedZeroConfig(pd)
+        self.fp16 = DeepSpeedFP16Config(pd)
+        self.bf16 = DeepSpeedBF16Config(pd)
+        amp_dict = get_dict_param(pd, C.AMP, {})
+        self.amp_enabled = get_scalar_param(amp_dict, C.AMP_ENABLED, C.AMP_ENABLED_DEFAULT)
+        self.amp_params = {k: v for k, v in amp_dict.items() if k != C.AMP_ENABLED}
+        self.activation_checkpointing = DeepSpeedActivationCheckpointingConfig(pd)
+        self.flops_profiler = DeepSpeedFlopsProfilerConfig(pd)
+        self.tensorboard = DeepSpeedTensorboardConfig(pd)
+        self.pipeline = DeepSpeedPipelineConfig(pd)
+        self.curriculum = DeepSpeedCurriculumConfig(pd)
+        self.pld = DeepSpeedPLDConfig(pd)
+        self.eigenvalue = DeepSpeedEigenvalueConfig(pd)
+        self.quantize_training = DeepSpeedQuantizeTrainingConfig(pd)
+        self.checkpoint_config = DeepSpeedCheckpointConfig(pd)
+        self.mesh_config = DeepSpeedMeshConfig(pd)
+        self.sequence_parallel = DeepSpeedSequenceParallelConfig(pd)
+        self.wall_clock_breakdown = get_scalar_param(pd, C.WALL_CLOCK_BREAKDOWN,
+                                                     C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(pd, C.MEMORY_BREAKDOWN,
+                                                 C.MEMORY_BREAKDOWN_DEFAULT)
+        self.dataloader_drop_last = get_scalar_param(pd, C.DATALOADER_DROP_LAST,
+                                                     C.DATALOADER_DROP_LAST_DEFAULT)
+        self.sparse_attention = get_dict_param(pd, C.SPARSE_ATTENTION, None)
+        self.aio_config = dict(C.AIO_DEFAULT_DICT)
+        self.aio_config.update(get_dict_param(pd, C.AIO, {}))
+        self.autotuning_config = get_dict_param(pd, C.AUTOTUNING, {})
+
+    # -- batch arithmetic --------------------------------------------------
+    def _configure_train_batch_size(self):
+        """Solve for the missing one of (train_batch, micro_batch, gas).
+
+        Parity: reference ``config.py:1049 _configure_train_batch_size`` and
+        ``:980 _batch_assertion``.
+        """
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        ws = self.world_size
+
+        if train_batch is not None and micro_batch is not None and gas is not None:
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            gas = train_batch // micro_batch
+            gas //= ws
+        elif train_batch is not None and gas is not None:
+            micro_batch = train_batch // ws
+            micro_batch //= gas
+        elif micro_batch is not None and gas is not None:
+            train_batch = micro_batch * gas * ws
+        elif train_batch is not None:
+            gas = 1
+            micro_batch = train_batch // ws
+        elif micro_batch is not None:
+            train_batch = micro_batch * ws
+            gas = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided")
+
+        self.train_batch_size = train_batch
+        self.train_micro_batch_size_per_gpu = micro_batch
+        self.gradient_accumulation_steps = gas
+
+        self._batch_assertion()
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert gas > 0, f"Gradient accumulation steps: {gas} has to be greater than 0"
+        assert train_batch == micro_batch * gas * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal to "
+            f"micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {gas} * {self.world_size}")
+
+    def _do_sanity_check(self):
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.optimizer_name is not None and \
+                self.optimizer_name not in C.DEEPSPEED_OPTIMIZERS:
+            # torch-style names fall through to optax equivalents; only warn.
+            logger.warning(f"Optimizer '{self.optimizer_name}' is not a DeepSpeed-native "
+                           f"optimizer; resolving via the generic optax registry.")
+        if self.zero_config.stage > 0 and self.amp_enabled:
+            raise DeepSpeedConfigError("amp and ZeRO are not compatible (reference parity)")
+
+    def print(self, name="DeepSpeedConfig"):
+        import json
+        from .config_utils import ScientificNotationEncoder
+        logger.info(f"{name}:")
+        logger.info(json.dumps(self._param_dict, cls=ScientificNotationEncoder, indent=4))
+
+    @property
+    def zero_enabled(self):
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self):
+        return self.zero_config.stage
+
+    @property
+    def precision_dtype(self):
+        """Compute dtype implied by the config ('bfloat16'|'float16'|'float32')."""
+        if self.bf16.enabled:
+            return "bfloat16"
+        if self.fp16.enabled:
+            return "float16"
+        return "float32"

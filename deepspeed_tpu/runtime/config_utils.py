@@ -1,0 +1,78 @@
+"""Config parsing helpers.
+
+Parity: reference ``deepspeed/runtime/config_utils.py`` (``get_scalar_param``,
+``dict_raise_error_on_duplicate_keys``).
+"""
+
+import json
+from collections import Counter
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys while JSON parsing (reference behavior)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = Counter([pair[0] for pair in ordered_pairs])
+        keys = [key for key, value in counter.items() if value > 1]
+        raise ValueError("Duplicate keys in DeepSpeed config: {}".format(keys))
+    return d
+
+
+def load_config_dict(config):
+    """Accept a path to a JSON file or an already-parsed dict."""
+    if isinstance(config, dict):
+        return config
+    if isinstance(config, str):
+        with open(config, "r") as f:
+            return json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+    raise ValueError(f"Expected a dict or path to a JSON file, got: {type(config)}")
+
+
+class ScientificNotationEncoder(json.JSONEncoder):
+    """Print large numbers in scientific notation (reference config printing)."""
+
+    def iterencode(self, o, _one_shot=False, level=0):
+        indent = self.indent if self.indent is not None else 4
+        prefix_close = " " * level * indent
+        level += 1
+        prefix = " " * level * indent
+        if isinstance(o, bool):
+            yield str(o).lower()
+        elif isinstance(o, float) or isinstance(o, int):
+            if o > 1e3:
+                yield f"{o:e}"
+            else:
+                yield f"{o}"
+        elif isinstance(o, dict):
+            yield "{"
+            first = True
+            for k, v in o.items():
+                if not first:
+                    yield ", "
+                yield f"\n{prefix}\"{k}\": "
+                yield from self.iterencode(v, level=level)
+                first = False
+            yield f"\n{prefix_close}}}"
+        elif isinstance(o, list) or isinstance(o, tuple):
+            yield "["
+            first = True
+            for v in o:
+                if not first:
+                    yield ", "
+                yield from self.iterencode(v, level=level)
+                first = False
+            yield "]"
+        else:
+            yield from super().iterencode(o, _one_shot=_one_shot)

@@ -1,0 +1,174 @@
+"""ZeRO configuration.
+
+Parity: reference ``deepspeed/runtime/zero/config.py:14`` (``DeepSpeedZeroConfig``)
+and ``zero/offload_config.py``.  Same JSON keys; TPU semantics documented per field.
+
+On TPU, ZeRO stages map to sharding placement over the ``fsdp`` mesh axis
+(SURVEY.md §7): stage 1 shards optimizer state, stage 2 additionally
+reduce-scatters gradients, stage 3 additionally shards parameters.  Bucket-size
+knobs are accepted for config compatibility; XLA's SPMD partitioner performs
+its own collective scheduling, so they inform (but do not dictate) chunking.
+"""
+
+from ..config_utils import get_scalar_param, get_dict_param
+
+ZERO_FORMAT = """
+ZeRO optimization should be enabled as:
+"zero_optimization": {
+  "stage": [0|1|2|3],
+  "overlap_comm": [true|false],
+  "reduce_scatter": [true|false],
+  "reduce_bucket_size": 500000000,
+  "allgather_bucket_size": 500000000,
+  "offload_param": {...},
+  "offload_optimizer": {...},
+  ...
+}
+"""
+
+ZERO_OPTIMIZATION = "zero_optimization"
+
+ZERO_OPTIMIZATION_DISABLED = 0
+ZERO_OPTIMIZATION_OPTIMIZER_STATES = 1
+ZERO_OPTIMIZATION_GRADIENTS = 2
+ZERO_OPTIMIZATION_WEIGHTS = 3
+MAX_STAGE_ZERO_OPTIMIZATION = ZERO_OPTIMIZATION_WEIGHTS
+
+# Offload devices
+OFFLOAD_DEVICE_NONE = "none"
+OFFLOAD_DEVICE_CPU = "cpu"
+OFFLOAD_DEVICE_NVME = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig:
+    """``zero_optimization.offload_param`` — reference ``zero/offload_config.py``."""
+
+    def __init__(self, param_dict=None):
+        param_dict = param_dict or {}
+        self.device = get_scalar_param(param_dict, "device", OFFLOAD_DEVICE_NONE)
+        self.nvme_path = get_scalar_param(param_dict, "nvme_path", None)
+        self.buffer_count = get_scalar_param(param_dict, "buffer_count", 5)
+        self.buffer_size = int(get_scalar_param(param_dict, "buffer_size", 1e8))
+        self.max_in_cpu = int(get_scalar_param(param_dict, "max_in_cpu", 1e9))
+        self.pin_memory = get_scalar_param(param_dict, "pin_memory", False)
+
+    def repr_dict(self):
+        return dict(device=self.device, nvme_path=self.nvme_path,
+                    buffer_count=self.buffer_count, buffer_size=self.buffer_size,
+                    max_in_cpu=self.max_in_cpu, pin_memory=self.pin_memory)
+
+
+class DeepSpeedZeroOffloadOptimizerConfig:
+    """``zero_optimization.offload_optimizer`` — reference ``zero/offload_config.py``."""
+
+    def __init__(self, param_dict=None):
+        param_dict = param_dict or {}
+        self.device = get_scalar_param(param_dict, "device", OFFLOAD_DEVICE_NONE)
+        self.nvme_path = get_scalar_param(param_dict, "nvme_path", None)
+        self.buffer_count = get_scalar_param(param_dict, "buffer_count", 4)
+        self.pin_memory = get_scalar_param(param_dict, "pin_memory", False)
+        self.pipeline_read = get_scalar_param(param_dict, "pipeline_read", False)
+        self.pipeline_write = get_scalar_param(param_dict, "pipeline_write", False)
+        self.fast_init = get_scalar_param(param_dict, "fast_init", False)
+
+    @property
+    def pipeline(self):
+        return self.pipeline_read or self.pipeline_write
+
+    def repr_dict(self):
+        return dict(device=self.device, nvme_path=self.nvme_path,
+                    buffer_count=self.buffer_count, pin_memory=self.pin_memory,
+                    pipeline_read=self.pipeline_read, pipeline_write=self.pipeline_write,
+                    fast_init=self.fast_init)
+
+
+class DeepSpeedZeroConfig:
+    """Parsed ``zero_optimization`` section.
+
+    Field inventory mirrors reference ``zero/config.py:18-42``.
+    """
+
+    def __init__(self, param_dict=None):
+        if param_dict is None:
+            param_dict = {}
+        zero_dict = param_dict.get(ZERO_OPTIMIZATION, {})
+        if isinstance(zero_dict, bool):
+            # legacy: "zero_optimization": true meant stage 1
+            zero_dict = {"stage": 1 if zero_dict else 0}
+
+        self.stage = get_scalar_param(zero_dict, "stage", 0)
+        if self.stage not in (0, 1, 2, 3):
+            raise ValueError(f"Invalid ZeRO stage {self.stage}. {ZERO_FORMAT}")
+        self.contiguous_gradients = get_scalar_param(zero_dict, "contiguous_gradients", True)
+        self.reduce_scatter = get_scalar_param(zero_dict, "reduce_scatter", True)
+        self.reduce_bucket_size = int(get_scalar_param(zero_dict, "reduce_bucket_size", 5e8))
+        self.allgather_partitions = get_scalar_param(zero_dict, "allgather_partitions", True)
+        self.allgather_bucket_size = int(get_scalar_param(zero_dict, "allgather_bucket_size", 5e8))
+        self.overlap_comm = get_scalar_param(
+            zero_dict, "overlap_comm", True if self.stage == 3 else False)
+        self.load_from_fp32_weights = get_scalar_param(zero_dict, "load_from_fp32_weights", True)
+        self.elastic_checkpoint = get_scalar_param(zero_dict, "elastic_checkpoint", False)
+        self.cpu_offload = get_scalar_param(zero_dict, "cpu_offload", False)
+        self.cpu_offload_params = get_scalar_param(zero_dict, "cpu_offload_params", False)
+
+        offload_param_dict = get_dict_param(zero_dict, "offload_param", None)
+        self.offload_param = (DeepSpeedZeroOffloadParamConfig(offload_param_dict)
+                              if offload_param_dict is not None else None)
+        offload_opt_dict = get_dict_param(zero_dict, "offload_optimizer", None)
+        if offload_opt_dict is None and self.cpu_offload:
+            offload_opt_dict = {"device": OFFLOAD_DEVICE_CPU}
+        self.offload_optimizer = (DeepSpeedZeroOffloadOptimizerConfig(offload_opt_dict)
+                                  if offload_opt_dict is not None else None)
+
+        self.sub_group_size = int(get_scalar_param(zero_dict, "sub_group_size", 1e9))
+        self.prefetch_bucket_size = int(get_scalar_param(
+            zero_dict, "stage3_prefetch_bucket_size",
+            get_scalar_param(zero_dict, "prefetch_bucket_size", 5e7)))
+        self.param_persistence_threshold = int(get_scalar_param(
+            zero_dict, "stage3_param_persistence_threshold",
+            get_scalar_param(zero_dict, "param_persistence_threshold", 1e5)))
+        self.max_live_parameters = int(get_scalar_param(
+            zero_dict, "stage3_max_live_parameters",
+            get_scalar_param(zero_dict, "max_live_parameters", 1e9)))
+        self.max_reuse_distance = int(get_scalar_param(
+            zero_dict, "stage3_max_reuse_distance",
+            get_scalar_param(zero_dict, "max_reuse_distance", 1e9)))
+        self.gather_16bit_weights_on_model_save = get_scalar_param(
+            zero_dict, "stage3_gather_16bit_weights_on_model_save",
+            get_scalar_param(zero_dict, "gather_16bit_weights_on_model_save", False))
+        self.ignore_unused_parameters = get_scalar_param(
+            zero_dict, "ignore_unused_parameters", True)
+        self.round_robin_gradients = get_scalar_param(zero_dict, "round_robin_gradients", False)
+        self.legacy_stage1 = get_scalar_param(zero_dict, "legacy_stage1", False)
+
+    def offload_optimizer_device(self):
+        return self.offload_optimizer.device if self.offload_optimizer else OFFLOAD_DEVICE_NONE
+
+    def offload_param_device(self):
+        return self.offload_param.device if self.offload_param else OFFLOAD_DEVICE_NONE
+
+    def repr_dict(self):
+        d = dict(stage=self.stage,
+                 contiguous_gradients=self.contiguous_gradients,
+                 reduce_scatter=self.reduce_scatter,
+                 reduce_bucket_size=self.reduce_bucket_size,
+                 allgather_partitions=self.allgather_partitions,
+                 allgather_bucket_size=self.allgather_bucket_size,
+                 overlap_comm=self.overlap_comm,
+                 load_from_fp32_weights=self.load_from_fp32_weights,
+                 elastic_checkpoint=self.elastic_checkpoint,
+                 sub_group_size=self.sub_group_size,
+                 prefetch_bucket_size=self.prefetch_bucket_size,
+                 param_persistence_threshold=self.param_persistence_threshold,
+                 max_live_parameters=self.max_live_parameters,
+                 max_reuse_distance=self.max_reuse_distance,
+                 gather_16bit_weights_on_model_save=self.gather_16bit_weights_on_model_save,
+                 ignore_unused_parameters=self.ignore_unused_parameters,
+                 round_robin_gradients=self.round_robin_gradients)
+        d["offload_param"] = self.offload_param.repr_dict() if self.offload_param else None
+        d["offload_optimizer"] = (self.offload_optimizer.repr_dict()
+                                  if self.offload_optimizer else None)
+        return d
+
+    def __repr__(self):
+        return f"DeepSpeedZeroConfig({self.repr_dict()})"

@@ -1,0 +1,176 @@
+"""Wall-clock + throughput timers.
+
+Parity: reference ``deepspeed/utils/timer.py:34`` (``SynchronizedWallClockTimer``)
+and ``:134`` (``ThroughputTimer``).  On TPU there are no CUDA events; accurate
+device timing means blocking on output buffers (``jax.block_until_ready``)
+before reading the host clock — real per-op breakdowns come from
+``jax.profiler`` traces instead (see ``deepspeed_tpu/profiling``).
+"""
+
+import time
+
+from .logging import logger
+
+
+class SynchronizedWallClockTimer:
+    """Named timer registry, device-synchronized at stop when requested."""
+
+    class Timer:
+        def __init__(self, name):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = time.time()
+            self.records = []
+
+        def start(self):
+            assert not self.started_, f"{self.name_} timer has already been started"
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset=False, record=False, sync_obj=None):
+            assert self.started_, f"{self.name_} timer is not started"
+            if sync_obj is not None:
+                import jax
+                jax.block_until_ready(sync_obj)
+            elapsed = time.time() - self.start_time
+            if reset:
+                self.elapsed_ = elapsed
+            else:
+                self.elapsed_ += elapsed
+            if record:
+                self.records.append(self.elapsed_)
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def elapsed(self, reset=True):
+            started = self.started_
+            if started:
+                self.stop()
+            elapsed = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return elapsed
+
+        def mean(self):
+            if not self.records:
+                return 0.0
+            return sum(self.records) / len(self.records)
+
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name):
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage():
+        """Device-memory summary (replaces torch.cuda allocator stats in
+        ``utils/timer.py memory_usage``)."""
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0)
+            peak = stats.get("peak_bytes_in_use", 0)
+            return f"mem in use {in_use / 2**30:.2f} GB | peak {peak / 2**30:.2f} GB"
+        except Exception:
+            return "mem stats unavailable"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        from .logging import log_dist
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += " | {}: {:.2f}".format(name, elapsed_time)
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].mean() * 1000.0 / normalizer
+                means[name] = elapsed_time
+        return means
+
+
+class ThroughputTimer:
+    """Samples/sec tracking. Parity: reference ``utils/timer.py:134``."""
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50,
+                 monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or logger.info
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True, sync_obj=None):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            if sync_obj is not None:
+                import jax
+                jax.block_until_ready(sync_obj)
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step:
+                if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    self.logging(
+                        "epoch={}/micro_step={}/global_step={}, RunningAvgSamplesPerSec={}, "
+                        "CurrSamplesPerSec={}".format(
+                            self.epoch_count, self.micro_step_count, self.global_step_count,
+                            self.avg_samples_per_sec(),
+                            self.batch_size / self.step_elapsed_time))
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step:
+            samples_per_step = self.batch_size
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / total_step_offset
+            return samples_per_step / avg_time_per_step
+        return float("-inf")

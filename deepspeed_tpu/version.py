@@ -1,0 +1,7 @@
+"""Version of the deepspeed_tpu framework.
+
+Capability parity target: DeepSpeed 0.6.6 (see /root/reference/version.txt:1),
+re-designed TPU-native on JAX/XLA/Pallas.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,60 @@
+"""Test harness: in-process multi-device virtual mesh.
+
+TPU-native analogue of the reference's ``@distributed_test`` fork-N-processes
+fixture (``tests/unit/common.py:66``): instead of forking torch.multiprocessing
+workers with TCP rendezvous, one process sees 8 virtual CPU devices
+(``--xla_force_host_platform_device_count=8``) and multi-"host" behavior is
+exercised through ``jax.sharding.Mesh`` over them (SURVEY.md §4 lesson).
+
+Must set env BEFORE jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU: the session env may pin JAX_PLATFORMS to a real accelerator
+# (e.g. 'axon' single-chip TPU) which can't model an 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# The env var alone is not enough under the axon site hook; force via config.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def mesh8(devices):
+    """8-way data-parallel mesh."""
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    return make_mesh({"data": 8})
+
+
+@pytest.fixture
+def mesh_fsdp8(devices):
+    """8-way fsdp (ZeRO) mesh."""
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    return make_mesh({"data": 1, "fsdp": 8})
+
+
+@pytest.fixture
+def mesh_2x4(devices):
+    """data=2 × fsdp=4 hybrid mesh."""
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    return make_mesh({"data": 2, "fsdp": 4})
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

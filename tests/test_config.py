@@ -1,0 +1,203 @@
+"""Config parsing tests.
+
+Parity model: reference ``tests/unit/test_config.py`` + ``test_ds_arguments.py``
+(batch arithmetic, zero config, fp16/bf16 exclusivity, duplicate keys).
+"""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.config_utils import load_config_dict
+
+
+def test_batch_all_three_given():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+         "gradient_accumulation_steps": 2}, world_size=4)
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_infer_gas():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4}, world_size=4)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_infer_micro():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "gradient_accumulation_steps": 2}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_infer_train():
+    cfg = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2}, world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_only_train():
+    cfg = DeepSpeedConfig({"train_batch_size": 32}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_mismatch_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(
+            {"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4,
+             "gradient_accumulation_steps": 2}, world_size=4)
+
+
+def test_batch_none_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=4)
+
+
+def test_zero_config_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, world_size=1)
+    assert cfg.zero_optimization_stage == 0
+    assert not cfg.zero_enabled
+    z = cfg.zero_config
+    assert z.reduce_scatter is True
+    assert z.reduce_bucket_size == int(5e8)
+    assert z.overlap_comm is False  # stage<3 default
+
+
+def test_zero_stage3_overlap_default():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "zero_optimization": {"stage": 3}}, world_size=1)
+    assert cfg.zero_config.overlap_comm is True
+    assert cfg.zero_enabled
+
+
+def test_zero_offload_configs():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "cpu", "pin_memory": True},
+            "offload_optimizer": {"device": "nvme", "nvme_path": "/tmp/nvme"},
+        }}, world_size=1)
+    assert cfg.zero_config.offload_param_device() == "cpu"
+    assert cfg.zero_config.offload_param.pin_memory
+    assert cfg.zero_config.offload_optimizer_device() == "nvme"
+    assert cfg.zero_config.offload_optimizer.nvme_path == "/tmp/nvme"
+
+
+def test_zero_legacy_cpu_offload_flag():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "zero_optimization": {"stage": 2, "cpu_offload": True}},
+        world_size=1)
+    assert cfg.zero_config.offload_optimizer_device() == "cpu"
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(
+            {"train_batch_size": 8, "zero_optimization": {"stage": 5}}, world_size=1)
+
+
+def test_fp16_defaults_and_dynamic_scale():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "fp16": {"enabled": True}}, world_size=1)
+    assert cfg.fp16.enabled
+    assert cfg.fp16.dynamic_loss_scale  # loss_scale == 0 → dynamic
+    assert cfg.fp16.initial_scale_power == 16
+    assert cfg.fp16.loss_scale_window == 1000
+    assert cfg.fp16.hysteresis == 2
+    assert cfg.precision_dtype == "float16"
+
+
+def test_fp16_static_scale():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "fp16": {"enabled": True, "loss_scale": 128}}, world_size=1)
+    assert not cfg.fp16.dynamic_loss_scale
+    assert cfg.fp16.loss_scale == 128
+
+
+def test_bf16_both_spellings():
+    for key in ("bf16", "bfloat16"):
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 8, key: {"enabled": True}}, world_size=1)
+        assert cfg.bf16.enabled
+        assert cfg.precision_dtype == "bfloat16"
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=1)
+
+
+def test_optimizer_scheduler_sections():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3, "betas": [0.9, 0.999]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    }, world_size=1)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 1e-3
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params["warmup_num_steps"] == 10
+
+
+def test_duplicate_json_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        load_config_dict(str(p))
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 16, "zero_optimization": {"stage": 2}}))
+    cfg = DeepSpeedConfig(str(p), world_size=2)
+    assert cfg.train_batch_size == 16
+    assert cfg.zero_optimization_stage == 2
+
+
+def test_mesh_config_extension():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "mesh": {"axes": {"data": 2, "fsdp": 4}},
+    }, world_size=8)
+    assert cfg.mesh_config.axes["data"] == 2
+    assert cfg.mesh_config.axes["fsdp"] == 4
+    assert cfg.mesh_config.axes["tensor"] == 1
+
+
+def test_mesh_config_unknown_axis():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "mesh": {"axes": {"bogus": 2}}},
+                        world_size=1)
+
+
+def test_checkpoint_tag_validation_modes():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "checkpoint": {"tag_validation": "Fail"}}, world_size=1)
+    assert cfg.checkpoint_config.tag_validation == "Fail"
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "checkpoint": {"tag_validation": "bogus"}}, world_size=1)
+
+
+def test_gradient_clipping_and_misc():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8, "gradient_clipping": 1.0, "steps_per_print": 5,
+        "prescale_gradients": True, "wall_clock_breakdown": True,
+    }, world_size=1)
+    assert cfg.gradient_clipping == 1.0
+    assert cfg.steps_per_print == 5
+    assert cfg.prescale_gradients
+    assert cfg.wall_clock_breakdown
+
+
+def test_aio_defaults_merge():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "aio": {"queue_depth": 16}}, world_size=1)
+    assert cfg.aio_config["queue_depth"] == 16
+    assert cfg.aio_config["block_size"] == 1048576

@@ -1,0 +1,116 @@
+"""Elasticity math tests. Parity model: reference ``tests/unit/test_elastic.py``
+(pure-math config tests, no accelerator)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (compute_elastic_config, _get_compatible_gpus_v01,
+                                      ElasticityConfigError, ElasticityError,
+                                      ElasticityIncompatibleWorldSize)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    final_batch_size, valid_gpus, _ = compute_elastic_config(
+        ds_config=BASE, target_deepspeed_version="any")
+    assert final_batch_size <= 10000
+    assert len(valid_gpus) > 0
+    # every valid gpu count must actually divide cleanly for some micro batch
+    for w in valid_gpus:
+        assert 32 <= w <= 1500
+        assert any(final_batch_size % (mb * w) == 0
+                   for mb in BASE["elasticity"]["micro_batch_sizes"])
+
+
+def test_with_world_size():
+    _, valid, _ = compute_elastic_config(ds_config=BASE, target_deepspeed_version="any")
+    ws = valid[len(valid) // 2]
+    final_batch_size, valid_gpus, micro = compute_elastic_config(
+        ds_config=BASE, target_deepspeed_version="any", world_size=ws)
+    assert ws in valid_gpus
+    assert micro in BASE["elasticity"]["micro_batch_sizes"]
+    assert final_batch_size // ws % micro == 0
+
+
+def test_incompatible_world_size():
+    cfg = {k: dict(v) for k, v in BASE.items()}
+    cfg["elasticity"]["micro_batch_sizes"] = [8, 16]
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config=cfg, target_deepspeed_version="any",
+                               world_size=1501)
+
+
+def test_missing_section_raises():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config={"train_batch_size": 4},
+                               target_deepspeed_version="any")
+
+
+def test_invalid_micro_batches():
+    for bad in ([0, 8], [-1], ["x"], 8):
+        cfg = {"elasticity": dict(BASE["elasticity"])}
+        cfg["elasticity"]["micro_batch_sizes"] = bad
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(ds_config=cfg, target_deepspeed_version="any")
+
+
+def test_future_version_rejected():
+    cfg = {"elasticity": dict(BASE["elasticity"])}
+    cfg["elasticity"]["version"] = 0.2
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config=cfg, target_deepspeed_version="any")
+
+
+def test_prefer_larger():
+    big, gpus_big = _get_compatible_gpus_v01(
+        micro_batches=[2, 4], max_acceptable_batch_size=120, prefer_larger=True)
+    small, gpus_small = _get_compatible_gpus_v01(
+        micro_batches=[2, 4], max_acceptable_batch_size=120, prefer_larger=False)
+    assert len(gpus_big) == len(gpus_small)
+    assert big >= small
+
+
+def test_config_hookup():
+    """elasticity overwrites train batch keys pre-parse (reference config.py:815-830)."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    ds_config = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 100,
+            "version": 0.1,
+        }
+    }
+    cfg = DeepSpeedConfig(dict(ds_config), world_size=4)
+    assert cfg.elasticity_enabled
+    assert cfg.train_batch_size == \
+        cfg.train_micro_batch_size_per_gpu * cfg.gradient_accumulation_steps * 4
+
+
+def test_config_hookup_conflict_raises():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+    ds_config = {
+        "train_batch_size": 16,
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 100,
+            "version": 0.1,
+        }
+    }
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(ds_config, world_size=4)
