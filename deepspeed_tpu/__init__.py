@@ -1,0 +1,109 @@
+"""deepspeed_tpu — TPU-native training framework with DeepSpeed's capabilities.
+
+API facade parity: reference ``deepspeed/__init__.py`` —
+``initialize`` (:51), ``init_inference`` (:221), ``add_config_arguments``
+(:205), ``init_distributed``.  Built from scratch on JAX/XLA/Pallas; the
+compute path is jitted SPMD over a named device mesh, not a port of the
+reference's torch/CUDA machinery.
+"""
+
+from .version import __version__
+from .runtime.engine import DeepSpeedEngine
+from .runtime.config import DeepSpeedConfig
+from .runtime.lr_schedules import get_lr_scheduler
+from .utils.logging import logger, log_dist
+
+
+def initialize(args=None, model=None, optimizer=None, model_parameters=None,
+               training_data=None, lr_scheduler=None, mpu=None,
+               dist_init_required=None, collate_fn=None, config=None,
+               config_params=None, mesh=None, loss_fn=None, params=None,
+               apply_fn=None, rng_seed=0):
+    """Initialize the engine. Returns ``(engine, optimizer, dataloader, lr_scheduler)``.
+
+    Parity: reference ``deepspeed/__init__.py:51-151``.  ``args.deepspeed_config``
+    is honored when ``config`` is not given.  If the model is a
+    ``PipelineModule``, a ``PipelineEngine`` is built instead
+    (reference ``__init__.py:119-143``).
+    """
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None and \
+            getattr(args, "deepspeed_config", None) is not None:
+        config = args.deepspeed_config
+    assert config is not None, \
+        "DeepSpeed requires --deepspeed_config to specify configuration file"
+
+    try:
+        from .runtime.pipe.module import PipelineModule
+        is_pipe = isinstance(model, PipelineModule)
+    except ImportError:
+        is_pipe = False
+    if is_pipe:
+        from .runtime.pipe.engine import PipelineEngine
+        engine = PipelineEngine(model=model, optimizer=optimizer, config=config,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler, mesh=mesh,
+                                collate_fn=collate_fn, rng_seed=rng_seed)
+    else:
+        engine = DeepSpeedEngine(model=model, optimizer=optimizer, config=config,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler, mesh=mesh,
+                                 collate_fn=collate_fn, loss_fn=loss_fn,
+                                 params=params, apply_fn=apply_fn,
+                                 rng_seed=rng_seed, mpu=mpu,
+                                 dist_init_required=dist_init_required)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_distributed(dist_backend=None, auto_mpi_discovery=True,
+                     distributed_port=29500, verbose=True, timeout=None,
+                     init_method=None):
+    """Multi-host runtime init.
+
+    Parity: reference ``deepspeed/utils/distributed.py:12``.  On TPU pods this
+    is ``jax.distributed.initialize()`` (one process per host); single-host it
+    is a no-op.  NCCL/MPI rendezvous is replaced by the TPU runtime's own
+    coordination service.
+    """
+    import os
+    import jax
+    # JAX auto-discovers the coordinator on TPU pods (metadata service), SLURM,
+    # and Open MPI; call initialize() whenever any multi-host signal is present.
+    multi_host_signals = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                          "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+                          "TPU_WORKER_ID", "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE")
+    if any(os.environ.get(k) for k in multi_host_signals):
+        try:
+            jax.distributed.initialize()
+            log_dist(f"jax.distributed initialized: process "
+                     f"{jax.process_index()}/{jax.process_count()}", ranks=[0])
+        except Exception as e:  # already initialized or effectively single-host
+            logger.debug(f"jax.distributed.initialize skipped: {e}")
+    return None
+
+
+def add_config_arguments(parser):
+    """Add ``--deepspeed``/``--deepspeed_config`` args.
+
+    Parity: reference ``deepspeed/__init__.py:205``.
+    """
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag to indicate use)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed JSON config file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated alias of --deepspeed_config")
+    group.add_argument("--local_rank", type=int, default=-1,
+                       help="Accepted for launcher compatibility; unused on TPU "
+                            "(one process drives all local chips)")
+    return parser
+
+
+def init_inference(model=None, **kwargs):
+    """Build an InferenceEngine. Parity: reference ``deepspeed/__init__.py:221``."""
+    from .inference.engine import InferenceEngine
+    return InferenceEngine(model, **kwargs)

@@ -1,0 +1,50 @@
+"""Adagrad optimizer.
+
+Parity: reference ``deepspeed/ops/adagrad/cpu_adagrad.py`` (DeepSpeedCPUAdagrad
+bound to the AVX kernel ``csrc/adagrad/cpu_adagrad.cpp:219-226``).  The update
+math is identical; "CPU" in the reference name refers to the offload execution
+tier — here the same class runs on-device by default and participates in the
+host-offload tier via the engine's offload configs (see
+``runtime/swap_tensor``).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AdagradState(NamedTuple):
+    sum_sq: dict
+
+
+class DeepSpeedCPUAdagrad:
+    name = "adagrad"
+
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return AdagradState(sum_sq=jax.tree_util.tree_map(zeros, params))
+
+    def update(self, grads, state, params, *, step, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def upd(p, g, s):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay != 0.0:
+                g = g + self.weight_decay * p32
+            s_new = s + jnp.square(g)
+            p_new = p32 - lr * g / (jnp.sqrt(s_new) + self.eps)
+            return p_new.astype(p.dtype), s_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(state.sum_sq)
+        outs = [upd(p, g, s) for p, g, s in zip(flat_p, flat_g, flat_s)]
+        return (treedef.unflatten([o[0] for o in outs]),
+                AdagradState(sum_sq=treedef.unflatten([o[1] for o in outs])))

@@ -1,0 +1,112 @@
+"""Adam/AdamW as a single fused XLA update over the parameter pytree.
+
+Parity: reference ``deepspeed/ops/adam/fused_adam.py:16`` (``FusedAdam``) and
+the CUDA kernel ``csrc/adam/multi_tensor_adam.cu``.  The reference needs apex-
+style chunked multi-tensor CUDA kernels to fuse the elementwise update across
+hundreds of tensors; under XLA a single jitted update over the whole pytree
+compiles to fused loops — the multi-tensor machinery is unnecessary
+(SURVEY.md §2.4 TPU-equivalent note).
+
+Math matches torch.optim.Adam/AdamW exactly (bias correction, eps OUTSIDE the
+sqrt) so loss curves can be matched against the reference bit-for-bit modulo
+dtype (SURVEY.md §7 "Hard parts": optimizer math must match).
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class AdamState(NamedTuple):
+    exp_avg: dict      # first moment pytree (fp32)
+    exp_avg_sq: dict   # second moment pytree (fp32)
+
+
+def adam_init(params) -> AdamState:
+    zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+    return AdamState(exp_avg=jax.tree_util.tree_map(zeros, params),
+                     exp_avg_sq=jax.tree_util.tree_map(zeros, params))
+
+
+def adam_update(grads, state: AdamState, params, *, step, lr,
+                betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                adam_w_mode=True, bias_correction=True):
+    """One Adam(W) step over the whole pytree.
+
+    ``step`` is the 1-based step count (traced scalar).  Returns
+    ``(new_params, new_state)``; all math in fp32.
+    """
+    b1, b2 = betas
+    step = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - b1 ** step
+        bc2 = 1.0 - b2 ** step
+    else:
+        bc1 = bc2 = 1.0
+
+    def upd(p, g, m, v):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if weight_decay != 0.0 and not adam_w_mode:
+            g = g + weight_decay * p32  # L2-regularization mode
+        m_new = b1 * m + (1.0 - b1) * g
+        v_new = b2 * v + (1.0 - b2) * jnp.square(g)
+        denom = jnp.sqrt(v_new) / jnp.sqrt(bc2) + eps
+        update = (m_new / bc1) / denom
+        if weight_decay != 0.0 and adam_w_mode:
+            update = update + weight_decay * p32  # decoupled (AdamW)
+        p_new = p32 - lr * update
+        return p_new.astype(p.dtype), m_new, v_new
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_m = treedef.flatten_up_to(state.exp_avg)
+    flat_v = treedef.flatten_up_to(state.exp_avg_sq)
+    outs = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    new_p = treedef.unflatten([o[0] for o in outs])
+    new_m = treedef.unflatten([o[1] for o in outs])
+    new_v = treedef.unflatten([o[2] for o in outs])
+    return new_p, AdamState(exp_avg=new_m, exp_avg_sq=new_v)
+
+
+class FusedAdam:
+    """Engine-facing optimizer object (config-driven hyperparams).
+
+    API parity with the reference's optimizer wrappers: hyperparameters mirror
+    ``ops/adam/fused_adam.py:16`` (lr, betas, eps, weight_decay, adam_w_mode,
+    bias_correction, amsgrad rejected as in the reference).
+    """
+
+    name = "adam"
+
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999), eps=1e-8,
+                 adam_w_mode=True, weight_decay=0.0, amsgrad=False):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant "
+                               "(reference parity).")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        return adam_init(params)
+
+    def update(self, grads, state, params, *, step, lr=None):
+        lr = self.lr if lr is None else lr
+        return adam_update(grads, state, params, step=step, lr=lr, betas=self.betas,
+                           eps=self.eps, weight_decay=self.weight_decay,
+                           adam_w_mode=self.adam_w_mode,
+                           bias_correction=self.bias_correction)
+
+
+class FusedAdamW(FusedAdam):
+    name = "adamw"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01,
+                 bias_correction=True, amsgrad=False):
+        super().__init__(lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
+                         adam_w_mode=True, weight_decay=weight_decay, amsgrad=amsgrad)

@@ -1,0 +1,48 @@
+"""SGD (+momentum) — config fallback optimizer.
+
+The reference resolves ``"type": "SGD"`` to torch.optim.SGD (``engine.py:1153``
+torch fallback path); here it is the same fused-pytree pattern as Adam.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SGDState(NamedTuple):
+    momentum_buf: dict
+
+
+class SGD:
+    name = "sgd"
+
+    def __init__(self, lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False):
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return SGDState(momentum_buf=jax.tree_util.tree_map(zeros, params))
+
+    def update(self, grads, state, params, *, step, lr=None):
+        lr = self.lr if lr is None else lr
+        mom, wd, nesterov = self.momentum, self.weight_decay, self.nesterov
+
+        def upd(p, g, b):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if wd != 0.0:
+                g = g + wd * p32
+            b_new = mom * b + g
+            d = g + mom * b_new if nesterov else (b_new if mom != 0.0 else g)
+            return (p32 - lr * d).astype(p.dtype), b_new
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_b = treedef.flatten_up_to(state.momentum_buf)
+        outs = [upd(p, g, b) for p, g, b in zip(flat_p, flat_g, flat_b)]
+        return (treedef.unflatten([o[0] for o in outs]),
+                SGDState(momentum_buf=treedef.unflatten([o[1] for o in outs])))

@@ -1,0 +1,125 @@
+"""Config-driven data loader.
+
+Parity: reference ``deepspeed/runtime/dataloader.py:33`` (``DeepSpeedDataLoader``)
+and ``:10`` (``RepeatingLoader``).
+
+TPU-native difference: the reference builds a per-process
+``DistributedSampler`` loader (one process per GPU); here ONE process feeds the
+whole mesh, so the loader yields GLOBAL micro-batches (micro_batch × dp_world
+samples) as host numpy pytrees and the engine shards them across the
+(data, fsdp) mesh axes at device_put time.  This is the idiomatic JAX input
+path — it also removes the sampler-rank bookkeeping entirely.
+
+Accepted dataset forms:
+- tuple/list of numpy arrays with equal leading dim → samples are tuples
+- anything with ``__getitem__``/``__len__`` (torch Dataset included)
+- a dict of arrays → samples are dicts
+"""
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class RepeatingLoader:
+    """Wrap an iterable loader to restart on StopIteration
+    (parity: reference ``dataloader.py:10``)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            if hasattr(self.loader, "new_epoch"):
+                self.loader.new_epoch()
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def _default_collate(samples):
+    """Stack a list of samples into a batch pytree of numpy arrays."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([np.asarray(s[i]) for s in samples])
+                     for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Shuffling, batching loader yielding global micro-batches."""
+
+    def __init__(self, dataset, batch_size, *, shuffle=True, seed=0,
+                 drop_last=False, collate_fn=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self._columnar = None
+
+        if isinstance(dataset, dict):
+            lens = {k: len(v) for k, v in dataset.items()}
+            assert len(set(lens.values())) == 1, f"ragged dict dataset: {lens}"
+            self._len = next(iter(lens.values()))
+            self._columnar = "dict"
+        elif isinstance(dataset, (tuple, list)) and len(dataset) > 0 and \
+                all(isinstance(a, np.ndarray) for a in dataset):
+            lens = [len(a) for a in dataset]
+            assert len(set(lens)) == 1, f"ragged tuple dataset: {lens}"
+            self._len = lens[0]
+            self._columnar = "tuple"
+        else:
+            self._len = len(dataset)
+
+        if self._len < batch_size:
+            logger.warning(f"dataset ({self._len}) smaller than global micro-batch "
+                           f"({batch_size}); it will be cycled within one batch")
+
+    def __len__(self):
+        if self.drop_last:
+            return self._len // self.batch_size
+        return (self._len + self.batch_size - 1) // self.batch_size
+
+    def new_epoch(self):
+        self.epoch += 1
+
+    def _order(self):
+        idx = np.arange(self._len)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        return idx
+
+    def _take(self, indices):
+        if self._columnar == "dict":
+            return {k: np.asarray(v)[indices] for k, v in self.dataset.items()}
+        if self._columnar == "tuple":
+            return tuple(np.asarray(a)[indices] for a in self.dataset)
+        return self.collate_fn([self.dataset[int(i)] for i in indices])
+
+    def __iter__(self):
+        order = self._order()
+        n_full = self._len // self.batch_size
+        for b in range(n_full):
+            yield self._take(order[b * self.batch_size:(b + 1) * self.batch_size])
+        rem = self._len - n_full * self.batch_size
+        if rem and not self.drop_last:
+            # pad the tail by cycling (keeps shapes static for jit; np.resize
+            # repeats the order as many times as needed for tiny datasets)
+            tail = order[n_full * self.batch_size:]
+            pad = np.resize(order, self.batch_size - rem)
+            yield self._take(np.concatenate([tail, pad]))
+        elif self._len < self.batch_size and n_full == 0:
+            # tiny dataset + drop_last: cycle to one full batch rather than
+            # yielding nothing (RepeatingLoader would otherwise spin forever)
+            yield self._take(np.resize(order, self.batch_size))

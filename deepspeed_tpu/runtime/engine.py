@@ -1,0 +1,644 @@
+"""DeepSpeedEngine: the train-loop wrapper, re-designed TPU-native.
+
+Parity: reference ``deepspeed/runtime/engine.py:168`` (``DeepSpeedEngine``).
+The reference wraps a torch ``nn.Module`` and exposes imperative
+``forward/backward/step``; behavior (ZeRO stage, precision, optimizer,
+schedule) is driven by the JSON config.  This engine keeps the config surface
+and the API names, but the hot path is ONE jitted SPMD train step:
+
+  - grad accumulation  = ``lax.scan`` over the microbatch axis
+    (reference: per-micro-batch backward + bucketed hook reduction,
+    ``engine.py:1684``)
+  - DP grad averaging  = mean over the globally-sharded batch; XLA inserts the
+    all-reduce (reference ``allreduce_gradients`` ``engine.py:1663``)
+  - ZeRO 1/2/3         = sharding placement of master/opt/grads/params over
+    the ``fsdp`` mesh axis (see ``runtime/zero/partition.py``)
+  - fp16 loss scaling  = branchless skip-step with on-device scaler state
+    (reference ``_take_model_step`` overflow path, ``engine.py:1819-1871``)
+  - checkpoint save/load with the reference's directory layout
+    (``engine.py:2797 save_checkpoint``, ``:2467 load_checkpoint``)
+
+The imperative ``forward()/backward()/step()`` trio is provided as a
+compatibility shim that stages microbatches and executes the fused step at the
+gradient-accumulation boundary.
+"""
+
+import json
+import os
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .config import DeepSpeedConfig
+from . import constants as C
+from .fp16 import loss_scaler as ls
+from .lr_schedules import get_lr_scheduler
+from .dataloader import DeepSpeedDataLoader, RepeatingLoader
+from .utils import (DummyOptim, clip_by_global_norm, global_norm, tree_cast,
+                    see_memory_usage)
+from .zero import partition as zpart
+from ..ops.adam.fused_adam import FusedAdam, FusedAdamW
+from ..ops.lamb.fused_lamb import FusedLamb
+from ..parallel import mesh as M
+from ..utils.logging import logger, log_dist
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+MODEL_FILE = "model_states.msgpack"
+OPTIM_FILE = "optim_states.msgpack"
+LATEST_FILE = "latest"
+
+
+class TrainState(NamedTuple):
+    """Device-resident training state (one pytree, donated each step)."""
+    global_steps: jnp.ndarray      # i32 — optimizer boundaries seen (incl. skipped)
+    optimizer_steps: jnp.ndarray   # i32 — actual optimizer steps (Adam bias corr.)
+    skipped_steps: jnp.ndarray     # i32 — overflow-skipped steps
+    params: Any                    # compute-dtype params (sharded per ZeRO stage)
+    master: Any                    # fp32 master params (None when training fp32)
+    opt_state: Any
+    scale: Any                     # LossScaleState (None unless fp16)
+
+
+def _resolve_model(model, loss_fn, params, apply_fn, rng_seed):
+    """Accept either a model object (``.init``/``.loss``[/``.apply``]) or an
+    explicit (loss_fn, params) pair."""
+    tp_specs = None
+    if model is not None:
+        if loss_fn is None:
+            assert hasattr(model, "loss"), \
+                "model must expose .loss(params, batch, rng) or pass loss_fn="
+            loss_fn = model.loss
+        if params is None:
+            assert hasattr(model, "init"), "model must expose .init(rng) -> params"
+            params = model.init(jax.random.PRNGKey(rng_seed))
+        if apply_fn is None and hasattr(model, "apply"):
+            apply_fn = model.apply
+        tp_specs = getattr(model, "partition_specs", None)
+        if callable(tp_specs):
+            tp_specs = tp_specs(params)
+    assert loss_fn is not None and params is not None, \
+        "Provide either model= (with .init/.loss) or loss_fn= and params="
+    return loss_fn, params, apply_fn, tp_specs
+
+
+class DeepSpeedEngine:
+    """Config-driven training engine over a jitted SPMD step."""
+
+    def __init__(self, model=None, optimizer=None, config=None, config_params=None,
+                 training_data=None, lr_scheduler=None, mesh=None, collate_fn=None,
+                 loss_fn=None, params=None, apply_fn=None, rng_seed=0, mpu=None,
+                 dist_init_required=None, dont_change_device=False):
+        config = config if config is not None else config_params
+        assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
+
+        # ---- mesh first (config batch math needs dp world size) ----------
+        if mesh is None:
+            from .config_utils import load_config_dict
+            raw = load_config_dict(config)
+            mesh = M.make_mesh(raw.get(C.MESH, {}).get("axes", None))
+            config = raw
+        self.mesh = mesh
+        self.mesh_ctx = M.MeshContext(mesh)
+        self.config = DeepSpeedConfig(config, world_size=self.mesh_ctx.dp_world_size)
+
+        self.zero_stage = self.config.zero_optimization_stage
+        self.compute_dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+                              "float32": jnp.float32}[self.config.precision_dtype]
+        self.fp16_enabled = self.config.fp16.enabled
+        self.bfloat16_enabled = self.config.bf16.enabled
+
+        # ---- model ---------------------------------------------------------
+        self.module = model
+        self._loss_fn, params0, self._apply_fn, self._tp_specs = _resolve_model(
+            model, loss_fn, params, apply_fn, rng_seed)
+        params0 = tree_cast(params0, jnp.float32)
+
+        # ---- optimizer -----------------------------------------------------
+        self.optimizer = self._configure_optimizer(optimizer)
+        # ---- lr scheduler --------------------------------------------------
+        self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+
+        # ---- shardings (ZeRO stages as placement; partition.py) -----------
+        fsdp = self.mesh_ctx.fsdp_size
+        self._param_specs = zpart.param_specs(
+            params0, self.zero_stage, fsdp,
+            persistence_threshold=self.config.zero_config.param_persistence_threshold,
+            tp_specs=self._tp_specs)
+        self._master_specs = zpart.master_specs(params0, self.zero_stage, fsdp,
+                                                tp_specs=self._tp_specs)
+        self._grad_specs = zpart.grad_specs(params0, self.zero_stage, fsdp,
+                                            tp_specs=self._tp_specs)
+        self._param_sh = zpart.to_named(self._param_specs, self.mesh)
+        self._master_sh = zpart.to_named(self._master_specs, self.mesh)
+        self._repl_sh = NamedSharding(self.mesh, P())
+
+        # shape → master spec map: optimizer-state leaves that are param-shaped
+        # (Adam moments etc.) inherit the master sharding.
+        self._shape_spec_cache = {}
+        for p, sp in zip(jax.tree_util.tree_leaves(params0),
+                         jax.tree_util.tree_leaves(
+                             self._master_specs, is_leaf=lambda x: isinstance(x, P))):
+            self._shape_spec_cache.setdefault(np.shape(p), sp)
+
+        # ---- initial device state -----------------------------------------
+        self.state = self._init_state(params0)
+        self._needs_master = self.compute_dtype != jnp.float32
+
+        # ---- data ----------------------------------------------------------
+        self.training_dataloader = None
+        self._data_iterator = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data,
+                                                         collate_fn=collate_fn)
+            self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
+
+        # ---- compiled steps -------------------------------------------------
+        self._jit_train_step = jax.jit(self._train_step, donate_argnums=(0,))
+        self._jit_eval = None
+
+        # ---- misc parity state ---------------------------------------------
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(),
+            steps_per_output=self.config.steps_per_print)
+        self.micro_steps = 0
+        self._global_steps_host = 0
+        self._base_rng = jax.random.PRNGKey(rng_seed)
+        self._pending_microbatches = []   # forward/backward/step shim buffer
+        self._last_metrics = {}
+        self._tb_writer = None
+        self.loaded_checkpoint_tag = None
+        self.global_samples = 0
+        if self.config.tensorboard.enabled:
+            self._setup_tensorboard()
+        if self.config.memory_breakdown:
+            see_memory_usage("Engine initialized", force=True)
+        log_dist(f"DeepSpeedEngine ready: zero_stage={self.zero_stage} "
+                 f"dtype={self.config.precision_dtype} mesh={dict(self.mesh.shape)} "
+                 f"micro_batch={self.train_micro_batch_size_per_gpu()} "
+                 f"gas={self.gradient_accumulation_steps()}", ranks=[0])
+
+    # ------------------------------------------------------------------ config
+    def _configure_optimizer(self, client_optimizer):
+        """Parity: reference ``engine.py:1079 _configure_optimizer`` /
+        ``:1153 _configure_basic_optimizer`` (config name → optimizer)."""
+        if client_optimizer is not None:
+            assert hasattr(client_optimizer, "init") and hasattr(client_optimizer, "update"), \
+                "client optimizer must expose .init(params) and .update(...)"
+            return client_optimizer
+        name = self.config.optimizer_name
+        if name is None:
+            return DummyOptim()
+        p = dict(self.config.optimizer_params or {})
+        p.pop("torch_adam", None)  # accepted in reference configs; no-op here
+        if name == C.ADAMW_OPTIMIZER:
+            p.pop("adam_w_mode", None)  # implied by the optimizer type
+        if name in (C.ADAM_OPTIMIZER,):
+            return FusedAdam(**p)
+        if name == C.ADAMW_OPTIMIZER:
+            return FusedAdamW(**p)
+        if name == C.LAMB_OPTIMIZER:
+            return FusedLamb(**p)
+        if name == C.ONEBIT_ADAM_OPTIMIZER:
+            from .fp16.onebit.adam import OnebitAdam
+            return OnebitAdam(**p)
+        if name == C.ONEBIT_LAMB_OPTIMIZER:
+            from .fp16.onebit.lamb import OnebitLamb
+            return OnebitLamb(**p)
+        if name == C.ZERO_ONE_ADAM_OPTIMIZER:
+            from .fp16.onebit.zoadam import ZeroOneAdam
+            return ZeroOneAdam(**p)
+        if name == C.ADAGRAD_OPTIMIZER:
+            from ..ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
+            return DeepSpeedCPUAdagrad(**p)
+        if name == C.SGD_OPTIMIZER:
+            from ..ops.sgd import SGD
+            return SGD(**p)
+        raise ValueError(f"Unknown optimizer type {name!r}")
+
+    def _configure_lr_scheduler(self, client_scheduler):
+        """Parity: reference ``engine.py:780``."""
+        if client_scheduler is not None:
+            return client_scheduler
+        if self.config.scheduler_name is not None:
+            return get_lr_scheduler(self.config.scheduler_name,
+                                    self.config.scheduler_params,
+                                    optimizer=self.optimizer)
+        return None
+
+    def _lr_at(self, step):
+        """Traced lr as a function of the global step counter."""
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "lr_fn"):
+            return self.lr_scheduler.lr_fn(step)
+        return jnp.asarray(getattr(self.optimizer, "lr", 0.0), jnp.float32)
+
+    # ------------------------------------------------------------------- state
+    def _init_state(self, params0):
+        dtype = self.compute_dtype
+        needs_master = dtype != jnp.float32
+
+        params = jax.device_put(tree_cast(params0, dtype), self._param_sh)
+        master = jax.device_put(params0, self._master_sh) if needs_master else None
+
+        # opt state created under jit so it materializes directly sharded
+        base = master if needs_master else params
+
+        def mk_opt(p):
+            return self.optimizer.init(p)
+        opt_state = jax.jit(mk_opt)(base)
+        # constrain opt-state leaves that mirror params to the master sharding
+        opt_state = jax.device_put(
+            opt_state, self._opt_shardings(opt_state))
+
+        scale = None
+        if self.fp16_enabled:
+            scaler = ls.create_loss_scaler(self.config.fp16)
+            self._scaler = scaler
+            scale = jax.device_put(scaler.state, self._repl_sh)
+        else:
+            self._scaler = None
+
+        z = lambda: jax.device_put(jnp.asarray(0, jnp.int32), self._repl_sh)
+        return TrainState(global_steps=z(), optimizer_steps=z(), skipped_steps=z(),
+                          params=params, master=master, opt_state=opt_state,
+                          scale=scale)
+
+    def _opt_shardings(self, opt_state):
+        """Optimizer-state leaves that are param-shaped inherit the master
+        sharding; anything else (scalars, counters) is replicated."""
+        def sh_for(leaf):
+            spec = self._shape_spec_cache.get(np.shape(leaf))
+            return NamedSharding(self.mesh, spec if spec is not None else P())
+        return jax.tree_util.tree_map(sh_for, opt_state)
+
+    # ------------------------------------------------------------- train step
+    def _train_step(self, state: TrainState, batch, rng):
+        """One full optimizer step: scan over gas microbatches, reduce, update.
+
+        ``batch`` leaves are shaped (gas, global_micro_batch, ...) with the
+        second axis sharded over (data, fsdp).
+        """
+        gas = self.gradient_accumulation_steps()
+        dtype = self.compute_dtype
+        needs_master = dtype != jnp.float32
+        base = state.master if needs_master else state.params
+
+        cur_scale = state.scale.cur_scale if state.scale is not None else jnp.float32(1.0)
+
+        def micro_loss(base_params, mb, r):
+            p = tree_cast(base_params, dtype) if needs_master else base_params
+            p = zpart.constrain(p, self._param_specs, self.mesh)
+            loss = self._loss_fn(p, mb, r)
+            return loss * cur_scale / gas
+
+        grad_fn = jax.value_and_grad(micro_loss)
+
+        def body(carry, xs):
+            gacc, lacc, idx = carry
+            mb = xs
+            r = jax.random.fold_in(rng, idx)
+            scaled_loss, grads = grad_fn(base, mb, r)
+            grads = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), gacc, grads)
+            return (grads, lacc + scaled_loss, idx + 1), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), base)
+        (grads, scaled_loss_sum, _), _ = jax.lax.scan(
+            body, (zeros, jnp.float32(0.0), jnp.int32(0)), batch)
+
+        # unscale (fp16); loss for reporting is the true mean loss
+        grads = jax.tree_util.tree_map(lambda g: g / cur_scale, grads)
+        loss = scaled_loss_sum / cur_scale
+
+        overflow = ls.has_overflow(grads) if self.fp16_enabled else jnp.asarray(False)
+
+        # grad clipping on the unscaled grads (reference clip order:
+        # unscale → clip → step, stage_1_and_2.py:1736 unscale_and_clip)
+        if self.config.gradient_clipping > 0:
+            grads, gnorm = clip_by_global_norm(grads, self.config.gradient_clipping)
+        else:
+            gnorm = global_norm(grads)
+
+        # ZeRO-2: constrain grads to fsdp sharding → reduce-scatter
+        grads = zpart.constrain(grads, self._grad_specs, self.mesh)
+
+        lr = self._lr_at(state.global_steps)
+        new_base, new_opt = self.optimizer.update(
+            grads, state.opt_state, base, step=state.optimizer_steps + 1, lr=lr)
+        new_base = zpart.constrain(new_base, self._master_specs if needs_master
+                                   else self._param_specs, self.mesh)
+
+        if self.fp16_enabled:
+            # branchless skip-step on overflow
+            sel = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_base = sel(new_base, base)
+            new_opt = sel(new_opt, state.opt_state)
+            new_scale = ls.update_scale(
+                state.scale, overflow, dynamic=self._scaler.dynamic,
+                scale_factor=self._scaler.scale_factor,
+                scale_window=self._scaler.scale_window,
+                min_scale=self._scaler.min_scale,
+                delayed_shift=self._scaler.delayed_shift,
+                consecutive_hysteresis=self._scaler.consecutive_hysteresis)
+        else:
+            new_scale = state.scale
+
+        if needs_master:
+            new_params = zpart.constrain(tree_cast(new_base, dtype),
+                                         self._param_specs, self.mesh)
+            new_master = new_base
+        else:
+            new_params = new_base
+            new_master = None
+
+        ovf_i = overflow.astype(jnp.int32)
+        new_state = TrainState(
+            global_steps=state.global_steps + 1,
+            optimizer_steps=state.optimizer_steps + (1 - ovf_i),
+            skipped_steps=state.skipped_steps + ovf_i,
+            params=new_params, master=new_master, opt_state=new_opt,
+            scale=new_scale)
+        metrics = {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
+                   "lr": lr, "loss_scale": cur_scale}
+        return new_state, metrics
+
+    # ------------------------------------------------------------- public API
+    def train_batch(self, data_iter=None):
+        """Run one full training step (gas microbatches → one optimizer step).
+
+        Parity: ``PipelineEngine.train_batch`` naming; for the non-pipeline
+        engine this replaces the forward/backward/step trio with one call.
+        """
+        it = data_iter if data_iter is not None else self._data_iterator
+        assert it is not None, "train_batch needs training_data or a data_iter"
+        gas = self.gradient_accumulation_steps()
+        micro_batches = [next(it) for _ in range(gas)]
+        batch = self._stack_microbatches(micro_batches)
+        return self._run_fused_step(batch)
+
+    def _stack_microbatches(self, micro_batches):
+        batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro_batches)
+        sh = jax.tree_util.tree_map(
+            lambda x: NamedSharding(self.mesh, P(None, ("data", "fsdp"))), batch)
+        return jax.device_put(batch, sh)
+
+    def _run_fused_step(self, batch):
+        self.tput_timer.start()
+        rng = jax.random.fold_in(self._base_rng, self.micro_steps)
+        self.state, metrics = self._jit_train_step(self.state, batch, rng)
+        self._last_metrics = metrics
+        self.micro_steps += self.gradient_accumulation_steps()
+        self.global_samples += self.train_batch_size()
+        self._global_steps_host += 1
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
+            self.lr_scheduler.step()
+        if self._scaler is not None and self.state.scale is not None:
+            self._scaler.state = self.state.scale
+        # host sync (float()/block) only on steps that actually report — keeps
+        # the hot path async so input prep overlaps device compute
+        step_no = self._global_steps_host
+        reporting = step_no % self.config.steps_per_print == 0
+        if reporting:
+            self._report_progress(step_no, metrics)
+        self.tput_timer.stop(global_step=True,
+                             sync_obj=metrics["loss"] if reporting else None)
+        self._write_tensorboard(step_no, metrics)
+        return metrics["loss"]
+
+    def eval_batch(self, batch, rng=None):
+        """Loss without gradient/update (jitted separately)."""
+        if self._jit_eval is None:
+            def eval_fn(params, mb, r):
+                return self._loss_fn(params, mb, r)
+            self._jit_eval = jax.jit(eval_fn)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        batch = self._device_batch(batch)
+        return self._jit_eval(self.state.params, batch, rng)
+
+    def _device_batch(self, batch):
+        sh = jax.tree_util.tree_map(
+            lambda x: NamedSharding(self.mesh, P(("data", "fsdp"))), batch)
+        return jax.device_put(batch, sh)
+
+    # --- forward/backward/step compatibility shim -------------------------
+    def forward(self, batch, rng=None):
+        """Compatibility shim: computes the (eval) loss AND stages the batch
+        for the fused step executed at the gas boundary in :meth:`step`."""
+        self._staged_batch = batch
+        return self.eval_batch(batch, rng)
+
+    def backward(self, loss=None):
+        """Compatibility shim: queue the staged microbatch.  The actual
+        gradient computation happens fused inside :meth:`step` at the
+        accumulation boundary (reference semantics: grads materialize during
+        backward; here XLA fuses them into the optimizer step)."""
+        assert getattr(self, "_staged_batch", None) is not None, \
+            "call forward(batch) before backward()"
+        self._pending_microbatches.append(self._staged_batch)
+        self._staged_batch = None
+        return loss
+
+    def is_gradient_accumulation_boundary(self):
+        """Parity: reference ``engine.py:1267``."""
+        return len(self._pending_microbatches) >= self.gradient_accumulation_steps()
+
+    def step(self):
+        """Compatibility shim: at the gas boundary, run the fused train step
+        over the queued microbatches."""
+        if not self.is_gradient_accumulation_boundary():
+            return None
+        batch = self._stack_microbatches(self._pending_microbatches)
+        self._pending_microbatches = []
+        return self._run_fused_step(batch)
+
+    # ------------------------------------------------------------ data/loader
+    def deepspeed_io(self, dataset, batch_size=None, route=None, data_sampler=None,
+                     collate_fn=None, num_local_io_workers=None):
+        """Build the config-driven loader (parity: reference ``engine.py:1493``).
+
+        One process feeds the whole mesh, so the loader yields GLOBAL
+        micro-batches of ``micro_batch × dp_world`` samples; the engine shards
+        them over the (data, fsdp) axes on device_put.
+        """
+        if batch_size is None:
+            batch_size = self.train_micro_batch_size_per_gpu() * self.mesh_ctx.dp_world_size
+        return DeepSpeedDataLoader(dataset, batch_size=batch_size,
+                                   collate_fn=collate_fn,
+                                   drop_last=self.config.dataloader_drop_last)
+
+    # ------------------------------------------------------------- reporting
+    def _report_progress(self, step, metrics):
+        lr = float(metrics["lr"])
+        loss = float(metrics["loss"])
+        msg = f"step={step}, loss={loss:.6f}, lr={lr:.3e}"
+        if self.fp16_enabled:
+            msg += (f", loss_scale={float(metrics['loss_scale']):.1f}"
+                    f", skipped={int(self.state.skipped_steps)}")
+        log_dist(msg, ranks=[0])
+
+    def _setup_tensorboard(self):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            path = os.path.join(self.config.tensorboard.output_path,
+                                self.config.tensorboard.job_name)
+            self._tb_writer = SummaryWriter(log_dir=path)
+        except Exception as e:
+            logger.warning(f"tensorboard unavailable: {e}")
+
+    def _write_tensorboard(self, step, metrics):
+        if self._tb_writer is None:
+            return
+        self._tb_writer.add_scalar("Train/loss", float(metrics["loss"]), step)
+        self._tb_writer.add_scalar("Train/lr", float(metrics["lr"]), step)
+        if self.fp16_enabled:
+            self._tb_writer.add_scalar("Train/loss_scale",
+                                       float(metrics["loss_scale"]), step)
+
+    # ------------------------------------------------------------ properties
+    @property
+    def global_steps(self):
+        return int(self.state.global_steps)
+
+    @property
+    def skipped_steps(self):
+        return int(self.state.skipped_steps)
+
+    def train_batch_size(self):
+        return self.config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self.config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self):
+        return self.zero_stage
+
+    def loss_scale(self):
+        if self.state.scale is None:
+            return 1.0
+        return float(self.state.scale.cur_scale)
+
+    def get_lr(self):
+        return [float(self._lr_at(self.state.global_steps))]
+
+    def get_global_grad_norm(self):
+        m = self._last_metrics.get("grad_norm")
+        return float(m) if m is not None else None
+
+    def module_state_dict(self):
+        """Full (gathered) params as a host pytree of numpy arrays."""
+        return jax.tree_util.tree_map(np.asarray, self.state.params)
+
+    # ----------------------------------------------------------- checkpoints
+    def _get_ckpt_name(self, checkpoints_path, tag):
+        return os.path.join(checkpoints_path, str(tag))
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        """Parity: reference ``engine.py:2797``.  Layout:
+        ``<dir>/<tag>/{model,optim}_states.msgpack`` + ``<dir>/latest``.
+        Arrays are gathered to host; ZeRO-sharded state is saved in full so
+        checkpoints reshard freely across mesh-size changes (the reference
+        needs ``elastic_checkpoint`` machinery for this; here resharding is a
+        device_put)."""
+        from ..checkpoint.serialization import save_tree
+        tag = tag or f"global_step{self.global_steps}"
+        path = self._get_ckpt_name(save_dir, tag)
+        os.makedirs(path, exist_ok=True)
+
+        engine_meta = {
+            "global_steps": self.global_steps,
+            "optimizer_steps": int(self.state.optimizer_steps),
+            "skipped_steps": self.skipped_steps,
+            "micro_steps": self.micro_steps,
+            "global_samples": self.global_samples,
+            "zero_stage": self.zero_stage,
+            "dtype": self.config.precision_dtype,
+            "client_state": client_state or {},
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler is not None and
+                             hasattr(self.lr_scheduler, "state_dict") else None),
+        }
+        save_tree(os.path.join(path, MODEL_FILE),
+                  {"params": self.state.params}, meta=engine_meta)
+        optim_tree = {"opt_state": self.state.opt_state}
+        if self.state.master is not None:
+            optim_tree["master"] = self.state.master
+        if self.state.scale is not None:
+            optim_tree["scale"] = self.state.scale
+        save_tree(os.path.join(path, OPTIM_FILE), optim_tree)
+
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(tag)
+        log_dist(f"saved checkpoint {path}", ranks=[0])
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_only=False,
+                        load_optimizer_states=True, load_lr_scheduler_states=True):
+        """Parity: reference ``engine.py:2467``. Returns (path, client_state)."""
+        from ..checkpoint.serialization import load_tree
+        if tag is None:
+            latest = os.path.join(load_dir, LATEST_FILE)
+            assert os.path.isfile(latest), f"missing {latest}; pass tag="
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = self._get_ckpt_name(load_dir, tag)
+
+        from ..checkpoint.serialization import restore_like
+        model_tree, meta = load_tree(os.path.join(path, MODEL_FILE), with_meta=True)
+        params = restore_like(self.state.params, model_tree["params"])
+        params = jax.device_put(
+            jax.tree_util.tree_map(lambda x, p: np.asarray(x).astype(p.dtype),
+                                   params, self.state.params),
+            self._param_sh)
+        state = self.state._replace(params=params)
+        if state.master is not None:
+            # keep the fp32 master coherent with the loaded params NOW; if
+            # optimizer states are loaded below this is overwritten with the
+            # checkpointed master, otherwise (load_module_only) the train step
+            # would silently resume from the stale master.
+            loaded_master = restore_like(state.master, model_tree["params"])
+            state = state._replace(master=jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda x: np.asarray(x).astype(np.float32), loaded_master),
+                self._master_sh))
+
+        if load_optimizer_states and not load_module_only:
+            optim_tree, _ = load_tree(os.path.join(path, OPTIM_FILE), with_meta=True)
+            opt_state = jax.device_put(
+                restore_like(self.state.opt_state, optim_tree["opt_state"]),
+                self._opt_shardings(self.state.opt_state))
+            master = state.master
+            if "master" in optim_tree and master is not None:
+                master = jax.device_put(
+                    restore_like(master, optim_tree["master"]), self._master_sh)
+            scale = state.scale
+            if "scale" in optim_tree and scale is not None:
+                scale = jax.device_put(
+                    restore_like(scale, optim_tree["scale"]), self._repl_sh)
+            state = state._replace(opt_state=opt_state, master=master, scale=scale)
+
+        mk = lambda v: jax.device_put(jnp.asarray(v, jnp.int32), self._repl_sh)
+        self._global_steps_host = int(meta["global_steps"])
+        state = state._replace(global_steps=mk(meta["global_steps"]),
+                               optimizer_steps=mk(meta["optimizer_steps"]),
+                               skipped_steps=mk(meta["skipped_steps"]))
+        self.state = state
+        self.micro_steps = meta.get("micro_steps", 0)
+        self.global_samples = meta.get("global_samples", 0)
+        if (load_lr_scheduler_states and self.lr_scheduler is not None
+                and meta.get("lr_scheduler") is not None
+                and hasattr(self.lr_scheduler, "load_state_dict")):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        log_dist(f"loaded checkpoint {path} at global_step={meta['global_steps']}",
+                 ranks=[0])
+        return path, meta.get("client_state", {})

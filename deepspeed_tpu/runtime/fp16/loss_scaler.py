@@ -1,0 +1,177 @@
+"""Static + dynamic loss scaling for fp16 training.
+
+Parity: reference ``deepspeed/runtime/fp16/loss_scaler.py:54,77``
+(``LossScaler``/``DynamicLossScaler``) with the same knobs:
+``init_scale = 2**initial_scale_power``, ``scale_window``, ``scale_factor``,
+``min_scale``, ``delayed_shift`` (hysteresis).
+
+TPU-native design: the scaler state is a small pytree carried INSIDE the
+jitted train step (no host round-trip per step).  Overflow handling is
+branchless: the step computes both the "apply" and "skip" outcomes with
+``jnp.where`` — matching the reference's skip-step semantics
+(``stage_1_and_2.py:1667-1688``) without data-dependent control flow.
+
+bf16 training needs no scaler (the default on TPU); fp16 parity keeps the
+whole config surface working.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    """Device-resident scaler state (all scalars)."""
+    cur_scale: jnp.ndarray        # f32
+    cur_hysteresis: jnp.ndarray   # i32 — remaining tolerated overflows before shrink
+    last_overflow_iter: jnp.ndarray  # i32
+    iter_num: jnp.ndarray         # i32
+
+
+def static_state(loss_scale: float) -> LossScaleState:
+    return LossScaleState(
+        cur_scale=jnp.asarray(loss_scale, jnp.float32),
+        cur_hysteresis=jnp.asarray(0, jnp.int32),
+        last_overflow_iter=jnp.asarray(-1, jnp.int32),
+        iter_num=jnp.asarray(0, jnp.int32),
+    )
+
+
+def dynamic_state(initial_scale_power: int = 16, delayed_shift: int = 2) -> LossScaleState:
+    return LossScaleState(
+        cur_scale=jnp.asarray(2.0 ** initial_scale_power, jnp.float32),
+        cur_hysteresis=jnp.asarray(delayed_shift, jnp.int32),
+        last_overflow_iter=jnp.asarray(-1, jnp.int32),
+        iter_num=jnp.asarray(0, jnp.int32),
+    )
+
+
+def update_scale(state: LossScaleState, overflow, *, dynamic: bool,
+                 scale_factor: float = 2.0, scale_window: int = 1000,
+                 min_scale: float = 1.0, delayed_shift: int = 2,
+                 consecutive_hysteresis: bool = False) -> LossScaleState:
+    """One ``update_scale`` tick. Parity: reference ``loss_scaler.py:115-139``.
+
+    - On overflow with hysteresis left: consume one hysteresis credit.
+    - On overflow without: scale = max(scale/scale_factor, min_scale).
+    - After ``scale_window`` clean iters: scale *= scale_factor (and restore
+      hysteresis unless ``consecutive_hysteresis``).
+    """
+    if not dynamic:
+        return state._replace(iter_num=state.iter_num + 1)
+
+    overflow = jnp.asarray(overflow)
+    iter_num = state.iter_num + 1
+
+    # -- overflow branch
+    hysteresis_left = state.cur_hysteresis > 1
+    ovf_scale = jnp.where(hysteresis_left, state.cur_scale,
+                          jnp.maximum(state.cur_scale / scale_factor, min_scale))
+    ovf_hyst = jnp.where(hysteresis_left, state.cur_hysteresis - 1, state.cur_hysteresis)
+    ovf_last = state.iter_num  # record this iteration as the overflow point
+
+    # -- clean branch (reference loss_scaler.py:115-139: pre-increment iter,
+    # consecutive_hysteresis=True replenishes hysteresis EVERY clean iter,
+    # False replenishes only when the window elapses and the scale grows)
+    window_elapsed = (state.iter_num - state.last_overflow_iter) % scale_window == 0
+    grow = jnp.logical_and(window_elapsed, state.iter_num > state.last_overflow_iter)
+    clean_scale = jnp.where(grow, state.cur_scale * scale_factor, state.cur_scale)
+    if consecutive_hysteresis:
+        clean_hyst = jnp.asarray(delayed_shift, jnp.int32) * jnp.ones_like(
+            state.cur_hysteresis)
+    else:
+        clean_hyst = jnp.where(grow, jnp.asarray(delayed_shift, jnp.int32),
+                               state.cur_hysteresis)
+
+    return LossScaleState(
+        cur_scale=jnp.where(overflow, ovf_scale, clean_scale),
+        cur_hysteresis=jnp.where(overflow, ovf_hyst, clean_hyst).astype(jnp.int32),
+        last_overflow_iter=jnp.where(overflow, ovf_last,
+                                     state.last_overflow_iter).astype(jnp.int32),
+        iter_num=iter_num,
+    )
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """Global any-nonfinite scan over a grad pytree.
+
+    Parity: reference ``CheckOverflow`` / ``_has_inf_or_nan`` (``stage3.py:2498``).
+    Under SPMD this is computed on sharded grads and XLA inserts the cross-
+    device reduction — the reference needed an explicit allreduce
+    (``stage_1_and_2.py:1660``).
+    """
+    import jax
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+class LossScaler:
+    """Host-side stateful facade (reference API parity).
+
+    Wraps a :class:`LossScaleState`; the engine reads ``.state`` into the
+    jitted step and writes the updated state back.
+    """
+
+    def __init__(self, scale=1.0):
+        self.dynamic = False
+        self.scale_factor = 2.0
+        self.scale_window = 1000
+        self.min_scale = 1.0
+        self.delayed_shift = 1
+        self.consecutive_hysteresis = False
+        self.state = static_state(scale)
+
+    @property
+    def loss_scale(self):
+        return float(self.state.cur_scale)
+
+    def update_scale(self, overflow):
+        self.state = update_scale(self.state, overflow, dynamic=self.dynamic,
+                                  scale_factor=self.scale_factor,
+                                  scale_window=self.scale_window,
+                                  min_scale=self.min_scale,
+                                  delayed_shift=self.delayed_shift,
+                                  consecutive_hysteresis=self.consecutive_hysteresis)
+
+    def backward(self, loss):
+        # JAX has no .backward(); engine scales inside the jitted step.
+        raise RuntimeError("LossScaler.backward is not meaningful under JAX; "
+                           "the engine scales the loss inside its train step.")
+
+
+class DynamicLossScaler(LossScaler):
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0, scale_window=1000,
+                 min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.dynamic = True
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.state = LossScaleState(
+            cur_scale=jnp.asarray(init_scale, jnp.float32),
+            cur_hysteresis=jnp.asarray(delayed_shift, jnp.int32),
+            last_overflow_iter=jnp.asarray(-1, jnp.int32),
+            iter_num=jnp.asarray(0, jnp.int32),
+        )
+
+
+def create_loss_scaler(fp16_config):
+    """Build a scaler from the parsed ``fp16`` config section.
+
+    Parity: reference engine scaler selection (``fp16/fused_optimizer.py`` init):
+    ``loss_scale == 0`` → dynamic with ``2**initial_scale_power``.
+    """
+    if fp16_config.dynamic_loss_scale:
+        return DynamicLossScaler(init_scale=2.0 ** fp16_config.initial_scale_power,
+                                 scale_window=fp16_config.loss_scale_window,
+                                 min_scale=fp16_config.min_loss_scale,
+                                 delayed_shift=fp16_config.hysteresis)
+    return LossScaler(scale=fp16_config.loss_scale)

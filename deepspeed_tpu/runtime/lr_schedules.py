@@ -1,0 +1,232 @@
+"""LR schedules: LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR.
+
+Parity: reference ``deepspeed/runtime/lr_schedules.py:310,563,685,772`` — same
+schedule names, parameter names, and shapes of the curves.
+
+TPU-native design: each schedule is fundamentally a PURE function
+``lr(step) -> float`` (exposed as ``.lr_fn``) so it can be traced into the
+jitted train step (the step counter lives on device).  The class wrappers keep
+the reference's stateful API (``step()``, ``get_lr()``, ``state_dict()``)
+for users porting DeepSpeed training scripts.
+"""
+
+import math
+
+import jax.numpy as jnp
+
+LR_SCHEDULE = "lr_schedule"
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+LR_RANGE_TEST_MIN_LR = "lr_range_test_min_lr"
+LR_RANGE_TEST_STEP_RATE = "lr_range_test_step_rate"
+LR_RANGE_TEST_STEP_SIZE = "lr_range_test_step_size"
+LR_RANGE_TEST_STAIRCASE = "lr_range_test_staircase"
+
+WARMUP_MIN_LR = "warmup_min_lr"
+WARMUP_MAX_LR = "warmup_max_lr"
+WARMUP_NUM_STEPS = "warmup_num_steps"
+WARMUP_TYPE = "warmup_type"
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+
+TOTAL_NUM_STEPS = "total_num_steps"
+
+
+class _ScheduleBase:
+    """Stateful wrapper over a pure ``lr(step)`` function.
+
+    ``optimizer`` is optional: when the engine owns the update, the schedule's
+    ``lr_fn`` is traced into the train step directly and this object only
+    mirrors state for logging/checkpointing.
+    """
+
+    def __init__(self, optimizer=None, last_batch_iteration=-1):
+        self.optimizer = optimizer
+        self.last_batch_iteration = last_batch_iteration
+
+    # -- pure function; subclasses implement with jnp so it is traceable ----
+    def lr_fn(self, step):
+        raise NotImplementedError
+
+    def get_lr(self):
+        step = max(0, self.last_batch_iteration)
+        return [float(self.lr_fn(step))]
+
+    def get_last_lr(self):
+        assert getattr(self, "_last_lr", None) is not None, "need to call step() first"
+        return self._last_lr
+
+    def step(self, last_batch_iteration=None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+        self._last_lr = self.get_lr()
+        if self.optimizer is not None and hasattr(self.optimizer, "set_lr"):
+            self.optimizer.set_lr(self._last_lr[0])
+        return self._last_lr
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+class LRRangeTest(_ScheduleBase):
+    """LR range-test sweep. Parity: reference ``lr_schedules.py:310``."""
+
+    def __init__(self, optimizer=None, lr_range_test_min_lr=1e-3,
+                 lr_range_test_step_size=2000, lr_range_test_step_rate=1.0,
+                 lr_range_test_staircase=False, last_batch_iteration=-1):
+        super().__init__(optimizer, last_batch_iteration)
+        if lr_range_test_step_size <= 0:
+            raise ValueError(f"Step size {lr_range_test_step_size} must be positive")
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+    def lr_fn(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.staircase:
+            interval = jnp.floor(step / self.step_size)
+        else:
+            interval = step / self.step_size
+        return self.min_lr * (1.0 + interval * self.step_rate)
+
+
+class OneCycle(_ScheduleBase):
+    """1-cycle policy (up-phase, down-phase, then decay).
+
+    Parity: reference ``lr_schedules.py:563`` (lr cycling + optional momentum
+    cycling; momentum exposed via :meth:`momentum_fn` for optimizers that use it).
+    """
+
+    def __init__(self, optimizer=None, cycle_min_lr=0.0, cycle_max_lr=1e-2,
+                 decay_lr_rate=0.0, cycle_first_step_size=2000,
+                 cycle_second_step_size=None, cycle_first_stair_count=0,
+                 cycle_second_stair_count=None, decay_step_size=0,
+                 cycle_momentum=True, cycle_min_mom=0.8, cycle_max_mom=0.9,
+                 decay_mom_rate=0.0, last_batch_iteration=-1):
+        super().__init__(optimizer, last_batch_iteration)
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first_size = cycle_first_step_size
+        self.second_size = (cycle_second_step_size
+                            if cycle_second_step_size is not None else cycle_first_step_size)
+        self.decay_step_size = decay_step_size
+        self.cycle_momentum = cycle_momentum
+        self.cycle_min_mom = cycle_min_mom
+        self.cycle_max_mom = cycle_max_mom
+        self.decay_mom_rate = decay_mom_rate
+        self.total_size = self.first_size + self.second_size
+
+    def lr_fn(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        up = jnp.clip(step / self.first_size, 0.0, 1.0)
+        down = jnp.clip((step - self.first_size) / self.second_size, 0.0, 1.0)
+        cycle_lr = self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * (up - down)
+        # decay phase after the cycle completes
+        decay_steps = jnp.maximum(step - self.total_size, 0.0)
+        if self.decay_step_size > 0:
+            decay_intervals = jnp.floor(decay_steps / self.decay_step_size)
+        else:
+            decay_intervals = decay_steps
+        decayed = self.cycle_min_lr / (1.0 + self.decay_lr_rate * decay_intervals)
+        return jnp.where(step <= self.total_size, cycle_lr, decayed)
+
+    def momentum_fn(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        up = jnp.clip(step / self.first_size, 0.0, 1.0)
+        down = jnp.clip((step - self.first_size) / self.second_size, 0.0, 1.0)
+        # momentum runs opposite to lr: high at the ends, low mid-cycle
+        cycle_mom = self.cycle_max_mom - (self.cycle_max_mom - self.cycle_min_mom) * (up - down)
+        decay_steps = jnp.maximum(step - self.total_size, 0.0)
+        if self.decay_step_size > 0:
+            decay_intervals = jnp.floor(decay_steps / self.decay_step_size)
+        else:
+            decay_intervals = decay_steps
+        decayed = self.cycle_max_mom * (1.0 + self.decay_mom_rate * decay_intervals)
+        return jnp.where(step <= self.total_size, cycle_mom, decayed)
+
+    def get_mom(self):
+        step = max(0, self.last_batch_iteration)
+        return [float(self.momentum_fn(step))]
+
+
+class WarmupLR(_ScheduleBase):
+    """Warmup from min to max lr, then hold. Parity: ``lr_schedules.py:685``."""
+
+    def __init__(self, optimizer=None, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                 warmup_num_steps=1000, warmup_type=WARMUP_LOG_RATE,
+                 last_batch_iteration=-1):
+        super().__init__(optimizer, last_batch_iteration)
+        self.min_lr = warmup_min_lr
+        self.max_lr = warmup_max_lr
+        self.warmup_num_steps = max(2, warmup_num_steps)
+        if warmup_type not in (WARMUP_LOG_RATE, WARMUP_LINEAR_RATE):
+            raise ValueError(f"warmup_type {warmup_type} must be 'log' or 'linear'")
+        self.warmup_type = warmup_type
+        self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
+
+    def _warmup_gamma(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.warmup_type == WARMUP_LOG_RATE:
+            # log warmup: gamma = log(step+1)/log(warmup_num_steps)
+            gamma = self.inverse_log_warm_up * jnp.log(step + 1.0)
+        else:
+            gamma = step / self.warmup_num_steps
+        return jnp.clip(gamma, 0.0, 1.0)
+
+    def lr_fn(self, step):
+        gamma = self._warmup_gamma(step)
+        return self.min_lr + (self.max_lr - self.min_lr) * gamma
+
+
+class WarmupDecayLR(WarmupLR):
+    """Warmup then linear decay to zero at total_num_steps.
+
+    Parity: ``lr_schedules.py:772``.
+    """
+
+    def __init__(self, optimizer=None, total_num_steps=10000, warmup_min_lr=0.0,
+                 warmup_max_lr=0.001, warmup_num_steps=1000,
+                 warmup_type=WARMUP_LOG_RATE, last_batch_iteration=-1):
+        self.total_num_steps = total_num_steps
+        super().__init__(optimizer, warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                         warmup_type, last_batch_iteration)
+        if self.total_num_steps < self.warmup_num_steps:
+            from ..utils.logging import logger
+            logger.warning(f"total_num_steps {total_num_steps} is less than "
+                           f"warmup_num_steps {warmup_num_steps}")
+
+    def lr_fn(self, step):
+        step_f = jnp.asarray(step, jnp.float32)
+        warm = super().lr_fn(step)
+        decay = jnp.clip(
+            (self.total_num_steps - step_f) /
+            max(1.0, self.total_num_steps - self.warmup_num_steps),
+            0.0, 1.0)
+        # decays to warmup_min_lr, not zero (reference lr = min_lr + delta*gamma)
+        decayed = self.min_lr + (self.max_lr - self.min_lr) * decay
+        return jnp.where(step_f < self.warmup_num_steps, warm, decayed)
+
+
+SCHEDULE_CLASSES = {
+    LR_RANGE_TEST: LRRangeTest,
+    ONE_CYCLE: OneCycle,
+    WARMUP_LR: WarmupLR,
+    WARMUP_DECAY_LR: WarmupDecayLR,
+}
+
+
+def get_lr_scheduler(name, params, optimizer=None):
+    """Instantiate a scheduler from the config's ``scheduler`` section."""
+    if name not in SCHEDULE_CLASSES:
+        raise ValueError(f"Unknown LR schedule {name!r}; valid: {VALID_LR_SCHEDULES}")
+    return SCHEDULE_CLASSES[name](optimizer=optimizer, **params)

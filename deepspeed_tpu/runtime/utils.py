@@ -1,0 +1,177 @@
+"""Runtime helpers: grad norms/clipping, partitioning math, memory reporting.
+
+Parity: reference ``deepspeed/runtime/utils.py`` (``clip_grad_norm_`` :328,
+``partition_uniform`` :576, ``partition_balanced`` :642, ``see_memory_usage``
+:818, ``DummyOptim`` :37).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class DummyOptim:
+    """Placeholder optimizer when the engine runs without one
+    (parity: reference ``runtime/utils.py:37``)."""
+
+    def __init__(self, params=None):
+        self.params = params
+
+    def init(self, params):
+        return ()
+
+    def update(self, grads, state, params, *, step, lr=None):
+        return params, state
+
+
+def global_norm(tree, ord=2):
+    """Global grad norm across a pytree (fp32 accumulation).
+
+    Parity: reference ``get_grad_norm_direct`` (``stage_1_and_2.py:1496``) /
+    ``clip_grad_norm_`` (``utils.py:328``).  Under SPMD the sum-of-squares over
+    sharded leaves is reduced by XLA automatically — no mpu allreduce needed.
+    """
+    leaves = [g for g in jax.tree_util.tree_leaves(tree) if g is not None]
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    if ord == 2:
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        return jnp.sqrt(sq)
+    if ord == float("inf"):
+        return jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    total = sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** ord) for g in leaves)
+    return total ** (1.0 / ord)
+
+
+def clip_by_global_norm(tree, max_norm, *, norm=None, eps=1e-6):
+    """torch.nn.utils.clip_grad_norm_ semantics (reference ``utils.py:328``):
+    scale = max_norm / (total_norm + eps), applied only when < 1."""
+    if norm is None:
+        norm = global_norm(tree)
+    clip_coef = max_norm / (norm + eps)
+    clip_coef = jnp.minimum(clip_coef, 1.0)
+    return jax.tree_util.tree_map(lambda g: g * clip_coef, tree), norm
+
+
+def get_global_norm(norm_list):
+    """Combine per-group norms (reference ``utils.py get_global_norm``)."""
+    total = sum(n ** 2.0 for n in norm_list)
+    return np.sqrt(total)
+
+
+def partition_uniform(num_items, num_parts):
+    """Split num_items into num_parts contiguous ranges, remainder spread left.
+
+    Returns ``parts`` of len num_parts+1 (prefix offsets).
+    Parity: reference ``utils.py:576``.
+    """
+    parts = [0] * (num_parts + 1)
+    chunksize = num_items // num_parts
+    residual = num_items - (chunksize * num_parts)
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunksize + (1 if p < residual else 0)
+    assert parts[-1] == num_items
+    return parts
+
+def prefix_sum_inc(weights):
+    """Inclusive prefix sum (reference ``utils.py prefix_sum_inc``)."""
+    out = list(weights)
+    for i in range(1, len(out)):
+        out[i] += out[i - 1]
+    return out
+
+
+def partition_balanced(weights, num_parts, eps=1e-3):
+    """Binary-search the bottleneck so contiguous parts have near-equal weight.
+
+    Parity: reference ``utils.py:642`` (used by PipelineModule
+    ``method='parameters'`` partitioning).
+    """
+    num_items = len(weights)
+    if num_items <= num_parts:
+        # degenerate: one item per part
+        return partition_uniform(num_items, num_parts)
+
+    prefix = [0] + prefix_sum_inc(weights)
+
+    def parts_for_bottleneck(bottleneck):
+        # greedy: pack while under bottleneck
+        parts = [0]
+        total = 0
+        for i, w in enumerate(weights):
+            if w > bottleneck:
+                return None
+            if total + w > bottleneck:
+                parts.append(i)
+                total = 0
+            total += w
+        parts.append(num_items)
+        return parts if len(parts) <= num_parts + 1 else None
+
+    lo, hi = max(weights), sum(weights)
+    while hi - lo > eps * max(1.0, lo):
+        mid = (lo + hi) / 2
+        if parts_for_bottleneck(mid) is not None:
+            hi = mid
+        else:
+            lo = mid
+    parts = parts_for_bottleneck(hi)
+    # pad to exactly num_parts ranges
+    while len(parts) < num_parts + 1:
+        parts.append(num_items)
+    return parts
+
+
+def see_memory_usage(message, force=False):
+    """Device + host memory report (parity: reference ``utils.py:818``)."""
+    if not force:
+        return
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        in_use = stats.get("bytes_in_use", 0) / 2**30
+        peak = stats.get("peak_bytes_in_use", 0) / 2**30
+        limit = stats.get("bytes_limit", 0) / 2**30
+        logger.info(f"{message} | device mem: in_use={in_use:.2f}GB "
+                    f"peak={peak:.2f}GB limit={limit:.2f}GB")
+    except Exception:
+        logger.info(f"{message} | device memory stats unavailable")
+    try:
+        import resource
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+        logger.info(f"{message} | host peak RSS {rss:.2f}GB")
+    except Exception:
+        pass
+
+
+def call_to_str(base, *args, **kwargs):
+    """Debug formatter (parity: reference ``utils.py call_to_str``)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(str(arg) for arg in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{key}={arg}" for key, arg in kwargs.items())
+    name += ")"
+    return name
+
+
+def tree_cast(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def tree_zeros_like(tree, dtype=None):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, dtype or x.dtype), tree)
+
+
+def tree_size_bytes(tree):
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree))
+
+
+def ensure_divisibility(numerator, denominator, msg=""):
+    assert numerator % denominator == 0, \
+        f"{msg}{numerator} is not divisible by {denominator}"

@@ -1,0 +1,142 @@
+"""ZeRO stages as sharding placement rules.
+
+TPU-native re-design of the reference's ZeRO machinery (SURVEY.md §7
+"sharding, not hooks"):
+
+- reference stage 1 (``zero/stage_1_and_2.py:92``: flattened fp16 groups +
+  per-rank fp32 partition) → optimizer state + fp32 master params sharded on
+  the ``fsdp`` mesh axis; compute params stay replicated.
+- reference stage 2 (bucketed reduce-scatter fired by grad hooks,
+  ``stage_1_and_2.py:777,1198``) → gradients constrained to the same fsdp
+  sharding BEFORE the optimizer update; XLA's SPMD partitioner then emits a
+  reduce-scatter instead of an all-reduce — the entire hook/bucket/stream
+  apparatus disappears into one sharding constraint.
+- reference stage 3 (``zero/stage3.py:228`` + ``partition_parameters.py:555``
+  ``zero.Init`` param interception + ``partitioned_param_coordinator.py``
+  fetch/prefetch/release state machine) → parameters themselves sharded on
+  ``fsdp`` everywhere; XLA all-gathers them per-use inside the step and frees
+  the gathered copies after use (prefetch/release ≈ XLA latency hiding +
+  scan-over-layers; ``param_persistence_threshold`` keeps small params
+  replicated exactly like the reference's persistence threshold).
+
+The sharding rule for a single array: shard the LARGEST axis divisible by the
+fsdp extent (falls back to replicated if none divides), composing with any
+tensor-parallel spec the model declares.
+"""
+
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shardable_axis(shape, extent: int, taken_axes=()) -> Optional[int]:
+    """Largest axis divisible by ``extent``, excluding axes already sharded."""
+    if extent <= 1 or not shape:
+        return None
+    best = None
+    for i, dim in enumerate(shape):
+        if i in taken_axes:
+            continue
+        if dim % extent == 0:
+            if best is None or dim > shape[best]:
+                best = i
+    return best
+
+
+def fsdp_spec(shape, fsdp_size: int, *, persistence_threshold: int = 0,
+              base_spec: Optional[P] = None) -> P:
+    """PartitionSpec sharding one array over the fsdp axis.
+
+    ``base_spec`` carries tensor-parallel axes already assigned by the model;
+    fsdp composes onto a remaining axis.  Arrays with fewer elements than
+    ``persistence_threshold`` stay replicated (parity: reference
+    ``param_persistence_threshold``, ``zero/config.py``).
+    """
+    base = tuple(base_spec) if base_spec is not None else ()
+    base = base + (None,) * (len(shape) - len(base))
+    if int(np.prod(shape or (1,))) < persistence_threshold:
+        return P(*base)
+    taken = tuple(i for i, s in enumerate(base) if s is not None)
+    axis = shardable_axis(shape, fsdp_size, taken_axes=taken)
+    if axis is None:
+        return P(*base)
+    new = list(base)
+    existing = new[axis]
+    if existing is None:
+        new[axis] = "fsdp"
+    elif isinstance(existing, str):
+        new[axis] = (existing, "fsdp")
+    else:
+        new[axis] = tuple(existing) + ("fsdp",)
+    return P(*new)
+
+
+def _spec_tree(params, fn):
+    return jax.tree_util.tree_map(lambda p: fn(np.shape(p)), params)
+
+
+def param_specs(params, stage: int, fsdp_size: int, *,
+                persistence_threshold: int = 0, tp_specs=None):
+    """Sharding specs for the COMPUTE parameters by ZeRO stage.
+
+    Stage 0/1/2: replicated (modulo tensor-parallel specs).
+    Stage 3:     fsdp-sharded (reference param partitioning).
+    """
+    def one(shape, base):
+        if stage >= 3:
+            return fsdp_spec(shape, fsdp_size, persistence_threshold=persistence_threshold,
+                             base_spec=base)
+        return base if base is not None else P()
+
+    if tp_specs is None:
+        return _spec_tree(params, lambda s: one(s, None))
+    return jax.tree_util.tree_map(lambda p, sp: one(np.shape(p), sp), params, tp_specs)
+
+
+def master_specs(params, stage: int, fsdp_size: int, *, tp_specs=None):
+    """Sharding specs for fp32 master params + optimizer moments.
+
+    Stage >= 1: fsdp-sharded (reference per-rank fp32 partition,
+    ``stage_1_and_2.py:228-270``).  Stage 0: replicated.
+    """
+    def one(shape, base):
+        if stage >= 1:
+            return fsdp_spec(shape, fsdp_size, base_spec=base)
+        return base if base is not None else P()
+
+    if tp_specs is None:
+        return _spec_tree(params, lambda s: one(s, None))
+    return jax.tree_util.tree_map(lambda p, sp: one(np.shape(p), sp), params, tp_specs)
+
+
+def grad_specs(params, stage: int, fsdp_size: int, *, tp_specs=None):
+    """Sharding constraint applied to gradients before the update.
+
+    Stage >= 2: fsdp-sharded → XLA emits reduce-scatter (reference stage-2
+    bucketed reduce-scatter).  Stage < 2: same placement as params → plain
+    all-reduce (reference allreduce_bucket).
+    """
+    if stage >= 2:
+        return master_specs(params, 1, fsdp_size, tp_specs=tp_specs)
+    return param_specs(params, min(stage, 2), fsdp_size, tp_specs=tp_specs)
+
+
+def to_named(specs, mesh: Mesh):
+    return jax.tree_util.tree_map(lambda sp: NamedSharding(mesh, sp), specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def constrain(tree, specs, mesh: Optional[Mesh] = None):
+    """with_sharding_constraint over a pytree of PartitionSpecs.
+
+    ``mesh`` is required unless a mesh context is already set (jax.set_mesh);
+    with it, specs are bound into NamedShardings.
+    """
+    if mesh is not None:
+        bind = lambda sp: NamedSharding(mesh, sp)
+    else:
+        bind = lambda sp: sp
+    return jax.tree_util.tree_map(
+        lambda x, sp: jax.lax.with_sharding_constraint(x, bind(sp)), tree, specs)

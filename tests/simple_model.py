@@ -1,0 +1,64 @@
+"""Tiny deterministic model fixtures.
+
+Parity model: reference ``tests/unit/simple_model.py`` (``SimpleModel`` :10,
+random dataloaders :217-251) — tiny models + synthetic data, trained a few
+steps with the assertion that loss decreases or matches a baseline run.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class SimpleModel:
+    """Two-layer MLP regression model; params are a plain dict pytree."""
+
+    def __init__(self, dim=8, hidden=32, nlayers=2):
+        self.dim = dim
+        self.hidden = hidden
+        self.nlayers = nlayers
+
+    def init(self, rng):
+        params = {}
+        sizes = [self.dim] + [self.hidden] * (self.nlayers - 1) + [self.dim]
+        for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+            k1, rng = jax.random.split(rng)
+            params[f"layer_{i}"] = {
+                "w": jax.random.normal(k1, (din, dout), jnp.float32) / np.sqrt(din),
+                "b": jnp.zeros((dout,), jnp.float32),
+            }
+        return params
+
+    def apply(self, params, x):
+        h = x
+        for i in range(self.nlayers):
+            p = params[f"layer_{i}"]
+            h = h @ p["w"].astype(h.dtype) + p["b"].astype(h.dtype)
+            if i < self.nlayers - 1:
+                h = jax.nn.relu(h)
+        return h
+
+    def loss(self, params, batch, rng):
+        x, y = batch
+        pred = self.apply(params, x)
+        return jnp.mean(jnp.square(pred.astype(jnp.float32) - y.astype(jnp.float32)))
+
+
+def random_dataset(n=256, dim=8, seed=0):
+    """Linear-teacher regression data (learnable, deterministic)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    w_true = rng.normal(size=(dim, dim)).astype(np.float32) * 0.5
+    y = (x @ w_true).astype(np.float32)
+    return (x, y)
+
+
+def base_config(micro=4, gas=1, world=8, **over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(over)
+    return cfg

@@ -1,0 +1,57 @@
+"""Dataloader tests (tiny datasets, padding, epoch shuffling)."""
+
+import numpy as np
+
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from simple_model import random_dataset
+
+
+def test_full_batches_and_padding():
+    data = random_dataset(n=20)
+    loader = DeepSpeedDataLoader(data, batch_size=8, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3            # 2 full + 1 padded
+    assert all(b[0].shape[0] == 8 for b in batches)
+
+
+def test_drop_last():
+    data = random_dataset(n=20)
+    loader = DeepSpeedDataLoader(data, batch_size=8, drop_last=True)
+    assert len(list(loader)) == 2
+
+
+def test_dataset_smaller_than_batch_cycles():
+    data = random_dataset(n=4)
+    for drop_last in (False, True):
+        loader = DeepSpeedDataLoader(data, batch_size=16, drop_last=drop_last)
+        batches = list(loader)
+        assert len(batches) == 1
+        assert batches[0][0].shape[0] == 16
+
+
+def test_shuffle_changes_per_epoch():
+    data = random_dataset(n=32)
+    loader = DeepSpeedDataLoader(data, batch_size=32, shuffle=True)
+    b1 = next(iter(loader))[0].copy()
+    loader.new_epoch()
+    b2 = next(iter(loader))[0].copy()
+    assert not np.array_equal(b1, b2)
+    # same content, different order
+    assert np.allclose(np.sort(b1.ravel()), np.sort(b2.ravel()))
+
+
+def test_repeating_loader_advances_epochs():
+    data = random_dataset(n=8)
+    loader = DeepSpeedDataLoader(data, batch_size=8)
+    rep = iter(RepeatingLoader(loader))
+    for _ in range(3):
+        next(rep)
+    assert loader.epoch == 2
+
+
+def test_dict_dataset():
+    data = {"x": np.ones((10, 3)), "y": np.zeros((10,))}
+    loader = DeepSpeedDataLoader(data, batch_size=5)
+    b = next(iter(loader))
+    assert set(b) == {"x", "y"}
+    assert b["x"].shape == (5, 3)

@@ -1,0 +1,196 @@
+"""End-to-end engine tests on the virtual 8-device mesh.
+
+Parity model: reference ``tests/unit/test_fp16.py`` / ``test_zero.py`` style —
+train a tiny model a few steps on random data; assert loss decreases, ZeRO
+stages loss-match stage 0, fp16 overflow skips steps, state roundtrips.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+from simple_model import SimpleModel, random_dataset, base_config
+
+
+def _train(config, mesh, steps=10, seed=0, data_seed=0):
+    model = SimpleModel()
+    data = random_dataset(n=256, seed=data_seed)
+    engine, _, _, _ = ds.initialize(config=config, model=model,
+                                    training_data=data, mesh=mesh, rng_seed=seed)
+    losses = [float(engine.train_batch()) for _ in range(steps)]
+    return engine, losses
+
+
+def test_loss_decreases(mesh8):
+    _, losses = _train(base_config(), mesh8, steps=15)
+    assert losses[-1] < losses[0] * 0.5, f"loss did not decrease: {losses}"
+
+
+def test_bf16_training(mesh8):
+    cfg = base_config(**{"bf16": {"enabled": True}})
+    engine, losses = _train(cfg, mesh8, steps=15)
+    assert engine.compute_dtype == jnp.bfloat16
+    assert engine.state.master is not None  # fp32 master kept
+    assert losses[-1] < losses[0] * 0.6, f"bf16 loss did not decrease: {losses}"
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_matches_stage0(mesh_2x4, stage):
+    """ZeRO stages must be loss-identical to plain DP (the reference's own
+    test oracle: ZeRO-2 vs baseline loss equality, SURVEY.md §4)."""
+    cfg0 = base_config()
+    cfgN = base_config(zero_optimization={"stage": stage})
+    _, base_losses = _train(cfg0, mesh_2x4, steps=8)
+    _, zero_losses = _train(cfgN, mesh_2x4, steps=8)
+    np.testing.assert_allclose(base_losses, zero_losses, rtol=2e-4,
+                               err_msg=f"stage {stage} diverged from stage 0")
+
+
+def test_zero3_param_sharding(mesh_fsdp8):
+    # persistence_threshold=0: the tiny fixture would otherwise stay replicated
+    # (the reference keeps params below the threshold resident too)
+    cfg = base_config(zero_optimization={"stage": 3,
+                                         "stage3_param_persistence_threshold": 0})
+    engine, losses = _train(cfg, mesh_fsdp8, steps=8)
+    # hidden layer weights should actually be sharded over fsdp
+    from jax.sharding import PartitionSpec as P
+    w = engine.state.params["layer_0"]["w"]
+    assert "fsdp" in str(w.sharding.spec), f"stage3 params not sharded: {w.sharding}"
+    assert losses[-1] < losses[0]
+
+
+def test_gas_equivalence(mesh8):
+    """micro=4,gas=2 must equal micro=8,gas=1 in loss trajectory (same global
+    batch; the reference enforces this invariant via batch math)."""
+    cfg_a = base_config(micro=2, gas=2)
+    cfg_b = base_config(micro=4, gas=1)
+    _, la = _train(cfg_a, mesh8, steps=6)
+    _, lb = _train(cfg_b, mesh8, steps=6)
+    # same samples consumed per optimizer step; trajectories should be close
+    # (not bit-identical: batch partitioning into microbatches differs)
+    assert abs(la[-1] - lb[-1]) < 0.1 * max(la[0], lb[0])
+
+
+def test_gradient_clipping_runs(mesh8):
+    cfg = base_config(gradient_clipping=0.1)
+    engine, losses = _train(cfg, mesh8, steps=5)
+    assert engine.get_global_grad_norm() is not None
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_static_overflow_skips(mesh8):
+    """Astronomic static loss scale → immediate inf grads → step skipped,
+    params unchanged (reference skip-step semantics engine.py:1819-1871)."""
+    cfg = base_config(fp16={"enabled": True, "loss_scale": 2.0 ** 120})
+    model = SimpleModel()
+    data = random_dataset()
+    engine, _, _, _ = ds.initialize(config=cfg, model=model, training_data=data,
+                                    mesh=mesh8)
+    p_before = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    engine.train_batch()
+    p_after = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    assert engine.skipped_steps == 1
+    assert engine.global_steps == 1
+    flat_b = jax.tree_util.tree_leaves(p_before)
+    flat_a = jax.tree_util.tree_leaves(p_after)
+    for b, a in zip(flat_b, flat_a):
+        np.testing.assert_array_equal(b, a)
+
+
+def test_fp16_dynamic_trains(mesh8):
+    cfg = base_config(fp16={"enabled": True, "initial_scale_power": 8})
+    engine, losses = _train(cfg, mesh8, steps=15)
+    assert engine.compute_dtype == jnp.float16
+    assert engine.loss_scale() >= 1.0
+    assert losses[-1] < losses[0] * 0.6
+
+
+def test_forward_backward_step_shim(mesh8):
+    """The reference's imperative API must still work."""
+    cfg = base_config(micro=4, gas=2)
+    model = SimpleModel()
+    data = random_dataset()
+    engine, _, loader, _ = ds.initialize(config=cfg, model=model,
+                                         training_data=data, mesh=mesh8)
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+    it = iter(RepeatingLoader(loader))
+    losses = []
+    for _ in range(3):  # 3 optimizer steps
+        for _ in range(engine.gradient_accumulation_steps()):
+            mb = next(it)
+            loss = engine.forward(mb)
+            engine.backward(loss)
+        assert engine.is_gradient_accumulation_boundary()
+        out = engine.step()
+        losses.append(float(out))
+    assert engine.global_steps == 3
+    assert losses[-1] < losses[0] * 2  # sanity: finite + training
+
+
+def test_checkpoint_roundtrip(mesh8, tmp_path):
+    cfg = base_config(**{"bf16": {"enabled": True},
+                         "scheduler": {"type": "WarmupLR",
+                                       "params": {"warmup_num_steps": 10,
+                                                  "warmup_max_lr": 1e-2}}})
+    model = SimpleModel()
+    data = random_dataset()
+    engine, _, _, _ = ds.initialize(config=cfg, model=model, training_data=data,
+                                    mesh=mesh8)
+    for _ in range(4):
+        engine.train_batch()
+    engine.save_checkpoint(str(tmp_path), client_state={"note": "hi"})
+    ref_params = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    ref_master = jax.tree_util.tree_map(np.asarray, engine.state.master)
+
+    engine2, _, _, _ = ds.initialize(config=cfg, model=model, training_data=data,
+                                     mesh=mesh8, rng_seed=123)
+    path, client = engine2.load_checkpoint(str(tmp_path))
+    assert client == {"note": "hi"}
+    assert engine2.global_steps == 4
+    for a, b in zip(jax.tree_util.tree_leaves(ref_params),
+                    jax.tree_util.tree_leaves(
+                        jax.tree_util.tree_map(np.asarray, engine2.state.params))):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_master),
+                    jax.tree_util.tree_leaves(
+                        jax.tree_util.tree_map(np.asarray, engine2.state.master))):
+        np.testing.assert_array_equal(a, b)
+    # training continues from the restored state
+    l = float(engine2.train_batch())
+    assert np.isfinite(l)
+    assert engine2.global_steps == 5
+
+
+def test_checkpoint_reshard_across_mesh(mesh_2x4, mesh_fsdp8, tmp_path):
+    """Save under one mesh, load under another (elastic checkpoint parity —
+    the reference needs zero_elastic_checkpoint; here resharding is free)."""
+    cfg = base_config(zero_optimization={"stage": 2})
+    model = SimpleModel()
+    data = random_dataset()
+    e1, _, _, _ = ds.initialize(config=cfg, model=model, training_data=data,
+                                mesh=mesh_2x4)
+    for _ in range(3):
+        e1.train_batch()
+    e1.save_checkpoint(str(tmp_path))
+    ref = jax.tree_util.tree_map(np.asarray, e1.state.params)
+
+    e2, _, _, _ = ds.initialize(config=cfg, model=model, training_data=data,
+                                mesh=mesh_fsdp8, rng_seed=9)
+    e2.load_checkpoint(str(tmp_path))
+    got = jax.tree_util.tree_map(np.asarray, e2.state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(ref), jax.tree_util.tree_leaves(got)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_optimizer_variants(mesh8):
+    for opt in ({"type": "AdamW", "params": {"lr": 1e-2, "weight_decay": 0.01}},
+                {"type": "Lamb", "params": {"lr": 1e-2}},
+                {"type": "SGD", "params": {"lr": 0.05, "momentum": 0.9}},
+                {"type": "Adagrad", "params": {"lr": 0.05}}):
+        cfg = base_config(optimizer=opt)
+        _, losses = _train(cfg, mesh8, steps=10)
+        assert losses[-1] < losses[0], f"{opt['type']} did not train: {losses}"

@@ -1,0 +1,94 @@
+"""Loss scaler semantics tests.
+
+Parity model: reference ``tests/unit/test_fp16.py`` loss-scale cases
+(dynamic growth after scale_window, halving on overflow, hysteresis, floor).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16 import loss_scaler as ls
+
+
+def _tick(state, overflow, **kw):
+    defaults = dict(dynamic=True, scale_factor=2.0, scale_window=5, min_scale=1.0,
+                    delayed_shift=1)
+    defaults.update(kw)
+    return ls.update_scale(state, overflow, **defaults)
+
+
+def test_static_never_changes():
+    st = ls.static_state(128.0)
+    for i in range(10):
+        st = ls.update_scale(st, i % 2 == 0, dynamic=False)
+    assert float(st.cur_scale) == 128.0
+
+
+def test_dynamic_halves_on_overflow():
+    st = ls.dynamic_state(initial_scale_power=4, delayed_shift=1)  # scale 16
+    st = _tick(st, True)
+    assert float(st.cur_scale) == 8.0
+    st = _tick(st, True)
+    assert float(st.cur_scale) == 4.0
+
+
+def test_dynamic_floor():
+    st = ls.dynamic_state(initial_scale_power=1, delayed_shift=1)  # scale 2
+    for _ in range(5):
+        st = _tick(st, True)
+    assert float(st.cur_scale) == 1.0  # min_scale floor
+
+
+def test_dynamic_grows_after_window():
+    st = ls.dynamic_state(initial_scale_power=4, delayed_shift=1)  # 16
+    for _ in range(5):
+        st = _tick(st, False)
+    assert float(st.cur_scale) == 32.0
+
+
+def test_hysteresis_tolerates_overflows():
+    st = ls.dynamic_state(initial_scale_power=4, delayed_shift=3)  # 16, 3 credits
+    st = _tick(st, True, delayed_shift=3)
+    assert float(st.cur_scale) == 16.0  # credit consumed, no shrink
+    st = _tick(st, True, delayed_shift=3)
+    assert float(st.cur_scale) == 16.0
+    st = _tick(st, True, delayed_shift=3)
+    assert float(st.cur_scale) == 8.0  # credits exhausted → shrink
+
+
+def test_has_overflow():
+    good = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2))}
+    assert not bool(ls.has_overflow(good))
+    bad = {"a": jnp.array([1.0, np.inf]), "b": jnp.zeros((2,))}
+    assert bool(ls.has_overflow(bad))
+    nan = {"a": jnp.array([np.nan])}
+    assert bool(ls.has_overflow(nan))
+
+
+def test_create_from_config():
+    class FP16:
+        dynamic_loss_scale = True
+        initial_scale_power = 8
+        loss_scale_window = 100
+        min_loss_scale = 2
+        hysteresis = 2
+        loss_scale = 0
+    s = ls.create_loss_scaler(FP16())
+    assert s.dynamic
+    assert s.loss_scale == 256.0
+
+    class FP16s(FP16):
+        dynamic_loss_scale = False
+        loss_scale = 64
+    s = ls.create_loss_scaler(FP16s())
+    assert not s.dynamic
+    assert s.loss_scale == 64.0
+
+
+def test_consecutive_hysteresis_replenishes_every_clean_iter():
+    # True → each clean iteration restores the full hysteresis budget
+    st = ls.dynamic_state(initial_scale_power=4, delayed_shift=2)
+    st = _tick(st, True, delayed_shift=2, consecutive_hysteresis=True)   # consume
+    st = _tick(st, False, delayed_shift=2, consecutive_hysteresis=True)  # replenish
+    st = _tick(st, True, delayed_shift=2, consecutive_hysteresis=True)   # consume again
+    assert float(st.cur_scale) == 16.0  # never shrank

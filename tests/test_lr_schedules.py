@@ -1,0 +1,90 @@
+"""LR schedule curve tests (pure math, parity with reference semantics)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, get_lr_scheduler)
+
+
+def test_warmup_lr_linear():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10,
+                 warmup_type="linear")
+    assert float(s.lr_fn(0)) == 0.0
+    assert abs(float(s.lr_fn(5)) - 0.5) < 1e-6
+    assert float(s.lr_fn(10)) == 1.0
+    assert float(s.lr_fn(100)) == 1.0  # holds at max
+
+
+def test_warmup_lr_log():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=100,
+                 warmup_type="log")
+    # log warmup: gamma = log(step+1)/log(warmup_num_steps)
+    assert abs(float(s.lr_fn(99)) - 1.0) < 0.01
+    mid = float(s.lr_fn(9))  # log(10)/log(100) = 0.5
+    assert abs(mid - 0.5) < 1e-5
+
+
+def test_warmup_decay():
+    s = WarmupDecayLR(total_num_steps=100, warmup_min_lr=0.0, warmup_max_lr=1.0,
+                      warmup_num_steps=10, warmup_type="linear")
+    assert abs(float(s.lr_fn(5)) - 0.5) < 1e-6
+    assert abs(float(s.lr_fn(10)) - 1.0) < 1e-6
+    assert abs(float(s.lr_fn(55)) - 0.5) < 1e-6  # halfway through decay
+    assert float(s.lr_fn(100)) == 0.0
+    assert float(s.lr_fn(200)) == 0.0  # clamped
+
+
+def test_lr_range_test():
+    s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0)
+    assert abs(float(s.lr_fn(0)) - 0.01) < 1e-8
+    assert abs(float(s.lr_fn(10)) - 0.02) < 1e-8  # 0.01*(1+1)
+    stair = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert float(stair.lr_fn(9)) == pytest.approx(0.01)
+    assert float(stair.lr_fn(10)) == pytest.approx(0.02)
+
+
+def test_one_cycle():
+    s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0, cycle_first_step_size=10)
+    assert float(s.lr_fn(0)) == pytest.approx(0.1)
+    assert float(s.lr_fn(10)) == pytest.approx(1.0)  # peak
+    assert float(s.lr_fn(20)) == pytest.approx(0.1)  # back down
+    # momentum runs inverted
+    assert float(s.momentum_fn(0)) == pytest.approx(0.9)
+    assert float(s.momentum_fn(10)) == pytest.approx(0.8)
+
+
+def test_stateful_api():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=4,
+                 warmup_type="linear")
+    lrs = [s.step()[0] for _ in range(6)]
+    assert lrs[0] == 0.0
+    assert lrs[-1] == 1.0
+    sd = s.state_dict()
+    s2 = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=4,
+                  warmup_type="linear")
+    s2.load_state_dict(sd)
+    assert s2.last_batch_iteration == s.last_batch_iteration
+
+
+def test_factory():
+    s = get_lr_scheduler("WarmupLR", {"warmup_num_steps": 5})
+    assert isinstance(s, WarmupLR)
+    with pytest.raises(ValueError):
+        get_lr_scheduler("Bogus", {})
+
+
+def test_warmup_type_validation():
+    with pytest.raises(ValueError):
+        WarmupLR(warmup_type="exp")
+
+
+def test_warmup_decay_respects_min_lr_floor():
+    s = WarmupDecayLR(total_num_steps=100, warmup_min_lr=1e-5, warmup_max_lr=1e-3,
+                      warmup_num_steps=10, warmup_type="linear")
+    assert float(s.lr_fn(100)) == pytest.approx(1e-5)
+    assert float(s.lr_fn(500)) == pytest.approx(1e-5)  # clamped at the floor
