@@ -1,0 +1,95 @@
+"""Benchmark: GPT-2 125M training throughput + MFU on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+North star (BASELINE.md): samples/sec/chip + MFU for GPT-2 at ZeRO stages;
+``vs_baseline`` is measured MFU / 0.45 (the ≥45% MFU target; the reference's
+best published kernel efficiency is 52% of V100 peak on BERT-large,
+``docs/_posts/2020-05-19-bert-record.md:14``).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip():
+    """bf16 peak per chip by TPU generation (fallback: v5e)."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+        "v4": 275e12, "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build
+
+    seq = 512
+    micro = 8
+    steps = 20
+    warmup = 3
+
+    model = build("gpt2-125m", dtype=jnp.bfloat16, max_seq=seq,
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1},
+    }
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, model.config.vocab_size,
+                          size=(4096, seq + 1)).astype(np.int32)
+    engine, _, _, _ = ds.initialize(config=config, model=model,
+                                    training_data=(tokens,))
+
+    # NOTE: synchronize via a scalar device→host read. On some remote-attached
+    # runtimes block_until_ready returns before execution completes; a value
+    # read cannot lie.
+    for _ in range(warmup):
+        loss = engine.train_batch()
+    float(loss)
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch()
+    final_loss = float(loss)
+    dt = time.time() - t0
+
+    n_chips = jax.device_count()
+    # each train_batch consumes the GLOBAL batch (micro × dp_world), not micro
+    samples_per_sec = steps * engine.train_batch_size() / dt
+    tokens_per_sec = samples_per_sec * seq
+    # flops_per_token already counts fwd+bwd (6N + attention with backward)
+    model_flops = model.flops_per_token() * tokens_per_sec
+    mfu = model_flops / (peak_flops_per_chip() * n_chips)
+
+    print(json.dumps({
+        "metric": "gpt2_125m_seq512_bf16_zero1_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "samples_per_sec_per_chip": round(samples_per_sec / n_chips, 2),
+            "tokens_per_sec": round(tokens_per_sec, 0),
+            "final_loss": round(final_loss, 4),
+            "chips": n_chips,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,20 @@
+"""Model zoo: GPT-2 family (flagship), BERT encoder, MoE GPT."""
+
+from .gpt2 import GPT2, GPT2Config, PRESETS as GPT2_PRESETS
+
+
+def build(name, **overrides):
+    """Model factory by preset name."""
+    try:
+        if name.startswith("gpt2-moe"):
+            from .gpt2_moe import GPT2MoE
+            return GPT2MoE(preset=name, **overrides)
+        if name in GPT2_PRESETS:
+            return GPT2(preset=name, **overrides)
+        if name.startswith("bert"):
+            from .bert import Bert
+            return Bert(preset=name, **overrides)
+    except ImportError as e:
+        raise ValueError(f"Model family for {name!r} is not available: {e}") from e
+    raise ValueError(f"Unknown model preset {name!r}; GPT-2 presets: "
+                     f"{sorted(GPT2_PRESETS)}")

@@ -1,0 +1,55 @@
+"""Op registry.
+
+TPU equivalent of the reference's ``op_builder/`` JIT-compile matrix
+(``op_builder/builder.py:107 OpBuilder``): instead of compiling CUDA at import
+time, ops register an implementation per backend with an ``is_compatible``
+probe; ``report()`` mirrors ``ds_report`` (``deepspeed/env_report.py:24``).
+"""
+
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def backend():
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+@functools.lru_cache(maxsize=None)
+def flash_attention_available():
+    """Pallas flash attention runs on TPU; elsewhere the jnp path is used."""
+    try:
+        # must match the import path the model uses at call time
+        from .transformer.flash_attention import flash_attention  # noqa: F401
+        return backend() == "tpu"
+    except Exception:
+        return False
+
+
+OP_REGISTRY = {}
+
+
+def register_op(name, compatible_backends=("tpu", "cpu")):
+    def deco(fn):
+        OP_REGISTRY[name] = {"fn": fn, "backends": tuple(compatible_backends)}
+        return fn
+    return deco
+
+
+def is_compatible(name):
+    entry = OP_REGISTRY.get(name)
+    return entry is not None and backend() in entry["backends"]
+
+
+def report():
+    """ds_report equivalent: op → (registered, compatible-with-this-backend)."""
+    lines = [f"backend: {backend()}"]
+    for name, entry in sorted(OP_REGISTRY.items()):
+        lines.append(f"op {name}: registered=True "
+                     f"compatible={backend() in entry['backends']}")
+    lines.append(f"flash_attention: available={flash_attention_available()}")
+    return "\n".join(lines)
