@@ -185,10 +185,18 @@ class GPT2:
     def _attend(self, q, k, v, causal_mask, rng, deterministic):
         c = self.config
         impl = c.attention_impl
+        wants_dropout = c.attn_pdrop > 0.0 and not deterministic
         if impl == "auto":
             from ..ops import flash_attention_available
-            impl = "flash" if flash_attention_available() else "jnp"
+            # the pallas kernel has no in-kernel dropout yet; fall back to the
+            # jnp path when attention dropout is active
+            impl = ("flash" if flash_attention_available() and not wants_dropout
+                    else "jnp")
         if impl == "flash":
+            if wants_dropout:
+                from ..utils.logging import warning_once
+                warning_once("attention_impl='flash' has no in-kernel dropout; "
+                             "attn_pdrop is ignored on this path")
             from ..ops.transformer.flash_attention import flash_attention
             return flash_attention(q, k, v, causal=True)
         return _attention_jnp(q, k, v, causal_mask, c.attn_pdrop, rng, deterministic)

@@ -1,0 +1,88 @@
+"""Groupwise quantization ops (symmetric/asymmetric, nearest/stochastic).
+
+Parity: reference ``csrc/quantization/quantizer.cu`` bindings
+(``pt_binding.cpp:62-76``: ``ds_quantize_fp16``, ``ds_sr_quantize_fp16``,
+``ds_quantize_asym_fp16``, ``ds_sr_quantize_asym_fp16``) and the thin wrapper
+``ops/quantizer/quantizer.py``.
+
+Design note: these are bandwidth-bound elementwise ops; under jit XLA fuses
+the scale computation, rounding, and cast into one pass over the data, so a
+hand-written kernel buys nothing here — the CUDA kernels exist in the
+reference because eager torch could not fuse.  Stochastic rounding uses
+``jax.random`` bits (on TPU the hardware PRNG backs this).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _group_reshape(x, groups):
+    n = x.size
+    assert n % groups == 0, f"size {n} not divisible by groups {groups}"
+    return x.reshape(groups, n // groups)
+
+
+def quantize(x, groups=1, bits=8, symmetric=True, stochastic=False, rng=None):
+    """Groupwise quantize to int: returns ``(q, scale, zero_point)``.
+
+    - symmetric: q = round(x/scale), scale = absmax / qmax
+    - asymmetric: q = round((x-min)/scale) - qmax-ish offset, scale=(max-min)/range
+    Stochastic rounding adds uniform noise in [-0.5, 0.5) before rounding
+    (parity: ``ds_sr_quantize*``; unbiased, used by MoQ training).
+    """
+    orig_shape = x.shape
+    xg = _group_reshape(x.astype(jnp.float32), groups)
+    qmax = 2.0 ** (bits - 1) - 1
+
+    if symmetric:
+        absmax = jnp.max(jnp.abs(xg), axis=1, keepdims=True)
+        scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+        zero = jnp.zeros_like(scale)
+        scaled = xg / scale
+    else:
+        lo = jnp.min(xg, axis=1, keepdims=True)
+        hi = jnp.max(xg, axis=1, keepdims=True)
+        rng_span = jnp.where(hi == lo, 1.0, hi - lo)
+        scale = rng_span / (2.0 * qmax)
+        zero = lo + scale * qmax  # midpoint maps to 0
+        scaled = (xg - zero) / scale
+
+    if stochastic:
+        assert rng is not None, "stochastic rounding needs an rng key"
+        noise = jax.random.uniform(rng, scaled.shape, jnp.float32, -0.5, 0.5)
+        q = jnp.floor(scaled + 0.5 + noise)
+    else:
+        q = jnp.round(scaled)
+    q = jnp.clip(q, -qmax - 1, qmax)
+    dtype = jnp.int8 if bits <= 8 else jnp.int32
+    return q.astype(dtype).reshape(orig_shape), scale[:, 0], zero[:, 0]
+
+
+def dequantize(q, scale, zero=None, groups=None):
+    """Inverse of :func:`quantize`."""
+    orig_shape = q.shape
+    groups = groups if groups is not None else scale.shape[0]
+    qg = _group_reshape(q.astype(jnp.float32), groups)
+    x = qg * scale[:, None]
+    if zero is not None:
+        x = x + zero[:, None]
+    return x.reshape(orig_shape)
+
+
+class Quantizer:
+    """Stateful facade matching the reference wrapper (``ops/quantizer``)."""
+
+    def __init__(self, q_groups=1, q_bits=8, q_type="symmetric",
+                 q_rounding="nearest"):
+        self.q_groups = q_groups
+        self.q_bits = q_bits
+        self.symmetric = q_type == "symmetric"
+        self.stochastic = q_rounding == "stochastic"
+
+    def quantize(self, x, rng=None, bits=None):
+        return quantize(x, groups=self.q_groups, bits=bits or self.q_bits,
+                        symmetric=self.symmetric, stochastic=self.stochastic,
+                        rng=rng)
+
+    def dequantize(self, q, scale, zero=None):
+        return dequantize(q, scale, zero, groups=self.q_groups)

@@ -1,0 +1,77 @@
+"""SparseSelfAttention module.
+
+Parity: reference ``ops/sparse_attention/sparse_self_attention.py:13`` — an
+attention layer that consumes a :class:`SparsityConfig` and computes
+block-sparse softmax(QKᵀ)V.  The reference dispatches to Triton SDD/DSD/DDS
+matmuls + block-sparse softmax; here the layout gates blocks of the pallas
+flash kernel directly (``sparse_flash_attention``), skipping both the compute
+and the HBM traffic of disallowed blocks.
+"""
+
+import functools
+
+import numpy as np
+import jax.numpy as jnp
+
+from .sparsity_config import SparsityConfig, FixedSparsityConfig
+from ..transformer.flash_attention import (sparse_flash_attention,
+                                           sparse_attention_reference)
+
+
+class SparseSelfAttention:
+    """Callable attention op bound to one sparsity layout.
+
+    Usage: ``attn = SparseSelfAttention(config); out = attn(q, k, v)`` with
+    q/k/v shaped (B, T, H, d) — same layout as :func:`flash_attention`.
+    """
+
+    def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
+                 attn_mask_mode="mul", max_seq_length=2048):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self.max_seq_length = max_seq_length
+        self._layout_cache = {}
+
+    def get_layout(self, seq_len):
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = np.asarray(
+                self.sparsity_config.make_layout(seq_len), np.int32)
+        return self._layout_cache[seq_len]
+
+    def density(self, seq_len):
+        layout = self.get_layout(seq_len)
+        return float(layout.sum()) / layout[0].size / layout.shape[0]
+
+    def __call__(self, query, key, value, *, causal=None, sm_scale=None):
+        B, T, H, d = query.shape
+        causal = (self.sparsity_config.attention == "unidirectional"
+                  if causal is None and
+                  hasattr(self.sparsity_config, "attention") else bool(causal))
+        layout = jnp.asarray(self.get_layout(T))
+        return sparse_flash_attention(query, key, value, layout, causal=causal,
+                                      sm_scale=sm_scale)
+
+
+class BertSparseSelfAttention:
+    """BERT-shaped wrapper (parity: reference ``bert_sparse_self_attention.py:78``):
+    takes hidden states + projection params, returns the attention context."""
+
+    def __init__(self, num_attention_heads, hidden_size, sparsity_config=None):
+        assert hidden_size % num_attention_heads == 0
+        self.num_heads = num_attention_heads
+        self.hidden_size = hidden_size
+        self.head_dim = hidden_size // num_attention_heads
+        self.attn = SparseSelfAttention(
+            sparsity_config or FixedSparsityConfig(num_heads=num_attention_heads))
+
+    def __call__(self, hidden, params):
+        """params: {'q_w','q_b','k_w','k_b','v_w','v_b'} projection pytree."""
+        B, T, D = hidden.shape
+        proj = lambda w, b: (hidden @ w + b).reshape(B, T, self.num_heads,
+                                                     self.head_dim)
+        q = proj(params["q_w"], params["q_b"])
+        k = proj(params["k_w"], params["k_b"])
+        v = proj(params["v_w"], params["v_b"])
+        ctx = self.attn(q, k, v, causal=False)
+        return ctx.reshape(B, T, D)

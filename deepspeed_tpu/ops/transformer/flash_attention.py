@@ -1,0 +1,479 @@
+"""Flash attention — Pallas TPU kernel with custom VJP.
+
+Role parity: the reference's fused transformer attention kernels
+(``csrc/transformer/softmax_kernels.cu``, attention score path of
+``ds_transformer_cuda.cpp``) fuse QK^T → masked softmax → AV to avoid
+materializing the (T, T) score matrix.  On TPU this is the classic
+flash-attention online-softmax kernel: the score matrix never leaves VMEM,
+with fp32 running max/denominator and bf16 MXU matmuls.
+
+Layout: inputs (B, T, H, d) (the model's layout) are processed on a grid
+(B*H, q_blocks, k_blocks); the innermost k dimension revisits VMEM scratch
+carrying the online-softmax state (m, l, acc).  The backward pass recomputes
+probabilities from the saved logsumexp (no (T,T) residuals), with one kernel
+for dK/dV (grid over k blocks) and one for dQ (grid over q blocks).
+
+Runs compiled on TPU; ``interpret=True`` under other backends so numerics
+tests run on the CPU mesh (SURVEY.md §4: every kernel is tested against a
+pure-jnp reference).
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+# =============================================================== forward kernel
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
+                seq_len, use_layout=False):
+    """Grid: (BH, nq, nk) with nk innermost (revisits scratch).
+
+    With ``use_layout`` a block-layout ref (SMEM scalar per (head, qi, ki))
+    gates whole blocks — this is the block-sparse attention path (reference
+    ``ops/sparse_attention/matmul.py`` SDD/DSD/DDS Triton kernels; here the
+    same flash kernel simply skips disallowed blocks)."""
+    if use_layout:
+        layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        layout_ref = None
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: process only k blocks that intersect the lower triangle
+    should_compute = True
+    if causal:
+        should_compute = ki * block_k <= qi * block_q + (block_q - 1)
+    if layout_ref is not None:
+        should_compute = jnp.logical_and(should_compute, layout_ref[0, 0, 0] > 0)
+
+    @pl.when(should_compute)
+    def _():
+        q = q_ref[0]          # (block_q, d)
+        k = k_ref[0]          # (block_k, d)
+        v = v_ref[0]          # (block_k, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < seq_len               # mask padded key rows
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:]                     # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                # (bq, bk) fp32
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+
+
+def _pad_t(x, Tp):
+    T = x.shape[1]
+    if T == Tp:
+        return x
+    return jnp.pad(x, ((0, 0), (0, Tp - T), (0, 0)))
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
+         n_heads=None):
+    """q,k,v: (BH, T, d) → (out (BH, T, d), lse (BH, T)).
+
+    ``layout``: optional (n_heads, nq, nk) int32 block mask (block-sparse)."""
+    BH, T, d = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    # pallas clamps out-of-range blocks (dynamic-slice semantics), which would
+    # silently shift uneven tails — pad to block multiples and mask in-kernel.
+    Tp = int(np.ceil(T / max(block_q, block_k)) * max(block_q, block_k))
+    q, k, v = _pad_t(q, Tp), _pad_t(k, Tp), _pad_t(v, Tp)
+    nq = pl.cdiv(Tp, block_q)
+    nk = pl.cdiv(Tp, block_k)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = (q, k, v)
+    if layout is not None:
+        in_specs = [pl.BlockSpec(
+            (1, 1, 1), lambda b, i, j: (b % n_heads, i, j),
+            memory_space=pltpu.SMEM)] + in_specs
+        args = (layout,) + args
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          seq_len=T, use_layout=layout is not None),
+        grid=(BH, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tp, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return out[:, :T], lse[:, :T]
+
+
+# ============================================================== backward kernels
+def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
+                     seq_len, use_layout=False):
+    """Grid: (BH, nk, nq) with nq innermost; accumulates dK/dV for one k block."""
+    if use_layout:
+        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        layout_ref = None
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    should_compute = True
+    if causal:
+        should_compute = qi * block_q + (block_q - 1) >= ki * block_k
+    if layout_ref is not None:
+        should_compute = jnp.logical_and(should_compute, layout_ref[0, 0, 0] > 0)
+
+    @pl.when(should_compute)
+    def _():
+        q = q_ref[0]            # (bq, d)
+        k = k_ref[0]            # (bk, d)
+        v = v_ref[0]
+        do = do_ref[0]          # (bq, d)
+        lse = lse_ref[0][:, None]        # (bq, 1)
+        delta = delta_ref[0][:, None]    # (bq, 1)
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = jnp.logical_and(q_pos < seq_len, k_pos < seq_len)
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)                      # (bq, bk) fp32
+        # dV += P^T dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dP = dO V^T ; dS = P * (dP - delta)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dK += dS^T Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
+                   seq_len, use_layout=False):
+    """Grid: (BH, nq, nk) with nk innermost; accumulates dQ for one q block."""
+    if use_layout:
+        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         dq_acc) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
+        layout_ref = None
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    should_compute = True
+    if causal:
+        should_compute = ki * block_k <= qi * block_q + (block_q - 1)
+    if layout_ref is not None:
+        should_compute = jnp.logical_and(should_compute, layout_ref[0, 0, 0] > 0)
+
+    @pl.when(should_compute)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = jnp.logical_and(q_pos < seq_len, k_pos < seq_len)
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
+         n_heads=None):
+    q, k, v, out, lse = residuals
+    BH, T, d = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    Tp = int(np.ceil(T / max(block_q, block_k)) * max(block_q, block_k))
+    nq = pl.cdiv(Tp, block_q)
+    nk = pl.cdiv(Tp, block_k)
+
+    # delta_i = rowsum(dO * O) — cheap, fused by XLA
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if Tp != T:
+        pad2 = lambda x: jnp.pad(x, ((0, 0), (0, Tp - T)))
+        q, k, v, dout = (_pad_t(a, Tp) for a in (q, k, v, dout))
+        lse, delta = pad2(lse), pad2(delta)
+
+    dkdv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # q
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # k
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # v
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # do
+        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),        # lse
+        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),        # delta
+    ]
+    dkdv_args = (q, k, v, dout, lse, delta)
+    if layout is not None:
+        dkdv_specs = [pl.BlockSpec(
+            (1, 1, 1), lambda b, j, i: (b % n_heads, i, j),
+            memory_space=pltpu.SMEM)] + dkdv_specs
+        dkdv_args = (layout,) + dkdv_args
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          seq_len=T, use_layout=layout is not None),
+        grid=(BH, nk, nq),
+        in_specs=dkdv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tp, d), k.dtype),
+            jax.ShapeDtypeStruct((BH, Tp, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*dkdv_args)
+
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+    ]
+    dq_args = (q, k, v, dout, lse, delta)
+    if layout is not None:
+        dq_specs = [pl.BlockSpec(
+            (1, 1, 1), lambda b, i, j: (b % n_heads, i, j),
+            memory_space=pltpu.SMEM)] + dq_specs
+        dq_args = (layout,) + dq_args
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          seq_len=T, use_layout=layout is not None),
+        grid=(BH, nq, nk),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*dq_args)
+
+    return dq[:, :T], dk[:, :T], dv[:, :T]
+
+
+# ================================================================== public API
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhtd(q, k, v, sm_scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, residuals, dout):
+    return _bwd(sm_scale, causal, block_q, block_k, residuals, dout)
+
+
+_flash_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal=True, sm_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention over (B, T, H, d) tensors (the model layout).
+
+    Returns (B, T, H, d).  fp32 softmax statistics, input-dtype matmuls.
+    """
+    B, T, H, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    # (B, T, H, d) → (B*H, T, d)
+    to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v),
+                      float(sm_scale), bool(causal), int(block_q), int(block_k))
+    return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _sparse_bhtd(q, k, v, layout, sm_scale, causal, block_q, block_k, n_heads):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=layout,
+                  n_heads=n_heads)
+    return out
+
+
+def _sparse_fwd_rule(q, k, v, layout, sm_scale, causal, block_q, block_k, n_heads):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=layout,
+                    n_heads=n_heads)
+    return out, (q, k, v, out, lse, layout)
+
+
+def _sparse_bwd_rule(sm_scale, causal, block_q, block_k, n_heads, residuals, dout):
+    q, k, v, out, lse, layout = residuals
+    dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k, (q, k, v, out, lse),
+                      dout, layout=layout, n_heads=n_heads)
+    return dq, dk, dv, None
+
+
+_sparse_bhtd.defvjp(_sparse_fwd_rule, _sparse_bwd_rule)
+
+
+def sparse_flash_attention(q, k, v, layout, *, causal=True, sm_scale=None,
+                           block_q=None, block_k=None):
+    """Block-sparse flash attention over (B, T, H, d).
+
+    ``layout``: (n_heads_or_1, nq, nk) int block mask from a SparsityConfig
+    (reference ``ops/sparse_attention/sparsity_config.py`` hierarchy).  The
+    block size is implied: block_q = T // nq, block_k = T // nk.  Disallowed
+    blocks are skipped entirely (compute AND memory), which is where the
+    reference's 6.3× sparse speedup comes from (README.md:39).
+    """
+    B, T, H, d = q.shape
+    Lh, nq, nk = layout.shape
+    if block_q is None:
+        block_q = T // nq
+    if block_k is None:
+        block_k = T // nk
+    assert block_q * nq == T and block_k * nk == T, \
+        f"layout {layout.shape} incompatible with T={T}"
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    if Lh == 1 and H > 1:
+        layout = jnp.broadcast_to(layout, (H, nq, nk))
+    layout = jnp.asarray(layout, jnp.int32)
+    to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    out = _sparse_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), layout,
+                       float(sm_scale), bool(causal), int(block_q),
+                       int(block_k), int(H))
+    return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
+def attention_reference(q, k, v, *, causal=True, sm_scale=None):
+    """Pure-jnp oracle for numerics tests."""
+    B, T, H, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+def sparse_attention_reference(q, k, v, layout, *, causal=True, sm_scale=None):
+    """Dense oracle: expand the block layout to an element mask."""
+    B, T, H, d = q.shape
+    Lh, nq, nk = layout.shape
+    bq, bk = T // nq, T // nk
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    mask = jnp.kron(jnp.asarray(layout, jnp.float32),
+                    jnp.ones((bq, bk), jnp.float32)) > 0  # (Lh, T, T)
+    if Lh == 1 and H > 1:
+        mask = jnp.broadcast_to(mask, (H, T, T))
+    if causal:
+        mask = jnp.logical_and(mask, jnp.tril(jnp.ones((T, T), bool))[None])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    s = jnp.where(mask[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)

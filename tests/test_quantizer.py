@@ -1,0 +1,63 @@
+"""Quantizer numerics. Parity model: reference ``tests/unit/test_quantize.py``
+style — roundtrip error bounds, stochastic rounding unbiasedness."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops.quantizer.quantizer import quantize, dequantize, Quantizer
+
+
+def test_symmetric_roundtrip_error():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 256), jnp.float32)
+    q, scale, zero = quantize(x, groups=4, bits=8, symmetric=True)
+    assert q.dtype == jnp.int8
+    back = dequantize(q, scale, groups=4)
+    # int8 symmetric: error bounded by scale/2 per element
+    max_scale = float(scale.max())
+    assert float(jnp.max(jnp.abs(back - x))) <= max_scale * 0.5 + 1e-6
+
+
+def test_asymmetric_handles_shifted_data():
+    x = jax.random.uniform(jax.random.PRNGKey(1), (2, 128), jnp.float32,
+                           5.0, 9.0)  # all-positive, far from zero
+    qs, ss, _ = quantize(x, groups=2, symmetric=True)
+    qa, sa, za = quantize(x, groups=2, symmetric=False)
+    err_sym = float(jnp.max(jnp.abs(dequantize(qs, ss, groups=2) - x)))
+    err_asym = float(jnp.max(jnp.abs(dequantize(qa, sa, za, groups=2) - x)))
+    assert err_asym < err_sym  # asymmetric wins on shifted data
+
+
+def test_stochastic_rounding_unbiased():
+    x = jnp.full((1, 1024), 0.3, jnp.float32)
+    q, scale, _ = quantize(x, groups=1, bits=8, symmetric=True)  # scale ~0.3/127
+    vals = []
+    for i in range(16):
+        qs, ss, _ = quantize(x, groups=1, bits=8, symmetric=True,
+                             stochastic=True, rng=jax.random.PRNGKey(i))
+        vals.append(float(dequantize(qs, ss, groups=1).mean()))
+    # mean over many stochastic draws approaches the true value
+    assert abs(np.mean(vals) - 0.3) < 0.005
+
+
+def test_quantizer_facade_and_bits():
+    x = jax.random.normal(jax.random.PRNGKey(2), (256,), jnp.float32)
+    qz = Quantizer(q_groups=2, q_bits=4)
+    q, scale, zero = qz.quantize(x)
+    assert int(q.max()) <= 7 and int(q.min()) >= -8  # 4-bit range
+    back = qz.dequantize(q, scale)
+    assert float(jnp.max(jnp.abs(back - x))) <= float(scale.max()) * 0.5 + 1e-6
+
+
+def test_zero_input():
+    x = jnp.zeros((64,), jnp.float32)
+    q, scale, _ = quantize(x, groups=1)
+    np.testing.assert_array_equal(np.asarray(q), 0)
+    back = dequantize(q, scale, groups=1)
+    np.testing.assert_array_equal(np.asarray(back), 0.0)
+
+
+def test_indivisible_groups_raises():
+    with pytest.raises(AssertionError):
+        quantize(jnp.ones((10,)), groups=3)

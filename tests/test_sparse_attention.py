@@ -1,0 +1,163 @@
+"""Block-sparse attention tests: layout math + kernel vs dense reference.
+
+Parity model: reference ``tests/unit/test_sparse_attention.py`` (kernel vs
+dense reference) and the SparsityConfig semantics.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    DenseSparsityConfig, FixedSparsityConfig, VariableSparsityConfig,
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, build_sparsity_config)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention)
+from deepspeed_tpu.ops.transformer.flash_attention import (
+    sparse_flash_attention, sparse_attention_reference, attention_reference)
+
+
+# ----------------------------------------------------------- layout semantics
+def test_dense_layout_all_ones():
+    cfg = DenseSparsityConfig(num_heads=2, block=16)
+    layout = cfg.make_layout(64)
+    assert layout.shape == (1, 4, 4)
+    assert layout.sum() == 16
+
+
+def test_fixed_layout_local_window():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(128)  # 8 blocks
+    # block 0 and 1 are in the same window → attend each other
+    assert layout[0, 0, 1] == 1 and layout[0, 1, 0] == 1
+    # global column (last of each window) reaches everyone
+    assert layout[0, 6, 1] == 1  # col 1 = global of first window
+    # non-global, non-local pair is blocked
+    assert layout[0, 0, 2] == 0
+
+
+def test_fixed_unidirectional_is_lower_triangular_local():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = cfg.make_layout(128)
+    assert np.all(np.triu(layout[0], 1) == 0)
+
+
+def test_fixed_validation():
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(num_heads=1, num_local_blocks=4, num_global_blocks=3)
+    with pytest.raises(NotImplementedError):
+        FixedSparsityConfig(num_heads=1, attention="sideways")
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(num_heads=1, attention="unidirectional",
+                            horizontal_global_attention=True)
+
+
+def test_seq_not_divisible_raises():
+    cfg = FixedSparsityConfig(num_heads=1, block=16)
+    with pytest.raises(ValueError):
+        cfg.make_layout(100)
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    layout = cfg.make_layout(256)  # 16 blocks
+    n = layout.shape[1]
+    for i in range(n):
+        assert layout[0, i, i] == 1          # diagonal always in window
+    assert np.all(layout[0, 0, :] == 1)      # global row
+    assert np.all(layout[0, :, 0] == 1)      # global column
+    # non-global rows: at most window(3) + global col(1) + random(1) entries
+    assert layout[0, 1:].sum(axis=1).max() <= 5
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0, 5])
+    layout = cfg.make_layout(256)
+    assert np.all(layout[0, 5, :] == 1)
+    assert np.all(layout[0, :, 5] == 1)
+    assert layout[0, 2, 8] == 0  # outside window + not global
+
+
+def test_different_layout_per_head():
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4,
+                              num_global_blocks=1,
+                              different_layout_per_head=True,
+                              num_different_global_patterns=4)
+    layout = cfg.make_layout(256)
+    assert layout.shape[0] == 4
+    assert not np.array_equal(layout[0], layout[1])
+
+
+def test_build_from_json_section():
+    cfg = build_sparsity_config({"mode": "bigbird", "block": 16,
+                                 "num_random_blocks": 2}, num_heads=8)
+    assert isinstance(cfg, BigBirdSparsityConfig)
+    assert cfg.num_random_blocks == 2
+    with pytest.raises(ValueError):
+        build_sparsity_config({"mode": "diagonal"}, num_heads=8)
+
+
+# ------------------------------------------------------------ kernel numerics
+def make_qkv(B=1, T=128, H=2, d=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, T, H, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_sparse_kernel_matches_dense_reference(causal):
+    q, k, v = make_qkv()
+    cfg = FixedSparsityConfig(num_heads=2, block=32, num_local_blocks=2,
+                              num_global_blocks=1)
+    layout = jnp.asarray(cfg.make_layout(128), jnp.int32)
+    out = sparse_flash_attention(q, k, v, layout, causal=causal)
+    ref = sparse_attention_reference(q, k, v, layout, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sparse_dense_layout_equals_flash():
+    q, k, v = make_qkv()
+    layout = jnp.ones((1, 4, 4), jnp.int32)  # block 32, fully dense
+    out = sparse_flash_attention(q, k, v, layout, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sparse_backward_matches_dense_reference():
+    q, k, v = make_qkv(T=64)
+    cfg = BigBirdSparsityConfig(num_heads=2, block=16, num_random_blocks=0,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    layout = jnp.asarray(cfg.make_layout(64), jnp.int32)
+
+    def loss_sparse(q, k, v):
+        return jnp.sum(jnp.square(sparse_flash_attention(q, k, v, layout,
+                                                         causal=False)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(sparse_attention_reference(q, k, v, layout,
+                                                             causal=False)))
+
+    gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gs, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
+
+
+def test_sparse_self_attention_module():
+    q, k, v = make_qkv(T=128, H=4)
+    cfg = FixedSparsityConfig(num_heads=4, block=32, num_local_blocks=2)
+    attn = SparseSelfAttention(cfg)
+    out = attn(q, k, v, causal=False)
+    assert out.shape == q.shape
+    assert 0.0 < attn.density(128) <= 1.0
+    # layout cache reused
+    assert attn.get_layout(128) is attn.get_layout(128)
