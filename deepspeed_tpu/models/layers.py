@@ -1,0 +1,120 @@
+"""Minimal functional layer library (init/apply protocol).
+
+Building blocks for :class:`~deepspeed_tpu.runtime.pipe.module.PipelineModule`
+layer lists and test fixtures (role parity: the reference composes
+``torch.nn`` layers, e.g. the ``LinearStackPipe`` fixture in
+``tests/unit/simple_model.py:126``).
+
+Protocol: a layer is an object with
+
+    .init(rng) -> params          (pytree; ``{}`` when parameter-free)
+    .apply(params, x, rng=None) -> y
+
+Plain callables (activations) are adapted via :class:`Lambda`.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Layer:
+    """Base: parameter-free pass-through."""
+
+    def init(self, rng):
+        return {}
+
+    def apply(self, params, x, rng=None):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.__class__.__name__
+
+
+class Lambda(Layer):
+    """Adapt a plain callable ``x -> y`` into the layer protocol."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, params, x, rng=None):
+        return self.fn(x)
+
+    def __repr__(self):
+        return f"Lambda({getattr(self.fn, '__name__', self.fn)!r})"
+
+
+class Linear(Layer):
+    def __init__(self, in_features, out_features, bias=True, init_std=0.02):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.bias = bias
+        self.init_std = init_std
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.in_features, self.out_features),
+                              jnp.float32) * self.init_std
+        p = {"w": w}
+        if self.bias:
+            p["b"] = jnp.zeros((self.out_features,), jnp.float32)
+        return p
+
+    def apply(self, params, x, rng=None):
+        y = x @ params["w"].astype(x.dtype)
+        if self.bias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+
+class LayerNorm(Layer):
+    def __init__(self, dim, eps=1e-5):
+        self.dim = dim
+        self.eps = eps
+
+    def init(self, rng):
+        return {"scale": jnp.ones((self.dim,), jnp.float32),
+                "bias": jnp.zeros((self.dim,), jnp.float32)}
+
+    def apply(self, params, x, rng=None):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mu) * jax.lax.rsqrt(var + self.eps)
+        return (y * params["scale"] + params["bias"]).astype(x.dtype)
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, features, init_std=0.02):
+        self.num_embeddings = num_embeddings
+        self.features = features
+        self.init_std = init_std
+
+    def init(self, rng):
+        return {"table": jax.random.normal(
+            rng, (self.num_embeddings, self.features), jnp.float32) * self.init_std}
+
+    def apply(self, params, x, rng=None):
+        return params["table"][x]
+
+
+class Dropout(Layer):
+    def __init__(self, rate):
+        self.rate = rate
+
+    def apply(self, params, x, rng=None):
+        if rng is None or self.rate == 0.0:
+            return x
+        keep = jax.random.bernoulli(rng, 1.0 - self.rate, x.shape)
+        return jnp.where(keep, x / (1.0 - self.rate), 0.0).astype(x.dtype)
+
+
+def relu():
+    return Lambda(jax.nn.relu)
+
+
+def tanh():
+    return Lambda(jnp.tanh)
+
+
+def gelu():
+    return Lambda(jax.nn.gelu)

@@ -4,18 +4,23 @@ Parity: reference ``ops/sparse_attention/sparse_self_attention.py:13`` — an
 attention layer that consumes a :class:`SparsityConfig` and computes
 block-sparse softmax(QKᵀ)V.  The reference dispatches to Triton SDD/DSD/DDS
 matmuls + block-sparse softmax; here the layout gates blocks of the pallas
-flash kernel directly (``sparse_flash_attention``), skipping both the compute
-and the HBM traffic of disallowed blocks.
+flash kernel (``sparse_flash_attention``), which skips disallowed blocks'
+compute (K/V tiles are still streamed by the block pipeline; LUT grid
+compression is future work).
+
+Mask semantics parity (reference ``sparse_self_attention.py:46-75``):
+``key_padding_mask`` (B, T) over keys and ``attn_mask`` (T, T) are honored
+with 'add' (additive scores) or 'mul' (multiplicative, 0 = masked) modes.
+Masked calls run a dense jnp path with the layout applied as an element mask
+— the pallas kernel has no mask operand yet.
 """
 
-import functools
-
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .sparsity_config import SparsityConfig, FixedSparsityConfig
-from ..transformer.flash_attention import (sparse_flash_attention,
-                                           sparse_attention_reference)
+from ..transformer.flash_attention import sparse_flash_attention
 
 
 class SparseSelfAttention:
@@ -28,6 +33,10 @@ class SparseSelfAttention:
     def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
                  attn_mask_mode="mul", max_seq_length=2048):
         self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        if key_padding_mask_mode not in ("add", "mul"):
+            raise ValueError("key_padding_mask_mode must be 'add' or 'mul'")
+        if attn_mask_mode not in ("add", "mul"):
+            raise ValueError("attn_mask_mode must be 'add' or 'mul'")
         self.key_padding_mask_mode = key_padding_mask_mode
         self.attn_mask_mode = attn_mask_mode
         self.max_seq_length = max_seq_length
@@ -43,14 +52,53 @@ class SparseSelfAttention:
         layout = self.get_layout(seq_len)
         return float(layout.sum()) / layout[0].size / layout.shape[0]
 
-    def __call__(self, query, key, value, *, causal=None, sm_scale=None):
+    def __call__(self, query, key, value, *, causal=None, sm_scale=None,
+                 key_padding_mask=None, attn_mask=None):
         B, T, H, d = query.shape
+        assert T <= self.max_seq_length, \
+            f"seq_len {T} exceeds max_seq_length {self.max_seq_length}"
         causal = (self.sparsity_config.attention == "unidirectional"
                   if causal is None and
                   hasattr(self.sparsity_config, "attention") else bool(causal))
         layout = jnp.asarray(self.get_layout(T))
-        return sparse_flash_attention(query, key, value, layout, causal=causal,
-                                      sm_scale=sm_scale)
+        if key_padding_mask is None and attn_mask is None:
+            return sparse_flash_attention(query, key, value, layout,
+                                          causal=causal, sm_scale=sm_scale)
+        return self._masked_dense(query, key, value, layout, causal, sm_scale,
+                                  key_padding_mask, attn_mask)
+
+    def _masked_dense(self, q, k, v, layout, causal, sm_scale,
+                      key_padding_mask, attn_mask):
+        """Dense path with layout + user masks (reference applies masks inside
+        the block-sparse softmax; numerics are identical)."""
+        B, T, H, d = q.shape
+        Lh, nq, nk = layout.shape
+        bq, bk = T // nq, T // nk
+        if sm_scale is None:
+            sm_scale = 1.0 / np.sqrt(d)
+        mask = jnp.kron(jnp.asarray(layout, jnp.float32),
+                        jnp.ones((bq, bk), jnp.float32)) > 0    # (Lh, T, T)
+        if Lh == 1:
+            mask = jnp.broadcast_to(mask, (H, T, T))
+        if causal:
+            mask = jnp.logical_and(mask, jnp.tril(jnp.ones((T, T), bool))[None])
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+        s = jnp.where(mask[None], s, -jnp.inf)
+        if attn_mask is not None:
+            am = jnp.asarray(attn_mask)[None, None]             # (1,1,T,T)
+            if self.attn_mask_mode == "add":
+                s = s + am.astype(jnp.float32)
+            else:
+                s = jnp.where(am != 0, s, -jnp.inf)
+        if key_padding_mask is not None:
+            kp = jnp.asarray(key_padding_mask)[:, None, None, :]  # (B,1,1,T)
+            if self.key_padding_mask_mode == "add":
+                s = s + kp.astype(jnp.float32)
+            else:
+                s = jnp.where(kp != 0, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
 class BertSparseSelfAttention:
@@ -65,7 +113,7 @@ class BertSparseSelfAttention:
         self.attn = SparseSelfAttention(
             sparsity_config or FixedSparsityConfig(num_heads=num_attention_heads))
 
-    def __call__(self, hidden, params):
+    def __call__(self, hidden, params, key_padding_mask=None):
         """params: {'q_w','q_b','k_w','k_b','v_w','v_b'} projection pytree."""
         B, T, D = hidden.shape
         proj = lambda w, b: (hidden @ w + b).reshape(B, T, self.num_heads,
@@ -73,5 +121,6 @@ class BertSparseSelfAttention:
         q = proj(params["q_w"], params["q_b"])
         k = proj(params["k_w"], params["k_b"])
         v = proj(params["v_w"], params["v_b"])
-        ctx = self.attn(q, k, v, causal=False)
+        ctx = self.attn(q, k, v, causal=False,
+                        key_padding_mask=key_padding_mask)
         return ctx.reshape(B, T, D)

@@ -266,6 +266,9 @@ class BSLongformerSparsityConfig(SparsityConfig):
                 if s >= e:
                     raise ValueError("global block end must exceed start")
         self.global_block_end_indices = global_block_end_indices
+        if attention not in ("unidirectional", "bidirectional"):
+            raise NotImplementedError(
+                "only unidirectional or bidirectional attention is supported")
         self.attention = attention
 
     def make_layout(self, seq_len):

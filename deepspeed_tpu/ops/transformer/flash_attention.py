@@ -119,7 +119,11 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
     block_k = min(block_k, T)
     # pallas clamps out-of-range blocks (dynamic-slice semantics), which would
     # silently shift uneven tails — pad to block multiples and mask in-kernel.
-    Tp = int(np.ceil(T / max(block_q, block_k)) * max(block_q, block_k))
+    # pad to a multiple of BOTH block sizes (lcm), else the smaller-block
+    # grid still has an out-of-range tail block that dynamic-slice clamping
+    # would silently shift
+    blk = np.lcm(block_q, block_k)
+    Tp = int(np.ceil(T / blk) * blk)
     q, k, v = _pad_t(q, Tp), _pad_t(k, Tp), _pad_t(v, Tp)
     nq = pl.cdiv(Tp, block_q)
     nk = pl.cdiv(Tp, block_k)
@@ -283,7 +287,11 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
     BH, T, d = q.shape
     block_q = min(block_q, T)
     block_k = min(block_k, T)
-    Tp = int(np.ceil(T / max(block_q, block_k)) * max(block_q, block_k))
+    # pad to a multiple of BOTH block sizes (lcm), else the smaller-block
+    # grid still has an out-of-range tail block that dynamic-slice clamping
+    # would silently shift
+    blk = np.lcm(block_q, block_k)
+    Tp = int(np.ceil(T / blk) * blk)
     nq = pl.cdiv(Tp, block_q)
     nk = pl.cdiv(Tp, block_k)
 
@@ -423,8 +431,10 @@ def sparse_flash_attention(q, k, v, layout, *, causal=True, sm_scale=None,
     ``layout``: (n_heads_or_1, nq, nk) int block mask from a SparsityConfig
     (reference ``ops/sparse_attention/sparsity_config.py`` hierarchy).  The
     block size is implied: block_q = T // nq, block_k = T // nk.  Disallowed
-    blocks are skipped entirely (compute AND memory), which is where the
-    reference's 6.3× sparse speedup comes from (README.md:39).
+    blocks skip their compute in-kernel (``pl.when`` gating); their K/V
+    tiles are still DMA'd by the block pipeline, so the win is MXU time, not
+    HBM traffic (a LUT-compressed grid is future work; the reference's
+    Triton kernels compress the grid via LUTs, ``ops/sparse_attention/matmul.py:288``).
     """
     B, T, H, d = q.shape
     Lh, nq, nk = layout.shape
@@ -436,6 +446,8 @@ def sparse_flash_attention(q, k, v, layout, *, causal=True, sm_scale=None,
         f"layout {layout.shape} incompatible with T={T}"
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(d)
+    assert Lh in (1, H), \
+        f"layout has {Lh} head layouts; expected 1 (shared) or H={H}"
     if Lh == 1 and H > 1:
         layout = jnp.broadcast_to(layout, (H, nq, nk))
     layout = jnp.asarray(layout, jnp.int32)

@@ -1,0 +1,3 @@
+"""Public pipeline API (parity: reference ``deepspeed/pipe/__init__.py``)."""
+
+from ..runtime.pipe import PipelineModule, LayerSpec, TiedLayerSpec

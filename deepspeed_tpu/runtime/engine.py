@@ -276,18 +276,18 @@ class DeepSpeedEngine:
         return jax.tree_util.tree_map(sh_for, opt_state)
 
     # ------------------------------------------------------------- train step
-    def _train_step(self, state: TrainState, batch, rng):
-        """One full optimizer step: scan over gas microbatches, reduce, update.
+    def _grad_fn(self, base, batch, rng, cur_scale):
+        """Gradient computation inside the jitted step.
 
-        ``batch`` leaves are shaped (gas, global_micro_batch, ...) with the
-        second axis sharded over (data, fsdp).
+        Default: scan over the gas microbatch axis accumulating fp32 grads
+        (reference per-micro-batch backward + bucketed hook reduction,
+        ``engine.py:1684``).  ``PipelineEngine`` overrides this with the
+        pipelined forward/backward.  Returns ``(grads, scaled_loss_sum)``
+        where ``scaled_loss_sum == mean_loss * cur_scale``.
         """
         gas = self.gradient_accumulation_steps()
         dtype = self.compute_dtype
         needs_master = dtype != jnp.float32
-        base = state.master if needs_master else state.params
-
-        cur_scale = state.scale.cur_scale if state.scale is not None else jnp.float32(1.0)
 
         def micro_loss(base_params, mb, r):
             p = tree_cast(base_params, dtype) if needs_master else base_params
@@ -295,13 +295,13 @@ class DeepSpeedEngine:
             loss = self._loss_fn(p, mb, r)
             return loss * cur_scale / gas
 
-        grad_fn = jax.value_and_grad(micro_loss)
+        vgrad = jax.value_and_grad(micro_loss)
 
         def body(carry, xs):
             gacc, lacc, idx = carry
             mb = xs
             r = jax.random.fold_in(rng, idx)
-            scaled_loss, grads = grad_fn(base, mb, r)
+            scaled_loss, grads = vgrad(base, mb, r)
             grads = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32), gacc, grads)
             return (grads, lacc + scaled_loss, idx + 1), None
@@ -310,6 +310,21 @@ class DeepSpeedEngine:
             lambda p: jnp.zeros(p.shape, jnp.float32), base)
         (grads, scaled_loss_sum, _), _ = jax.lax.scan(
             body, (zeros, jnp.float32(0.0), jnp.int32(0)), batch)
+        return grads, scaled_loss_sum
+
+    def _train_step(self, state: TrainState, batch, rng):
+        """One full optimizer step: scan over gas microbatches, reduce, update.
+
+        ``batch`` leaves are shaped (gas, global_micro_batch, ...) with the
+        second axis sharded over (data, fsdp).
+        """
+        dtype = self.compute_dtype
+        needs_master = dtype != jnp.float32
+        base = state.master if needs_master else state.params
+
+        cur_scale = state.scale.cur_scale if state.scale is not None else jnp.float32(1.0)
+
+        grads, scaled_loss_sum = self._grad_fn(base, batch, rng, cur_scale)
 
         # unscale (fp16); loss for reporting is the true mean loss
         grads = jax.tree_util.tree_map(lambda g: g / cur_scale, grads)

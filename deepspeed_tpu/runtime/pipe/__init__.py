@@ -1,0 +1,10 @@
+"""Pipeline-parallel subsystem (parity: reference ``deepspeed/runtime/pipe/``)."""
+
+from .module import PipelineModule, LayerSpec, TiedLayerSpec
+from .topology import (ProcessTopology, PipeDataParallelTopology,
+                       PipeModelDataParallelTopology, PipelineParallelGrid)
+from .schedule import (PipeSchedule, TrainSchedule, InferenceSchedule,
+                       DataParallelSchedule, PipeInstruction, OptimizerStep,
+                       ReduceGrads, ReduceTiedGrads, LoadMicroBatch,
+                       ForwardPass, BackwardPass, SendActivation,
+                       RecvActivation, SendGrad, RecvGrad)

@@ -1,0 +1,220 @@
+"""PipelineEngine: pipelined training as ONE jitted SPMD program.
+
+Parity: reference ``deepspeed/runtime/pipe/engine.py`` — ``PipelineEngine``
+(:46), ``train_batch`` (:302), ``_exec_schedule`` (:1368) dispatching
+``_INSTRUCTION_MAP`` (:1355) over p2p send/recv (``pipe/p2p.py``).
+
+TPU-native redesign: the reference interprets the instruction IR, issuing one
+NCCL p2p per edge and one autograd call per micro-batch.  Here the ENTIRE
+schedule — every tick of every stage — is a single ``lax.scan`` inside a
+``shard_map`` over the ``pipe`` mesh axis:
+
+- tick t, stage s computes micro-batch ``t - s`` (the IR's semantics,
+  ``schedule.py``); total ticks = M + S - 1;
+- stage-to-stage transfer = ``ppermute`` ring rotation (the p2p of
+  ``pipe/p2p.py:48,69``), which XLA overlaps with compute over ICI;
+- the backward pipeline is NOT hand-written: ``jax.grad`` through the scan +
+  ppermute yields exactly the reverse schedule, with grad transfers as the
+  transposed ppermutes (reference ``_exec_send_grads``/``_exec_recv_grads``);
+- tied-weight gradient reduction (reference ``_exec_reduce_tied_grads`` :240)
+  falls out of autodiff: prologue/epilogue params enter the shard_map
+  replicated over 'pipe', so their cotangents are psum'd automatically;
+- the first-iteration tensor-shape handshake (``:836 _send_tensor_meta``)
+  disappears — shapes are static under jit;
+- loss aggregation from the last stage (``:552 _aggregate_total_loss``) is a
+  masked psum.
+
+Memory: activations live at stage boundaries for all M in-flight
+micro-batches (GPipe profile).  ``activation_checkpoint_interval != 0`` remats
+the stage body so only the boundary activations persist — the same highwater
+the reference's 1F1B + activation checkpointing achieves, without interleaved
+manual backward.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..engine import DeepSpeedEngine
+from ..utils import tree_cast
+from ..zero import partition as zpart
+from .module import PipelineModule
+from .schedule import TrainSchedule, InferenceSchedule
+
+
+def _split_labels(batch):
+    """(inputs, labels) from a stacked micro-batch pytree.
+
+    Accepted shapes: ``(inputs, labels)`` tuples (reference pipeline data
+    contract, ``pipe/engine.py:795 _exec_load_micro_batch``) or dicts with a
+    ``'labels'`` key.  Anything else is rejected rather than silently trained
+    with ``labels == inputs``.
+    """
+    if isinstance(batch, (tuple, list)) and len(batch) >= 2:
+        return batch[0], batch[1]
+    if isinstance(batch, dict) and "labels" in batch:
+        inputs = {k: v for k, v in batch.items() if k != "labels"}
+        if len(inputs) == 1:
+            inputs = next(iter(inputs.values()))
+        return inputs, batch["labels"]
+    raise ValueError(
+        "PipelineEngine batches must be (inputs, labels) tuples or dicts "
+        f"with a 'labels' key; got {type(batch).__name__}")
+
+
+class PipelineEngine(DeepSpeedEngine):
+    """Config/mesh-driven pipeline-parallel engine.
+
+    ``gradient_accumulation_steps`` doubles as the micro-batch count M
+    (reference: ``train_batch() = micro_batches`` micro-steps,
+    ``pipe/engine.py:302``).
+    """
+
+    def __init__(self, model=None, **kwargs):
+        assert isinstance(model, PipelineModule), \
+            "PipelineEngine requires a PipelineModule"
+        super().__init__(model=model, loss_fn=self._no_flat_loss, **kwargs)
+        S = self.mesh_ctx.pipe_size
+        assert S == model.num_stages, \
+            (f"mesh pipe axis ({S}) != PipelineModule.num_stages "
+             f"({model.num_stages}); set config mesh.axes.pipe")
+        self.num_stages = model.num_stages
+        self.micro_batches = self.gradient_accumulation_steps()
+
+    @staticmethod
+    def _no_flat_loss(params, batch, rng):
+        raise RuntimeError("PipelineEngine computes loss via the pipelined "
+                           "schedule; flat loss_fn is unused")
+
+    # ------------------------------------------------------------ schedules
+    def train_schedule(self, stage_id=0):
+        """The instruction-IR view of what the fused program executes."""
+        return TrainSchedule(micro_batches=self.micro_batches,
+                             stages=self.num_stages, stage_id=stage_id)
+
+    def inference_schedule(self, stage_id=0):
+        return InferenceSchedule(micro_batches=self.micro_batches,
+                                 stages=self.num_stages, stage_id=stage_id)
+
+    # ------------------------------------------------------------- gradients
+    def _grad_fn(self, base, batch, rng, cur_scale):
+        """Pipelined forward + autodiff backward (replaces the gas scan)."""
+        dtype = self.compute_dtype
+        needs_master = dtype != jnp.float32
+
+        def total_loss(base_params):
+            p = tree_cast(base_params, dtype) if needs_master else base_params
+            p = zpart.constrain(p, self._param_specs, self.mesh)
+            return self._pipeline_loss(p, batch, rng) * cur_scale
+
+        scaled_loss, grads = jax.value_and_grad(total_loss)(base)
+        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        return grads, scaled_loss
+
+    # ------------------------------------------------------- fused pipeline
+    def _pipeline_loss(self, params, batch, rng):
+        """Mean loss over M micro-batches, computed by the collective
+        pipeline.  ``batch`` leaves are (M, micro_batch, ...)."""
+        module = self.module
+        S = self.num_stages
+        inputs, labels = _split_labels(batch)
+        M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+
+        stages = params["stages"]
+        other = {k: v for k, v in params.items() if k != "stages"}
+        # remat every `interval` layers within the stage body (reference
+        # ``pipeline.activation_checkpoint_interval``; 0 disables)
+        interval = int(module.activation_checkpoint_interval)
+
+        def per_stage(stages_local, other_p, inp, lab, key):
+            s = lax.axis_index("pipe")
+            local = jax.tree_util.tree_map(lambda a: a[0], stages_local)
+
+            L = module.layers_per_stage
+            def chunk_body(lo, hi):
+                def run(h, t):
+                    for j in range(lo, hi):
+                        r = jax.random.fold_in(key, (t * S + s) * 131 + j)
+                        h = module.slot_apply(j, local[j], h, r)
+                    return h
+                return run
+
+            chunks = []
+            step_sz = interval if interval > 0 else L
+            for lo in range(0, L, step_sz):
+                c = chunk_body(lo, min(lo + step_sz, L))
+                if interval > 0:
+                    c = jax.checkpoint(c)
+                chunks.append(c)
+
+            def stage_body(x, t):
+                for c in chunks:
+                    x = c(x, t)
+                return x
+
+            def load_mb(t):
+                return jax.tree_util.tree_map(lambda a: a[t], inp)
+
+            x0_probe = module.prologue_apply(other_p, load_mb(0),
+                                             rng=jax.random.fold_in(key, 7))
+            zero_h = jnp.zeros_like(x0_probe)
+
+            def tick(carry, t):
+                y_prev = carry
+                # receive previous tick's output from stage s-1 (p2p recv)
+                perm = [(i, (i + 1) % S) for i in range(S)]
+                x_recv = lax.ppermute(y_prev, "pipe", perm)
+                # first stage loads micro-batch t instead
+                x0 = module.prologue_apply(other_p, load_mb(jnp.clip(t, 0, M - 1)),
+                                           rng=jax.random.fold_in(key, t * 7 + 1))
+                x_in = jnp.where(s == 0, x0, x_recv)
+                y = stage_body(x_in, t)
+                return y, y
+
+            # carry values become pipe-varying after the first ppermute;
+            # mark the initial carry accordingly (shard_map vma typing)
+            carry0 = lax.pcast(zero_h, ("pipe",), to="varying")
+            _, ys = lax.scan(tick, carry0, jnp.arange(M + S - 1))
+
+            # Epilogue + loss ONCE over the M completed micro-batches
+            # (ticks S-1 … M+S-2 on the last stage), batched into a single
+            # vmapped application instead of per-tick masked compute.
+            ys_valid = ys[S - 1:]                       # (M, mb, ...)
+            def one_loss(i, y):
+                out = module.epilogue_apply(other_p, y,
+                                            rng=jax.random.fold_in(key, i * 7 + 3))
+                lb = jax.tree_util.tree_map(lambda a: a[i], lab)
+                return module.compute_loss(out, lb).astype(jnp.float32)
+            losses = jax.vmap(one_loss)(jnp.arange(M), ys_valid)
+            mean_loss = jnp.mean(losses)
+            # aggregate from the last stage (reference _aggregate_total_loss)
+            return lax.psum(jnp.where(s == S - 1, mean_loss, 0.0), "pipe")
+
+        fn = jax.shard_map(per_stage, mesh=self.mesh,
+                           in_specs=(P("pipe"), P(), P(), P(), P()),
+                           out_specs=P(), axis_names={"pipe"})
+        return fn(stages, other, inputs, labels, rng)
+
+    # ------------------------------------------------------------------ eval
+    def eval_batch(self, batch, rng=None):
+        """Pipelined forward-only loss on ONE micro-batch ``(inputs, labels)``
+        (promoted internally to a stack of one; pass pre-stacked batches
+        through ``_pipeline_loss`` directly if needed)."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if self._jit_eval is None:
+            def eval_fn(params, b, r):
+                return self._pipeline_loss(params, b, r)
+            self._jit_eval = jax.jit(eval_fn)
+        # promote a single micro-batch to a stack of one
+        batch = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[None], batch)
+        return self._jit_eval(self.state.params, batch, rng)
+
+    # forward/backward shim is meaningless under a fused pipeline schedule
+    def forward(self, *a, **k):
+        raise NotImplementedError("PipelineEngine: use train_batch()/eval_batch() "
+                                  "(reference PipelineEngine also forbids "
+                                  "forward/backward, pipe/engine.py:46)")
+
+    backward = forward
+    step = forward

@@ -1,0 +1,315 @@
+"""PipelineModule: partition a layer list across pipeline stages.
+
+Parity: reference ``deepspeed/runtime/pipe/module.py`` — ``LayerSpec`` (:25,
+lazy layer construction), ``TiedLayerSpec`` (:73), ``PipelineModule`` (:87)
+with ``_partition_layers`` (:363) supporting ``'uniform'``, ``'parameters'``
+and ``'type:regex'`` methods.
+
+TPU-native redesign: the reference builds only the LOCAL stage's layers per
+process and moves tensors between processes.  Here one process drives the
+whole mesh, so the module builds ALL layers and arranges their params for the
+SPMD collective pipeline (``pipe/engine.py``):
+
+- stages must be structurally homogeneous (same layer-type sequence, same
+  param shapes per slot) so per-slot params can be STACKED along a leading
+  stage axis sharded over the ``pipe`` mesh axis — each device then holds
+  exactly its stage's weights, like the reference's per-process build;
+- heterogeneous head/tail computation (embedding in, loss head out) is
+  expressed as ``prologue``/``epilogue`` modules that live OUTSIDE the
+  pipelined body, replicated over the ``pipe`` axis; a tied embedding used by
+  both IS the reference's tied-layer mechanism — the gradient all-reduce over
+  the tie group (reference ``pipe/module.py:419
+  allreduce_tied_weight_gradients``) falls out of autodiff-of-shard_map for
+  replicated inputs, no explicit collective needed.
+"""
+
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils import partition_uniform, partition_balanced
+from ...models.layers import Lambda
+from ...utils.logging import logger
+
+
+class LayerSpec:
+    """Lazily-constructed layer (parity ``pipe/module.py:25``).
+
+    ``typename`` is a class following the init/apply layer protocol
+    (``models/layers.py``); construction is deferred so huge models can
+    describe themselves cheaply.
+    """
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, object):
+            raise RuntimeError("LayerSpec needs a class")
+
+    def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        from ..utils import call_to_str
+        return call_to_str(self.typename.__name__, *self.module_args,
+                           **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose parameters are shared with every other spec carrying the
+    same ``key`` (parity ``pipe/module.py:73``).
+
+    Supported placement: tied specs may appear as the FIRST and/or LAST
+    elements of the layer list (the overwhelmingly common case: tied
+    embedding/head).  They are lifted out of the pipelined body into the
+    prologue/epilogue, sharing one parameter entry.
+    """
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="table", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+def _as_layer(obj):
+    """Accept LayerSpec, layer object, or plain callable."""
+    if isinstance(obj, LayerSpec):
+        return obj.build()
+    if hasattr(obj, "init") and hasattr(obj, "apply"):
+        return obj
+    if callable(obj):
+        return Lambda(obj)
+    raise TypeError(f"not a layer: {obj!r}")
+
+
+def _count_params(layer, rng):
+    shapes = jax.eval_shape(layer.init, rng)
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
+
+
+class PipelineModule:
+    """Partition ``layers`` into ``num_stages`` pipeline stages.
+
+    Exposes the engine model protocol (``init``/``loss``) so
+    ``deepspeed.initialize`` can treat it like any model; the pipelined
+    execution itself lives in :class:`~..pipe.engine.PipelineEngine`.
+
+    Args (parity with reference ``PipelineModule.__init__``):
+        layers: list of LayerSpec / layer objects / callables.
+        num_stages: pipeline depth (or derive from ``topology``).
+        topology: optional ``ProcessTopology`` with a 'pipe' axis.
+        loss_fn: ``loss_fn(outputs, labels) -> scalar``.
+        partition_method: 'uniform' | 'parameters' | 'type:regex'.
+        activation_checkpoint_interval: 0 disables remat of the stage body.
+        prologue/epilogue: optional init/apply modules running outside the
+            pipelined body (first / last stage semantics).
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seed_layers=False, base_seed=1234,
+                 partition_method="parameters",
+                 activation_checkpoint_interval=1,
+                 checkpointable_layers=None,
+                 prologue=None, epilogue=None):
+        if num_stages is None and topology is None:
+            raise RuntimeError("must provide num_stages or topology")
+        if topology is not None and num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = int(num_stages)
+        self.topology = topology
+        self.loss_fn = loss_fn
+        self.base_seed = int(base_seed)
+        self.seed_layers = seed_layers
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.checkpointable_layers = checkpointable_layers
+
+        self._layer_specs = list(layers)
+        self.prologue, self.epilogue, body = self._lift_tied(
+            prologue, epilogue, self._layer_specs)
+        self.forward_funcs = [_as_layer(l) for l in body]
+        self.parts = self._partition_layers(self.forward_funcs,
+                                            partition_method, self.num_stages)
+        self._validate_homogeneous()
+        self.layers_per_stage = self.parts[1] - self.parts[0]
+
+    # ---------------------------------------------------------------- tying
+    def _lift_tied(self, prologue, epilogue, specs):
+        """Lift edge TiedLayerSpecs into prologue/epilogue sharing params."""
+        body = list(specs)
+        tied_first = body and isinstance(body[0], TiedLayerSpec)
+        tied_last = len(body) > 1 and isinstance(body[-1], TiedLayerSpec)
+        if not (tied_first or tied_last):
+            if any(isinstance(s, TiedLayerSpec) for s in body):
+                raise NotImplementedError(
+                    "TiedLayerSpec inside the pipelined body is unsupported; "
+                    "place tied layers first/last (prologue/epilogue)")
+            return prologue, epilogue, body
+        assert prologue is None and epilogue is None, \
+            "cannot mix TiedLayerSpec lifting with explicit prologue/epilogue"
+        first = body.pop(0) if tied_first else None
+        last = body.pop(-1) if (tied_last and body) else None
+        if any(isinstance(s, TiedLayerSpec) for s in body):
+            raise NotImplementedError(
+                "TiedLayerSpec inside the pipelined body is unsupported")
+        pro = None
+        if first is not None:
+            pro = _TiedEdge(_as_layer(first), first.forward_fn, owner=True)
+        epi = None
+        if last is not None:
+            same = (first is not None and last.key == first.key)
+            epi = _TiedEdge(pro.layer if same else _as_layer(last),
+                            last.forward_fn, owner=not same,
+                            tied_to=pro if same else None)
+        return pro, epi, body
+
+    # ----------------------------------------------------------- partitioning
+    def _partition_layers(self, layers, method, num_stages):
+        """Stage boundary computation (parity ``pipe/module.py:363``)."""
+        n = len(layers)
+        method = method.lower()
+        if method == "uniform":
+            parts = partition_uniform(n, num_stages)
+        elif method == "parameters":
+            rng = jax.random.PRNGKey(0)
+            weights = [max(_count_params(l, rng), 1) for l in layers]
+            parts = partition_balanced(weights, num_stages)
+        elif method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            weights = [1 if re.search(pattern, type(l).__name__, re.IGNORECASE)
+                       else 0 for l in layers]
+            if sum(weights) == 0:
+                raise ValueError(f"no layer matches type:{pattern}")
+            parts = partition_balanced(weights, num_stages)
+        else:
+            raise NotImplementedError(f"partition method {method}")
+        return parts
+
+    def _validate_homogeneous(self):
+        """The SPMD engine stacks per-slot params over stages: every stage
+        needs the same number of layers with matching types.  Fall back to
+        uniform when the chosen method yields ragged stages."""
+        counts = [self.parts[i + 1] - self.parts[i]
+                  for i in range(self.num_stages)]
+        if len(set(counts)) != 1:
+            if len(self.forward_funcs) % self.num_stages == 0:
+                logger.warning(
+                    f"partition_method={self.partition_method!r} produced "
+                    f"ragged stages {counts}; falling back to uniform for the "
+                    f"SPMD collective pipeline")
+                self.parts = partition_uniform(len(self.forward_funcs),
+                                               self.num_stages)
+            else:
+                raise ValueError(
+                    f"{len(self.forward_funcs)} layers not divisible into "
+                    f"{self.num_stages} homogeneous stages (got {counts})")
+        L = self.parts[1] - self.parts[0]
+        for j in range(L):
+            types = {type(self.forward_funcs[self.parts[s] + j])
+                     for s in range(self.num_stages)}
+            if len(types) != 1:
+                raise ValueError(
+                    f"slot {j} has mixed layer types across stages: {types}; "
+                    "the SPMD pipeline requires structurally homogeneous stages")
+
+    def stage_layers(self, stage_id):
+        return self.forward_funcs[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    # --------------------------------------------------------------- protocol
+    def init(self, rng):
+        """Params: ``{'stages': [slot_j stacked over stages], 'prologue': …,
+        'epilogue': …}``; stacked leaves lead with the stage axis."""
+        S, L = self.num_stages, self.layers_per_stage
+        n_layers = len(self.forward_funcs)
+        keys = jax.random.split(rng, n_layers + 2)
+        per_layer = []
+        for i, layer in enumerate(self.forward_funcs):
+            if self.seed_layers:
+                k = jax.random.PRNGKey(self.base_seed + i)
+            else:
+                k = keys[i]
+            per_layer.append(layer.init(k))
+
+        slots = []
+        for j in range(L):
+            stage_params = [per_layer[self.parts[s] + j] for s in range(S)]
+            slots.append(jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *stage_params))
+        params = {"stages": slots}
+        if self.prologue is not None:
+            params["prologue"] = self.prologue.init(keys[n_layers])
+        if self.epilogue is not None and self._epilogue_owns_params():
+            params["epilogue"] = self.epilogue.init(keys[n_layers + 1])
+        return params
+
+    def _epilogue_owns_params(self):
+        return not (isinstance(self.epilogue, _TiedEdge)
+                    and self.epilogue.tied_to is not None)
+
+    def partition_specs(self, params=None):
+        """'pipe' sharding on the leading stage axis of every stacked slot;
+        prologue/epilogue replicated over 'pipe' (engine composes fsdp/tensor
+        on the remaining axes)."""
+        if params is None:
+            params = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        def spec_of(path0, leaf):
+            ndim = len(np.shape(leaf))
+            if path0 == "stages":
+                return P("pipe", *([None] * (ndim - 1)))
+            return P()
+        out = {}
+        for key, sub in params.items():
+            out[key] = jax.tree_util.tree_map(
+                lambda l, k=key: spec_of(k, l), sub)
+        return out
+
+    # Applied by PipelineEngine inside its shard_map region:
+    def slot_apply(self, j, slot_params, x, rng):
+        layer = self.forward_funcs[self.parts[0] + j]  # stage-0 rep of slot j
+        return layer.apply(slot_params, x, rng=rng)
+
+    def prologue_apply(self, params, x, rng=None):
+        if self.prologue is None:
+            return x
+        return self.prologue.apply(params.get("prologue", {}), x, rng=rng)
+
+    def epilogue_apply(self, params, x, rng=None):
+        if self.epilogue is None:
+            return x
+        p = params.get("epilogue")
+        if p is None:  # tied to prologue
+            p = params.get("prologue", {})
+        return self.epilogue.apply(p, x, rng=rng)
+
+    def compute_loss(self, outputs, labels):
+        assert self.loss_fn is not None, "PipelineModule needs loss_fn for training"
+        return self.loss_fn(outputs, labels)
+
+    def num_layers(self):
+        return len(self.forward_funcs)
+
+
+class _TiedEdge:
+    """Prologue/epilogue wrapper for a (possibly tied) edge layer."""
+
+    def __init__(self, layer, forward_fn=None, owner=True, tied_to=None):
+        self.layer = layer
+        self.forward_fn = forward_fn
+        self.owner = owner
+        self.tied_to = tied_to
+
+    def init(self, rng):
+        return self.layer.init(rng)
+
+    def apply(self, params, x, rng=None):
+        if self.forward_fn is not None:
+            return self.forward_fn(params, x)
+        return self.layer.apply(params, x, rng=rng)

@@ -1,0 +1,242 @@
+"""Pipeline instruction IR and schedules.
+
+Parity: reference ``deepspeed/runtime/pipe/schedule.py`` — ``PipeInstruction``
+(:317) and subclasses (:336-460), ``TrainSchedule`` 1F1B (:182),
+``InferenceSchedule`` (:129), ``num_pipe_buffers`` memory bound (:243).
+
+Role on TPU: the SPMD pipeline engine (``pipe/engine.py``) executes the whole
+schedule inside ONE jitted program (collective pipeline over the ``pipe`` mesh
+axis), so the IR is not dispatched instruction-by-instruction on the hot path.
+It is kept because (a) it is the precise, testable specification of what the
+fused program computes — tick t at stage s processes micro-batch t-s — and
+(b) schedule-dependent quantities (total tick count, buffer counts, memory
+bounds) are derived from it by both the engine and the tests.
+"""
+
+from abc import ABC, abstractmethod
+
+
+# --------------------------------------------------------------------------
+# Instructions
+# --------------------------------------------------------------------------
+class PipeInstruction:
+    """One step of work for one pipeline stage (parity ``schedule.py:317``)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (self.__class__ is other.__class__
+                and self.kwargs == other.kwargs)
+
+    def __hash__(self):
+        return hash((self.name, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    """Apply the optimizer (all stages, end of batch)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """All-reduce gradients of tied layers over their tie group."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    """Instruction operating on a pipeline buffer slot."""
+
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    """First/last stage: pull a micro-batch from the data iterator."""
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Schedules
+# --------------------------------------------------------------------------
+class PipeSchedule(ABC):
+    """Yields lists of :class:`PipeInstruction` to run per step.
+
+    Parity: reference ``schedule.py:24``.
+    """
+
+    def __init__(self, micro_batches, stages, stage_id):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    @abstractmethod
+    def steps(self):
+        """Generator of instruction lists, one per schedule step."""
+
+    def num_pipe_buffers(self):
+        """Upper bound of concurrently-live activation buffers this stage
+        needs (reference ``schedule.py:243``)."""
+        return self.micro_batches
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelined schedule (parity ``schedule.py:129``).
+
+    Tick t: stage s forwards micro-batch ``t - s`` when valid.  Total ticks =
+    ``micro_batches + stages - 1``.
+    """
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for t in range(total):
+            cmds = []
+            mb = t - self.stage_id
+            if self._valid_micro_batch(mb):
+                buf = mb % self.num_pipe_buffers()
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        """Two buffers suffice: one receiving while one computes."""
+        return min(2, self.micro_batches)
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (one-forward-one-backward) training schedule.
+
+    Parity: reference ``schedule.py:182``.  Stage s runs
+    ``warmup = stages - 1 - stage_id`` forwards, then alternates
+    forward/backward in steady state, then drains the remaining backwards.
+    Every stage issues exactly ``micro_batches`` forwards and backwards; the
+    peak number of in-flight (forwarded, not yet backwarded) micro-batches is
+    ``warmup + 1``, which bounds activation memory — this is the property the
+    SPMD engine's remat policy reproduces.
+    """
+
+    def steps(self):
+        warmup = min(self.stages - 1 - self.stage_id, self.micro_batches)
+        fwd_id, bwd_id = 0, 0
+        # Interleave: emit forwards until warmup satisfied, then strictly
+        # alternate 1F1B until forwards exhausted, then drain backwards.
+        while bwd_id < self.micro_batches:
+            if fwd_id < self.micro_batches and (
+                    fwd_id - bwd_id <= warmup or fwd_id == bwd_id):
+                # forward step
+                buf = fwd_id % self.num_pipe_buffers()
+                cmds = []
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                else:
+                    cmds.append(RecvActivation(buf))
+                if self.is_last_stage:
+                    # last stage also owns the labels for loss
+                    cmds.append(LoadMicroBatch(buf))
+                cmds.append(ForwardPass(buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf))
+                fwd_id += 1
+                yield cmds
+            else:
+                # backward step
+                buf = bwd_id % self.num_pipe_buffers()
+                cmds = []
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(buf))
+                cmds.append(BackwardPass(buf))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(buf))
+                bwd_id += 1
+                yield cmds
+        # batch boundary: reductions + optimizer step (reference order,
+        # ``pipe/engine.py:240-257,1162``)
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+    def num_pipe_buffers(self):
+        """Peak in-flight micro-batches (parity ``schedule.py:243``)."""
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule: plain grad-accumulated DP
+    (parity: reference ``schedule.py`` same-named class)."""
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if mb == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1

@@ -1,0 +1,228 @@
+"""Process/rank topology math for hybrid parallelism.
+
+Parity: reference ``deepspeed/runtime/pipe/topology.py`` —
+``ProcessTopology`` (:12) cartesian rank grid, ``PipeDataParallelTopology``
+(:235), ``PipeModelDataParallelTopology`` (:246), ``PipelineParallelGrid``
+(:252).
+
+On TPU the device mesh (`jax.sharding.Mesh`) subsumes process groups: there is
+no NCCL group construction, and collectives ride named mesh axes.  This module
+keeps the *pure math* of the rank grid because it is still needed for:
+
+- checkpoint naming across parallel coordinates (reference ``engine.py:2406``),
+- the launcher/CLI mapping hosts→coordinates,
+- tests of rank arithmetic (reference ``tests/unit/test_topology.py`` is
+  CPU-only math too),
+- mapping a mesh axis layout to the reference's ``['pipe','model','data']``
+  axis vocabulary.
+
+Ranks are assigned in row-major (C) order over the axes: the FIRST axis varies
+slowest (reference semantics).
+"""
+
+import itertools
+from collections import namedtuple
+
+from ..utils import ensure_divisibility
+
+
+class ProcessTopology:
+    """A cartesian grid of ranks over named axes.
+
+    ``axes`` orders dimensions from outermost (slowest-varying rank) to
+    innermost.  Parity: reference ``pipe/topology.py:12``.
+    """
+
+    def __init__(self, axes, dims):
+        assert len(axes) == len(dims), "axes and dims must align"
+        self.axes = list(axes)
+        self.dims = list(int(d) for d in dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+
+        self.mapping = {}
+        ranges = [range(d) for d in self.dims]
+        for global_rank, coord in enumerate(itertools.product(*ranges)):
+            key = dict(zip(self.axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs):
+        """Rank of the process at the given coordinate (all axes required)."""
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}, "
+                             f"got {list(coord_kwargs)}")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {key} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data",), inner_sep="_",
+                      outer_sep="-"):
+        """String like ``pipe_00-model_01`` used in checkpoint names
+        (reference ``topology.py:79``; consumed by ``engine.py:2406``)."""
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        """Extent of one axis (0 if absent — reference behavior)."""
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        """Coordinate namedtuple of a rank."""
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis):
+        """Lists of ranks that would form communicators along ``axis``:
+        all ranks that differ only in that axis.  Parity ``topology.py:131``."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for coord in itertools.product(*ranges):
+            other = dict(zip(other_axes, coord))
+            ranks = [self.get_rank(**{axis: i}, **other)
+                     for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """All ranks whose coordinates match the given axis values."""
+        def matches(coord):
+            return all(getattr(coord, ax) == val
+                       for ax, val in filter_kwargs.items())
+        return [rank for coord, rank in self.mapping.items() if matches(coord)]
+
+    def get_axis_list(self, axis, idx):
+        """Ranks with ``axis == idx``, sorted."""
+        return sorted(self.filter_match(**{axis: idx}))
+
+    def world_size(self):
+        import math
+        return math.prod(self.dims)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """axes = ['pipe', 'data'] — hybrid PP×DP (parity ``topology.py:235``)."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """axes = ['pipe', 'data', 'model'] — 3D (parity ``topology.py:246``)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Axis bookkeeping for one rank in a PP×DP(×MP) grid.
+
+    Parity: reference ``pipe/topology.py:252``, which builds NCCL groups for
+    every axis.  Here we only keep the rank arithmetic — the actual
+    communication rides the `jax` mesh — but the accessors match so checkpoint
+    naming, schedule construction, and tests carry over.
+    """
+
+    def __init__(self, topology=None, process_group=None, world_size=None,
+                 rank=0):
+        if topology is None:
+            assert world_size is not None
+            ensure_divisibility(world_size, 2, "default grid wants even world")
+            topology = PipeDataParallelTopology(2, world_size // 2)
+        self._topo = topology
+        self.global_rank = rank
+        self.world_size = topology.world_size()
+
+        self.data_parallel_size = max(topology.get_dim("data"), 1)
+        self.pipe_parallel_size = max(topology.get_dim("pipe"), 1)
+        self.model_parallel_size = max(topology.get_dim("model"), 1)
+        self.slice_parallel_size = self.model_parallel_size
+
+        coord = topology.get_coord(rank)
+        self.stage_id = getattr(coord, "pipe", 0)
+        self.data_parallel_id = getattr(coord, "data", 0)
+        self.model_parallel_id = getattr(coord, "model", 0) \
+            if "model" in topology.get_axis_names() else 0
+
+        # peer lists per axis (the reference's group rank lists)
+        self.pp_group = self._axis_peers("pipe")
+        self.dp_group = self._axis_peers("data")
+        self.mp_group = self._axis_peers("model") \
+            if "model" in topology.get_axis_names() else [rank]
+
+        # p2p neighbours on the pipe ring (reference p2p group pairs :373)
+        self.p2p_matrix = self._build_p2p()
+
+    def _axis_peers(self, axis):
+        if axis not in self._topo.get_axis_names():
+            return [self.global_rank]
+        for lst in self._topo.get_axis_comm_lists(axis):
+            if self.global_rank in lst:
+                return lst
+        return [self.global_rank]
+
+    def _build_p2p(self):
+        """(src → dst) pairs along the pipe axis ring for every pipe group."""
+        pairs = []
+        for lst in self._topo.get_axis_comm_lists("pipe"):
+            n = len(lst)
+            for i, src in enumerate(lst):
+                pairs.append((src, lst[(i + 1) % n]))
+        return pairs
+
+    # ---- accessors used by engines/checkpoint naming (reference API) ------
+    def get_stage_id(self):
+        return self.stage_id
+
+    def get_data_parallel_id(self):
+        return self.data_parallel_id
+
+    def get_pipe_parallel_rank(self):
+        return self.stage_id
+
+    def get_data_parallel_rank(self):
+        return self.data_parallel_id
+
+    def get_model_parallel_rank(self):
+        return self.model_parallel_id
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    def is_last_stage(self):
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def stage_to_global(self, stage_id, **kwargs):
+        """Global rank of ``stage_id`` keeping this rank's other coords."""
+        coord = self._topo.get_coord(self.global_rank)
+        transform = coord._replace(pipe=stage_id, **kwargs)._asdict()
+        return self._topo.get_rank(**transform)

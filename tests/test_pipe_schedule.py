@@ -1,0 +1,124 @@
+"""Schedule IR invariants (parity: reference ``tests/unit/test_pipe_schedule.py``)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.schedule import (
+    TrainSchedule, InferenceSchedule, DataParallelSchedule,
+    ForwardPass, BackwardPass, SendActivation, RecvActivation, SendGrad,
+    RecvGrad, LoadMicroBatch, OptimizerStep, ReduceGrads, ReduceTiedGrads)
+
+
+def _flat(sched):
+    return [cmd for step in sched for cmd in step]
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (8, 4), (2, 4), (1, 2)])
+def test_train_schedule_counts(micro_batches, stages):
+    for stage in range(stages):
+        sched = TrainSchedule(micro_batches=micro_batches, stages=stages,
+                              stage_id=stage)
+        cmds = _flat(sched)
+        fwd = [c for c in cmds if isinstance(c, ForwardPass)]
+        bwd = [c for c in cmds if isinstance(c, BackwardPass)]
+        assert len(fwd) == micro_batches
+        assert len(bwd) == micro_batches
+        # exactly one optimizer step at the end
+        assert isinstance(cmds[-1], OptimizerStep)
+        assert sum(isinstance(c, OptimizerStep) for c in cmds) == 1
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (8, 4)])
+def test_train_schedule_ordering(micro_batches, stages):
+    """Forward of mb i precedes backward of mb i; backwards are in order."""
+    for stage in range(stages):
+        sched = TrainSchedule(micro_batches, stages, stage)
+        fwd_pos, bwd_pos = {}, {}
+        fwd_seen = bwd_seen = 0
+        for pos, cmd in enumerate(_flat(sched)):
+            if isinstance(cmd, ForwardPass):
+                fwd_pos[fwd_seen] = pos
+                fwd_seen += 1
+            elif isinstance(cmd, BackwardPass):
+                bwd_pos[bwd_seen] = pos
+                bwd_seen += 1
+        for mb in range(micro_batches):
+            assert fwd_pos[mb] < bwd_pos[mb]
+
+
+@pytest.mark.parametrize("stages", [2, 4])
+def test_train_schedule_warmup_depth(stages):
+    """Peak in-flight forwards at stage s is bounded by stages - s (1F1B)."""
+    micro_batches = 8
+    for stage in range(stages):
+        sched = TrainSchedule(micro_batches, stages, stage)
+        in_flight = peak = 0
+        for cmd in _flat(sched):
+            if isinstance(cmd, ForwardPass):
+                in_flight += 1
+                peak = max(peak, in_flight)
+            elif isinstance(cmd, BackwardPass):
+                in_flight -= 1
+        assert peak <= stages - stage, \
+            f"stage {stage}: peak in-flight {peak} exceeds 1F1B bound"
+        assert peak <= sched.num_pipe_buffers()
+
+
+def test_train_schedule_sends_recvs():
+    """Interior stages send/recv both activations and grads; edges don't."""
+    sched = TrainSchedule(micro_batches=4, stages=4, stage_id=0)
+    cmds = _flat(sched)
+    assert not any(isinstance(c, RecvActivation) for c in cmds)
+    assert not any(isinstance(c, SendGrad) for c in cmds)
+    assert any(isinstance(c, SendActivation) for c in cmds)
+    assert any(isinstance(c, RecvGrad) for c in cmds)
+
+    sched = TrainSchedule(micro_batches=4, stages=4, stage_id=3)
+    cmds = _flat(sched)
+    assert not any(isinstance(c, SendActivation) for c in cmds)
+    assert not any(isinstance(c, RecvGrad) for c in cmds)
+    assert any(isinstance(c, RecvActivation) for c in cmds)
+    assert any(isinstance(c, SendGrad) for c in cmds)
+
+    # first stage loads data; last stage loads labels
+    s0 = _flat(TrainSchedule(4, 4, 0))
+    assert any(isinstance(c, LoadMicroBatch) for c in s0)
+    s3 = _flat(TrainSchedule(4, 4, 3))
+    assert any(isinstance(c, LoadMicroBatch) for c in s3)
+
+
+def test_train_schedule_reductions_last():
+    sched = TrainSchedule(micro_batches=2, stages=2, stage_id=0)
+    last_step = list(sched.steps())[-1]
+    names = [type(c).__name__ for c in last_step]
+    assert names == ["ReduceTiedGrads", "ReduceGrads", "OptimizerStep"]
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (3, 3), (1, 4)])
+def test_inference_schedule(micro_batches, stages):
+    for stage in range(stages):
+        sched = InferenceSchedule(micro_batches, stages, stage)
+        steps = list(sched.steps())
+        # total ticks = M + S - 1 (tick t at stage s serves micro-batch t-s)
+        assert len(steps) == micro_batches + stages - 1
+        cmds = [c for step in steps for c in step]
+        fwd = [c for c in cmds if isinstance(c, ForwardPass)]
+        assert len(fwd) == micro_batches
+        assert not any(isinstance(c, BackwardPass) for c in cmds)
+        assert sched.num_pipe_buffers() <= 2
+
+
+def test_buffer_ids_bounded():
+    for stage in range(4):
+        sched = TrainSchedule(micro_batches=8, stages=4, stage_id=stage)
+        nbuf = sched.num_pipe_buffers()
+        for cmd in _flat(sched):
+            if hasattr(cmd, "buffer_id"):
+                assert 0 <= cmd.buffer_id < nbuf
+
+
+def test_dataparallel_schedule():
+    sched = DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+    steps = list(sched.steps())
+    assert len(steps) == 3
+    assert any(isinstance(c, OptimizerStep) for c in steps[-1])
+    assert sched.num_pipe_buffers() == 1

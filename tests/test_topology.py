@@ -1,0 +1,88 @@
+"""Rank-grid topology math (parity: reference ``tests/unit/test_topology.py``
+— CPU-only, no devices needed)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.topology import (
+    ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
+    PipelineParallelGrid)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("a") == 2
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("c") == 4
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_rank_coord_roundtrip():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    for rank in range(topo.world_size()):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(**coord._asdict()) == rank
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    # ranks: (pipe,data) → p0d0=0 p0d1=1 p1d0=2 p1d1=3
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert [0, 2] in pipe_lists and [1, 3] in pipe_lists
+    data_lists = topo.get_axis_comm_lists("data")
+    assert [0, 1] in data_lists and [2, 3] in data_lists
+    assert topo.get_axis_comm_lists("nope") == []
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    ranks = topo.filter_match(pipe=0)
+    assert len(ranks) == 4
+    assert all(topo.get_coord(r).pipe == 0 for r in ranks)
+    ranks = topo.filter_match(pipe=1, model=1)
+    assert len(ranks) == 2
+
+
+def test_topology_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    # data omitted by default (checkpoint naming ignores the DP coordinate)
+    r = topo.get_rank_repr(rank=0)
+    assert "data" not in r
+    assert "pipe_00" in r
+
+
+def test_grid_basic():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, rank=0)
+    assert grid.pipe_parallel_size == 4
+    assert grid.data_parallel_size == 2
+    assert grid.get_stage_id() == 0
+    assert grid.is_first_stage()
+    last = PipelineParallelGrid(topology=topo, rank=topo.get_rank(pipe=3, data=0))
+    assert last.is_last_stage()
+
+
+def test_grid_stage_to_global():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    rank = topo.get_rank(pipe=0, data=1)
+    grid = PipelineParallelGrid(topology=topo, rank=rank)
+    nxt = grid.stage_to_global(stage_id=1)
+    assert topo.get_coord(nxt).pipe == 1
+    assert topo.get_coord(nxt).data == 1
+
+
+def test_grid_p2p_ring():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=1)
+    grid = PipelineParallelGrid(topology=topo, rank=0)
+    # the ring must include every stage handing to the next
+    assert (0, 1) in grid.p2p_matrix
+    assert (3, 0) in grid.p2p_matrix
