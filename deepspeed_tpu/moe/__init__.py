@@ -1,0 +1,15 @@
+"""Mixture-of-Experts / expert parallelism.
+
+Parity: reference ``deepspeed/moe/`` — ``MoE`` (``layer.py:18``), gating
+(``sharded_moe.py``), ``Experts`` (``experts.py:9``).  Expert parallelism
+rides the ``expert`` mesh axis (see ``parallel/mesh.py``).
+"""
+
+from .layer import MoE, MOELayer
+from .experts import Experts
+from .sharded_moe import TopKGate, top1gating, top2gating, compute_capacity
+from .utils import is_moe_param_path, split_moe_params
+
+__all__ = ["MoE", "MOELayer", "Experts", "TopKGate", "top1gating",
+           "top2gating", "compute_capacity", "is_moe_param_path",
+           "split_moe_params"]

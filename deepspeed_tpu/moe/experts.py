@@ -1,0 +1,48 @@
+"""Stacked expert bundle.
+
+Parity: reference ``deepspeed/moe/experts.py:9`` (``Experts``) — a ModuleList
+of deep-copied expert modules, each applied to its chunk of the dispatched
+tensor.  TPU re-design: ONE stacked parameter pytree with a leading expert
+axis, applied with ``jax.vmap`` — a single batched einsum per weight instead
+of a Python loop of per-expert matmuls, so the MXU sees one large batched
+contraction and the expert axis can be sharded over the ``expert`` mesh axis.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class Experts:
+    """``num_experts`` copies of ``expert`` with stacked parameters.
+
+    ``expert`` follows the layer protocol (``.init(rng)``, ``.apply``).
+    ``init`` → pytree whose leaves have a leading ``(num_experts,)`` axis.
+    ``apply(params, x)`` with ``x: (E, C, M)`` → ``(E, C, M_out)``.
+    """
+
+    def __init__(self, expert, num_experts: int = 1):
+        self.expert = expert
+        self.num_experts = num_experts
+
+    def init(self, rng):
+        rngs = jax.random.split(rng, self.num_experts)
+        return jax.vmap(self.expert.init)(rngs)
+
+    def apply(self, params, x, rng=None):
+        def one(p, xe, r):
+            out = self.expert.apply(p, xe, rng=r)
+            if isinstance(out, tuple):
+                out = out[0]
+            return out
+        if rng is not None:
+            rngs = jax.random.split(rng, self.num_experts)
+            return jax.vmap(one)(params, x, rngs)
+        return jax.vmap(lambda p, xe: one(p, xe, None))(params, x)
+
+    def partition_specs(self, params):
+        """Expert axis sharded over the ``expert`` mesh axis; inner expert
+        weight axes left for fsdp/tensor composition (reference: expert params
+        are per-EP-rank, ``experts.py:20 param.allreduce=False``)."""
+        return jax.tree_util.tree_map(
+            lambda p: P(*(("expert",) + (None,) * (p.ndim - 1))), params)

@@ -1,0 +1,44 @@
+"""MoE parameter bookkeeping.
+
+Parity: reference ``deepspeed/moe/utils.py`` — ``is_moe_param`` /
+``split_params_into_different_moe_groups_for_optimizer``.  The reference tags
+torch Parameters with ``.allreduce=False`` and group names so ZeRO can build
+expert-aware partitions (``stage_1_and_2.py:519 _configure_moe_settings``).
+Here params live in pytrees: MoE membership is a *path* property (any path
+segment named ``experts``), and "MoE-aware partitioning" is simply the
+``expert`` axis appearing in the leaf's PartitionSpec.
+"""
+
+import jax
+
+
+def _path_names(path):
+    names = []
+    for k in path:
+        if hasattr(k, "key"):
+            names.append(str(k.key))
+        elif hasattr(k, "name"):
+            names.append(str(k.name))
+        elif hasattr(k, "idx"):
+            names.append(str(k.idx))
+        else:
+            names.append(str(k))
+    return names
+
+
+def is_moe_param_path(path) -> bool:
+    """True when a pytree path addresses an expert-parallel parameter."""
+    return "experts" in _path_names(path)
+
+
+def split_moe_params(params):
+    """Split a param pytree into (non_moe, moe) trees with ``None`` holes.
+
+    Role parity: reference ``split_params_into_different_moe_groups_for_optimizer``
+    building separate optimizer param groups for expert vs dense params.
+    """
+    non_moe = jax.tree_util.tree_map_with_path(
+        lambda p, x: None if is_moe_param_path(p) else x, params)
+    moe = jax.tree_util.tree_map_with_path(
+        lambda p, x: x if is_moe_param_path(p) else None, params)
+    return non_moe, moe

@@ -99,7 +99,7 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+        lse_ref[:] = (m_ref[:] + jnp.log(l_safe))[:, 0]
 
 
 def _pad_t(x, Tp):
@@ -147,7 +147,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tp, d), q.dtype),
@@ -194,8 +194,8 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
         k = k_ref[0]            # (bk, d)
         v = v_ref[0]
         do = do_ref[0]          # (bq, d)
-        lse = lse_ref[0][:, None]        # (bq, 1)
-        delta = delta_ref[0][:, None]    # (bq, 1)
+        lse = lse_ref[:][:, None]        # (bq, 1)
+        delta = delta_ref[:][:, None]    # (bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
@@ -255,8 +255,8 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[:][:, None]
+        delta = delta_ref[:][:, None]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
@@ -307,8 +307,8 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # k
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # v
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # do
-        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),        # lse
-        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),        # delta
+        pl.BlockSpec((None, block_q), lambda b, j, i: (b, i)),     # lse
+        pl.BlockSpec((None, block_q), lambda b, j, i: (b, i)),     # delta
     ]
     dkdv_args = (q, k, v, dout, lse, delta)
     if layout is not None:
@@ -342,8 +342,8 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
+        pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
     ]
     dq_args = (q, k, v, dout, lse, delta)
     if layout is not None:

@@ -38,7 +38,11 @@ from ..utils.logging import logger
 MESH_AXES = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
 
 # Single source of truth for "which axes shard the batch dimension".
-BATCH_AXES = ("data", "fsdp")
+# ``expert`` is included: expert parallelism is a sub-grouping of data
+# parallelism exactly as in the reference (every rank is data-parallel and EP
+# groups partition the DP ranks, ``utils/groups.py:107-258``) — tokens shard
+# over the expert axis and expert-stacked params shard their expert dim on it.
+BATCH_AXES = ("data", "fsdp", "expert")
 
 
 def resolve_axis_sizes(axes: Optional[Dict[str, int]] = None,
@@ -104,7 +108,7 @@ def dp_world_size(mesh: Mesh) -> int:
 
 
 def batch_spec() -> P:
-    """PartitionSpec sharding the leading batch dim over (data, fsdp)."""
+    """PartitionSpec sharding the leading batch dim over ``BATCH_AXES``."""
     return P(BATCH_AXES)
 
 
@@ -121,6 +125,20 @@ def local_batch_size(mesh: Mesh, global_batch: int) -> int:
     if global_batch % ws != 0:
         raise ValueError(f"Global batch {global_batch} not divisible by dp world size {ws}")
     return global_batch // ws
+
+
+def maybe_constrain(x, spec: P):
+    """``with_sharding_constraint`` that degrades to identity when no mesh is
+    active or the mesh lacks the referenced axes (single-device eager use)."""
+    am = jax.sharding.get_abstract_mesh()
+    if am.empty:
+        return x
+    names = set(am.axis_names)
+    for entry in spec:
+        for ax in ((entry,) if isinstance(entry, str) else (entry or ())):
+            if ax not in names:
+                return x
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 class MeshContext:

@@ -1,0 +1,172 @@
+"""Sequence/context parallelism: ring attention + Ulysses head-scatter.
+
+NEW relative to the reference vintage (SURVEY.md §2.2: no SP/CP/ring/Ulysses
+exists in DeepSpeed 0.6.6 — its long-sequence story is block-sparse attention
++ curriculum seqlen).  The TPU framework makes long-context a first-class mesh
+axis ``seq``:
+
+- **Ring attention** (`ring_attention`): Q stays put; K/V shards rotate around
+  the ``seq`` axis ring via ``ppermute`` while each device maintains
+  fp32 online-softmax state (running max / denominator / weighted
+  accumulator).  ``n_seq - 1`` rotations fully overlap with the per-block
+  attention matmuls on ICI.  Memory per device is O(T_local²·heads) per block
+  pair — sequences scale linearly with the axis extent.
+- **Ulysses** (`ulysses_attention`): all_to_all converts sequence-sharding to
+  head-sharding (T/n, H) → (T, H/n), runs plain (flash) attention per head
+  group, and all_to_alls back.  Two collectives total; preferable when
+  heads ≥ axis extent and ICI all_to_all bandwidth beats ring latency.
+
+Both are differentiable end-to-end (``ppermute``/``all_to_all`` have
+transpose rules), so no custom VJP machinery is needed.
+"""
+
+import functools
+from typing import Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+# ------------------------------------------------------------- ring attention
+def ring_attention_inner(q, k, v, *, axis_name: str = "seq",
+                         causal: bool = True, sm_scale: Optional[float] = None):
+    """Per-shard ring attention; call inside ``shard_map``.
+
+    q, k, v: (B, T_local, H, d) — the local sequence shard. Returns the local
+    output shard (B, T_local, H, d).
+    """
+    B, T_loc, H, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+
+    q32_scale = jnp.float32(sm_scale)
+    iota_q = lax.broadcasted_iota(jnp.int32, (T_loc, T_loc), 0)
+    iota_k = lax.broadcasted_iota(jnp.int32, (T_loc, T_loc), 1)
+
+    def attend_block(carry, k_cur, v_cur, i):
+        """Online-softmax update of (o, m, l) against one K/V block."""
+        o, m, l = carry
+        src = (my - i) % n  # global block id of the K/V shard we now hold
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur,
+                       preferred_element_type=jnp.float32) * q32_scale
+        if causal:
+            q_pos = my * T_loc + iota_q
+            k_pos = src * T_loc + iota_k
+            valid = (q_pos >= k_pos)[None, None]          # (1,1,Tq,Tk)
+            s = jnp.where(valid, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)        # (B,H,Tq,1)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        if causal:
+            # fully-masked blocks must contribute 0, not exp(-inf - -inf) = 1
+            p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
+                        preferred_element_type=jnp.float32)
+        return (o * alpha + pv, m_new, l_new)
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        o, m, l = attend_block((o, m, l), k_cur, v_cur, i)
+        # rotate K/V to the next rank
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((B, H, T_loc, d), jnp.float32)
+    m0 = jnp.full((B, H, T_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T_loc, 1), jnp.float32)
+    # n-1 rotations; the last block is consumed without a (dead) final rotate
+    (o, m, l, k_last, v_last), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n - 1))
+    o, m, l = attend_block((o, m, l), k_last, v_last, jnp.int32(n - 1))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l_safe).astype(q.dtype)                    # (B,H,Tq,d)
+    return out.transpose(0, 2, 1, 3)
+
+
+def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
+                   axis_name: str = "seq", causal: bool = True,
+                   sm_scale: Optional[float] = None,
+                   batch_spec=P()):
+    """Ring attention over global (B, T, H, d) arrays.
+
+    Shards the T axis over ``axis_name`` with ``shard_map`` and runs
+    :func:`ring_attention_inner`.  ``batch_spec`` optionally shards B (e.g.
+    ``P(('data','fsdp'))`` when composing with data parallelism).
+    """
+    if mesh is None:
+        am = jax.sharding.get_abstract_mesh()
+        assert not am.empty, "ring_attention needs a mesh (pass mesh= or set one)"
+        mesh = am
+    b = tuple(batch_spec)[0] if len(tuple(batch_spec)) else None
+    spec = P(b, axis_name, None, None)
+    fn = functools.partial(ring_attention_inner, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
+# ------------------------------------------------------------------- Ulysses
+def ulysses_attention_inner(q, k, v, *, axis_name: str = "seq",
+                            causal: bool = True,
+                            sm_scale: Optional[float] = None,
+                            attn_fn: Optional[Callable] = None):
+    """Per-shard Ulysses attention; call inside ``shard_map``.
+
+    q, k, v: (B, T_local, H, d) sequence-sharded.  all_to_all re-shards to
+    (B, T, H_local, d), computes attention with full sequence context per head
+    group, and re-shards back.  Requires H divisible by the axis extent.
+    """
+    if attn_fn is None:
+        def attn_fn(q, k, v, *, causal, sm_scale):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                           preferred_element_type=jnp.float32)
+            s = s * (sm_scale if sm_scale is not None
+                     else 1.0 / np.sqrt(q.shape[-1]))
+            if causal:
+                T = q.shape[1]
+                mask = jnp.tril(jnp.ones((T, T), bool))
+                s = jnp.where(mask[None, None], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+    n = lax.axis_size(axis_name)
+    assert q.shape[2] % n == 0, \
+        f"Ulysses needs heads ({q.shape[2]}) divisible by seq axis ({n})"
+    # seq-sharded → head-sharded: split heads, gather sequence
+    scatter = lambda x: lax.all_to_all(x, axis_name, split_axis=2,
+                                       concat_axis=1, tiled=True)
+    gather = lambda x: lax.all_to_all(x, axis_name, split_axis=1,
+                                      concat_axis=2, tiled=True)
+    qh, kh, vh = scatter(q), scatter(k), scatter(v)       # (B, T, H/n, d)
+    out = attn_fn(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return gather(out)                                     # (B, T/n, H, d)
+
+
+def ulysses_attention(q, k, v, *, mesh: Optional[Mesh] = None,
+                      axis_name: str = "seq", causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None,
+                      batch_spec=P()):
+    """Ulysses attention over global (B, T, H, d) arrays (see inner)."""
+    if mesh is None:
+        am = jax.sharding.get_abstract_mesh()
+        assert not am.empty, "ulysses_attention needs a mesh"
+        mesh = am
+    b = tuple(batch_spec)[0] if len(tuple(batch_spec)) else None
+    spec = P(b, axis_name, None, None)
+    fn = functools.partial(ulysses_attention_inner, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale, attn_fn=attn_fn)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

@@ -316,7 +316,7 @@ class DeepSpeedEngine:
         """One full optimizer step: scan over gas microbatches, reduce, update.
 
         ``batch`` leaves are shaped (gas, global_micro_batch, ...) with the
-        second axis sharded over (data, fsdp).
+        second axis sharded over the batch axes (data, fsdp, expert).
         """
         dtype = self.compute_dtype
         needs_master = dtype != jnp.float32
@@ -400,13 +400,16 @@ class DeepSpeedEngine:
     def _stack_microbatches(self, micro_batches):
         batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro_batches)
         sh = jax.tree_util.tree_map(
-            lambda x: NamedSharding(self.mesh, P(None, ("data", "fsdp"))), batch)
+            lambda x: NamedSharding(self.mesh, P(None, M.BATCH_AXES)), batch)
         return jax.device_put(batch, sh)
 
     def _run_fused_step(self, batch):
         self.tput_timer.start()
         rng = jax.random.fold_in(self._base_rng, self.micro_steps)
-        self.state, metrics = self._jit_train_step(self.state, batch, rng)
+        # trace with the mesh in context so bare-PartitionSpec sharding
+        # constraints inside models (MoE expert axis, SP) bind to it
+        with jax.set_mesh(self.mesh):
+            self.state, metrics = self._jit_train_step(self.state, batch, rng)
         self._last_metrics = metrics
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
@@ -434,11 +437,12 @@ class DeepSpeedEngine:
             self._jit_eval = jax.jit(eval_fn)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         batch = self._device_batch(batch)
-        return self._jit_eval(self.state.params, batch, rng)
+        with jax.set_mesh(self.mesh):
+            return self._jit_eval(self.state.params, batch, rng)
 
     def _device_batch(self, batch):
         sh = jax.tree_util.tree_map(
-            lambda x: NamedSharding(self.mesh, P(("data", "fsdp"))), batch)
+            lambda x: NamedSharding(self.mesh, P(M.BATCH_AXES)), batch)
         return jax.device_put(batch, sh)
 
     # --- forward/backward/step compatibility shim -------------------------

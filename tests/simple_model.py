@@ -44,6 +44,71 @@ class SimpleModel:
         return jnp.mean(jnp.square(pred.astype(jnp.float32) - y.astype(jnp.float32)))
 
 
+class ExpertMLP:
+    """One expert: hidden→4h→hidden MLP (layer protocol)."""
+
+    def __init__(self, dim, hidden=None):
+        self.dim = dim
+        self.hidden = hidden or 4 * dim
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (self.dim, self.hidden), jnp.float32) / np.sqrt(self.dim),
+            "b1": jnp.zeros((self.hidden,), jnp.float32),
+            "w2": jax.random.normal(k2, (self.hidden, self.dim), jnp.float32) / np.sqrt(self.hidden),
+            "b2": jnp.zeros((self.dim,), jnp.float32),
+        }
+
+    def apply(self, params, x, rng=None):
+        h = jax.nn.relu(x @ params["w1"].astype(x.dtype) + params["b1"].astype(x.dtype))
+        return h @ params["w2"].astype(x.dtype) + params["b2"].astype(x.dtype)
+
+
+class SimpleMoEModel:
+    """Linear → MoE → Linear regression model (parity: reference
+    ``tests/unit/simple_model.py:40 SimpleMoEModel``)."""
+
+    def __init__(self, dim=8, num_experts=4, k=1, use_residual=False,
+                 aux_coef=0.01, capacity_factor=2.0, min_capacity=0,
+                 use_rts=False, noisy_gate_policy=None):
+        from deepspeed_tpu.moe import MoE
+        self.dim = dim
+        self.aux_coef = aux_coef
+        self.moe = MoE(dim, ExpertMLP(dim), num_experts=num_experts, k=k,
+                       capacity_factor=capacity_factor, min_capacity=min_capacity,
+                       use_residual=use_residual, use_rts=use_rts,
+                       noisy_gate_policy=noisy_gate_policy)
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        return {
+            "proj_in": {"w": jax.random.normal(k1, (self.dim, self.dim), jnp.float32) / np.sqrt(self.dim)},
+            "moe": self.moe.init(k2),
+            "proj_out": {"w": jax.random.normal(k3, (self.dim, self.dim), jnp.float32) / np.sqrt(self.dim)},
+        }
+
+    def apply(self, params, x, rng=None, train=True):
+        h = x @ params["proj_in"]["w"].astype(x.dtype)
+        h, l_aux, exp_counts = self.moe.apply(params["moe"], h, rng=rng, train=train)
+        y = h @ params["proj_out"]["w"].astype(x.dtype)
+        return y, l_aux
+
+    def loss(self, params, batch, rng):
+        x, y = batch
+        pred, l_aux = self.apply(params, x, rng=rng)
+        mse = jnp.mean(jnp.square(pred.astype(jnp.float32) - y.astype(jnp.float32)))
+        return mse + self.aux_coef * l_aux
+
+    def partition_specs(self, params):
+        from jax.sharding import PartitionSpec as P
+        return {
+            "proj_in": jax.tree_util.tree_map(lambda p: P(), params["proj_in"]),
+            "moe": self.moe.partition_specs(params["moe"]),
+            "proj_out": jax.tree_util.tree_map(lambda p: P(), params["proj_out"]),
+        }
+
+
 def random_dataset(n=256, dim=8, seed=0):
     """Linear-teacher regression data (learnable, deterministic)."""
     rng = np.random.default_rng(seed)

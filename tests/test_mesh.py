@@ -40,7 +40,7 @@ def test_batch_sharding_roundtrip(mesh8):
     x = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
     sharded = jax.device_put(x, M.batch_sharding(mesh8))
     np.testing.assert_array_equal(np.asarray(sharded), x)
-    assert sharded.sharding.spec == P(("data", "fsdp"))
+    assert sharded.sharding.spec == P(M.BATCH_AXES)
 
 
 def _smap(mesh, fn, in_spec, out_spec):
