@@ -1,0 +1,129 @@
+"""Error-compensated 1-bit compressed allreduce.
+
+Parity: reference ``deepspeed/runtime/comm/nccl.py:52``
+(``NcclBackend.compressed_allreduce``) and ``comm/mpi.py:170`` — the custom
+allreduce used by the 1-bit optimizers: each rank sends only the SIGN of the
+(error-compensated) tensor plus one fp32 scale, in two phases (worker →
+server chunk owners → broadcast), with per-rank worker/server error feedback
+buffers accumulating what the quantization dropped.
+
+TPU re-design:
+
+- The cupy bit-packing + NCCL alltoall/allgather pipeline becomes pure jnp:
+  signs pack to uint8 via ``jnp.packbits`` (32× smaller than fp32 on the
+  wire) and ride ``lax.all_to_all`` / ``lax.all_gather`` on a named mesh
+  axis inside ``shard_map``.  This matters only for DCN-spanning axes; over
+  ICI a plain psum is usually faster (reference docs say the same about
+  NVLink vs Ethernet, ``docs/_pages/features.md:179``).
+- ``sign(0) → +1`` exactly like the reference's ``sign().add_(1).bool()``
+  trick (``nccl.py:74``).
+- Scale = ||x||₂ / √numel (``nccl.py:73 worker_scale``).
+- When no axis is given (or the axis extent is 1) the same two-phase
+  quantization runs locally — the degenerate single-rank case.
+
+Called inside ``shard_map``; all shapes static.  Returns
+``(result, new_worker_error, new_server_error)``.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def padded_size(numel: int, world_size: int) -> int:
+    """Flat size padded so each of ``world_size`` chunks packs to whole bytes
+    (parity: reference ``corrected_tensor_size`` divider math,
+    ``onebit/adam.py:172-180``)."""
+    mult = world_size * 8
+    return int(int(np.ceil(numel / mult)) * mult)
+
+
+def server_chunk_size(numel: int, world_size: int) -> int:
+    return padded_size(numel, world_size) // world_size
+
+
+def _sign(x):
+    """sign with sign(0) = +1 (reference ``sign().add_(1).bool()`` mapping)."""
+    return jnp.where(x >= 0, 1.0, -1.0).astype(jnp.float32)
+
+
+def _scale(x):
+    return jnp.linalg.norm(x) / np.sqrt(x.size)
+
+
+def _quantize(x):
+    """One error-feedback quantization: x → (scale, sign, residual)."""
+    s = _scale(x)
+    sg = _sign(x)
+    return s, sg, x - s * sg
+
+
+def compressed_allreduce(x, worker_error, server_error,
+                         axis_name: Optional[str] = None,
+                         world_size: int = 1) -> Tuple:
+    """Two-phase error-compensated 1-bit allreduce of ``x``.
+
+    ``x``: any-shape fp32 tensor (same shape on every rank, different values).
+    ``worker_error``: (padded_size,) fp32; ``server_error``: (chunk,) fp32.
+    Inside ``shard_map`` pass ``axis_name``; ``world_size`` must equal the
+    axis extent (static).  Returns (averaged_x, new_worker_err, new_server_err).
+    """
+    shape = x.shape
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = world_size
+    L = worker_error.shape[0]
+    if flat.size != L:
+        flat = jnp.pad(flat, (0, L - flat.size))
+
+    # ---- worker phase (reference nccl.py:71-84) -------------------------
+    compensated = flat + worker_error
+    w_scale, w_sign, new_worker_error = _quantize(compensated)
+
+    if axis_name is None or n <= 1:
+        # degenerate single-rank path: same two-phase math, no wire; the
+        # server "chunk" is the full tensor (init buffers with world_size=1)
+        assert server_error.shape[0] == L, \
+            "single-rank mode needs full-size server_error (init with world_size=1)"
+        s_scale, s_sign, new_server_error = _quantize(w_scale * w_sign + server_error)
+        result = s_scale * s_sign
+        return result[:x.size].reshape(shape), new_worker_error, new_server_error
+
+    # ---- wire format: packed sign bits + one fp32 scale ------------------
+    bits = jnp.packbits((w_sign > 0).reshape(n, -1), axis=1)       # (n, L/n/8) u8
+    # alltoall: rank j receives chunk j of every rank's sign vector
+    recv_bits = lax.all_to_all(bits, axis_name, split_axis=0,
+                               concat_axis=0, tiled=False)          # (n, chunk/8)
+    scales = lax.all_gather(w_scale, axis_name)                     # (n,)
+
+    signs = jnp.unpackbits(recv_bits, axis=1).astype(jnp.float32) * 2.0 - 1.0
+    # server phase: exact average of the compressed values of my chunk
+    # (reference nccl.py:126-135)
+    avg_chunk = jnp.einsum("rc,r->c", signs, scales) / n            # (chunk,)
+    comp_server = avg_chunk + server_error
+    s_scale, s_sign, new_server_error = _quantize(comp_server)
+
+    # phase 2: broadcast my compressed chunk to everyone
+    s_bits = jnp.packbits(s_sign > 0)                               # (chunk/8,) u8
+    all_bits = lax.all_gather(s_bits, axis_name)                    # (n, chunk/8)
+    all_scales = lax.all_gather(s_scale, axis_name)                 # (n,)
+    all_signs = jnp.unpackbits(all_bits, axis=1).astype(jnp.float32) * 2.0 - 1.0
+    result = (all_signs * all_scales[:, None]).reshape(-1)          # (L,)
+    return result[:x.size].reshape(shape), new_worker_error, new_server_error
+
+
+def init_error_buffers(params, world_size: int):
+    """Per-leaf (worker_error, server_error) zero buffers (reference
+    ``state['worker_error']/['server_error']`` init, ``onebit/adam.py:181-186``)."""
+    def werr(p):
+        return jnp.zeros((padded_size(int(np.prod(p.shape)), world_size),),
+                         jnp.float32)
+
+    def serr(p):
+        return jnp.zeros((server_chunk_size(int(np.prod(p.shape)), world_size),),
+                         jnp.float32)
+
+    return (jax.tree_util.tree_map(werr, params),
+            jax.tree_util.tree_map(serr, params))

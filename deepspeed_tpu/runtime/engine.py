@@ -189,6 +189,8 @@ class DeepSpeedEngine:
         if client_optimizer is not None:
             assert hasattr(client_optimizer, "init") and hasattr(client_optimizer, "update"), \
                 "client optimizer must expose .init(params) and .update(...)"
+            if hasattr(client_optimizer, "set_world_size"):
+                client_optimizer.set_world_size(self.mesh_ctx.dp_world_size)
             return client_optimizer
         name = self.config.optimizer_name
         if name is None:
@@ -198,27 +200,31 @@ class DeepSpeedEngine:
         if name == C.ADAMW_OPTIMIZER:
             p.pop("adam_w_mode", None)  # implied by the optimizer type
         if name in (C.ADAM_OPTIMIZER,):
-            return FusedAdam(**p)
-        if name == C.ADAMW_OPTIMIZER:
-            return FusedAdamW(**p)
-        if name == C.LAMB_OPTIMIZER:
-            return FusedLamb(**p)
-        if name == C.ONEBIT_ADAM_OPTIMIZER:
+            opt = FusedAdam(**p)
+        elif name == C.ADAMW_OPTIMIZER:
+            opt = FusedAdamW(**p)
+        elif name == C.LAMB_OPTIMIZER:
+            opt = FusedLamb(**p)
+        elif name == C.ONEBIT_ADAM_OPTIMIZER:
             from .fp16.onebit.adam import OnebitAdam
-            return OnebitAdam(**p)
-        if name == C.ONEBIT_LAMB_OPTIMIZER:
+            opt = OnebitAdam(**p)
+        elif name == C.ONEBIT_LAMB_OPTIMIZER:
             from .fp16.onebit.lamb import OnebitLamb
-            return OnebitLamb(**p)
-        if name == C.ZERO_ONE_ADAM_OPTIMIZER:
+            opt = OnebitLamb(**p)
+        elif name == C.ZERO_ONE_ADAM_OPTIMIZER:
             from .fp16.onebit.zoadam import ZeroOneAdam
-            return ZeroOneAdam(**p)
-        if name == C.ADAGRAD_OPTIMIZER:
+            opt = ZeroOneAdam(**p)
+        elif name == C.ADAGRAD_OPTIMIZER:
             from ..ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
-            return DeepSpeedCPUAdagrad(**p)
-        if name == C.SGD_OPTIMIZER:
+            opt = DeepSpeedCPUAdagrad(**p)
+        elif name == C.SGD_OPTIMIZER:
             from ..ops.sgd import SGD
-            return SGD(**p)
-        raise ValueError(f"Unknown optimizer type {name!r}")
+            opt = SGD(**p)
+        else:
+            raise ValueError(f"Unknown optimizer type {name!r}")
+        if hasattr(opt, "set_world_size"):
+            opt.set_world_size(self.mesh_ctx.dp_world_size)
+        return opt
 
     def _configure_lr_scheduler(self, client_scheduler):
         """Parity: reference ``engine.py:780``."""

@@ -1,0 +1,12 @@
+"""1-bit / communication-compressed optimizers.
+
+Parity: reference ``deepspeed/runtime/fp16/onebit/`` — ``OnebitAdam``
+(``adam.py:14``), ``OnebitLamb`` (``lamb.py:11``), ``ZeroOneAdam``
+(``zoadam.py:14``).
+"""
+
+from .adam import OnebitAdam
+from .lamb import OnebitLamb
+from .zoadam import ZeroOneAdam
+
+__all__ = ["OnebitAdam", "OnebitLamb", "ZeroOneAdam"]

@@ -1,0 +1,183 @@
+"""1-bit LAMB: communication-compressed LAMB.
+
+Parity: reference ``deepspeed/runtime/fp16/onebit/lamb.py:11`` (``OnebitLamb``):
+
+- **warmup** (step < freeze_step): baseline LAMB — per-tensor trust ratio
+  ``lamb_coeff = clamp(‖w‖/‖update‖, min_coeff, max_coeff)`` with an EMA
+  tracked in ``lamb_coeff_freeze`` (``lamb.py:237-247``); at ``freeze_step``
+  the variance is snapshotted into ``exp_avg_sq_fresh`` (:229) and a
+  per-tensor ``scaling_coeff = united_scale / momentum_scale`` is computed
+  (:169-184) to equalize momentum magnitudes before 1-bit compression.
+- **compression stage**: momentum updated locally, scaled by
+  ``scaling_coeff``, compressed-allreduced, unscaled (:249-255, :336); the
+  fresh variance keeps updating from the *reconstructed* gradient
+  ``(m - β₁ m_prev)/(1-β₁)`` (:352-356); the effective trust ratio is the
+  frozen EMA times a drift factor ``max(√v_frozen+eps / √v_fresh+eps)``
+  clipped to [factor_min, factor_max] and rate-limited by
+  ``factor_threshold`` against its last value (:364-383).
+
+TPU re-design: branchless jitted update; host-side key flips become
+``jnp.where`` on the traced step.  The per-tensor ``united_scale`` (a mean
+over ALL tensors' momentum scales) is computed inside the same jitted update
+at the freeze boundary.
+"""
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...comm.compressed import compressed_allreduce, init_error_buffers
+
+
+class OnebitLambState(NamedTuple):
+    exp_avg: dict
+    exp_avg_sq: dict
+    exp_avg_sq_fresh: dict
+    worker_error: dict
+    server_error: dict
+    scaling_coeff: dict       # scalar per leaf
+    lamb_coeff_freeze: dict   # scalar per leaf (EMA of warmup trust ratios)
+    last_factor: dict         # scalar per leaf
+
+
+class OnebitLamb:
+    name = "onebitlamb"
+
+    def __init__(self, lr=1e-3, freeze_step=100000, betas=(0.9, 0.999),
+                 eps=1e-8, weight_decay=0.0, max_coeff=10.0, min_coeff=0.01,
+                 bias_correction=True, amsgrad=False, cuda_aware=False,
+                 comm_backend_name="nccl", coeff_beta=0.9, factor_max=4.0,
+                 factor_min=0.5, factor_threshold=0.1,
+                 axis_name: Optional[str] = None):
+        if amsgrad:
+            raise RuntimeError("1-bit Lamb does not support the AMSGrad variant")
+        self.lr = lr
+        self.freeze_step = freeze_step
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.coeff_beta = coeff_beta
+        self.factor_max = factor_max
+        self.factor_min = factor_min
+        self.factor_threshold = factor_threshold
+        self.comm_backend_name = comm_backend_name
+        self.axis_name = axis_name
+        self.world_size = 1
+
+    def set_world_size(self, n: int):
+        self.world_size = int(n) if self.axis_name is not None else 1
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        one = lambda p: jnp.asarray(1.0, jnp.float32)
+        zero = lambda p: jnp.asarray(0.0, jnp.float32)
+        werr, serr = init_error_buffers(
+            params, self.world_size if self.axis_name is not None else 1)
+        tm = jax.tree_util.tree_map
+        return OnebitLambState(
+            exp_avg=tm(zeros, params), exp_avg_sq=tm(zeros, params),
+            exp_avg_sq_fresh=tm(zeros, params),
+            worker_error=werr, server_error=serr,
+            scaling_coeff=tm(one, params),
+            lamb_coeff_freeze=tm(zero, params),
+            last_factor=tm(one, params))
+
+    def update(self, grads, state: OnebitLambState, params, *, step, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = jnp.asarray(step, jnp.int32)
+        frozen = step > self.freeze_step
+        at_freeze = step == self.freeze_step
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        fl = treedef.flatten_up_to
+        flat_g = fl(grads)
+        flat_m, flat_v = fl(state.exp_avg), fl(state.exp_avg_sq)
+        flat_vf = fl(state.exp_avg_sq_fresh)
+        flat_we, flat_se = fl(state.worker_error), fl(state.server_error)
+        flat_sc = fl(state.scaling_coeff)
+        flat_cf = fl(state.lamb_coeff_freeze)
+        flat_lf = fl(state.last_factor)
+
+        # momentum update happens in both stages (lamb.py:227,:253)
+        flat_m1 = [b1 * m + (1.0 - b1) * g.astype(jnp.float32)
+                   for m, g in zip(flat_m, flat_g)]
+
+        # scaling_coeff at the freeze boundary: united (mean) momentum scale
+        # over all tensors / this tensor's scale (lamb.py:169-184)
+        mom_scales = [jnp.linalg.norm(m) / np.sqrt(m.size) for m in flat_m1]
+        united = sum(mom_scales) / len(mom_scales)
+        flat_sc = [jnp.where(at_freeze, united / jnp.maximum(ms, 1e-16), sc)
+                   for ms, sc in zip(mom_scales, flat_sc)]
+        # variance snapshot at the freeze boundary (lamb.py:229)
+        flat_vf = [jnp.where(at_freeze, b2 * v + (1.0 - b2) * jnp.square(g),
+                             vf)
+                   for v, vf, g in zip(flat_v, flat_vf, flat_g)]
+
+        outs = []
+        for (p, g, m_prev, m1, v, vf, we, se, sc, cf, lf) in zip(
+                flat_p, flat_g, flat_m, flat_m1, flat_v, flat_vf, flat_we,
+                flat_se, flat_sc, flat_cf, flat_lf):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+
+            # ---- warmup branch -------------------------------------------
+            v_warm = b2 * v + (1.0 - b2) * jnp.square(g)
+            upd_warm = m1 / (jnp.sqrt(v_warm) + self.eps)
+            if self.weight_decay > 0.0:
+                upd_warm = upd_warm + self.weight_decay * p32
+            wnorm = jnp.linalg.norm(p32)
+            unorm = jnp.linalg.norm(upd_warm)
+            coeff = jnp.where((wnorm > 0) & (unorm > 0),
+                              jnp.clip(wnorm / jnp.maximum(unorm, 1e-16),
+                                       self.min_coeff, self.max_coeff), 1.0)
+            cf_new = jnp.where(coeff != 1.0,
+                               self.coeff_beta * cf + (1 - self.coeff_beta) * coeff,
+                               cf)
+
+            # ---- compression branch (lamb.py:326-386) --------------------
+            m_comm, we_n, se_n = compressed_allreduce(
+                m1 * sc, we, se, axis_name=self.axis_name,
+                world_size=self.world_size)
+            m_frozen = m_comm / sc
+            grad_recon = (m_frozen - m_prev * b1) / (1.0 - b1)
+            vf_new = b2 * vf + (1.0 - b2) * jnp.square(grad_recon)
+            denom = jnp.sqrt(v) + self.eps            # frozen variance
+            upd_prelim = m_frozen / denom
+            if self.weight_decay > 0.0:
+                upd_frozen = upd_prelim + self.weight_decay * p32
+            else:
+                upd_frozen = upd_prelim
+            denom_real = jnp.sqrt(vf_new) + self.eps
+            factor = jnp.max(denom / denom_real)
+            if self.weight_decay > 0.0:
+                ratio = jnp.minimum(
+                    1.0, jnp.linalg.norm(upd_prelim) /
+                    jnp.maximum(jnp.linalg.norm(upd_frozen), 1e-16))
+                factor = factor * ratio + (1.0 - ratio)
+            factor = jnp.clip(factor, self.factor_min, self.factor_max)
+            factor = jnp.clip(factor, lf * (1.0 - self.factor_threshold),
+                              lf * (1.0 + self.factor_threshold))
+            lamb_coeff_frozen = cf * factor
+
+            # ---- select by stage ----------------------------------------
+            sel = lambda a, b: jnp.where(frozen, a, b)
+            m_new = sel(m_frozen, m1)
+            v_new = sel(v, v_warm)
+            vf_out = sel(vf_new, vf)
+            p_new = sel(p32 - lr * lamb_coeff_frozen * upd_frozen,
+                        p32 - lr * coeff * upd_warm).astype(p.dtype)
+            outs.append((p_new, m_new, v_new, vf_out,
+                         sel(we_n, we), sel(se_n, se), sc,
+                         sel(cf, cf_new), sel(factor, lf)))
+
+        unf = lambda i: treedef.unflatten([o[i] for o in outs])
+        new_state = OnebitLambState(
+            exp_avg=unf(1), exp_avg_sq=unf(2), exp_avg_sq_fresh=unf(3),
+            worker_error=unf(4), server_error=unf(5), scaling_coeff=unf(6),
+            lamb_coeff_freeze=unf(7), last_factor=unf(8))
+        return unf(0), new_state

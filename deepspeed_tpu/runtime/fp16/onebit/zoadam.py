@@ -1,0 +1,158 @@
+"""0/1 Adam: adaptive-frequency compressed Adam (https://arxiv.org/abs/2202.06009).
+
+Parity: reference ``deepspeed/runtime/fp16/onebit/zoadam.py:14`` (``ZeroOneAdam``):
+
+- Variance is updated only on steps where ``step % var_interval == 0``; the
+  interval DOUBLES every ``var_update_scaler`` variance updates
+  (``zoadam.py:285-291``) — exponentially rarer exact synchronization.
+- On non-variance steps the *gradient* is synchronized with the compressed
+  allreduce and folded into the momentum (``zoadam.py:213-233``).
+- After ``var_freeze_step`` the variance freezes and "local steps" begin:
+  parameters drift locally while an accumulator collects the updates; every
+  ``local_step_interval`` steps the accumulated update is compressed-synced
+  and applied, the momentum is reconstructed from it, and the interval grows
+  (doubling, clipped to ``local_step_clipper``) (``zoadam.py:258-282,303-309``).
+
+TPU re-design: the whole policy state machine (intervals, counters, lr sum,
+momentum accumulator) lives as traced int32/fp32 scalars in the optimizer
+state; every branch is a ``jnp.where`` so the update stays one jitted SPMD
+program.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...comm.compressed import compressed_allreduce, init_error_buffers
+
+
+class ZeroOneAdamState(NamedTuple):
+    exp_avg: dict
+    exp_avg_sq: dict
+    worker_error: dict
+    server_error: dict
+    momentum_accumulator: dict
+    var_interval: jnp.ndarray        # i32 scalar
+    var_counter: jnp.ndarray         # i32 scalar
+    local_step_interval: jnp.ndarray  # i32 scalar
+    local_step_counter: jnp.ndarray  # i32 scalar
+    lrs: jnp.ndarray                 # f32 scalar — sum of lrs since last sync
+
+
+class ZeroOneAdam:
+    name = "zerooneadam"
+
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-8, weight_decay=0.0, var_freeze_step=100000,
+                 var_update_scaler=16, local_step_scaler=32678,
+                 local_step_clipper=16, amsgrad=False, cuda_aware=False,
+                 comm_backend_name="nccl", axis_name: Optional[str] = None):
+        if amsgrad:
+            raise RuntimeError("0/1 Adam does not support the AMSGrad variant")
+        self.lr = lr
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.var_freeze_step = var_freeze_step
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+        self.comm_backend_name = comm_backend_name
+        self.axis_name = axis_name
+        self.world_size = 1
+
+    def set_world_size(self, n: int):
+        self.world_size = int(n) if self.axis_name is not None else 1
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        werr, serr = init_error_buffers(
+            params, self.world_size if self.axis_name is not None else 1)
+        tm = jax.tree_util.tree_map
+        i32 = lambda v: jnp.asarray(v, jnp.int32)
+        return ZeroOneAdamState(
+            exp_avg=tm(zeros, params), exp_avg_sq=tm(zeros, params),
+            worker_error=werr, server_error=serr,
+            momentum_accumulator=tm(zeros, params),
+            var_interval=i32(1), var_counter=i32(0),
+            local_step_interval=i32(1), local_step_counter=i32(0),
+            lrs=jnp.asarray(0.0, jnp.float32))
+
+    def update(self, grads, state: ZeroOneAdamState, params, *, step, lr=None):
+        lr = jnp.asarray(self.lr if lr is None else lr, jnp.float32)
+        b1, b2 = self.betas
+        step = jnp.asarray(step, jnp.int32)
+        frozen = step > self.var_freeze_step          # zoadam.py:324-326
+        var_step = (step % state.var_interval == 0) & ~frozen
+        local_sync = (step % state.local_step_interval == 0) & frozen
+
+        lrs_new = jnp.where(frozen, state.lrs + lr, state.lrs)
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        fl = treedef.flatten_up_to
+        outs = []
+        for p, g, m, v, we, se, acc in zip(
+                flat_p, fl(grads), fl(state.exp_avg), fl(state.exp_avg_sq),
+                fl(state.worker_error), fl(state.server_error),
+                fl(state.momentum_accumulator)):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+
+            # gradient compressed-sync on non-variance steps (zoadam.py:218-233)
+            g_onebit, we1, se1 = compressed_allreduce(
+                g, we, se, axis_name=self.axis_name, world_size=self.world_size)
+            g_eff = jnp.where(var_step | frozen, g, g_onebit)
+            we = jnp.where(var_step | frozen, we, we1)
+            se = jnp.where(var_step | frozen, se, se1)
+
+            m_new = b1 * m + (1.0 - b1) * g_eff
+            v_new = jnp.where(var_step, b2 * v + (1.0 - b2) * jnp.square(g), v)
+
+            update = m_new / (jnp.sqrt(v_new) + self.eps)
+            if self.weight_decay > 0.0:
+                update = update + self.weight_decay * p32
+            p1 = p32 - lr * update
+            acc1 = jnp.where(frozen, acc - lr * update, acc)
+
+            # local-step sync (zoadam.py:258-282): apply accumulated update
+            # exactly, reconstruct momentum from the synced accumulator
+            acc_m = acc1 * (jnp.sqrt(v_new) + self.eps)
+            acc_sync, we2, se2 = compressed_allreduce(
+                acc_m, we, se, axis_name=self.axis_name,
+                world_size=self.world_size)
+            p_sync = p1 - acc1 + acc_sync / (jnp.sqrt(v_new) + self.eps)
+            m_sync = -acc_sync / jnp.maximum(lrs_new, 1e-16)
+
+            do_sync = local_sync
+            p_new = jnp.where(do_sync, p_sync, p1).astype(p.dtype)
+            m_out = jnp.where(do_sync, m_sync, m_new)
+            acc_out = jnp.where(do_sync, jnp.zeros_like(acc1), acc1)
+            we_out = jnp.where(do_sync, we2, we)
+            se_out = jnp.where(do_sync, se2, se)
+            outs.append((p_new, m_out, v_new, we_out, se_out, acc_out))
+
+        # ---- policy-state updates (zoadam.py:285-309) ----------------------
+        vc = jnp.where(var_step, state.var_counter + 1, state.var_counter)
+        bump = var_step & (vc == self.var_update_scaler)
+        var_counter = jnp.where(bump, 0, vc)
+        var_interval = jnp.where(bump, state.var_interval * 2,
+                                 state.var_interval)
+        lc = jnp.where(frozen, state.local_step_counter + 1,
+                       state.local_step_counter)
+        lbump = frozen & (lc == self.local_step_scaler)
+        local_step_counter = jnp.where(lbump, 0, lc)
+        local_step_interval = jnp.where(
+            lbump, jnp.minimum(self.local_step_clipper,
+                               state.local_step_interval * 2),
+            state.local_step_interval)
+        lrs_out = jnp.where(local_sync, 0.0, lrs_new)
+
+        unf = lambda i: treedef.unflatten([o[i] for o in outs])
+        new_state = ZeroOneAdamState(
+            exp_avg=unf(1), exp_avg_sq=unf(2), worker_error=unf(3),
+            server_error=unf(4), momentum_accumulator=unf(5),
+            var_interval=var_interval, var_counter=var_counter,
+            local_step_interval=local_step_interval,
+            local_step_counter=local_step_counter, lrs=lrs_out)
+        return unf(0), new_state

@@ -1,0 +1,184 @@
+"""1-bit optimizer + compressed allreduce tests.
+
+Parity model: reference ``tests/onebit/`` (accuracy of compressed_allreduce
+vs exact) and ``tests/unit/test_onebit.py`` (e2e training with
+OneBitAdam/OneBitLamb/ZeroOneAdam configs).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.parallel.mesh import make_mesh
+from deepspeed_tpu.runtime.comm.compressed import (
+    compressed_allreduce, init_error_buffers, padded_size, server_chunk_size)
+from deepspeed_tpu.runtime.fp16.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
+
+from simple_model import SimpleModel, random_dataset, base_config
+
+
+# ------------------------------------------------------------- numpy oracle
+def np_compressed_allreduce(xs, worker_errors, server_errors):
+    """Literal numpy transcription of the two-phase algorithm
+    (reference ``runtime/comm/nccl.py:52-201``) for n ranks."""
+    n = len(xs)
+    L = worker_errors[0].size
+    chunk = L // n
+    signs, scales = [], []
+    new_we = []
+    for r in range(n):
+        flat = np.pad(xs[r].ravel(), (0, L - xs[r].size)) + worker_errors[r]
+        scale = np.linalg.norm(flat) / np.sqrt(L)
+        sg = np.where(flat >= 0, 1.0, -1.0)
+        new_we.append(flat - scale * sg)
+        signs.append(sg)
+        scales.append(scale)
+    # server phase per chunk owner
+    out_chunks, new_se = [], []
+    for r in range(n):
+        avg = sum(signs[i][r * chunk:(r + 1) * chunk] * scales[i]
+                  for i in range(n)) / n
+        comp = avg + server_errors[r]
+        s = np.linalg.norm(comp) / np.sqrt(chunk)
+        sg = np.where(comp >= 0, 1.0, -1.0)
+        new_se.append(comp - s * sg)
+        out_chunks.append(s * sg)
+    result = np.concatenate(out_chunks)
+    return result, new_we, new_se
+
+
+def test_compressed_allreduce_matches_oracle(devices):
+    n, numel = 8, 100
+    mesh = make_mesh({"data": 8})
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=numel).astype(np.float32) for _ in range(n)]
+    L = padded_size(numel, n)
+    chunk = server_chunk_size(numel, n)
+    wes = [rng.normal(size=L).astype(np.float32) * 0.1 for _ in range(n)]
+    ses = [rng.normal(size=chunk).astype(np.float32) * 0.1 for _ in range(n)]
+
+    expected, exp_we, exp_se = np_compressed_allreduce(xs, wes, ses)
+
+    def per_rank(x, we, se):
+        out, we_n, se_n = compressed_allreduce(x, we, se, axis_name="data",
+                                               world_size=n)
+        return out, we_n, se_n
+
+    fn = jax.shard_map(per_rank, mesh=mesh,
+                       in_specs=(P("data"), P("data"), P("data")),
+                       out_specs=(P("data"), P("data"), P("data")),
+                       check_vma=False)
+    x_in = np.stack(xs).reshape(n * numel)
+    we_in = np.stack(wes).reshape(n * L)
+    se_in = np.stack(ses).reshape(n * chunk)
+    with jax.set_mesh(mesh):
+        out, we_out, se_out = jax.jit(fn)(x_in, we_in, se_in)
+    out = np.asarray(out).reshape(n, numel)
+    we_out = np.asarray(we_out).reshape(n, L)
+    se_out = np.asarray(se_out).reshape(n, chunk)
+    for r in range(n):
+        # every rank receives the same averaged result
+        np.testing.assert_allclose(out[r], expected[:numel], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(we_out[r], exp_we[r], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(se_out[r], exp_se[r], rtol=1e-5, atol=1e-6)
+
+
+def test_error_feedback_accumulates_to_truth():
+    """Classic EF property: with a CONSTANT input, the running sum of
+    compressed outputs tracks the true sum (single-rank mode)."""
+    numel = 64
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=numel).astype(np.float32))
+    L = padded_size(numel, 1)
+    we = jnp.zeros((L,)); se = jnp.zeros((L,))
+    total = jnp.zeros((numel,))
+    steps = 200
+    for _ in range(steps):
+        out, we, se = compressed_allreduce(x, we, se)
+        total = total + out
+    err = np.linalg.norm(np.asarray(total / steps - x)) / np.linalg.norm(np.asarray(x))
+    assert err < 0.05, err
+
+
+# --------------------------------------------------------------- optimizers
+def test_onebit_adam_warmup_is_adam_no_bias_correction():
+    """Warmup phase must be exactly Adam with update m/(sqrt(v)+eps)
+    (reference onebit/adam.py:200-204)."""
+    rng = np.random.default_rng(2)
+    p = {"w": jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))}
+    g = {"w": jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))}
+    opt = OnebitAdam(lr=0.1, freeze_step=100, betas=(0.9, 0.99), eps=1e-8)
+    st = opt.init(p)
+    p1, st1 = opt.update(g, st, p, step=1)
+    m = 0.1 * np.asarray(g["w"])
+    v = 0.01 * np.asarray(g["w"]) ** 2
+    exp = np.asarray(p["w"]) - 0.1 * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(np.asarray(p1["w"]), exp, rtol=1e-5)
+
+
+def test_onebit_adam_freezes_variance():
+    rng = np.random.default_rng(3)
+    p = {"w": jnp.asarray(rng.normal(size=(8,)).astype(np.float32))}
+    opt = OnebitAdam(lr=0.01, freeze_step=3)
+    st = opt.init(p)
+    for step in range(1, 8):
+        g = {"w": jnp.asarray(rng.normal(size=(8,)).astype(np.float32))}
+        p, st_new = opt.update(g, st, p, step=step)
+        if step > 3:  # frozen: v unchanged
+            np.testing.assert_array_equal(np.asarray(st_new.exp_avg_sq["w"]),
+                                          np.asarray(st.exp_avg_sq["w"]))
+        else:
+            assert not np.array_equal(np.asarray(st_new.exp_avg_sq["w"]),
+                                      np.asarray(st.exp_avg_sq["w"]))
+        st = st_new
+
+
+@pytest.mark.parametrize("opt_name,params", [
+    ("OneBitAdam", {"lr": 1e-2, "freeze_step": 5}),
+    ("OneBitLamb", {"lr": 1e-2, "freeze_step": 5}),
+    ("ZeroOneAdam", {"lr": 1e-2, "var_freeze_step": 5}),
+])
+def test_onebit_e2e_training(devices, opt_name, params):
+    """Train through the freeze boundary; loss must keep decreasing
+    (reference test_onebit.py pattern)."""
+    model = SimpleModel(dim=8)
+    cfg = base_config(micro=4, over={
+        "optimizer": {"type": opt_name, "params": params}})
+    engine, _, _, _ = ds.initialize(config=cfg, model=model,
+                                    training_data=random_dataset(n=256),
+                                    mesh=make_mesh({"data": 8}))
+    losses = [float(engine.train_batch()) for _ in range(20)]
+    assert np.isfinite(losses).all(), losses
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+def test_zerooneadam_var_interval_doubles():
+    p = {"w": jnp.ones((4,), jnp.float32)}
+    opt = ZeroOneAdam(lr=1e-3, var_freeze_step=10**6, var_update_scaler=2)
+    st = opt.init(p)
+    intervals = []
+    for step in range(1, 12):
+        g = {"w": jnp.ones((4,), jnp.float32) * 0.1}
+        _, st = opt.update(g, st, p, step=step)
+        intervals.append(int(st.var_interval))
+    # doubles after every var_update_scaler=2 variance updates
+    assert intervals[0] == 1 and intervals[-1] > 1
+    assert sorted(set(intervals)) == sorted(set([1, 2, 4, 8]) & set(intervals))
+
+
+def test_onebit_lamb_scaling_coeff_set_at_freeze():
+    rng = np.random.default_rng(4)
+    p = {"a": jnp.asarray(rng.normal(size=(4,)).astype(np.float32)),
+         "b": jnp.asarray(rng.normal(size=(4,)).astype(np.float32) * 10)}
+    opt = OnebitLamb(lr=1e-2, freeze_step=3)
+    st = opt.init(p)
+    for step in range(1, 6):
+        g = {k: jnp.asarray(rng.normal(size=(4,)).astype(np.float32) *
+                            (10 if k == "b" else 1)) for k in p}
+        p, st = opt.update(g, st, p, step=step)
+    # scaling coeffs set (≠1) and inversely related to momentum magnitude
+    sa, sb = float(st.scaling_coeff["a"]), float(st.scaling_coeff["b"])
+    assert sa != 1.0 and sb != 1.0 and sa > sb
