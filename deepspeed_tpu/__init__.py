@@ -8,6 +8,7 @@ reference's torch/CUDA machinery.
 """
 
 from .version import __version__
+from .runtime.activation_checkpointing import checkpointing
 from .runtime.engine import DeepSpeedEngine
 from .runtime.config import DeepSpeedConfig
 from .runtime.lr_schedules import get_lr_scheduler

@@ -1,0 +1,6 @@
+"""Activation checkpointing (remat). Parity: reference
+``deepspeed/runtime/activation_checkpointing/``."""
+
+from . import checkpointing
+
+__all__ = ["checkpointing"]

@@ -1,0 +1,242 @@
+"""Activation checkpointing — remat policies instead of autograd surgery.
+
+Parity: reference ``runtime/activation_checkpointing/checkpointing.py`` —
+``CheckpointFunction`` (:493), ``checkpoint()`` (:743), ``configure()``
+(:825), ``CudaRNGStatesTracker`` (:122).  The reference re-implements
+torch's checkpoint autograd.Function with four extras: activation
+PARTITIONING across TP ranks (:367), CPU checkpointing (:480), contiguous
+buffers, and profiling.
+
+TPU re-design (SURVEY.md §7: "memory/recompute switches map to JAX remat
+policies rather than kernel variants"):
+
+- ``checkpoint(fn, *args)`` = ``jax.checkpoint`` — XLA rematerializes the
+  wrapped region in backward; no saved-tensor bookkeeping.
+- ``partition_activations`` → the checkpoint *inputs* (what remat saves) get
+  a sharding constraint over the ``tensor`` axis; the SPMD partitioner emits
+  the scatter/gather pair the reference codes by hand
+  (``partition_activations`` :367 / ``gather_partitioned_activations`` :259).
+- ``cpu_checkpointing`` → remat policy offloading saved residuals to
+  ``pinned_host`` memory via ``jax.checkpoint_policies
+  .save_and_offload_only_these_names`` when named checkpoints are used;
+  plain regions fall back to full recompute (which uses no more memory).
+- ``contiguous_memory_optimization`` → no-op: XLA's allocator packs live
+  buffers already; kept as an accepted flag for config parity.
+- The CUDA RNG state tracker becomes an explicit named-PRNGKey tracker:
+  JAX rngs are values, so "fork" hands out a fresh fold of the named key.
+"""
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...parallel.mesh import maybe_constrain
+from ...utils.logging import logger
+
+# module configuration state (parity: reference module globals :30-56)
+_enabled = False
+mpu = None
+num_layers = None
+PARTITION_ACTIVATIONS = False
+CPU_CHECKPOINT = False
+CONTIGUOUS_CHECKPOINTING = False
+SYNCHRONIZE = False
+PROFILE_TIME = False
+
+
+# ------------------------------------------------------------ rng tracker
+class RNGStatesTracker:
+    """Named PRNGKey tracker (parity: ``CudaRNGStatesTracker``, :122).
+
+    The reference snapshots/restores the CUDA RNG state so dropout draws the
+    same mask in recompute; with JAX keys-as-values remat replays the same
+    key automatically — the tracker's remaining job is giving model-parallel
+    regions a distinct, named stream.
+    """
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise Exception(f"seed {name} already exists")
+        for existing in self.states_.values():
+            if int(existing[1]) == int(seed):
+                raise Exception(f"seed {seed} already exists")
+        self.states_[name] = [jax.random.PRNGKey(seed), seed, 0]
+
+    @contextlib.contextmanager
+    def fork(self, name="model-parallel-rng"):
+        """Yields a fresh key from the named stream (the reference swaps the
+        global CUDA rng state; here the caller receives the key value)."""
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        key, seed, count = self.states_[name]
+        self.states_[name] = [key, seed, count + 1]
+        yield jax.random.fold_in(key, count)
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker():
+    """Parity: reference ``get_cuda_rng_tracker`` (:193)."""
+    return _RNG_TRACKER
+
+
+# alias keeping the reference's public name importable
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_seed(seed, tensor_axis_index: int = 0):
+    """Parity: ``model_parallel_cuda_manual_seed`` (:198) — data-parallel
+    stream gets ``seed``, model-parallel stream ``seed + 2718 + tp_rank``."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("data-parallel-rng", seed)
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718 + tensor_axis_index)
+
+
+model_parallel_cuda_manual_seed = model_parallel_seed
+
+
+# ------------------------------------------------------------- checkpoint
+def _shard_leaf(x):
+    """Shard a saved activation's largest even axis over ``tensor``
+    (reference ``partition_activations`` :367 splits flat activations across
+    the TP group)."""
+    if not hasattr(x, "ndim") or x.ndim == 0:
+        return x
+    from jax.sharding import PartitionSpec as P
+    am = jax.sharding.get_abstract_mesh()
+    if am.empty or "tensor" not in am.axis_names:
+        return x
+    tp = dict(zip(am.axis_names, am.axis_sizes)).get("tensor", 1)
+    if tp <= 1:
+        return x
+    for axis in np.argsort([-d for d in x.shape]):
+        if x.shape[axis] % tp == 0:
+            spec = [None] * x.ndim
+            spec[int(axis)] = "tensor"
+            return maybe_constrain(x, P(*spec))
+    return x
+
+
+def checkpoint(function, *args):
+    """Checkpoint (remat) a model region (parity: reference ``checkpoint``
+    :743 → ``CheckpointFunction`` :493)."""
+    fn = function
+    if PARTITION_ACTIVATIONS:
+        inner = fn
+
+        def fn(*a):
+            a = jax.tree_util.tree_map(_shard_leaf, a)
+            return inner(*a)
+
+    policy = None
+    if CPU_CHECKPOINT:
+        # offload whatever the model marked with jax.ad_checkpoint.checkpoint_name
+        try:
+            policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=["ckpt"],
+                offload_src="device", offload_dst="pinned_host")
+        except Exception:  # backend without pinned_host support
+            policy = None
+    ck = jax.checkpoint(fn, policy=policy) if policy is not None else jax.checkpoint(fn)
+    return ck(*args)
+
+
+def checkpoint_wrapper(function):
+    """Decorator form used by layer libraries."""
+    def wrapped(*args):
+        return checkpoint(function, *args)
+    return wrapped
+
+
+# ----------------------------------------------------------- configuration
+def partition_activations_in_checkpoint(partition_activation):
+    """Parity: reference :755."""
+    global PARTITION_ACTIVATIONS
+    PARTITION_ACTIVATIONS = partition_activation
+    logger.info(f"**************Partition Activations {PARTITION_ACTIVATIONS}************")
+
+
+def set_num_layers(nlayers):
+    global num_layers
+    num_layers = nlayers
+
+
+def reset():
+    """Parity: reference :768 (frees contiguous buffers — stateless here)."""
+
+
+def _configure_defaults():
+    global PARTITION_ACTIVATIONS, CONTIGUOUS_CHECKPOINTING, num_layers, \
+        CPU_CHECKPOINT, SYNCHRONIZE, PROFILE_TIME, _enabled
+    PARTITION_ACTIVATIONS = False
+    CONTIGUOUS_CHECKPOINTING = False
+    num_layers = None
+    CPU_CHECKPOINT = False
+    SYNCHRONIZE = False
+    PROFILE_TIME = False
+    _enabled = True
+
+
+def _configure_using_config_file(config, mpu=None):
+    from ..config import DeepSpeedConfig
+    global PARTITION_ACTIVATIONS, CONTIGUOUS_CHECKPOINTING, num_layers, \
+        CPU_CHECKPOINT, SYNCHRONIZE, PROFILE_TIME
+    c = DeepSpeedConfig(config).activation_checkpointing
+    PARTITION_ACTIVATIONS = c.partition_activations
+    CONTIGUOUS_CHECKPOINTING = c.contiguous_memory_optimization
+    num_layers = c.number_checkpoints
+    CPU_CHECKPOINT = c.cpu_checkpointing
+    SYNCHRONIZE = c.synchronize_checkpoint_boundary
+    PROFILE_TIME = c.profile
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Parity: reference ``configure`` (:825) — same argument surface."""
+    global mpu, num_layers, PARTITION_ACTIVATIONS, CONTIGUOUS_CHECKPOINTING, \
+        CPU_CHECKPOINT, SYNCHRONIZE, PROFILE_TIME
+    _configure_defaults()
+    if mpu_ is not None:
+        mpu = mpu_
+    if deepspeed_config is not None:
+        _configure_using_config_file(deepspeed_config, mpu=mpu)
+    if partition_activations is not None:
+        PARTITION_ACTIVATIONS = partition_activations
+    if contiguous_checkpointing is not None:
+        CONTIGUOUS_CHECKPOINTING = contiguous_checkpointing
+    if num_checkpoints is not None:
+        num_layers = num_checkpoints
+    if checkpoint_in_cpu is not None:
+        CPU_CHECKPOINT = checkpoint_in_cpu
+    if synchronize is not None:
+        SYNCHRONIZE = synchronize
+    if profile is not None:
+        PROFILE_TIME = profile
+    if CONTIGUOUS_CHECKPOINTING:
+        assert PARTITION_ACTIVATIONS, \
+            "Contiguous Checkpointing is only available with partitioned activations."
+        assert num_layers is not None, \
+            "Must specify the number of layers with contiguous memory checkpointing"
+
+
+def is_configured():
+    """Parity: reference :907."""
+    return _enabled
