@@ -1,0 +1,5 @@
+"""Inference engine. Parity: reference ``deepspeed/inference/``."""
+
+from .engine import InferenceEngine
+
+__all__ = ["InferenceEngine"]

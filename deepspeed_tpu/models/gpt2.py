@@ -234,6 +234,90 @@ class GPT2:
                             params["wte"].astype(jnp.float32))
         return logits
 
+    # ------------------------------------------------------- KV-cache decode
+    def init_cache(self, batch_size: int, max_len: Optional[int] = None,
+                   dtype=None):
+        """Empty KV cache pytree: k/v stacked over layers
+        (role parity: the reference inference kernels' ``layer_past`` KV
+        layout, ``ops/transformer/inference/transformer_inference.py:345``)."""
+        c = self.config
+        max_len = max_len or c.max_seq
+        dtype = dtype or self.dtype
+        shape = (c.n_layer, batch_size, max_len, c.n_head, c.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "index": jnp.zeros((), jnp.int32)}
+
+    def _block_with_cache(self, x, layer_params, cache_k, cache_v, index):
+        """One block over ``x: (B, T, D)`` attending to cache[:index] + x.
+
+        Returns (y, new_cache_k, new_cache_v).  Static cache length; key
+        positions ≥ index+T are masked.
+        """
+        c = self.config
+        B, T, D = x.shape
+        H, hd = c.n_head, c.head_dim
+        p = layer_params
+        S = cache_k.shape[1]
+
+        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
+        qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, hd)
+        k = k.reshape(B, T, H, hd)
+        v = v.reshape(B, T, H, hd)
+
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, k.astype(cache_k.dtype), (0, index, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, v.astype(cache_v.dtype), (0, index, 0, 0))
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k).astype(jnp.float32)
+        scores = scores / np.sqrt(hd)
+        q_pos = index + jnp.arange(T)[:, None]          # (T, 1)
+        k_pos = jnp.arange(S)[None, :]                  # (1, S)
+        valid = k_pos <= q_pos                          # causal within cache
+        scores = jnp.where(valid[None, None], scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v).reshape(B, T, D)
+        attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+        x = x + attn
+
+        h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
+        h = h @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        h = h @ p["fc_proj_w"].astype(h.dtype) + p["fc_proj_b"].astype(h.dtype)
+        return x + h, cache_k, cache_v
+
+    def apply_with_cache(self, params, tokens, cache):
+        """Forward ``tokens: (B, T)`` starting at ``cache['index']``.
+
+        Returns ``(logits (B, T, V), new_cache)``.  Used for both prefill
+        (T = prompt length) and single-token decode (T = 1); dropout is
+        always off (inference).
+        """
+        c = self.config
+        B, T = tokens.shape
+        dtype = self.dtype
+        index = cache["index"]
+
+        pos = index + jnp.arange(T)
+        x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[pos]
+
+        def scan_body(carry, xs):
+            h = carry
+            layer_params, ck, cv = xs
+            h, ck, cv = self._block_with_cache(h, layer_params, ck, cv, index)
+            return h, (ck, cv)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+
+        x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], c.layer_norm_eps)
+        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                            params["wte"].astype(jnp.float32))
+        new_cache = {"k": new_k, "v": new_v, "index": index + T}
+        return logits, new_cache
+
     # ------------------------------------------------------------------ loss
     def loss(self, params, batch, rng):
         """Next-token LM loss.  ``batch``: (B, T+1) int tokens, or a dict with

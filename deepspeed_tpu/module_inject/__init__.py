@@ -1,0 +1,7 @@
+"""Module injection. Parity: reference ``deepspeed/module_inject/``."""
+
+from .replace_module import replace_transformer_layer
+from .replace_policy import DSPolicy, HFGPT2LayerPolicy, replace_policies
+
+__all__ = ["replace_transformer_layer", "DSPolicy", "HFGPT2LayerPolicy",
+           "replace_policies"]

@@ -1,0 +1,41 @@
+"""Model injection: swap a HuggingFace torch model for the TPU-native family.
+
+Parity: reference ``deepspeed/module_inject/replace_module.py:123``
+(``replace_transformer_layer``) — walks the torch model, replaces each
+transformer layer with ``DeepSpeedTransformerInference`` (kernel injection)
+or TP-sliced generic layers (``ReplaceWithTensorSlicing`` :41,
+``LinearAllreduce`` :12).
+
+TPU re-design: "kernel injection" converts the WHOLE model once into this
+framework's equivalent model family (flash-attention/XLA paths built in)
+instead of per-layer module surgery, and tensor slicing disappears — the
+converted params carry ``partition_specs`` and the sharded ``device_put``
+does the slicing declaratively.
+"""
+
+from typing import Optional
+
+from .replace_policy import replace_policies, DSPolicy
+from ..utils.logging import logger
+
+
+def replace_transformer_layer(orig_layer_impl, model, policy: Optional[type] = None,
+                              dtype=None, **kwargs):
+    """Convert ``model`` (HF torch module) → ``(tpu_model, params)``.
+
+    ``policy``: optional explicit :class:`DSPolicy` subclass (parity:
+    reference ``injection_dict``); auto-detected from the registry otherwise
+    (reference ``replace_method='auto'``).
+    """
+    if policy is not None:
+        if isinstance(policy, dict):  # reference-style {module: policy}
+            policy = next(iter(policy.values()))
+        assert issubclass(policy, DSPolicy)
+        return policy.convert(model, dtype=dtype)
+    for cand in replace_policies:
+        if cand.match(model):
+            logger.info(f"module_inject: converting with {cand.__name__}")
+            return cand.convert(model, dtype=dtype)
+    raise ValueError(
+        f"No injection policy matches {type(model).__name__}; supported: "
+        f"{[p.__name__ for p in replace_policies]}")

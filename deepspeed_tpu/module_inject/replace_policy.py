@@ -1,0 +1,91 @@
+"""Injection policies: where each HF architecture keeps its weights.
+
+Parity: reference ``deepspeed/module_inject/replace_policy.py:50-324`` —
+policy classes (``HFBertLayerPolicy``, ``HFGPT2LayerPolicy``, ``HFGPTNEOLayerPolicy``,
+…) declare how to pull qkv/mlp/layernorm weights out of a given architecture's
+layer module so the replacement layer can be populated (and TP-sliced).
+
+Here a policy maps a HuggingFace *model* to this framework's model family +
+a parameter pytree; TP slicing is not done by hand — the params get sharded
+by the model's ``partition_specs`` at ``device_put`` time.
+"""
+
+import numpy as np
+
+
+def _t(x):
+    """torch tensor → numpy fp32 (detached, CPU)."""
+    return np.asarray(x.detach().cpu().float().numpy())
+
+
+class DSPolicy:
+    """Base policy (parity: reference ``DSPolicy``, ``replace_policy.py:14``)."""
+    _orig_layer_class = None
+
+    @staticmethod
+    def match(hf_model) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def convert(hf_model, dtype=None):
+        """Returns ``(model, params)`` in this framework's format."""
+        raise NotImplementedError
+
+
+class HFGPT2LayerPolicy(DSPolicy):
+    """HF ``GPT2LMHeadModel``/``GPT2Model`` → :class:`~deepspeed_tpu.models.gpt2.GPT2`.
+
+    Parity: reference ``HFGPT2LayerPolicy`` (``replace_policy.py:237``).
+    HF GPT-2 stores linear weights as ``Conv1D`` with (in, out) orientation —
+    the same orientation this framework uses, so weights stack without
+    transposition.
+    """
+
+    @staticmethod
+    def match(hf_model) -> bool:
+        return type(hf_model).__name__ in ("GPT2LMHeadModel", "GPT2Model")
+
+    @staticmethod
+    def convert(hf_model, dtype=None):
+        import jax.numpy as jnp
+        from ..models.gpt2 import GPT2, GPT2Config
+
+        tr = hf_model.transformer if hasattr(hf_model, "transformer") else hf_model
+        hf_cfg = hf_model.config
+        config = GPT2Config(
+            vocab_size=hf_cfg.vocab_size, max_seq=hf_cfg.n_positions,
+            n_embd=hf_cfg.n_embd, n_layer=hf_cfg.n_layer, n_head=hf_cfg.n_head,
+            embd_pdrop=hf_cfg.embd_pdrop, attn_pdrop=hf_cfg.attn_pdrop,
+            resid_pdrop=hf_cfg.resid_pdrop,
+            layer_norm_eps=hf_cfg.layer_norm_epsilon)
+        model = GPT2(config, dtype=dtype or jnp.bfloat16)
+
+        blocks = tr.h
+        stack = lambda get: np.stack([get(b) for b in blocks])
+        params = {
+            "wte": _t(tr.wte.weight),
+            "wpe": _t(tr.wpe.weight),
+            "blocks": {
+                "ln1_scale": stack(lambda b: _t(b.ln_1.weight)),
+                "ln1_bias": stack(lambda b: _t(b.ln_1.bias)),
+                "qkv_w": stack(lambda b: _t(b.attn.c_attn.weight)),
+                "qkv_b": stack(lambda b: _t(b.attn.c_attn.bias)),
+                "proj_w": stack(lambda b: _t(b.attn.c_proj.weight)),
+                "proj_b": stack(lambda b: _t(b.attn.c_proj.bias)),
+                "ln2_scale": stack(lambda b: _t(b.ln_2.weight)),
+                "ln2_bias": stack(lambda b: _t(b.ln_2.bias)),
+                "fc_w": stack(lambda b: _t(b.mlp.c_fc.weight)),
+                "fc_b": stack(lambda b: _t(b.mlp.c_fc.bias)),
+                "fc_proj_w": stack(lambda b: _t(b.mlp.c_proj.weight)),
+                "fc_proj_b": stack(lambda b: _t(b.mlp.c_proj.bias)),
+            },
+            "lnf_scale": _t(tr.ln_f.weight),
+            "lnf_bias": _t(tr.ln_f.bias),
+        }
+        import jax
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        return model, params
+
+
+# ordered registry (parity: reference ``replace_policies`` list)
+replace_policies = [HFGPT2LayerPolicy]
