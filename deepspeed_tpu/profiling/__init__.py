@@ -1,0 +1,1 @@
+"""Profiling. Parity: reference ``deepspeed/profiling/`` (FLOPS profiler)."""

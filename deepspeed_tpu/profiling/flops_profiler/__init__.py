@@ -1,0 +1,3 @@
+from .profiler import (FlopsProfiler, get_model_profile, jaxpr_flops,
+                       flops_to_string, macs_to_string, params_to_string,
+                       duration_to_string, number_to_string)

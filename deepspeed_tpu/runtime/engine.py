@@ -412,6 +412,11 @@ class DeepSpeedEngine:
     def _run_fused_step(self, batch):
         self.tput_timer.start()
         rng = jax.random.fold_in(self._base_rng, self.micro_steps)
+        # FLOPS profiler: profile the step program BEFORE the donated buffers
+        # are consumed (reference: engine.py:1583-1588 profile_step bracket)
+        if (self.config.flops_profiler.enabled
+                and self._global_steps_host + 1 == self.config.flops_profiler.profile_step):
+            self._profile_train_step(batch, rng)
         # trace with the mesh in context so bare-PartitionSpec sharding
         # constraints inside models (MoE expert axis, SP) bind to it
         with jax.set_mesh(self.mesh):
@@ -496,6 +501,31 @@ class DeepSpeedEngine:
         return DeepSpeedDataLoader(dataset, batch_size=batch_size,
                                    collate_fn=collate_fn,
                                    drop_last=self.config.dataloader_drop_last)
+
+    def _profile_train_step(self, batch, rng):
+        """Print the FLOPS profile of the compiled train step (parity:
+        reference flops-profiler engine integration, ``engine.py:1583-1588``)."""
+        from ..profiling.flops_profiler.profiler import FlopsProfiler
+        prof = FlopsProfiler(ds_engine=self)
+        prof.start_profile()
+        try:
+            with jax.set_mesh(self.mesh):
+                lowered = self._jit_train_step.lower(self.state, batch, rng)
+                ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            prof._flops = int(ca.get("flops", 0) or 0)
+            prof._macs = prof._flops // 2
+            prof._bytes = ca.get("bytes accessed")
+            prof._duration = self.tput_timer.avg_step_time() if hasattr(
+                self.tput_timer, "avg_step_time") else 0.0
+        except Exception as e:
+            logger.warning(f"flops profiler cost analysis failed: {e}")
+        prof.print_model_profile(
+            profile_step=self.config.flops_profiler.profile_step,
+            detailed=self.config.flops_profiler.detailed,
+            output_file=self.config.flops_profiler.output_file)
+        prof.end_profile()
 
     # ------------------------------------------------------------- reporting
     def _report_progress(self, step, metrics):

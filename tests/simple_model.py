@@ -118,12 +118,13 @@ def random_dataset(n=256, dim=8, seed=0):
     return (x, y)
 
 
-def base_config(micro=4, gas=1, world=8, **over):
+def base_config(micro=4, gas=1, world=8, over=None, **kw):
     cfg = {
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": gas,
         "steps_per_print": 1000,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
     }
-    cfg.update(over)
+    cfg.update(over or {})
+    cfg.update(kw)
     return cfg
