@@ -353,6 +353,7 @@ class DeepSpeedConfig:
         self.pipeline = DeepSpeedPipelineConfig(pd)
         self.curriculum = DeepSpeedCurriculumConfig(pd)
         self.pld = DeepSpeedPLDConfig(pd)
+        self.progressive_layer_drop = self.pld  # reference-facing alias
         self.eigenvalue = DeepSpeedEigenvalueConfig(pd)
         self.quantize_training = DeepSpeedQuantizeTrainingConfig(pd)
         self.checkpoint_config = DeepSpeedCheckpointConfig(pd)

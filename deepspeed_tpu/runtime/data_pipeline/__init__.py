@@ -1,0 +1,6 @@
+"""Data pipeline (curriculum learning). Parity: reference
+``deepspeed/runtime/data_pipeline/``."""
+
+from .curriculum_scheduler import CurriculumScheduler
+
+__all__ = ["CurriculumScheduler"]

@@ -1,0 +1,103 @@
+"""Curriculum learning scheduler.
+
+Parity: reference ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py:8``
+(``CurriculumScheduler``) — schedules a difficulty value (typically sequence
+length) as a function of global step.  Pure host-side math; identical
+config schema and semantics:
+
+- ``fixed_discrete``: difficulty list + max_step boundaries.
+- ``fixed_linear`` / ``fixed_root``: difficulty grows like
+  ``(step/total)^(1/root)`` from min to max, snapped down to a multiple of
+  ``difficulty_step`` (kept multiple-of-8 friendly — on TPU this aligns the
+  seq dim to the lane tiling the same way it aligned Tensor Cores).
+"""
+
+import math
+
+from ...utils.logging import logger
+
+
+class CurriculumScheduler:
+    def __init__(self, config):
+        self.state = {}
+        for key in ("curriculum_type", "min_difficulty", "max_difficulty",
+                    "schedule_type"):
+            assert key in config, \
+                f"Curriculum learning requires the config '{key}'"
+        self.state["min_difficulty"] = config["min_difficulty"]
+        self.state["max_difficulty"] = config["max_difficulty"]
+        self.state["current_difficulty"] = config["min_difficulty"]
+        self.state["schedule_type"] = config["schedule_type"]
+        self.first_step = True
+        sched = config.get("schedule_config", {})
+        stype = config["schedule_type"]
+        if stype == "fixed_discrete":
+            assert "difficulty" in sched and "max_step" in sched
+            assert len(sched["max_step"]) > 0
+            assert len(sched["difficulty"]) == len(sched["max_step"]) + 1
+            self.state["schedule"] = sched
+        elif stype == "fixed_root":
+            for k in ("total_curriculum_step", "difficulty_step", "root_degree"):
+                assert k in sched, f"fixed_root schedule requires '{k}'"
+            self._warn_step(sched)
+            self.state["schedule"] = sched
+        elif stype == "fixed_linear":
+            for k in ("total_curriculum_step", "difficulty_step"):
+                assert k in sched, f"fixed_linear schedule requires '{k}'"
+            self._warn_step(sched)
+            self.state["schedule"] = sched
+        else:
+            raise RuntimeError("Unsupported curriculum schedule type")
+
+    @staticmethod
+    def _warn_step(sched):
+        if sched["difficulty_step"] % 8 != 0:
+            logger.warning(
+                "difficulty_step should be a multiple of 8 to keep the "
+                "sequence dimension aligned to the TPU lane tiling.")
+
+    def get_current_difficulty(self):
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty):
+        self.state["current_difficulty"] = difficulty
+
+    def get_state(self):
+        return self.state
+
+    def set_state(self, state):
+        self.state = state
+
+    def _fixed_discrete(self, global_steps):
+        s = self.state["schedule"]
+        if global_steps > s["max_step"][-1]:
+            return s["difficulty"][-1]
+        for i, mx in enumerate(s["max_step"]):
+            if global_steps <= mx:
+                return s["difficulty"][i]
+
+    def _fixed_root(self, global_steps, root_degree=None):
+        s = self.state["schedule"]
+        if root_degree is None:
+            root_degree = s["root_degree"]
+        nd = (float(global_steps) / s["total_curriculum_step"]) ** (1.0 / root_degree)
+        nd = math.floor(nd * (self.state["max_difficulty"] -
+                              self.state["min_difficulty"]) +
+                        self.state["min_difficulty"])
+        nd -= nd % s["difficulty_step"]
+        return min(nd, self.state["max_difficulty"])
+
+    def get_difficulty(self, global_steps):
+        stype = self.state["schedule_type"]
+        if stype == "fixed_discrete":
+            return self._fixed_discrete(global_steps)
+        if stype == "fixed_linear":
+            return self._fixed_root(global_steps, 1)
+        if stype == "fixed_root":
+            return self._fixed_root(global_steps)
+        raise RuntimeError("Unsupported curriculum schedule type")
+
+    def update_difficulty(self, global_steps):
+        if self.state["current_difficulty"] < self.state["max_difficulty"]:
+            self.state["current_difficulty"] = self.get_difficulty(global_steps)
+        return self.state["current_difficulty"]

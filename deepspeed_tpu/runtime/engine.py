@@ -160,6 +160,20 @@ class DeepSpeedEngine:
         self._jit_train_step = jax.jit(self._train_step, donate_argnums=(0,))
         self._jit_eval = None
 
+        # ---- curriculum learning / PLD ------------------------------------
+        # (reference: engine injects curriculum_seqlen, engine.py:1596-1602;
+        # PLD theta passed into model fwd, progressive_layer_drop.py)
+        self.curriculum_scheduler = None
+        if self.config.curriculum.enabled:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(self.config.curriculum.params)
+        self.progressive_layer_drop = None
+        if self.config.progressive_layer_drop.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self.config.progressive_layer_drop.theta,
+                gamma=self.config.progressive_layer_drop.gamma)
+
         # ---- misc parity state ---------------------------------------------
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -400,8 +414,29 @@ class DeepSpeedEngine:
         assert it is not None, "train_batch needs training_data or a data_iter"
         gas = self.gradient_accumulation_steps()
         micro_batches = [next(it) for _ in range(gas)]
+        if self.curriculum_scheduler is not None:
+            micro_batches = [self._apply_curriculum(mb) for mb in micro_batches]
         batch = self._stack_microbatches(micro_batches)
         return self._run_fused_step(batch)
+
+    def _apply_curriculum(self, mb):
+        """Crop token sequences to the scheduled difficulty (reference:
+        ``curriculum_seqlen`` kwarg injection, ``engine.py:1596-1602``; here
+        the seq axis itself is cropped — same tokens seen, shorter program)."""
+        seqlen = self.curriculum_scheduler.update_difficulty(
+            self._global_steps_host + 1)
+
+        def crop(x):
+            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > seqlen:
+                return x[:, :seqlen + 1] if np.issubdtype(
+                    np.asarray(x).dtype, np.integer) else x[:, :seqlen]
+            return x
+        return jax.tree_util.tree_map(crop, mb)
+
+    def curriculum_seqlen(self):
+        if self.curriculum_scheduler is None:
+            return None
+        return self.curriculum_scheduler.get_current_difficulty()
 
     def _stack_microbatches(self, micro_batches):
         batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro_batches)
@@ -427,6 +462,8 @@ class DeepSpeedEngine:
         self._global_steps_host += 1
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self._global_steps_host)
         if self._scaler is not None and self.state.scale is not None:
             self._scaler.state = self.state.scale
         # host sync (float()/block) only on steps that actually report — keeps

@@ -1,0 +1,32 @@
+"""Progressive Layer Drop (https://arxiv.org/pdf/2010.13369.pdf).
+
+Parity: reference ``deepspeed/runtime/progressive_layer_drop.py`` —
+``theta(t) = (1 - θ)·e^(−γ·t) + θ`` keep-probability schedule passed into the
+model forward.  On TPU the model consumes ``pld_theta`` as a per-layer keep
+probability drawn with the step rng (stochastic depth over the scanned layer
+stack stays shape-static: dropped layers multiply by 0 through the residual).
+"""
+
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        self.current_theta = (1.0 - self.theta) * np.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta

@@ -1,0 +1,70 @@
+"""Sparse (indices, values) tensor for embedding-style gradients.
+
+Parity: reference ``deepspeed/runtime/sparse_tensor.py`` (``SparseTensor``,
+70 LoC) + the engine's ``sparse_allreduce_no_retain`` (``engine.py:2227``):
+torch's sparse embedding grads carry (indices, values) and the engine
+all-gathers both across DP ranks instead of densifying.
+
+JAX autodiff produces dense gradients, so here the class serves the
+framework's sparse-reduction path: densify-free averaging of row-sparse
+updates via index/value all_gathers inside ``shard_map``.
+"""
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class SparseTensor:
+    """Row-sparse view of a 2-D tensor: ``values[i]`` is row ``indices[i]``."""
+
+    def __init__(self, indices, values, dense_size):
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.dense_size = tuple(dense_size)
+
+    @classmethod
+    def from_dense(cls, dense, max_rows: Optional[int] = None):
+        """Extract the nonzero rows (static count = ``max_rows``; XLA needs
+        static shapes, so the densest possible case bounds the buffer)."""
+        dense = jnp.asarray(dense)
+        nz = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+        k = max_rows if max_rows is not None else dense.shape[0]
+        # top-k on the nonzero mask gives the first k nonzero row indices
+        _, idx = lax.top_k(nz.astype(jnp.int32) +
+                           jnp.arange(dense.shape[0], 0, -1) * 1e-9, k)
+        idx = jnp.sort(idx)
+        vals = dense[idx] * nz[idx].astype(dense.dtype)[:, None]
+        return cls(idx, vals, dense.shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def add(self, other: "SparseTensor"):
+        assert self.dense_size == other.dense_size
+        return SparseTensor(jnp.concatenate([self.indices, other.indices]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.dense_size)
+
+    def sparse_size(self):
+        return int(self.indices.shape[0]) * int(np.prod(self.values.shape[1:]))
+
+    def __str__(self):
+        return (f"SparseTensor(indices={self.indices.shape}, "
+                f"values={self.values.shape}, dense_size={self.dense_size})")
+
+
+def sparse_allreduce(st: SparseTensor, axis_name: str) -> SparseTensor:
+    """Average a row-sparse gradient across an axis WITHOUT densifying the
+    wire format (parity: engine ``sparse_allreduce_no_retain``,
+    ``engine.py:2227-2280``: all_gather indices + values, concatenate).
+    Call inside ``shard_map``.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.all_gather(st.indices, axis_name, axis=0, tiled=True)
+    vals = lax.all_gather(st.values, axis_name, axis=0, tiled=True)
+    return SparseTensor(idx, vals / n, st.dense_size)
