@@ -1,0 +1,114 @@
+"""``ds_report``: environment + op compatibility report.
+
+Parity: reference ``deepspeed/env_report.py`` (``op_report`` :24, ``main``)
+— prints the compatible/installed matrix of ops plus framework versions.
+The JIT-compile columns of the reference become backend-compatibility
+columns (no CUDA builds on TPU; Pallas/XLA paths either lower or they don't).
+"""
+
+import importlib
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+SUCCESS = f"{GREEN}[OKAY]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+FAIL = f"{RED}[FAIL]{END}"
+INFO = "[INFO]"
+
+COLUMNS = ["op name", "installed", "compatible"]
+
+
+def op_report():
+    """Print the op compatibility matrix (parity: reference ``op_report``)."""
+    from . import ops
+    max_dots = 23
+    print("-" * 64)
+    print("DeepSpeed-TPU op report")
+    print("-" * 64)
+    print("op name" + "." * (max_dots - len("op name")) +
+          " installed .. compatible")
+    print("-" * 64)
+
+    rows = [
+        ("flash_attention[pallas]", True, ops.flash_attention_available()),
+        ("sparse_attention[pallas]", True, ops.flash_attention_available()),
+        ("fused_adam", True, True),
+        ("fused_lamb", True, True),
+        ("cpu_adam (host offload)", _has("deepspeed_tpu.ops.adam.fused_adam"), True),
+        ("cpu_adagrad", _has("deepspeed_tpu.ops.adagrad.cpu_adagrad"), True),
+        ("quantizer", _has("deepspeed_tpu.ops.quantizer.quantizer"), True),
+        ("transformer_inference", _has("deepspeed_tpu.inference.engine"), True),
+        ("async_io (NVMe)", _has("deepspeed_tpu.ops.aio"), _has("deepspeed_tpu.ops.aio")),
+    ]
+    for name, installed, compatible in rows:
+        print(f"{name}{'.' * max(1, max_dots - len(name))} "
+              f"{SUCCESS if installed else FAIL} ...... "
+              f"{SUCCESS if compatible else WARNING}")
+    for name, entry in sorted(ops.OP_REGISTRY.items()):
+        comp = ops.backend() in entry["backends"]
+        print(f"{name}{'.' * max(1, max_dots - len(name))} "
+              f"{SUCCESS} ...... {SUCCESS if comp else WARNING}")
+    print("-" * 64)
+
+
+def _has(mod):
+    try:
+        importlib.import_module(mod)
+        return True
+    except Exception:
+        return False
+
+
+def debug_report():
+    """Versions + device info (parity: reference ``debug_report``)."""
+    import jax
+    from .version import __version__
+
+    devices = []
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        devices = [f"<unavailable: {e}>"]
+
+    report = [
+        ("deepspeed_tpu install path", __file__),
+        ("deepspeed_tpu version", __version__),
+        ("jax version", jax.__version__),
+        ("jax backend", _safe(lambda: jax.default_backend())),
+        ("device count", _safe(lambda: jax.device_count())),
+        ("devices", _safe(lambda: [str(d) for d in devices])),
+        ("python version", sys.version.replace("\n", " ")),
+    ]
+    for opt in ("flax", "optax", "orbax.checkpoint", "chex", "numpy"):
+        try:
+            m = importlib.import_module(opt)
+            report.append((f"{opt} version", getattr(m, "__version__", "?")))
+        except Exception:
+            report.append((f"{opt} version", "not installed"))
+
+    print("-" * 64)
+    print("DeepSpeed-TPU general environment info:")
+    print("-" * 64)
+    for name, value in report:
+        print(f"{name} ................... {value}")
+
+
+def _safe(fn):
+    try:
+        return fn()
+    except Exception as e:
+        return f"<unavailable: {e}>"
+
+
+def main():
+    op_report()
+    debug_report()
+
+
+cli_main = main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Launcher/CLI. Parity: reference ``deepspeed/launcher/``."""

@@ -1,0 +1,208 @@
+"""``deepspeed`` CLI launcher, TPU-native.
+
+Parity: reference ``deepspeed/launcher/runner.py:318`` (``main``) — hostfile
+parsing (:158), ``--include/--exclude`` resource filters (:199), per-node
+launch with rendezvous env.
+
+TPU re-design (SURVEY.md §7): one PROCESS PER HOST drives all local chips
+(the reference spawns one process per GPU via ``launcher/launch.py``), and
+rendezvous is the JAX coordination service instead of the NCCL TCP store.
+Single-host: exec the user script directly with the env set.  Multi-host:
+per-host ssh fan-out setting ``JAX_COORDINATOR_ADDRESS`` /
+``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` so ``jax.distributed.initialize``
+picks everything up (replacing pdsh/mpirun runners — TPU pods normally use
+their own per-host bootstrap; this covers hostfile-style clusters).
+"""
+
+import argparse
+import base64
+import collections
+import json
+import os
+import shlex
+import subprocess
+import sys
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+COORD_PORT_DEFAULT = 29500
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeepSpeed-TPU launcher (one process per host)")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile path: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Resource filter, e.g. 'worker-0@worker-1:0,2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Resource filter to drop hosts/slots")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus")
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--master_port", type=int, default=COORD_PORT_DEFAULT)
+    parser.add_argument("--ssh_port", type=int, default=None)
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"],
+                        help="Run the autotuner instead of the job")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parity: reference ``fetch_hostfile`` (:158)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning("Unable to find hostfile, will proceed with training "
+                       "with local resources only.")
+        return None
+    resource_pool = collections.OrderedDict()
+    with open(hostfile_path) as fd:
+        for line in fd.readlines():
+            line = line.strip()
+            if line == "":
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError as err:
+                logger.error("Hostfile is not formatted correctly, unable to "
+                             "proceed with training.")
+                raise err
+            if hostname in resource_pool:
+                raise ValueError(f"host {hostname} is already defined")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _stable_remove_duplicates(data):
+    out = []
+    for x in data:
+        if x not in out:
+            out.append(x)
+    return out
+
+
+def parse_resource_filter(host_info, include_str="", exclude_str=""):
+    """Filter hosts/slots (parity: reference ``parse_resource_filter`` :199).
+
+    Syntax: ``host1@host2:0,2`` — ``@`` separates hosts, ``:s0,s1`` selects
+    slots.  Only one of include/exclude may be given.
+    """
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive.")
+    if not include_str and not exclude_str:
+        return host_info
+
+    filtered_hosts = dict()
+    if include_str:
+        parse_str = include_str
+    else:
+        parse_str = exclude_str
+        filtered_hosts = {h: list(range(c)) for h, c in host_info.items()}
+
+    for name_range in parse_str.split("@"):
+        if ":" in name_range:
+            hostname, slots_str = name_range.split(":")
+            slots = [int(x) for x in slots_str.split(",")]
+            if hostname not in host_info:
+                raise ValueError(f"Hostname '{hostname}' not found in hostfile")
+            for slot in slots:
+                if slot >= host_info[hostname]:
+                    raise ValueError(f"No slot '{slot}' specified on host "
+                                     f"'{hostname}'")
+            if include_str:
+                filtered_hosts.setdefault(hostname, [])
+                filtered_hosts[hostname] = _stable_remove_duplicates(
+                    filtered_hosts[hostname] + slots)
+            else:
+                for slot in slots:
+                    if slot in filtered_hosts.get(hostname, []):
+                        filtered_hosts[hostname].remove(slot)
+        else:
+            hostname = name_range
+            if hostname not in host_info:
+                raise ValueError(f"Hostname '{hostname}' not found in hostfile")
+            if include_str:
+                filtered_hosts[hostname] = list(range(host_info[hostname]))
+            else:
+                filtered_hosts[hostname] = []
+
+    # drop empty hosts, preserve hostfile order, sort slots
+    ordered = collections.OrderedDict()
+    for host in host_info:
+        if host in filtered_hosts and len(filtered_hosts[host]) > 0:
+            ordered[host] = sorted(_stable_remove_duplicates(filtered_hosts[host]))
+    return ordered
+
+
+def encode_world_info(resource_pool):
+    """Parity: reference ``encode_world_info`` — base64 world map."""
+    world_info = {h: (s if isinstance(s, list) else list(range(s)))
+                  for h, s in resource_pool.items()}
+    return base64.urlsafe_b64encode(json.dumps(world_info).encode()).decode()
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    if args.autotuning:
+        from ..autotuning.autotuner import run_autotuning
+        return run_autotuning(args)
+
+    resource_pool = fetch_hostfile(args.hostfile)
+    if resource_pool:
+        active = parse_resource_filter(
+            {h: c for h, c in resource_pool.items()},
+            include_str=args.include, exclude_str=args.exclude)
+    else:
+        active = None
+
+    env = os.environ.copy()
+    cmd_tail = [args.user_script] + list(args.user_args)
+
+    if not active or (len(active) == 1 and not args.force_multi):
+        # single host: this process's python drives every local chip
+        env.setdefault("RANK", "0")
+        env.setdefault("LOCAL_RANK", "0")
+        env.setdefault("WORLD_SIZE", "1")
+        cmd = [sys.executable, "-u"] + cmd_tail
+        logger.info(f"cmd = {' '.join(map(shlex.quote, cmd))}")
+        result = subprocess.Popen(cmd, env=env)
+        result.wait()
+        return result.returncode
+
+    # multi host: ssh fan-out, one process per host, jax.distributed env
+    hosts = list(active.keys())
+    coordinator = args.master_addr or hosts[0]
+    world = encode_world_info(active)
+    procs = []
+    for proc_id, host in enumerate(hosts):
+        remote_env = {
+            "JAX_COORDINATOR_ADDRESS": f"{coordinator}:{args.master_port}",
+            "COORDINATOR_ADDRESS": f"{coordinator}:{args.master_port}",
+            "JAX_NUM_PROCESSES": str(len(hosts)),
+            "JAX_PROCESS_ID": str(proc_id),
+            "DS_WORLD_INFO": world,
+        }
+        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in remote_env.items())
+        remote_cmd = (f"cd {shlex.quote(os.getcwd())} && {exports} "
+                      f"{sys.executable} -u " +
+                      " ".join(map(shlex.quote, cmd_tail)))
+        ssh = ["ssh"] + (["-p", str(args.ssh_port)] if args.ssh_port else []) \
+            + [host, remote_cmd]
+        logger.info(f"[{host}] {' '.join(map(shlex.quote, ssh))}")
+        procs.append(subprocess.Popen(ssh, env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

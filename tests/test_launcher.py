@@ -1,0 +1,125 @@
+"""Launcher / ds_report tests (parity model: reference
+``tests/unit/test_ds_arguments.py`` + runner hostfile unit coverage)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (fetch_hostfile,
+                                           parse_resource_filter,
+                                           encode_world_info, parse_args)
+
+
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(textwrap.dedent(text))
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = _hostfile(tmp_path, """\
+        worker-0 slots=4
+        worker-1 slots=8
+    """)
+    pool = fetch_hostfile(path)
+    assert pool == {"worker-0": 4, "worker-1": 8}
+
+
+def test_fetch_hostfile_missing(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    path = _hostfile(tmp_path, """\
+        worker-0 slots=4
+        worker-0 slots=4
+    """)
+    with pytest.raises(ValueError):
+        fetch_hostfile(path)
+
+
+def test_resource_filter_include():
+    pool = {"worker-0": 4, "worker-1": 4}
+    out = parse_resource_filter(pool, include_str="worker-1:0,2")
+    assert out == {"worker-1": [0, 2]}
+    out = parse_resource_filter(pool, include_str="worker-0@worker-1:1")
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [1]}
+
+
+def test_resource_filter_exclude():
+    pool = {"worker-0": 4, "worker-1": 4}
+    out = parse_resource_filter(pool, exclude_str="worker-1")
+    assert out == {"worker-0": [0, 1, 2, 3]}
+    out = parse_resource_filter(pool, exclude_str="worker-0:1,3")
+    assert out["worker-0"] == [0, 2]
+
+
+def test_resource_filter_errors():
+    pool = {"worker-0": 2}
+    with pytest.raises(ValueError):
+        parse_resource_filter(pool, include_str="a", exclude_str="b")
+    with pytest.raises(ValueError):
+        parse_resource_filter(pool, include_str="missing-host")
+    with pytest.raises(ValueError):
+        parse_resource_filter(pool, include_str="worker-0:7")
+
+
+def test_encode_world_info_roundtrip():
+    import base64
+    import json
+    enc = encode_world_info({"h0": [0, 1], "h1": 2})
+    dec = json.loads(base64.urlsafe_b64decode(enc))
+    assert dec == {"h0": [0, 1], "h1": [0, 1]}
+
+
+def test_parse_args_remainder():
+    args = parse_args(["--num_nodes", "2", "train.py", "--lr", "0.1"])
+    assert args.user_script == "train.py"
+    assert args.user_args == ["--lr", "0.1"]
+    assert args.num_nodes == 2
+
+
+def test_single_host_launch(tmp_path):
+    """End-to-end: launcher runs a user script in a subprocess."""
+    script = tmp_path / "user.py"
+    script.write_text("import os, sys; print('RANK=' + os.environ['RANK']); "
+                      "sys.exit(0)\n")
+    from deepspeed_tpu.launcher.runner import main
+    rc = main([str(script)])
+    assert rc == 0
+
+
+def test_ds_report_runs():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the axon site hook overrides JAX_PLATFORMS; force via jax.config so the
+    # report never touches the (possibly remote) accelerator tunnel
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "from deepspeed_tpu import env_report; env_report.main()")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=180,
+                         cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert out.returncode == 0, out.stderr
+    assert "op report" in out.stdout
+    assert "general environment info" in out.stdout
+
+
+def test_ds_elastic_runs(tmp_path):
+    import json
+    cfg = {"train_batch_size": 0,
+           "elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 64, "min_time": 20, "version": 0.1}}
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps(cfg))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, "bin/ds_elastic", "-c", str(p),
+                          "-w", "8"], env=env, capture_output=True, text=True,
+                         timeout=120,
+                         cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert out.returncode == 0, out.stderr
+    assert "final_batch_size" in out.stdout
