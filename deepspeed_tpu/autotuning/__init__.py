@@ -1,0 +1,7 @@
+"""Autotuning. Parity: reference ``deepspeed/autotuning/``."""
+
+from .autotuner import (Autotuner, GridSearchTuner, RandomTuner,
+                        ModelBasedTuner, model_state_bytes_per_chip)
+
+__all__ = ["Autotuner", "GridSearchTuner", "RandomTuner", "ModelBasedTuner",
+           "model_state_bytes_per_chip"]

@@ -1,0 +1,316 @@
+"""Autotuner: find the best ZeRO stage + micro-batch size for a model.
+
+Parity: reference ``deepspeed/autotuning/autotuner.py:29`` (``Autotuner``):
+``tune()`` (:396) walks ZeRO stages in memory-fit order, and per stage
+(``tune_space`` :502) sweeps micro-batch sizes, measuring a metric
+(throughput/latency/flops) per experiment; best config is written out.
+
+TPU re-design: experiments run IN-PROCESS (build an engine, time a few
+steps, tear down) instead of scheduling jobs over hostfile slots via ssh —
+one TPU host already drives all its chips, so the reference's
+``ResourceManager``/``scheduler.py`` machinery reduces to a loop.  Memory
+fit uses the same analytic model (params × bytes-per-state ÷ shard degree)
+with per-chip HBM read from ``device.memory_stats``.
+"""
+
+import itertools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+
+from . import constants as AC
+from ..utils.logging import logger
+
+DEFAULT_HBM_BYTES = 16 * (1 << 30)  # v5e-class default when stats unavailable
+
+
+# ------------------------------------------------------------- memory model
+def model_state_bytes_per_chip(num_params: int, zero_stage: int,
+                               shard_degree: int) -> int:
+    """Per-chip bytes for params+grads+optimizer states under a ZeRO stage
+    (parity: reference ``get_instantiation_memory_required_per_gpu`` :261)."""
+    p = AC.BYTES_PER_PARAM_BF16
+    g = AC.BYTES_PER_PARAM_GRAD
+    o = AC.BYTES_PER_PARAM_OPTIM
+    n = max(1, shard_degree)
+    if zero_stage == 0:
+        per_param = p + g + o
+    elif zero_stage == 1:
+        per_param = p + g + o / n
+    elif zero_stage == 2:
+        per_param = p + g / n + o / n
+    else:
+        per_param = (p + g + o) / n
+    return int(num_params * per_param)
+
+
+def get_hbm_bytes() -> int:
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return DEFAULT_HBM_BYTES
+
+
+# ------------------------------------------------------------------- tuners
+class BaseTuner:
+    """Walks an experiment list, tracking the best (parity: reference
+    ``tuner/base_tuner.py``)."""
+
+    def __init__(self, exps: List[dict], metric=AC.AUTOTUNING_METRIC_DEFAULT):
+        self.all_exps = list(exps)
+        self.metric = metric
+        self.best_exp = None
+        self.best_metric_val = -float("inf")
+
+    def next_batch(self, sample_size: int) -> List[dict]:
+        raise NotImplementedError
+
+    def update(self, exp, metric_val):
+        if metric_val is not None and metric_val > self.best_metric_val:
+            self.best_metric_val = metric_val
+            self.best_exp = exp
+
+
+class GridSearchTuner(BaseTuner):
+    def next_batch(self, sample_size):
+        batch, self.all_exps = (self.all_exps[:sample_size],
+                                self.all_exps[sample_size:])
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, exps, metric=AC.AUTOTUNING_METRIC_DEFAULT, seed=0):
+        super().__init__(exps, metric)
+        self._rng = np.random.default_rng(seed)
+        self._rng.shuffle(self.all_exps)
+
+    next_batch = GridSearchTuner.next_batch
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cheap cost-model tuner (parity role: reference
+    ``tuner/model_based_tuner.py`` XGBoost model): predicts the metric of
+    unseen micro-batch sizes by linear interpolation over observed ones and
+    explores the most promising first."""
+
+    def __init__(self, exps, metric=AC.AUTOTUNING_METRIC_DEFAULT):
+        super().__init__(exps, metric)
+        self.observed: Dict[int, float] = {}
+
+    def next_batch(self, sample_size):
+        if not self.observed:
+            batch, self.all_exps = (self.all_exps[:sample_size],
+                                    self.all_exps[sample_size:])
+            return batch
+        xs = sorted(self.observed)
+        ys = [self.observed[x] for x in xs]
+
+        def predict(exp):
+            mbs = exp["ds_config"]["train_micro_batch_size_per_gpu"]
+            return float(np.interp(mbs, xs, ys))
+
+        self.all_exps.sort(key=predict, reverse=True)
+        batch, self.all_exps = (self.all_exps[:sample_size],
+                                self.all_exps[sample_size:])
+        return batch
+
+    def update(self, exp, metric_val):
+        super().update(exp, metric_val)
+        if metric_val is not None:
+            self.observed[exp["ds_config"]["train_micro_batch_size_per_gpu"]] = \
+                metric_val
+
+
+TUNERS = {AC.AUTOTUNING_TUNER_GRIDSEARCH: GridSearchTuner,
+          AC.AUTOTUNING_TUNER_RANDOM: RandomTuner,
+          AC.AUTOTUNING_TUNER_MODELBASED: ModelBasedTuner}
+
+
+# ---------------------------------------------------------------- autotuner
+class Autotuner:
+    def __init__(self, model, base_config: dict, training_data,
+                 mesh=None, collate_fn=None, autotuning_config: Optional[dict] = None,
+                 num_params: Optional[int] = None):
+        self.model = model
+        self.base_config = dict(base_config)
+        at = autotuning_config or self.base_config.get(AC.AUTOTUNING, {}) or {}
+        self.at = at
+        self.training_data = training_data
+        self.mesh = mesh
+        self.collate_fn = collate_fn
+        self.metric = at.get(AC.AUTOTUNING_METRIC, AC.AUTOTUNING_METRIC_DEFAULT)
+        self.start_step = at.get(AC.AUTOTUNING_START_PROFILE_STEP,
+                                 AC.AUTOTUNING_START_PROFILE_STEP_DEFAULT)
+        self.end_step = at.get(AC.AUTOTUNING_END_PROFILE_STEP,
+                               AC.AUTOTUNING_END_PROFILE_STEP_DEFAULT)
+        self.results_dir = at.get(AC.AUTOTUNING_RESULTS_DIR,
+                                  AC.AUTOTUNING_RESULTS_DIR_DEFAULT)
+        self.tuner_type = at.get(AC.AUTOTUNING_TUNER_TYPE,
+                                 AC.AUTOTUNING_TUNER_TYPE_DEFAULT)
+        self.early_stopping = at.get(AC.AUTOTUNING_TUNER_EARLY_STOPPING,
+                                     AC.AUTOTUNING_TUNER_EARLY_STOPPING_DEFAULT)
+        self.num_trials = at.get(AC.AUTOTUNING_TUNER_NUM_TRIALS,
+                                 AC.AUTOTUNING_TUNER_NUM_TRIALS_DEFAULT)
+        self.records: Dict[str, list] = {}
+        self._num_params = num_params
+        self.best_exp = None
+        self.best_metric_val = -float("inf")
+
+    # ------------------------------------------------------------- model info
+    def get_model_num_params(self):
+        """Parity: reference ``model_info_profile_run`` (:664) — here the
+        params are countable without a profile job."""
+        if self._num_params is None:
+            if hasattr(self.model, "num_params"):
+                self._num_params = int(self.model.num_params())
+            else:
+                params = self.model.init(jax.random.PRNGKey(0))
+                self._num_params = sum(int(np.prod(p.shape)) for p in
+                                       jax.tree_util.tree_leaves(params))
+        return self._num_params
+
+    def _shard_degree(self):
+        if self.mesh is not None:
+            from ..parallel import mesh as M
+            return M.dp_world_size(self.mesh)
+        return jax.device_count()
+
+    # ---------------------------------------------------------- experiments
+    def _mbs_candidates(self) -> List[int]:
+        lo = self.at.get(AC.AUTOTUNING_MIN_TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                         AC.AUTOTUNING_MIN_TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        hi = self.at.get(AC.AUTOTUNING_MAX_TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                         AC.AUTOTUNING_MAX_TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        out, m = [], max(1, lo)
+        while m <= hi:
+            out.append(m)
+            m *= 2
+        return out
+
+    def _generate_experiments(self) -> List[dict]:
+        """ZeRO stages that fit memory × micro-batch candidates (parity:
+        reference ``tune`` stage-fit walk :396-500)."""
+        hbm = get_hbm_bytes()
+        n_params = self.get_model_num_params()
+        shard = self._shard_degree()
+        stages = self.at.get(AC.AUTOTUNING_ZERO_STAGES, [0, 1, 2, 3])
+        user_stage = self.base_config.get("zero_optimization", {}).get("stage")
+        if user_stage is not None:
+            stages = [user_stage]
+        exps = []
+        for stage in stages:
+            state_mem = model_state_bytes_per_chip(n_params, stage, shard)
+            if state_mem >= hbm:
+                logger.info(f"zero stage {stage} does not fit: model states "
+                            f"{state_mem / 1e9:.2f}GB >= HBM {hbm / 1e9:.2f}GB")
+                continue
+            for mbs in self._mbs_candidates():
+                cfg = json.loads(json.dumps(self.base_config))
+                cfg.pop(AC.AUTOTUNING, None)
+                cfg.setdefault("zero_optimization", {})["stage"] = stage
+                cfg["train_micro_batch_size_per_gpu"] = mbs
+                cfg.pop("train_batch_size", None)
+                cfg.setdefault("gradient_accumulation_steps", 1)
+                exps.append({"name": f"z{stage}_mbs{mbs}", "ds_config": cfg,
+                             "zero_stage": stage})
+        return exps
+
+    # -------------------------------------------------------------- running
+    def run_experiment(self, exp: dict) -> Optional[float]:
+        """Build an engine with the experiment config, time steps
+        ``start..end``, return the metric (None = failed/OOM).  Parity:
+        reference ``scheduler.py:327 run_experiment`` (subprocess job)."""
+        import deepspeed_tpu as ds
+        try:
+            engine, _, _, _ = ds.initialize(
+                config=exp["ds_config"], model=self.model,
+                training_data=self.training_data, mesh=self.mesh,
+                collate_fn=self.collate_fn)
+            for _ in range(self.start_step):
+                loss = engine.train_batch()
+            float(loss)  # sync
+            t0 = time.time()
+            for _ in range(self.start_step, self.end_step):
+                loss = engine.train_batch()
+            final = float(loss)
+            dt = time.time() - t0
+            if not np.isfinite(final):
+                return None
+            steps = self.end_step - self.start_step
+            latency = dt / max(1, steps)
+            if self.metric == AC.AUTOTUNING_METRIC_LATENCY:
+                return -latency
+            samples = engine.train_batch_size() * steps
+            throughput = samples / dt
+            if self.metric == AC.AUTOTUNING_METRIC_FLOPS and \
+                    hasattr(self.model, "flops_per_token"):
+                return throughput * self.model.flops_per_token()
+            return throughput
+        except Exception as e:
+            logger.warning(f"experiment {exp['name']} failed: {e}")
+            return None
+
+    def tune(self) -> Optional[dict]:
+        """Run the tuner over the experiment grid; returns the best exp
+        (parity: reference ``tune`` :396)."""
+        exps = self._generate_experiments()
+        if not exps:
+            logger.warning("no feasible experiments (model does not fit?)")
+            return None
+        tuner = TUNERS[self.tuner_type](exps, self.metric)
+        trials = 0
+        stale = 0
+        while trials < self.num_trials:
+            batch = tuner.next_batch(1)
+            if not batch:
+                break
+            exp = batch[0]
+            val = self.run_experiment(exp)
+            self.records.setdefault(f"z{exp['zero_stage']}", []).append(
+                (exp, val, 1))
+            prev_best = tuner.best_metric_val
+            tuner.update(exp, val)
+            logger.info(f"experiment {exp['name']}: {self.metric}="
+                        f"{val if val is not None else 'failed'}")
+            stale = stale + 1 if tuner.best_metric_val <= prev_best else 0
+            if stale >= self.early_stopping:
+                logger.info(f"early stopping after {trials + 1} trials")
+                break
+            trials += 1
+        self.best_exp = tuner.best_exp
+        self.best_metric_val = tuner.best_metric_val
+        if self.best_exp is not None:
+            os.makedirs(self.results_dir, exist_ok=True)
+            with open(os.path.join(self.results_dir, "best_config.json"), "w") as f:
+                json.dump({"name": self.best_exp["name"],
+                           self.metric: self.best_metric_val,
+                           "ds_config": self.best_exp["ds_config"]}, f, indent=2)
+            logger.info(f"best experiment: {self.best_exp['name']} "
+                        f"({self.metric}={self.best_metric_val:.3f})")
+        return self.best_exp
+
+    def print_tuning_results(self):
+        for space, records in self.records.items():
+            for exp, val, n in records:
+                logger.info(f"{space}: {exp['name']} -> {val}")
+
+
+def run_autotuning(args):
+    """Launcher hook (parity: reference ``runner.py:305 run_autotuning``).
+
+    The reference schedules tuning jobs over hostfile slots; here the user
+    script is expected to construct an Autotuner itself (in-process
+    experiments) — point users at the API.
+    """
+    logger.error(
+        "Autotuning from the CLI requires the user script to build an "
+        "Autotuner(model, config, data) and call .tune(); in-process "
+        "experiments replace the reference's ssh job scheduler on TPU.")
+    return 1
