@@ -1,0 +1,7 @@
+"""Checkpoint serialization + constants. Parity: reference
+``deepspeed/checkpoint/``."""
+
+from . import constants
+from .serialization import save_tree, load_tree, restore_like
+
+__all__ = ["constants", "save_tree", "load_tree", "restore_like"]

@@ -671,7 +671,37 @@ class DeepSpeedEngine:
         if save_latest:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(tag)
+        self._copy_recovery_script(save_dir)
+        if self.config.zero_config.gather_16bit_weights_on_model_save:
+            self.save_16bit_model(path)
         log_dist(f"saved checkpoint {path}", ranks=[0])
+        return True
+
+    def _copy_recovery_script(self, save_path):
+        """Drop zero_to_fp32.py beside the checkpoint so weights can be
+        extracted without this framework installed (parity: reference
+        ``engine.py:3095 _copy_recovery_script``)."""
+        import shutil
+        from ..utils import zero_to_fp32 as z2f
+        src = z2f.__file__
+        dst = os.path.join(save_path, "zero_to_fp32.py")
+        try:
+            shutil.copy2(src, dst)
+            os.chmod(dst, 0o755)
+        except OSError as e:
+            logger.warning(f"could not copy recovery script: {e}")
+
+    def save_16bit_model(self, save_dir, save_filename="model_16bit.msgpack"):
+        """Save the full (gathered) params in the 16-bit compute dtype
+        (parity: reference ``engine.py:3194 save_16bit_model`` /
+        ``_zero3_consolidated_16bit_state_dict`` :3118 — with sharded state
+        the gather here is just the host transfer in ``save_tree``)."""
+        from ..checkpoint.serialization import save_tree
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename)
+        save_tree(path, {"params": self.state.params},
+                  meta={"dtype": self.config.precision_dtype})
+        log_dist(f"saved 16-bit model to {path}", ranks=[0])
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_module_only=False,

@@ -1,0 +1,122 @@
+"""Checkpoint loaders for MP-degree-changing loads.
+
+Parity: reference ``deepspeed/runtime/state_dict_factory.py`` —
+``SDLoaderFactory`` (:17) and ``MegatronSDLoader`` (:195): given a JSON
+descriptor ``{'type': 'Megatron', 'checkpoints': [...], 'version': ...}``
+they load MP-sharded torch checkpoints and MERGE (when target mp_world_size
+< source) or SPLIT (when larger) attention/mlp weights along the right axes.
+
+TPU re-design: checkpoints here store FULL arrays (gathered at save), so
+changing the tensor-parallel degree needs no file surgery — resharding is a
+``device_put`` with the new mesh's partition specs.  The factory therefore:
+
+- loads this framework's checkpoints directly (single file), and
+- still supports multi-file descriptors by merging shard files with the
+  Megatron axis rules (column-parallel concat on the output axis,
+  row-parallel on the input axis) so externally produced sharded dumps can
+  be imported.
+"""
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..checkpoint.serialization import load_tree
+from ..utils.logging import logger
+
+AUTO_MODULE_KEY = "auto"
+
+
+class SDLoaderFactory:
+    @staticmethod
+    def get_sd_loader_json(json_file_or_dict):
+        """Parity: reference ``SDLoaderFactory.get_sd_loader_json`` (:17)."""
+        if isinstance(json_file_or_dict, str):
+            with open(json_file_or_dict) as f:
+                data = json.load(f)
+        else:
+            data = dict(json_file_or_dict)
+        sd_type = data["type"]
+        ckpt_list = data["checkpoints"]
+        version = data.get("version", 1.0)
+        return SDLoaderFactory.get_sd_loader(ckpt_list, sd_type, version)
+
+    @staticmethod
+    def get_sd_loader(ckpt_list, sd_type="Megatron", version=None):
+        if sd_type.lower() in ("megatron", "ds_model", "auto"):
+            return MegatronSDLoader(ckpt_list, version)
+        raise ValueError(f"Unknown checkpoint loader type {sd_type}")
+
+
+class SDLoaderBase:
+    def __init__(self, ckpt_list: List[str], version=None):
+        self.ckpt_list = list(ckpt_list)
+        self.version = version
+
+    def _load_one(self, path):
+        tree, meta = load_tree(path, with_meta=True)
+        return tree.get("params", tree), meta
+
+    def load(self, mp_world_size: int, mp_rank: int, module_key=AUTO_MODULE_KEY,
+             is_pipe_parallel=False, quantize=False, quantize_bits=8,
+             quantize_groups=64, mlp_extra_grouping=True):
+        """Returns ``(ckpt_file_name, full_param_tree, meta)``.
+
+        Unlike the reference (which returns the mp_rank's slice), the full
+        tree is returned — slicing to ``mp_world_size`` happens when the
+        caller device_puts with its tensor-parallel partition specs.
+        """
+        if len(self.ckpt_list) == 1:
+            tree, meta = self._load_one(self.ckpt_list[0])
+            return self.ckpt_list[0], tree, meta
+        return self.merge_state_dict(mp_world_size, mp_rank)
+
+    def merge_state_dict(self, mp_world_size, mp_rank):
+        raise NotImplementedError
+
+
+class MegatronSDLoader(SDLoaderBase):
+    """Merges multi-file tensor-parallel shard dumps (parity: reference
+    ``MegatronSDLoader`` :195 — qkv/mlp merge rules)."""
+
+    # substrings → concat axis (Megatron column-parallel outputs on the last
+    # axis, row-parallel inputs on the first weight axis)
+    COLUMN_PARALLEL = ("qkv", "query_key_value", "fc_w", "dense_h_to_4h",
+                       "attention.query", "wte")
+    ROW_PARALLEL = ("proj_w", "dense_4h_to_h", "attention.dense", "fc_proj_w")
+
+    def merge_state_dict(self, mp_world_size, mp_rank):
+        trees = []
+        meta = None
+        for path in self.ckpt_list:
+            t, m = self._load_one(path)
+            trees.append(t)
+            meta = meta or m
+
+        def merge(key_path, leaves):
+            name = "/".join(key_path)
+            a0 = np.asarray(leaves[0])
+            if all(np.asarray(l).shape == a0.shape for l in leaves[1:]):
+                if any(s in name for s in self.COLUMN_PARALLEL):
+                    return np.concatenate([np.asarray(l) for l in leaves],
+                                          axis=a0.ndim - 1)
+                if any(s in name for s in self.ROW_PARALLEL):
+                    axis = max(0, a0.ndim - 2)
+                    return np.concatenate([np.asarray(l) for l in leaves],
+                                          axis=axis)
+            # replicated leaves (layernorms, biases of row-parallel): take one
+            return a0
+
+        merged = _tree_merge(trees, merge)
+        logger.info(f"merged {len(trees)} checkpoint shards")
+        return self.ckpt_list[0], merged, meta
+
+
+def _tree_merge(trees, fn, path=()):
+    first = trees[0]
+    if isinstance(first, dict):
+        return {k: _tree_merge([t[k] for t in trees], fn, path + (k,))
+                for k in first}
+    return fn(path, trees)

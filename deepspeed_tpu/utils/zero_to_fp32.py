@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Extract standalone fp32 weights from a checkpoint.
+
+Parity: reference ``deepspeed/utils/zero_to_fp32.py:362``
+(``get_fp32_state_dict_from_zero_checkpoint`` /
+``convert_zero_checkpoint_to_fp32_state_dict`` /
+``load_state_dict_from_zero_checkpoint``) — the offline tool that merges
+per-rank flat fp32 ZeRO partitions back into a full state dict.
+
+TPU simplification: this framework's checkpoints already store FULL arrays
+(sharded state is gathered at save; see ``checkpoint/serialization.py``), so
+"consolidation" reduces to preferring the fp32 master weights from the
+optimizer file over the low-precision compute params, flattening the pytree
+to '/'-joined names, and writing a framework-free ``.npz``.  The reference's
+partition stitching (flat-group padding, ``_get_fp32_state_dict_from_zero2/3_
+checkpoint`` :186/:289) has no analogue because partitions never hit disk.
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from ..checkpoint.serialization import load_tree
+from ..checkpoint import constants as CK
+from .logging import logger
+
+
+def _resolve_dir(checkpoint_dir, tag=None):
+    latest = os.path.join(checkpoint_dir, CK.LATEST_FILE)
+    if tag is None:
+        if os.path.isfile(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            raise ValueError(f"Unable to find 'latest' file at {latest}")
+    ds_dir = os.path.join(checkpoint_dir, str(tag))
+    if not os.path.isdir(ds_dir):
+        raise FileNotFoundError(f"Directory '{ds_dir}' doesn't exist")
+    return ds_dir
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """Returns ``{'/'-joined param name: fp32 numpy array}``.
+
+    Prefers the fp32 master weights saved with the optimizer states; falls
+    back to upcasting the compute params (fp32 training saves no master).
+    """
+    ds_dir = _resolve_dir(checkpoint_dir, tag)
+    model_tree, _ = load_tree(os.path.join(ds_dir, CK.MODEL_FILE),
+                              with_meta=True)
+    params = model_tree["params"]
+
+    optim_path = os.path.join(ds_dir, CK.OPTIM_FILE)
+    master = None
+    if os.path.isfile(optim_path):
+        optim_tree, _ = load_tree(optim_path, with_meta=True)
+        master = optim_tree.get(CK.FP32_MASTER)
+
+    src = master if master is not None else params
+    flat = _flatten(src)
+    return {k: v.astype(np.float32) for k, v in flat.items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,
+                                               tag=None):
+    """Write the consolidated fp32 weights to ``output_file`` (.npz —
+    loadable with plain numpy, no framework required).  Parity: reference
+    :411."""
+    state_dict = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    # np.savez forbids '/' only on some platforms; keep keys verbatim via dict
+    np.savez(output_file, **state_dict)
+    logger.info(f"Saved fp32 state dict to {output_file}")
+    return state_dict
+
+
+def load_state_dict_from_zero_checkpoint(target_params, checkpoint_dir, tag=None):
+    """Restore ``target_params``' pytree structure with fp32 weights from the
+    checkpoint (parity: reference :427 which mutates a torch model)."""
+    from ..checkpoint.serialization import restore_like
+    ds_dir = _resolve_dir(checkpoint_dir, tag)
+    model_tree, _ = load_tree(os.path.join(ds_dir, CK.MODEL_FILE),
+                              with_meta=True)
+    flat_fp32 = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+
+    # rebuild the nested dict from flattened names
+    nested = {}
+    for key, arr in flat_fp32.items():
+        node = nested
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return restore_like(target_params, nested)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Extract fp32 weights from a deepspeed_tpu checkpoint")
+    parser.add_argument("checkpoint_dir", type=str,
+                        help="checkpoint folder, e.g. path/checkpoint-12")
+    parser.add_argument("output_file", type=str,
+                        help="output .npz path")
+    parser.add_argument("-t", "--tag", type=str, default=None)
+    args = parser.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(
+        os.path.dirname(args.checkpoint_dir.rstrip("/"))
+        if os.path.basename(args.checkpoint_dir.rstrip("/")).startswith("global_step")
+        else args.checkpoint_dir,
+        args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""Checkpoint tooling tests: zero_to_fp32, save_16bit_model, SDLoader.
+
+Parity model: reference ``tests/unit/test_checkpointing.py`` consolidation
+cases + ``zero_to_fp32`` roundtrip.
+"""
+
+import os
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.utils.zero_to_fp32 import (
+    get_fp32_state_dict_from_zero_checkpoint,
+    convert_zero_checkpoint_to_fp32_state_dict,
+    load_state_dict_from_zero_checkpoint)
+from deepspeed_tpu.runtime.state_dict_factory import SDLoaderFactory
+from deepspeed_tpu.checkpoint.serialization import save_tree, load_tree
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+from simple_model import SimpleModel, random_dataset, base_config
+
+
+def _train_and_save(tmp_path, stage=2, dtype_cfg=None, steps=3):
+    model = SimpleModel(dim=8)
+    over = {"zero_optimization": {"stage": stage}}
+    over.update(dtype_cfg or {})
+    engine, _, _, _ = ds.initialize(config=base_config(micro=4, over=over),
+                                    model=model,
+                                    training_data=random_dataset(n=64),
+                                    mesh=make_mesh({"data": 2, "fsdp": 4}))
+    for _ in range(steps):
+        engine.train_batch()
+    engine.save_checkpoint(str(tmp_path), tag="tag1")
+    return engine
+
+
+def test_zero_to_fp32_roundtrip(tmp_path, devices):
+    engine = _train_and_save(tmp_path, stage=2,
+                             dtype_cfg={"bf16": {"enabled": True}})
+    sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path))
+    # bf16 training → fp32 master is preferred and matches engine state
+    master_leaf = np.asarray(jax.tree_util.tree_leaves(engine.state.master)[0])
+    keys = sorted(sd.keys())
+    assert all(v.dtype == np.float32 for v in sd.values())
+    flat_engine = {k: np.asarray(v) for k, v in
+                   zip(keys, [sd[k] for k in keys])}
+    found = any(np.allclose(v, master_leaf) for v in sd.values())
+    assert found, "fp32 master weights not found in consolidated state dict"
+
+
+def test_zero_to_fp32_npz_output(tmp_path, devices):
+    _train_and_save(tmp_path, stage=1)
+    out = str(tmp_path / "fp32_weights.npz")
+    sd = convert_zero_checkpoint_to_fp32_state_dict(str(tmp_path), out)
+    loaded = np.load(out)
+    for k, v in sd.items():
+        np.testing.assert_array_equal(loaded[k], v)
+
+
+def test_load_state_dict_from_zero_checkpoint(tmp_path, devices):
+    engine = _train_and_save(tmp_path, stage=0)
+    model = SimpleModel(dim=8)
+    target = model.init(jax.random.PRNGKey(0))
+    restored = load_state_dict_from_zero_checkpoint(target, str(tmp_path))
+    for a, b in zip(jax.tree_util.tree_leaves(restored),
+                    jax.tree_util.tree_leaves(engine.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_recovery_script_copied(tmp_path, devices):
+    _train_and_save(tmp_path, stage=1)
+    assert os.path.isfile(tmp_path / "tag1" / "zero_to_fp32.py")
+
+
+def test_save_16bit_model(tmp_path, devices):
+    engine = _train_and_save(tmp_path, stage=1,
+                             dtype_cfg={"bf16": {"enabled": True}})
+    engine.save_16bit_model(str(tmp_path / "16bit"))
+    tree, meta = load_tree(str(tmp_path / "16bit" / "model_16bit.msgpack"),
+                           with_meta=True)
+    leaf = jax.tree_util.tree_leaves(tree["params"])[0]
+    assert str(leaf.dtype) == "bfloat16"
+    assert meta["dtype"] == "bfloat16"
+
+
+def test_gather_16bit_on_save_config(tmp_path, devices):
+    model = SimpleModel(dim=8)
+    cfg = base_config(micro=4, over={
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3,
+                              "gather_16bit_weights_on_model_save": True}})
+    engine, _, _, _ = ds.initialize(config=cfg, model=model,
+                                    training_data=random_dataset(n=64),
+                                    mesh=make_mesh({"fsdp": 8}))
+    engine.train_batch()
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    assert os.path.isfile(tmp_path / "t" / "model_16bit.msgpack")
+
+
+def test_sd_loader_single_file(tmp_path):
+    params = {"a": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    save_tree(str(tmp_path / "ck.msgpack"), {"params": params})
+    loader = SDLoaderFactory.get_sd_loader_json(
+        {"type": "Megatron", "checkpoints": [str(tmp_path / "ck.msgpack")],
+         "version": 1.0})
+    _, tree, _ = loader.load(mp_world_size=2, mp_rank=0)
+    np.testing.assert_array_equal(tree["a"], params["a"])
+
+
+def test_sd_loader_merges_column_and_row_shards(tmp_path):
+    # two TP shards: column-parallel fc_w concat on last axis,
+    # row-parallel proj_w concat on first axis, layernorm replicated
+    shard0 = {"fc_w": np.ones((4, 8), np.float32),
+              "proj_w": np.ones((8, 4), np.float32) * 2,
+              "ln": np.ones((4,), np.float32)}
+    shard1 = {"fc_w": np.ones((4, 8), np.float32) * 3,
+              "proj_w": np.ones((8, 4), np.float32) * 4,
+              "ln": np.ones((4,), np.float32)}
+    p0, p1 = str(tmp_path / "s0.msgpack"), str(tmp_path / "s1.msgpack")
+    save_tree(p0, {"params": shard0})
+    save_tree(p1, {"params": shard1})
+    loader = SDLoaderFactory.get_sd_loader([p0, p1])
+    _, tree, _ = loader.load(mp_world_size=1, mp_rank=0)
+    assert tree["fc_w"].shape == (4, 16)
+    assert tree["proj_w"].shape == (16, 4)
+    assert tree["ln"].shape == (4,)
