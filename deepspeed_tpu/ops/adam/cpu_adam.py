@@ -1,0 +1,146 @@
+"""DeepSpeedCPUAdam: host-resident fused Adam(W) for the offload tier.
+
+Parity: reference ``deepspeed/ops/adam/cpu_adam.py:13`` (``DeepSpeedCPUAdam``
+bound to the AVX kernel ``csrc/adam/cpu_adam.cpp``, with
+``step(fp16_param_groups=...)`` fusing the low-precision copy-back).  Here
+the optimizer state lives in host numpy arrays, the step runs in the native
+C++ kernel (``csrc/adam/ds_cpu_adam.cpp``, OpenMP + auto-vectorized), and
+the fused copy-back emits the bf16/fp16 payload that the engine uploads to
+the TPU — the host does one memory sweep per step, exactly like the
+reference's ``adam_update_copy``.
+
+A pure-numpy fallback keeps the offload configs functional where the
+toolchain is unavailable.
+"""
+
+import ctypes
+
+import numpy as np
+
+from ..op_builder import CPUAdamBuilder
+
+_builder = CPUAdamBuilder()
+_f32p = ctypes.POINTER(ctypes.c_float)
+_u16p = ctypes.POINTER(ctypes.c_uint16)
+
+_OUT_KIND = {None: 0, "bfloat16": 1, "float16": 2}
+
+
+def native_available():
+    return _builder.is_compatible()
+
+
+def _ptr(a, ty):
+    return a.ctypes.data_as(ty)
+
+
+def _np_adam_step(params, grads, m, v, step, lr, beta1, beta2, eps,
+                  weight_decay, adamw_mode, bias_correction):
+    """Numpy fallback with identical math (used when g++ is unavailable)."""
+    g = grads
+    if weight_decay != 0.0 and not adamw_mode:
+        g = g + weight_decay * params
+    np.multiply(m, beta1, out=m)
+    m += (1.0 - beta1) * g
+    np.multiply(v, beta2, out=v)
+    v += (1.0 - beta2) * np.square(g)
+    bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
+    bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
+    denom = np.sqrt(v) / np.sqrt(bc2) + eps
+    update = (m / bc1) / denom
+    if weight_decay != 0.0 and adamw_mode:
+        update += weight_decay * params
+    params -= lr * update
+
+
+class DeepSpeedCPUAdam:
+    """Fused host Adam over flat fp32 numpy buffers (in-place)."""
+
+    name = "cpu_adam"
+
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-8, weight_decay=0.0, amsgrad=False, adamw_mode=True,
+                 fp32_optimizer_states=True):
+        if amsgrad:
+            raise RuntimeError("DeepSpeedCPUAdam does not support AMSGrad "
+                               "(reference parity).")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self._lib = _builder.load(verbose=False) if native_available() else None
+
+    @property
+    def is_native(self):
+        return self._lib is not None
+
+    def init_buffers(self, numel):
+        """Allocate the (exp_avg, exp_avg_sq) state for one flat buffer."""
+        return (np.zeros(numel, np.float32), np.zeros(numel, np.float32))
+
+    def step_flat(self, params, grads, exp_avg, exp_avg_sq, step, lr=None,
+                  out16=None, out_dtype=None):
+        """One in-place Adam step over a flat fp32 buffer.
+
+        ``out16``/``out_dtype`` request the fused low-precision copy-back:
+        the updated params are ALSO written into ``out16`` (uint16 view of a
+        bf16/fp16 buffer) in the same pass.
+        """
+        lr = self.lr if lr is None else float(lr)
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        grads = np.ascontiguousarray(grads, np.float32)
+        kind = _OUT_KIND[out_dtype]
+        if kind:
+            assert out16 is not None and out16.dtype == np.uint16 \
+                and out16.size == params.size
+        if self._lib is not None:
+            self._lib.ds_adam_step(
+                _ptr(params, _f32p), _ptr(grads, _f32p), _ptr(exp_avg, _f32p),
+                _ptr(exp_avg_sq, _f32p), params.size, int(step), lr,
+                self.betas[0], self.betas[1], self.eps, self.weight_decay,
+                int(self.adamw_mode), int(self.bias_correction),
+                _ptr(out16, _u16p) if kind else _u16p(), kind)
+        else:
+            _np_adam_step(params, grads, exp_avg, exp_avg_sq, int(step), lr,
+                          self.betas[0], self.betas[1], self.eps,
+                          self.weight_decay, self.adamw_mode,
+                          self.bias_correction)
+            if kind:
+                import jax.numpy as jnp
+                tgt = jnp.bfloat16 if kind == 1 else jnp.float16
+                out16[...] = np.asarray(params, dtype=tgt).view(np.uint16)
+
+    # -- pytree convenience (mirrors FusedAdam's init/update, on host) -----
+    def init(self, params):
+        import jax
+        zeros = lambda p: np.zeros(np.shape(p), np.float32)
+        return {"exp_avg": jax.tree_util.tree_map(zeros, params),
+                "exp_avg_sq": jax.tree_util.tree_map(zeros, params)}
+
+    def update(self, grads, state, params, *, step, lr=None):
+        import jax
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state["exp_avg"])
+        flat_v = treedef.flatten_up_to(state["exp_avg_sq"])
+        out = []
+        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            p = np.ascontiguousarray(np.asarray(p, np.float32))
+            if not p.flags.writeable:
+                p = p.copy()  # zero-copy views of jax arrays are immutable
+            self.step_flat(p.ravel(), np.asarray(g, np.float32).ravel(),
+                           m.ravel(), v.ravel(), step, lr=lr)
+            out.append(p)
+        return treedef.unflatten(out), state
+
+
+class DeepSpeedCPUAdamW(DeepSpeedCPUAdam):
+    name = "cpu_adamw"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.01, bias_correction=True, amsgrad=False):
+        super().__init__(lr=lr, bias_correction=bias_correction, betas=betas,
+                         eps=eps, weight_decay=weight_decay, amsgrad=amsgrad,
+                         adamw_mode=True)

@@ -1,0 +1,105 @@
+"""Python surface of the native async-I/O op.
+
+Parity: reference ``csrc/aio/py_lib/py_ds_aio.cpp`` bindings —
+``aio_handle(block_size, queue_depth, single_submit, overlap_events,
+thread_count)`` with ``sync_pread/sync_pwrite/async_pread/async_pwrite/
+wait`` — operating on numpy buffers instead of torch tensors.
+"""
+
+import numpy as np
+
+from ..op_builder import AsyncIOBuilder
+
+_builder = AsyncIOBuilder()
+
+
+def aio_available():
+    return _builder.is_compatible()
+
+
+def _buf_ptr(arr: np.ndarray):
+    assert arr.flags["C_CONTIGUOUS"], "aio buffers must be C-contiguous"
+    import ctypes
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+class AsyncIOHandle:
+    """One I/O queue: worker threads + pending-request tracking."""
+
+    def __init__(self, block_size=1048576, queue_depth=8, single_submit=False,
+                 overlap_events=False, thread_count=1):
+        self._lib = _builder.load(verbose=False)
+        self._h = self._lib.dsaio_create(block_size, queue_depth,
+                                         int(single_submit),
+                                         int(overlap_events), thread_count)
+        # async buffers must outlive the C++ workers: retained until wait()
+        self._inflight = []
+
+    # -- properties (parity: aio_handle get_* accessors) -------------------
+    def get_block_size(self):
+        return self._lib.dsaio_block_size(self._h)
+
+    def get_queue_depth(self):
+        return self._lib.dsaio_queue_depth(self._h)
+
+    def get_single_submit(self):
+        return bool(self._lib.dsaio_single_submit(self._h))
+
+    def get_overlap_events(self):
+        return bool(self._lib.dsaio_overlap_events(self._h))
+
+    def get_thread_count(self):
+        return self._lib.dsaio_thread_count(self._h)
+
+    def pending_count(self):
+        return self._lib.dsaio_pending_count(self._h)
+
+    # -- synchronous I/O ---------------------------------------------------
+    def sync_pread(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        """Read len(buffer) bytes at offset into buffer; returns bytes read."""
+        n = self._lib.dsaio_sync_pread(self._h, filename.encode(),
+                                       _buf_ptr(buffer), buffer.nbytes, offset)
+        if n < 0:
+            raise OSError(f"aio read failed: {filename}")
+        return n
+
+    def sync_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        n = self._lib.dsaio_sync_pwrite(self._h, filename.encode(),
+                                        _buf_ptr(buffer), buffer.nbytes, offset)
+        if n < 0:
+            raise OSError(f"aio write failed: {filename}")
+        return n
+
+    # -- asynchronous I/O (completed by wait()) ----------------------------
+    def async_pread(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        rc = self._lib.dsaio_async_pread(self._h, filename.encode(),
+                                         _buf_ptr(buffer), buffer.nbytes, offset)
+        if rc < 0:
+            raise OSError(f"aio submit read failed: {filename}")
+        self._inflight.append(buffer)
+        return rc
+
+    def async_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        rc = self._lib.dsaio_async_pwrite(self._h, filename.encode(),
+                                          _buf_ptr(buffer), buffer.nbytes, offset)
+        if rc < 0:
+            raise OSError(f"aio submit write failed: {filename}")
+        self._inflight.append(buffer)
+        return rc
+
+    def wait(self):
+        """Block until every submitted async op completes; returns the number
+        completed (raises if any failed — parity: handle.wait())."""
+        n = self._lib.dsaio_wait(self._h)
+        self._inflight.clear()
+        if n < 0:
+            raise OSError("aio wait: one or more requests failed")
+        return n
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.dsaio_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

@@ -1,0 +1,2 @@
+from .builder import (OpBuilder, AsyncIOBuilder, CPUAdamBuilder,
+                      CPUAdagradBuilder, UtilsBuilder, ALL_OPS, get_builder)

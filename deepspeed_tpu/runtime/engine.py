@@ -671,7 +671,7 @@ class DeepSpeedEngine:
         if save_latest:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(tag)
-        self._copy_recovery_script(save_dir)
+        self._copy_recovery_script(path)
         if self.config.zero_config.gather_16bit_weights_on_model_save:
             self.save_16bit_model(path)
         log_dist(f"saved checkpoint {path}", ranks=[0])

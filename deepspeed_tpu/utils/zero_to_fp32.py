@@ -32,6 +32,11 @@ def _resolve_dir(checkpoint_dir, tag=None):
         if os.path.isfile(latest):
             with open(latest) as f:
                 tag = f.read().strip()
+        elif os.path.isfile(os.path.join(checkpoint_dir, CK.MODEL_FILE)):
+            # checkpoint_dir IS a tag directory (the recovery script is
+            # dropped inside each tag dir, so `python zero_to_fp32.py .`
+            # from there must work without the parent's `latest` file)
+            return checkpoint_dir
         else:
             raise ValueError(f"Unable to find 'latest' file at {latest}")
     ds_dir = os.path.join(checkpoint_dir, str(tag))

@@ -1,0 +1,199 @@
+"""Native op tests: AIO handle + CPU Adam kernel.
+
+Parity model: reference ``tests/unit/test_aio.py`` (read/write roundtrips,
+sync and async, handle accessors) and ``tests/unit/test_cpu_adam.py``
+(numerics vs torch.optim.Adam).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder, CPUAdamBuilder
+from deepspeed_tpu.ops.aio import AsyncIOHandle, aio_available
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam, native_available
+
+needs_toolchain = pytest.mark.skipif(not aio_available(),
+                                     reason="g++ toolchain unavailable")
+
+
+# --------------------------------------------------------------------- aio
+@needs_toolchain
+def test_aio_handle_accessors():
+    h = AsyncIOHandle(block_size=4096, queue_depth=16, single_submit=True,
+                      overlap_events=True, thread_count=2)
+    assert h.get_block_size() == 4096
+    assert h.get_queue_depth() == 16
+    assert h.get_single_submit() is True
+    assert h.get_overlap_events() is True
+    assert h.get_thread_count() == 2
+
+
+@needs_toolchain
+@pytest.mark.parametrize("nbytes", [13, 4096, 1 << 20])
+def test_aio_sync_roundtrip(tmp_path, nbytes):
+    h = AsyncIOHandle(block_size=4096, thread_count=4)
+    src = np.random.randint(0, 256, size=nbytes, dtype=np.uint8)
+    path = str(tmp_path / "swap.bin")
+    assert h.sync_pwrite(src, path) == nbytes
+    dst = np.zeros(nbytes, np.uint8)
+    assert h.sync_pread(dst, path) == nbytes
+    np.testing.assert_array_equal(src, dst)
+
+
+@needs_toolchain
+def test_aio_async_roundtrip(tmp_path):
+    h = AsyncIOHandle(block_size=1 << 16, thread_count=4)
+    bufs = [np.random.rand(1 << 14).astype(np.float32) for _ in range(4)]
+    paths = [str(tmp_path / f"t{i}.bin") for i in range(4)]
+    for b, p in zip(bufs, paths):
+        h.async_pwrite(b, p)
+    assert h.pending_count() == 4
+    assert h.wait() == 4
+    outs = [np.zeros_like(b) for b in bufs]
+    for o, p in zip(outs, paths):
+        h.async_pread(o, p)
+    assert h.wait() == 4
+    for b, o in zip(bufs, outs):
+        np.testing.assert_array_equal(b, o)
+
+
+@needs_toolchain
+def test_aio_read_at_offset(tmp_path):
+    h = AsyncIOHandle()
+    src = np.arange(1000, dtype=np.float32)
+    path = str(tmp_path / "off.bin")
+    h.sync_pwrite(src, path)
+    dst = np.zeros(100, np.float32)
+    h.sync_pread(dst, path, offset=400)  # 100 floats at element 100
+    np.testing.assert_array_equal(dst, src[100:200])
+
+
+@needs_toolchain
+def test_aio_missing_file_raises(tmp_path):
+    h = AsyncIOHandle()
+    with pytest.raises(OSError):
+        h.sync_pread(np.zeros(8, np.uint8), str(tmp_path / "nope.bin"))
+
+
+# ---------------------------------------------------------------- cpu adam
+@pytest.mark.parametrize("adamw", [False, True])
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_cpu_adam_matches_torch(adamw, wd):
+    import torch
+    n = 4099  # odd size to exercise vector tails
+    rng = np.random.RandomState(0)
+    p0 = rng.randn(n).astype(np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-2, weight_decay=wd, adamw_mode=adamw)
+    p = p0.copy()
+    m, v = opt.init_buffers(n)
+
+    tp = torch.nn.Parameter(torch.from_numpy(p0.copy()))
+    tcls = torch.optim.AdamW if adamw else torch.optim.Adam
+    topt = tcls([tp], lr=1e-2, weight_decay=wd)
+
+    for step in range(1, 6):
+        g = rng.randn(n).astype(np.float32)
+        opt.step_flat(p, g, m, v, step)
+        tp.grad = torch.from_numpy(g.copy())
+        topt.step()
+    np.testing.assert_allclose(p, tp.detach().numpy(), rtol=2e-5, atol=2e-6)
+
+
+@needs_toolchain
+def test_cpu_adam_fused_bf16_copyback():
+    import jax.numpy as jnp
+    n = 1025
+    rng = np.random.RandomState(1)
+    p = rng.randn(n).astype(np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-2)
+    m, v = opt.init_buffers(n)
+    out16 = np.zeros(n, np.uint16)
+    opt.step_flat(p, rng.randn(n).astype(np.float32), m, v, 1,
+                  out16=out16, out_dtype="bfloat16")
+    expect = np.asarray(jnp.asarray(p).astype(jnp.bfloat16)).view(np.uint16)
+    np.testing.assert_array_equal(out16, expect)
+
+
+@needs_toolchain
+def test_cpu_adam_fused_fp16_copyback():
+    n = 513
+    rng = np.random.RandomState(2)
+    p = rng.randn(n).astype(np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-2)
+    m, v = opt.init_buffers(n)
+    out16 = np.zeros(n, np.uint16)
+    opt.step_flat(p, rng.randn(n).astype(np.float32), m, v, 1,
+                  out16=out16, out_dtype="float16")
+    np.testing.assert_array_equal(out16, p.astype(np.float16).view(np.uint16))
+
+
+@needs_toolchain
+def test_native_matches_numpy_fallback():
+    n = 777
+    rng = np.random.RandomState(3)
+    p_nat = rng.randn(n).astype(np.float32)
+    p_np = p_nat.copy()
+    g = rng.randn(n).astype(np.float32)
+    nat = DeepSpeedCPUAdam(lr=3e-3, weight_decay=0.05, adamw_mode=True)
+    ref = DeepSpeedCPUAdam(lr=3e-3, weight_decay=0.05, adamw_mode=True)
+    ref._lib = None  # force numpy path
+    m1, v1 = nat.init_buffers(n)
+    m2, v2 = ref.init_buffers(n)
+    for step in range(1, 4):
+        nat.step_flat(p_nat, g, m1, v1, step)
+        ref.step_flat(p_np, g, m2, v2, step)
+    np.testing.assert_allclose(p_nat, p_np, rtol=1e-6, atol=1e-7)
+
+
+@needs_toolchain
+def test_cpu_adagrad_native():
+    lib = CPUAdamBuilder().load(verbose=False)
+    import ctypes
+    n = 257
+    rng = np.random.RandomState(4)
+    p = rng.randn(n).astype(np.float32)
+    g = rng.randn(n).astype(np.float32)
+    s = np.zeros(n, np.float32)
+    p_ref = p.copy()
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.ds_adagrad_step(p.ctypes.data_as(f32p), g.ctypes.data_as(f32p),
+                        s.ctypes.data_as(f32p), n, 0.01, 1e-10, 0.0,
+                        ctypes.POINTER(ctypes.c_uint16)(), 0)
+    s_ref = g * g
+    p_ref -= 0.01 * g / (np.sqrt(s_ref) + 1e-10)
+    np.testing.assert_allclose(p, p_ref, rtol=1e-6)
+    np.testing.assert_allclose(s, s_ref, rtol=1e-6)
+
+
+@needs_toolchain
+def test_ds_memcpy_and_bf16_sweeps():
+    import ctypes
+    lib = CPUAdamBuilder().load(verbose=False)
+    src = np.random.rand(1 << 16).astype(np.float32)
+    dst = np.zeros_like(src)
+    lib.ds_memcpy(dst.ctypes.data_as(ctypes.c_void_p),
+                  src.ctypes.data_as(ctypes.c_void_p), src.nbytes)
+    np.testing.assert_array_equal(src, dst)
+
+    import jax.numpy as jnp
+    u16 = np.zeros(src.size, np.uint16)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    lib.ds_fp32_to_bf16(src.ctypes.data_as(f32p),
+                        u16.ctypes.data_as(u16p), src.size)
+    expect = np.asarray(jnp.asarray(src).astype(jnp.bfloat16)).view(np.uint16)
+    np.testing.assert_array_equal(u16, expect)
+    back = np.zeros_like(src)
+    lib.ds_bf16_to_fp32(u16.ctypes.data_as(u16p),
+                        back.ctypes.data_as(f32p), src.size)
+    np.testing.assert_allclose(back, src, rtol=1e-2)
+
+
+def test_builders_registered():
+    from deepspeed_tpu.ops.op_builder import ALL_OPS, get_builder
+    for name in ("async_io", "cpu_adam", "cpu_adagrad", "utils"):
+        assert name in ALL_OPS
+        b = get_builder(name)
+        assert b.name() == name
