@@ -144,6 +144,27 @@ class DeepSpeedEngine:
                              self._master_specs, is_leaf=lambda x: isinstance(x, P))):
             self._shape_spec_cache.setdefault(np.shape(p), sp)
 
+        # ---- host offload tier (ZeRO-Offload / -Infinity optimizer) -------
+        # reference: stage_1_and_2.py cpu_offload path + stage3 swap tier
+        self._offload = None
+        offload_device = self.config.zero_config.offload_optimizer_device()
+        if offload_device in ("cpu", "nvme") and not isinstance(self.optimizer, DummyOptim):
+            if optimizer is not None:
+                raise ValueError(
+                    "offload_optimizer requires a config-specified Adam/AdamW "
+                    "(the host tier runs its own fused step; a client "
+                    "optimizer object cannot be offloaded)")
+            name = self.config.optimizer_name or C.ADAM_OPTIMIZER
+            assert name in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER), \
+                f"offload_optimizer requires Adam/AdamW (got {name!r}; " \
+                "reference parity: DeepSpeedCPUAdam)"
+            from .zero.offload_engine import HostOffloadOptimizer
+            self._offload = HostOffloadOptimizer(
+                params0, self.config.zero_config, self.config.aio_config,
+                optimizer_name=name,
+                optimizer_params=self.config.optimizer_params,
+                compute_dtype_name=self.config.precision_dtype)
+
         # ---- initial device state -----------------------------------------
         self.state = self._init_state(params0)
         self._needs_master = self.compute_dtype != jnp.float32
@@ -158,6 +179,7 @@ class DeepSpeedEngine:
 
         # ---- compiled steps -------------------------------------------------
         self._jit_train_step = jax.jit(self._train_step, donate_argnums=(0,))
+        self._jit_grad_step = jax.jit(self._grad_only_step)
         self._jit_eval = None
 
         # ---- curriculum learning / PLD ------------------------------------
@@ -262,6 +284,22 @@ class DeepSpeedEngine:
         needs_master = dtype != jnp.float32
 
         params = jax.device_put(tree_cast(params0, dtype), self._param_sh)
+
+        if self._offload is not None:
+            # fp32 master + optimizer state live on the HOST (or NVMe); the
+            # device holds only the compute-dtype params
+            scale = None
+            if self.fp16_enabled:
+                scaler = ls.create_loss_scaler(self.config.fp16)
+                self._scaler = scaler
+                scale = jax.device_put(scaler.state, self._repl_sh)
+            else:
+                self._scaler = None
+            z = lambda: jax.device_put(jnp.asarray(0, jnp.int32), self._repl_sh)
+            return TrainState(global_steps=z(), optimizer_steps=z(),
+                              skipped_steps=z(), params=params, master=None,
+                              opt_state=None, scale=scale)
+
         master = jax.device_put(params0, self._master_sh) if needs_master else None
 
         # opt state created under jit so it materializes directly sharded
@@ -332,6 +370,31 @@ class DeepSpeedEngine:
             body, (zeros, jnp.float32(0.0), jnp.int32(0)), batch)
         return grads, scaled_loss_sum
 
+    def _grads_and_metrics(self, state: TrainState, base, batch, rng):
+        """Shared gradient post-processing contract, used by the fused
+        in-device step AND the offload grad-only step: scan microbatches,
+        unscale, overflow check, clip, constrain to ZeRO-2 sharding
+        (reference clip order: unscale → clip → step,
+        ``stage_1_and_2.py:1736 unscale_and_clip``)."""
+        cur_scale = (state.scale.cur_scale if state.scale is not None
+                     else jnp.float32(1.0))
+        grads, scaled_loss_sum = self._grad_fn(base, batch, rng, cur_scale)
+        # unscale (fp16); loss for reporting is the true mean loss
+        grads = jax.tree_util.tree_map(lambda g: g / cur_scale, grads)
+        loss = scaled_loss_sum / cur_scale
+        overflow = (ls.has_overflow(grads) if self.fp16_enabled
+                    else jnp.asarray(False))
+        if self.config.gradient_clipping > 0:
+            grads, gnorm = clip_by_global_norm(grads, self.config.gradient_clipping)
+        else:
+            gnorm = global_norm(grads)
+        # ZeRO-2: constrain grads to fsdp sharding → reduce-scatter
+        grads = zpart.constrain(grads, self._grad_specs, self.mesh)
+        lr = self._lr_at(state.global_steps)
+        metrics = {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
+                   "lr": lr, "loss_scale": cur_scale}
+        return grads, overflow, lr, metrics
+
     def _train_step(self, state: TrainState, batch, rng):
         """One full optimizer step: scan over gas microbatches, reduce, update.
 
@@ -342,27 +405,8 @@ class DeepSpeedEngine:
         needs_master = dtype != jnp.float32
         base = state.master if needs_master else state.params
 
-        cur_scale = state.scale.cur_scale if state.scale is not None else jnp.float32(1.0)
-
-        grads, scaled_loss_sum = self._grad_fn(base, batch, rng, cur_scale)
-
-        # unscale (fp16); loss for reporting is the true mean loss
-        grads = jax.tree_util.tree_map(lambda g: g / cur_scale, grads)
-        loss = scaled_loss_sum / cur_scale
-
-        overflow = ls.has_overflow(grads) if self.fp16_enabled else jnp.asarray(False)
-
-        # grad clipping on the unscaled grads (reference clip order:
-        # unscale → clip → step, stage_1_and_2.py:1736 unscale_and_clip)
-        if self.config.gradient_clipping > 0:
-            grads, gnorm = clip_by_global_norm(grads, self.config.gradient_clipping)
-        else:
-            gnorm = global_norm(grads)
-
-        # ZeRO-2: constrain grads to fsdp sharding → reduce-scatter
-        grads = zpart.constrain(grads, self._grad_specs, self.mesh)
-
-        lr = self._lr_at(state.global_steps)
+        grads, overflow, lr, metrics = self._grads_and_metrics(
+            state, base, batch, rng)
         new_base, new_opt = self.optimizer.update(
             grads, state.opt_state, base, step=state.optimizer_steps + 1, lr=lr)
         new_base = zpart.constrain(new_base, self._master_specs if needs_master
@@ -399,9 +443,45 @@ class DeepSpeedEngine:
             skipped_steps=state.skipped_steps + ovf_i,
             params=new_params, master=new_master, opt_state=new_opt,
             scale=new_scale)
-        metrics = {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
-                   "lr": lr, "loss_scale": cur_scale}
         return new_state, metrics
+
+    def _grad_only_step(self, state: TrainState, batch, rng):
+        """Device half of the offload step: grads (unscaled, clipped, sharded)
+        + metrics; the optimizer update happens on the host
+        (reference: backward populates the fp32 cpu partition,
+        ``stage_1_and_2.py:1008-1160``)."""
+        grads, _, _, metrics = self._grads_and_metrics(
+            state, state.params, batch, rng)
+        return grads, metrics
+
+    def _host_offload_update(self, grads, metrics):
+        """Host half of the offload step: d2h grads → native fused Adam on
+        the flat fp32 master (moments on host RAM or streamed from NVMe) →
+        h2d of the 16-bit payload."""
+        state = self.state
+        overflow = bool(metrics["overflow"]) if self.fp16_enabled else False
+        ovf = jnp.asarray(int(overflow), jnp.int32)
+        if not overflow:
+            flat = self._offload.flatten_grads(grads)
+            lr = float(metrics["lr"])
+            self._offload.step(flat, int(state.optimizer_steps) + 1, lr)
+            params = jax.device_put(self._offload.payload_tree(), self._param_sh)
+        else:
+            params = state.params
+        scale = state.scale
+        if self.fp16_enabled:
+            scale = ls.update_scale(
+                scale, jnp.asarray(overflow), dynamic=self._scaler.dynamic,
+                scale_factor=self._scaler.scale_factor,
+                scale_window=self._scaler.scale_window,
+                min_scale=self._scaler.min_scale,
+                delayed_shift=self._scaler.delayed_shift,
+                consecutive_hysteresis=self._scaler.consecutive_hysteresis)
+        self.state = TrainState(
+            global_steps=state.global_steps + 1,
+            optimizer_steps=state.optimizer_steps + (1 - ovf),
+            skipped_steps=state.skipped_steps + ovf,
+            params=params, master=None, opt_state=None, scale=scale)
 
     # ------------------------------------------------------------- public API
     def train_batch(self, data_iter=None):
@@ -455,7 +535,11 @@ class DeepSpeedEngine:
         # trace with the mesh in context so bare-PartitionSpec sharding
         # constraints inside models (MoE expert axis, SP) bind to it
         with jax.set_mesh(self.mesh):
-            self.state, metrics = self._jit_train_step(self.state, batch, rng)
+            if self._offload is not None:
+                grads, metrics = self._jit_grad_step(self.state, batch, rng)
+                self._host_offload_update(grads, metrics)
+            else:
+                self.state, metrics = self._jit_train_step(self.state, batch, rng)
         self._last_metrics = metrics
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
@@ -546,8 +630,10 @@ class DeepSpeedEngine:
         prof = FlopsProfiler(ds_engine=self)
         prof.start_profile()
         try:
+            step_fn = (self._jit_grad_step if self._offload is not None
+                       else self._jit_train_step)
             with jax.set_mesh(self.mesh):
-                lowered = self._jit_train_step.lower(self.state, batch, rng)
+                lowered = step_fn.lower(self.state, batch, rng)
                 ca = lowered.compile().cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else {}
@@ -661,9 +747,17 @@ class DeepSpeedEngine:
         }
         save_tree(os.path.join(path, MODEL_FILE),
                   {"params": self.state.params}, meta=engine_meta)
-        optim_tree = {"opt_state": self.state.opt_state}
-        if self.state.master is not None:
-            optim_tree["master"] = self.state.master
+        if self._offload is not None:
+            # host-resident state saved in the SAME layout as the in-device
+            # AdamState (param-shaped moment pytrees + full master pytree),
+            # so offload/non-offload runs can load each other's checkpoints
+            # and zero_to_fp32 consolidation works unchanged
+            optim_tree = {"opt_state": self._offload.moments_tree(),
+                          "master": self._offload.master_tree()}
+        else:
+            optim_tree = {"opt_state": self.state.opt_state}
+            if self.state.master is not None:
+                optim_tree["master"] = self.state.master
         if self.state.scale is not None:
             optim_tree["scale"] = self.state.scale
         save_tree(os.path.join(path, OPTIM_FILE), optim_tree)
@@ -734,7 +828,24 @@ class DeepSpeedEngine:
                     lambda x: np.asarray(x).astype(np.float32), loaded_master),
                 self._master_sh))
 
-        if load_optimizer_states and not load_module_only:
+        if self._offload is not None:
+            # host tier: master/moments restored into the offload buffers;
+            # the device payload is refreshed from the loaded master
+            self._offload.load_state(master_tree=model_tree["params"])
+            if load_optimizer_states and not load_module_only:
+                optim_tree, _ = load_tree(os.path.join(path, OPTIM_FILE),
+                                          with_meta=True)
+                opt = optim_tree.get("opt_state", {})
+                self._offload.load_state(
+                    master_tree=optim_tree.get("master"),
+                    m=opt.get("exp_avg"), v=opt.get("exp_avg_sq"))
+                if "scale" in optim_tree and state.scale is not None:
+                    state = state._replace(scale=jax.device_put(
+                        restore_like(state.scale, optim_tree["scale"]),
+                        self._repl_sh))
+            state = state._replace(params=jax.device_put(
+                self._offload.payload_tree(), self._param_sh))
+        elif load_optimizer_states and not load_module_only:
             optim_tree, _ = load_tree(os.path.join(path, OPTIM_FILE), with_meta=True)
             opt_state = jax.device_put(
                 restore_like(self.state.opt_state, optim_tree["opt_state"]),

@@ -1,0 +1,70 @@
+"""Double-buffered async tensor writer.
+
+Parity: reference ``runtime/swap_tensor/async_swapper.py``
+(``AsyncTensorSwapper``, 173 LoC): tensors queued for swap-out are copied
+into an aligned buffer and written asynchronously while the caller keeps
+computing; ``add_buffers``/``flush`` bracket a swap-out burst.
+"""
+
+import numpy as np
+
+from .utils import SwapBufferPool, swap_out_tensors, aligned_numel
+from ...utils.logging import logger
+
+
+class AsyncTensorSwapper:
+    def __init__(self, aio_handle, numel_alignment=None, timers=None,
+                 buffer_count=2, buffer_numel=None):
+        self.aio_handle = aio_handle
+        self.timers = timers
+        self.buffer_count = max(2, buffer_count)
+        self._pool = None
+        self._buffer_numel = buffer_numel
+        self._pending = []          # buffers with writes in flight
+        self.swapped_bytes = 0
+
+    def _ensure_pool(self, numel, dtype):
+        need = aligned_numel(numel, np.dtype(dtype).itemsize)
+        if self._pool is None or self._buffer_numel is None \
+                or need > self._buffer_numel:
+            # grow-on-demand double buffer (reference allocates from the
+            # engine's pinned aio buffers; host RAM here)
+            self._flush_pending()
+            self._buffer_numel = need
+            self._pool = SwapBufferPool(self.buffer_count, need, dtype)
+
+    def swap_out(self, array: np.ndarray, path: str):
+        """Queue one array for async write; returns once the data is staged
+        (the write itself completes at flush())."""
+        flat = np.ascontiguousarray(array).ravel()
+        self._ensure_pool(flat.size, flat.dtype)
+        try:
+            buf = self._pool.get()
+        except RuntimeError:
+            self._flush_pending()
+            buf = self._pool.get()
+        view = buf.view(flat.size)
+        np.copyto(view, flat)
+        swap_out_tensors(self.aio_handle, [view], [path])
+        self._pending.append(buf)
+        self.swapped_bytes += flat.nbytes
+
+    def add_buffers(self, arrays, paths):
+        for a, p in zip(arrays, paths):
+            self.swap_out(a, p)
+
+    def _flush_pending(self):
+        if self._pending:
+            self.aio_handle.wait()
+            for b in self._pending:
+                self._pool.release(b)
+            self._pending = []
+
+    def flush(self):
+        """Wait for every queued write to hit storage."""
+        self._flush_pending()
+
+    def release_buffers(self):
+        self._flush_pending()
+        self._pool = None
+        self._buffer_numel = None

@@ -1,0 +1,114 @@
+"""NVMe optimizer-state swapper.
+
+Parity: reference ``runtime/swap_tensor/partitioned_optimizer_swapper.py:27``
+(``PartitionedOptimizerSwapper``): the fp32 optimizer state of each ZeRO
+sub-group (master slice + Adam moments) lives on NVMe between steps; the
+step swaps a sub-group in, updates it, and swaps it back out.  The
+pipelined variant overlaps the next sub-group's read with the current
+sub-group's compute (``pipelined_optimizer_swapper.py``).
+"""
+
+import os
+
+import numpy as np
+
+from .utils import make_swap_path
+from ...utils.logging import logger
+
+
+class OptimizerSwapper:
+    """Base: per-(group, tensor-name) files, sync swap in/out."""
+
+    def __init__(self, swap_config, aio_config, nvme_path, rank=0):
+        from ...ops.aio import AsyncIOHandle
+        aio = dict(aio_config or {})
+        self.aio_handle = AsyncIOHandle(
+            block_size=aio.get("block_size", 1048576),
+            queue_depth=aio.get("queue_depth", 8),
+            single_submit=aio.get("single_submit", False),
+            overlap_events=aio.get("overlap_events", True),
+            thread_count=aio.get("thread_count", 1))
+        self.swap_folder = os.path.join(nvme_path, "zero_stage_optimizer",
+                                        f"rank{rank}")
+        os.makedirs(self.swap_folder, exist_ok=True)
+        self._numel = {}   # (group, name) -> numel
+
+    def _path(self, group, name):
+        return make_swap_path(self.swap_folder, f"group{group}_{name}")
+
+    def swap_out_group(self, group, tensors: dict, async_op=False):
+        """Write {name: flat fp32 array} for one sub-group."""
+        for name, arr in tensors.items():
+            flat = np.ascontiguousarray(arr, np.float32).ravel()
+            self._numel[(group, name)] = flat.size
+            self.aio_handle.async_pwrite(flat, self._path(group, name))
+        if not async_op:
+            self.aio_handle.wait()
+
+    def swap_in_group(self, group, names, out: dict = None, async_op=False):
+        """Read the named tensors of one sub-group into (new or provided)
+        host arrays; returns {name: array}."""
+        out = out if out is not None else {}
+        for name in names:
+            numel = self._numel[(group, name)]
+            if name not in out or out[name].size != numel:
+                out[name] = np.zeros(numel, np.float32)
+            self.aio_handle.async_pread(out[name], self._path(group, name))
+        if not async_op:
+            self.aio_handle.wait()
+        return out
+
+    def wait(self):
+        self.aio_handle.wait()
+
+
+class PartitionedOptimizerSwapper(OptimizerSwapper):
+    """Synchronous per-group swap (reference class of the same name)."""
+
+
+class PipelinedOptimizerSwapper(OptimizerSwapper):
+    """Overlapped variant (reference ``pipelined_optimizer_swapper.py``):
+    separate read/write queues so group g+1's read and group g-1's write
+    proceed while group g computes."""
+
+    def __init__(self, swap_config, aio_config, nvme_path, rank=0):
+        super().__init__(swap_config, aio_config, nvme_path, rank)
+        from ...ops.aio import AsyncIOHandle
+        aio = dict(aio_config or {})
+        self.aio_read_handle = AsyncIOHandle(
+            block_size=aio.get("block_size", 1048576),
+            queue_depth=aio.get("queue_depth", 8),
+            single_submit=aio.get("single_submit", False),
+            overlap_events=aio.get("overlap_events", True),
+            thread_count=aio.get("thread_count", 1))
+        self._read_bufs = {}   # group -> {name: array} prefetch in flight
+        self._reads_pending = set()
+
+    def prefetch_group(self, group, names):
+        if group in self._read_bufs or (group,) and group in self._reads_pending:
+            return
+        bufs = {}
+        for name in names:
+            numel = self._numel[(group, name)]
+            bufs[name] = np.zeros(numel, np.float32)
+            self.aio_read_handle.async_pread(bufs[name], self._path(group, name))
+        self._read_bufs[group] = bufs
+        self._reads_pending.add(group)
+
+    def get_group(self, group, names):
+        """Prefetched tensors if available, else a synchronous read."""
+        if group in self._read_bufs:
+            if self._reads_pending:
+                self.aio_read_handle.wait()
+                self._reads_pending.clear()
+            return self._read_bufs.pop(group)
+        return self.swap_in_group(group, names)
+
+    def swap_out_group(self, group, tensors, async_op=True):
+        # keep copies so callers may reuse their arrays immediately
+        staged = {n: np.array(a, np.float32).ravel() for n, a in tensors.items()}
+        for name, flat in staged.items():
+            self._numel[(group, name)] = flat.size
+            self.aio_handle.async_pwrite(flat, self._path(group, name))
+        if not async_op:
+            self.aio_handle.wait()

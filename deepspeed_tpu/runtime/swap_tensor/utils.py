@@ -1,0 +1,78 @@
+"""Swap-buffer plumbing shared by the NVMe swappers.
+
+Parity: reference ``runtime/swap_tensor/utils.py`` (``swap_in_tensors`` /
+``swap_out_tensors`` submitting one async op per tensor, ``MIN_AIO_BYTES`` /
+``AIO_ALIGNED_BYTES`` sizing rules) and the pinned-buffer pool in
+``optimizer_utils.py`` — on the TPU host the buffers are plain aligned numpy
+arrays (no CUDA pinned memory; the device transfer is a ``device_put``).
+"""
+
+import os
+
+import numpy as np
+
+MIN_AIO_BYTES = 1024 ** 2
+AIO_ALIGNED_BYTES = 1024
+
+
+def swappable_numel(numel, itemsize=4):
+    """A tensor is worth swapping only above MIN_AIO_BYTES (reference
+    ``swap_tensor/utils.py MIN_AIO_BYTES`` gate)."""
+    return numel * itemsize >= MIN_AIO_BYTES
+
+
+def aligned_numel(numel, itemsize=4):
+    """Round numel up so the byte count is AIO_ALIGNED_BYTES-aligned."""
+    align = AIO_ALIGNED_BYTES // itemsize
+    return ((numel + align - 1) // align) * align
+
+
+def swap_in_tensors(aio_handle, buffers, paths):
+    """Submit one async read per (buffer, path); caller waits on the handle."""
+    for buf, path in zip(buffers, paths):
+        aio_handle.async_pread(buf, path)
+
+
+def swap_out_tensors(aio_handle, buffers, paths):
+    """Submit one async write per (buffer, path)."""
+    for buf, path in zip(buffers, paths):
+        aio_handle.async_pwrite(buf, path)
+
+
+class SwapBuffer:
+    """One reusable aligned host buffer with a free/busy flag."""
+
+    def __init__(self, numel, dtype=np.float32):
+        self.data = np.zeros(aligned_numel(numel, np.dtype(dtype).itemsize),
+                             dtype)
+        self.in_use = False
+
+    def view(self, numel):
+        return self.data[:numel]
+
+
+class SwapBufferPool:
+    """Fixed pool of swap buffers (reference ``SwapBufferPool``: pinned
+    buffers handed out round-robin to in-flight swaps)."""
+
+    def __init__(self, count, numel, dtype=np.float32):
+        self.buffers = [SwapBuffer(numel, dtype) for _ in range(count)]
+
+    def get(self):
+        for b in self.buffers:
+            if not b.in_use:
+                b.in_use = True
+                return b
+        raise RuntimeError("no free swap buffer (increase buffer_count)")
+
+    def release(self, buf):
+        buf.in_use = False
+
+    def release_all(self):
+        for b in self.buffers:
+            b.in_use = False
+
+
+def make_swap_path(folder, name):
+    os.makedirs(folder, exist_ok=True)
+    return os.path.join(folder, f"{name}.swp")

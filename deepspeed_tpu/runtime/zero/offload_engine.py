@@ -1,0 +1,210 @@
+"""ZeRO-Offload / ZeRO-Infinity host optimizer tier.
+
+Parity: reference stage-1/2 ``cpu_offload`` path
+(``zero/stage_1_and_2.py:1008-1160``: fp32 master partition + Adam state on
+host, ``DeepSpeedCPUAdam.step(fp16_param_groups=...)`` with fused copy-back)
+and the stage-3 NVMe tier (``stage3.py:2339`` per-sub-group swap-in → step →
+swap-out over ``swap_tensor/``).
+
+TPU-native shape: the device step computes fp32 gradients (sharded, clipped,
+unscaled); this object owns the flat fp32 master + Adam moments on the HOST,
+runs the native fused step (``csrc/adam/ds_cpu_adam.cpp``) sub-group by
+sub-group, and hands back the 16-bit payload for ``device_put`` — one host
+memory sweep per step, PCIe-analogous transfers at the step boundary only.
+With ``device == "nvme"`` the Adam moments live on NVMe between steps and are
+streamed through the aio op (prefetch of group g+1 overlaps compute of g via
+``PipelinedOptimizerSwapper``).
+"""
+
+import numpy as np
+import jax
+
+from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
+from ...utils.logging import logger, log_dist
+
+OUT_DTYPE = {"bfloat16": "bfloat16", "float16": "float16",
+             "float32": None}
+
+
+class HostOffloadOptimizer:
+    def __init__(self, params0, zero_config, aio_config, *, optimizer_name,
+                 optimizer_params, compute_dtype_name, rank=0):
+        p = dict(optimizer_params or {})
+        p.pop("torch_adam", None)
+        # same default as FusedAdam (adam_w_mode=True): identical update rule
+        # with and without offload for the same config
+        adam_w_mode = p.pop("adam_w_mode", True)
+        adamw = True if optimizer_name == "adamw" else adam_w_mode
+        self.opt = DeepSpeedCPUAdam(adamw_mode=adamw, **p)
+        self.out_dtype = OUT_DTYPE[compute_dtype_name]
+
+        # ---- flat layout of the fp32 master --------------------------------
+        leaves, self.treedef = jax.tree_util.tree_flatten(params0)
+        self.shapes = [np.shape(l) for l in leaves]
+        sizes = [int(np.prod(s or (1,))) for s in self.shapes]
+        self.offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+        self.numel = int(self.offsets[-1])
+        self.master = np.empty(self.numel, np.float32)
+        for leaf, off, n in zip(leaves, self.offsets, sizes):
+            self.master[off:off + n] = np.asarray(leaf, np.float32).ravel()
+
+        # ---- sub-groups (reference sub_group_size elements) ----------------
+        sg = int(zero_config.sub_group_size)
+        bounds = list(range(0, self.numel, sg)) + [self.numel]
+        self.sub_groups = [(bounds[i], bounds[i + 1])
+                           for i in range(len(bounds) - 1)]
+
+        # ---- moments: host RAM or NVMe -------------------------------------
+        off_cfg = zero_config.offload_optimizer
+        self.nvme = off_cfg is not None and off_cfg.device == "nvme"
+        if self.nvme:
+            from ..swap_tensor.partitioned_optimizer_swapper import (
+                PartitionedOptimizerSwapper, PipelinedOptimizerSwapper)
+            cls = (PipelinedOptimizerSwapper if off_cfg.pipeline
+                   else PartitionedOptimizerSwapper)
+            assert off_cfg.nvme_path, \
+                "offload_optimizer.device=nvme requires nvme_path"
+            self.swapper = cls(off_cfg, aio_config, off_cfg.nvme_path, rank)
+            for g, (s, e) in enumerate(self.sub_groups):
+                z = np.zeros(e - s, np.float32)
+                self.swapper.swap_out_group(
+                    g, {"exp_avg": z, "exp_avg_sq": z}, async_op=False)
+            self.m = self.v = None
+        else:
+            self.swapper = None
+            self.m, self.v = self.opt.init_buffers(self.numel)
+        log_dist(f"host offload optimizer: {self.numel} params, "
+                 f"{len(self.sub_groups)} sub-group(s), "
+                 f"moments on {'nvme' if self.nvme else 'cpu'}, "
+                 f"native={self.opt.is_native}", ranks=[0])
+
+    # ------------------------------------------------------------ flattening
+    def flatten_grads(self, grads_tree):
+        """Device grads pytree → flat host fp32 (the d2h transfer)."""
+        leaves = self.treedef.flatten_up_to(grads_tree)
+        flat = np.empty(self.numel, np.float32)
+        for leaf, off, shape in zip(leaves, self.offsets, self.shapes):
+            n = int(np.prod(shape or (1,)))
+            flat[off:off + n] = np.asarray(leaf, np.float32).ravel()
+        return flat
+
+    def payload_tree(self):
+        """Master as a pytree of compute-dtype numpy arrays (h2d payload)."""
+        import jax.numpy as jnp
+        if self.out_dtype is None:
+            src = self.master
+        else:
+            src = self._out16.view(
+                jnp.bfloat16 if self.out_dtype == "bfloat16" else np.float16)
+        leaves = [src[off:off + int(np.prod(s or (1,)))].reshape(s)
+                  for off, s in zip(self.offsets, self.shapes)]
+        return self.treedef.unflatten(leaves)
+
+    # ------------------------------------------------------------------ step
+    def step(self, flat_grads: np.ndarray, step_no: int, lr: float):
+        """One fused host Adam step over all sub-groups (in place)."""
+        if self.out_dtype is not None and not hasattr(self, "_out16"):
+            self._out16 = np.empty(self.numel, np.uint16)
+        out16 = getattr(self, "_out16", None)
+        kind = self.out_dtype
+
+        if not self.nvme:
+            self._step_range(0, self.numel, flat_grads, self.m, self.v,
+                             step_no, lr, out16, kind)
+            return
+
+        pipelined = hasattr(self.swapper, "prefetch_group")
+        names = ("exp_avg", "exp_avg_sq")
+        if pipelined and self.sub_groups:
+            self.swapper.prefetch_group(0, names)
+        for g, (s, e) in enumerate(self.sub_groups):
+            if pipelined:
+                bufs = self.swapper.get_group(g, names)
+                if g + 1 < len(self.sub_groups):
+                    self.swapper.prefetch_group(g + 1, names)
+            else:
+                bufs = self.swapper.swap_in_group(g, names)
+            self._step_range(s, e, flat_grads, bufs["exp_avg"],
+                             bufs["exp_avg_sq"], step_no, lr, out16, kind,
+                             moment_offset=s)
+            self.swapper.swap_out_group(g, bufs,
+                                        async_op=pipelined)
+        self.swapper.wait()
+
+    def _step_range(self, s, e, flat_grads, m, v, step_no, lr, out16, kind,
+                    moment_offset=0):
+        ms, mv = (m[s - moment_offset:e - moment_offset],
+                  v[s - moment_offset:e - moment_offset])
+        self.opt.step_flat(
+            self.master[s:e], flat_grads[s:e], ms, mv, step_no, lr=lr,
+            out16=out16[s:e] if out16 is not None else None, out_dtype=kind)
+
+    # ----------------------------------------------------------- checkpoints
+    def master_tree(self):
+        leaves = [self.master[off:off + int(np.prod(s or (1,)))].reshape(s).copy()
+                  for off, s in zip(self.offsets, self.shapes)]
+        return self.treedef.unflatten(leaves)
+
+    def moments(self):
+        """(exp_avg, exp_avg_sq) flat fp32 — gathered from NVMe if needed."""
+        if not self.nvme:
+            return self.m, self.v
+        m = np.empty(self.numel, np.float32)
+        v = np.empty(self.numel, np.float32)
+        for g, (s, e) in enumerate(self.sub_groups):
+            bufs = self.swapper.swap_in_group(g, ("exp_avg", "exp_avg_sq"))
+            m[s:e] = bufs["exp_avg"]
+            v[s:e] = bufs["exp_avg_sq"]
+        return m, v
+
+    def _unflatten(self, flat):
+        leaves = [flat[off:off + int(np.prod(s or (1,)))].reshape(s).copy()
+                  for off, s in zip(self.offsets, self.shapes)]
+        return self.treedef.unflatten(leaves)
+
+    def moments_tree(self):
+        """Moments as param-shaped pytrees — the SAME checkpoint layout as
+        the in-device AdamState, so offload and non-offload runs can load
+        each other's checkpoints (leaves match by ``exp_avg/...`` paths)."""
+        m, v = self.moments()
+        return {"exp_avg": self._unflatten(m),
+                "exp_avg_sq": self._unflatten(v)}
+
+    def _to_flat(self, x):
+        """Accept a flat array OR a param-shaped pytree of moments."""
+        if x is None:
+            return None
+        leaves = jax.tree_util.tree_leaves(x)
+        if len(leaves) == 1 and np.ndim(leaves[0]) == 1 \
+                and np.size(leaves[0]) == self.numel:
+            return np.asarray(leaves[0], np.float32)
+        return np.concatenate(
+            [np.asarray(l, np.float32).ravel() for l in leaves])
+
+    def load_state(self, master_tree=None, m=None, v=None):
+        if master_tree is not None:
+            leaves = self.treedef.flatten_up_to(master_tree)
+            for leaf, off, shape in zip(leaves, self.offsets, self.shapes):
+                n = int(np.prod(shape or (1,)))
+                self.master[off:off + n] = np.asarray(leaf, np.float32).ravel()
+        m, v = self._to_flat(m), self._to_flat(v)
+        if m is not None and v is not None:
+            if self.nvme:
+                for g, (s, e) in enumerate(self.sub_groups):
+                    self.swapper.swap_out_group(
+                        g, {"exp_avg": m[s:e], "exp_avg_sq": v[s:e]},
+                        async_op=False)
+            else:
+                np.copyto(self.m, m)
+                np.copyto(self.v, v)
+        # refresh the device payload for the next upload
+        if self.out_dtype is not None:
+            if not hasattr(self, "_out16"):
+                self._out16 = np.empty(self.numel, np.uint16)
+            import jax.numpy as jnp
+            tgt = (jnp.bfloat16 if self.out_dtype == "bfloat16"
+                   else np.float16)
+            self._out16[...] = np.asarray(
+                jnp.asarray(self.master).astype(tgt)).view(np.uint16) \
+                if self.out_dtype == "bfloat16" \
+                else self.master.astype(np.float16).view(np.uint16)
