@@ -35,12 +35,16 @@ def main():
     from deepspeed_tpu.models import build
 
     seq = 512
-    micro = 8
+    micro = 16       # swept on v5e: 16 > 8/24/32 (32 exceeds compile limits)
     steps = 20
     warmup = 3
 
+    # remat off: 125M fits HBM comfortably; rematerialization costs ~6% tput.
+    # flash attention: the Pallas kernel beats both the jnp path (+16%) and
+    # the upstream pallas ops kernel on this chip (see ops/transformer).
     model = build("gpt2-125m", dtype=jnp.bfloat16, max_seq=seq,
-                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+                  remat=False, attention_impl="flash")
     config = {
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": 1,

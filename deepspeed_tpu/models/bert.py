@@ -1,0 +1,267 @@
+"""BERT encoder family — the reference's headline benchmark model.
+
+Role parity: the reference's flagship perf claims are BERT-large pretraining
+(``docs/_posts/2020-05-28-fastest-bert-training.md``: 272/52 samples/s/GPU at
+seq 128/512, 66 TFLOPS/GPU kernel efficiency) driven by the fused transformer
+kernels (``csrc/transformer/``), and its kernel-numerics tests run a vendored
+HF-BERT (``tests/unit/modeling.py``).
+
+TPU-first design, same shape as GPT-2 (``models/gpt2.py``): stacked block
+params + ``lax.scan`` over layers, remat per block, fp32 LN/softmax, bf16
+matmuls, Megatron TP specs.  Encoder blocks use the SAME math as
+``ops/transformer/transformer.py`` (post-LN BERT by default, pre-LN
+switchable) with parameter names matching the fused layer's state dict, so
+``DeepSpeedTransformerLayer`` weights map 1:1 onto the stacked model.
+"""
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq: int = 512
+    type_vocab_size: int = 2
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    n_layer: int = 12
+    n_head: int = 12
+    hidden_dropout: float = 0.1
+    attn_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = False      # classic BERT is post-LN
+    remat: bool = True
+
+    @property
+    def head_dim(self):
+        assert self.hidden_size % self.n_head == 0
+        return self.hidden_size // self.n_head
+
+
+PRESETS = {
+    "bert-base": dict(hidden_size=768, n_layer=12, n_head=12,
+                      intermediate_size=3072),
+    "bert-large": dict(hidden_size=1024, n_layer=24, n_head=16,
+                       intermediate_size=4096),
+    "bert-tiny": dict(hidden_size=128, n_layer=4, n_head=4,
+                      intermediate_size=512, vocab_size=1024, max_seq=128),
+}
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _dropout(x, rate, rng, deterministic):
+    if deterministic or rate == 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+class Bert:
+    """Bidirectional encoder with MLM head (params: dict pytree, scanned
+    blocks; block param names match the fused layer: attn_qkvw … norm_b)."""
+
+    def __init__(self, config: Optional[BertConfig] = None, preset: str = None,
+                 dtype=jnp.bfloat16, **overrides):
+        if config is None:
+            base = dict(PRESETS[preset or "bert-base"])
+            base.update(overrides)
+            config = BertConfig(**base)
+        self.config = config
+        self.dtype = dtype
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng):
+        c = self.config
+        D, I, L = c.hidden_size, c.intermediate_size, c.n_layer
+        k = jax.random.split(rng, 8)
+        std = 0.02
+        out_std = std / np.sqrt(2.0 * L)   # adjust_init_range semantics
+        n = lambda key, shape, s=std: jax.random.normal(key, shape, jnp.float32) * s
+        return {
+            "word_embeddings": n(k[0], (c.vocab_size, D)),
+            "position_embeddings": n(k[1], (c.max_seq, D)),
+            "token_type_embeddings": n(k[2], (c.type_vocab_size, D)),
+            "emb_ln_scale": jnp.ones((D,), jnp.float32),
+            "emb_ln_bias": jnp.zeros((D,), jnp.float32),
+            "blocks": {
+                "attn_qkvw": n(k[3], (L, D, 3 * D)),
+                "attn_qkvb": jnp.zeros((L, 3 * D), jnp.float32),
+                "attn_ow": n(k[4], (L, D, D), out_std),
+                "attn_ob": jnp.zeros((L, D), jnp.float32),
+                "attn_nw": jnp.ones((L, D), jnp.float32),
+                "attn_nb": jnp.zeros((L, D), jnp.float32),
+                "inter_w": n(k[5], (L, D, I)),
+                "inter_b": jnp.zeros((L, I), jnp.float32),
+                "output_w": n(k[6], (L, I, D), out_std),
+                "output_b": jnp.zeros((L, D), jnp.float32),
+                "norm_w": jnp.ones((L, D), jnp.float32),
+                "norm_b": jnp.zeros((L, D), jnp.float32),
+            },
+            # MLM transform head (dense + LN; decoder tied to word embeddings)
+            "mlm_dense_w": n(k[7], (D, D)),
+            "mlm_dense_b": jnp.zeros((D,), jnp.float32),
+            "mlm_ln_scale": jnp.ones((D,), jnp.float32),
+            "mlm_ln_bias": jnp.zeros((D,), jnp.float32),
+            "mlm_bias": jnp.zeros((c.vocab_size,), jnp.float32),
+        }
+
+    # ------------------------------------------------- tensor-parallel specs
+    def partition_specs(self, params=None):
+        """Megatron TP: qkv/inter column-split, attn_ow/output row-split,
+        vocab-parallel word embeddings."""
+        return {
+            "word_embeddings": P("tensor", None),
+            "position_embeddings": P(),
+            "token_type_embeddings": P(),
+            "emb_ln_scale": P(), "emb_ln_bias": P(),
+            "blocks": {
+                "attn_qkvw": P(None, None, "tensor"),
+                "attn_qkvb": P(None, "tensor"),
+                "attn_ow": P(None, "tensor", None),
+                "attn_ob": P(),
+                "attn_nw": P(), "attn_nb": P(),
+                "inter_w": P(None, None, "tensor"),
+                "inter_b": P(None, "tensor"),
+                "output_w": P(None, "tensor", None),
+                "output_b": P(),
+                "norm_w": P(), "norm_b": P(),
+            },
+            "mlm_dense_w": P(), "mlm_dense_b": P(),
+            "mlm_ln_scale": P(), "mlm_ln_bias": P(),
+            "mlm_bias": P("tensor"),
+        }
+
+    # --------------------------------------------------------------- forward
+    def _block(self, x, p, mask, rng, deterministic):
+        c = self.config
+        B, T, D = x.shape
+        H, hd = c.n_head, c.head_dim
+        r1, r2, r3 = jax.random.split(rng, 3)
+
+        def attention(h):
+            qkv = h @ p["attn_qkvw"].astype(h.dtype) + p["attn_qkvb"].astype(h.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            f = lambda t: t.reshape(B, T, H, hd)
+            q, k, v = f(q), f(k), f(v)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            scores = scores / np.sqrt(hd)
+            if mask is not None:
+                scores = scores + mask.astype(scores.dtype)
+            probs = jax.nn.softmax(scores, axis=-1)
+            probs = _dropout(probs, c.attn_dropout, r1, deterministic).astype(h.dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+            out = ctx @ p["attn_ow"].astype(h.dtype) + p["attn_ob"].astype(h.dtype)
+            return _dropout(out, c.hidden_dropout, r2, deterministic)
+
+        def mlp(h):
+            inter = h @ p["inter_w"].astype(h.dtype) + p["inter_b"].astype(h.dtype)
+            inter = jax.nn.gelu(inter, approximate=False)
+            out = inter @ p["output_w"].astype(h.dtype) + p["output_b"].astype(h.dtype)
+            return _dropout(out, c.hidden_dropout, r3, deterministic)
+
+        eps = c.layer_norm_eps
+        if c.pre_layer_norm:
+            x = x + attention(_layer_norm(x, p["attn_nw"], p["attn_nb"], eps))
+            x = x + mlp(_layer_norm(x, p["norm_w"], p["norm_b"], eps))
+        else:
+            x = _layer_norm(x + attention(x), p["attn_nw"], p["attn_nb"], eps)
+            x = _layer_norm(x + mlp(x), p["norm_w"], p["norm_b"], eps)
+        return x
+
+    def apply(self, params, input_ids, attention_mask=None, token_type_ids=None,
+              rng=None, deterministic=True):
+        """input_ids: (B, T) int32; attention_mask: (B, T) 1/0 → encoder
+        hidden states (B, T, D)."""
+        c = self.config
+        B, T = input_ids.shape
+        assert T <= c.max_seq, f"sequence length {T} exceeds max_seq {c.max_seq}"
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        dtype = self.dtype
+
+        pos = jnp.arange(T)
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros_like(input_ids))
+        x = (params["word_embeddings"].astype(dtype)[input_ids]
+             + params["position_embeddings"].astype(dtype)[pos]
+             + params["token_type_embeddings"].astype(dtype)[tt])
+        x = _layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                        c.layer_norm_eps)
+        x = _dropout(x, c.hidden_dropout, jax.random.fold_in(rng, 17),
+                     deterministic)
+
+        # HF additive mask convention: (B, 1, 1, T), 0 keep / -10000 drop
+        add_mask = None
+        if attention_mask is not None:
+            add_mask = (1.0 - attention_mask.astype(jnp.float32)) * -10000.0
+            add_mask = add_mask[:, None, None, :]
+
+        block = self._block
+        if c.remat:
+            block = jax.checkpoint(block, static_argnums=(4,))
+
+        def scan_body(h, xs):
+            layer_params, layer_rng = xs
+            return block(h, layer_params, add_mask, layer_rng, deterministic), None
+
+        layer_rngs = jax.random.split(jax.random.fold_in(rng, 31), c.n_layer)
+        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+        return x
+
+    def mlm_logits(self, params, hidden):
+        """MLM head: dense → gelu → LN → tied decoder + bias."""
+        h = hidden @ params["mlm_dense_w"].astype(hidden.dtype) \
+            + params["mlm_dense_b"].astype(hidden.dtype)
+        h = jax.nn.gelu(h, approximate=False)
+        h = _layer_norm(h, params["mlm_ln_scale"], params["mlm_ln_bias"],
+                        self.config.layer_norm_eps)
+        return jnp.einsum("btd,vd->btv", h.astype(jnp.float32),
+                          params["word_embeddings"].astype(jnp.float32)) \
+            + params["mlm_bias"]
+
+    # ------------------------------------------------------------------ loss
+    def loss(self, params, batch, rng):
+        """Masked-LM loss.  ``batch``: dict with 'input_ids', optional
+        'attention_mask', 'labels' (-100 = unmasked/ignored, HF convention)."""
+        if isinstance(batch, (tuple, list)):
+            batch = {"input_ids": batch[0], "labels": batch[1]}
+        ids = batch["input_ids"]
+        labels = batch["labels"]
+        hidden = self.apply(params, ids,
+                            attention_mask=batch.get("attention_mask"),
+                            token_type_ids=batch.get("token_type_ids"),
+                            rng=rng, deterministic=False)
+        logits = self.mlm_logits(params, hidden)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = labels >= 0
+        safe = jnp.where(valid, labels, 0)
+        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(valid), 1)
+        return -jnp.sum(jnp.where(valid, ll, 0.0)) / denom
+
+    # ----------------------------------------------------------- flop counts
+    def num_params(self):
+        c = self.config
+        D, I = c.hidden_size, c.intermediate_size
+        per_layer = (3 * D * D + D * D + 2 * D * I  # qkv, attn_ow, inter, output
+                     + 3 * D + D + I + D            # their biases
+                     + 4 * D)                       # 2 LayerNorms
+        emb = (c.vocab_size + c.max_seq + c.type_vocab_size) * D + 2 * D
+        head = D * D + D + 2 * D + c.vocab_size     # dense + LN + tied bias
+        return emb + c.n_layer * per_layer + head
+
+    def flops_per_token(self):
+        c = self.config
+        return 6 * self.num_params() + 12 * c.n_layer * c.hidden_size * c.max_seq

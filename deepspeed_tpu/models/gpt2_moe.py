@@ -1,0 +1,204 @@
+"""GPT-2 with Mixture-of-Experts FFN layers.
+
+Role parity: the reference's MoE usage pattern (``deepspeed/moe/layer.py``
+applied inside Megatron GPT, and BASELINE's graded "GPT-MoE 350M×16e"
+config): every other transformer block replaces its dense FFN with a
+top-k-gated expert layer; the gate's aux loss is added to the LM loss with
+a configurable coefficient.
+
+Unlike the dense GPT-2's scanned blocks, MoE blocks alternate two block
+types, so the layer loop is a Python loop over per-layer param subtrees
+(L is small for the MoE configs; compile time stays manageable) — expert
+dispatch inside sharded over the mesh ``expert`` axis via all_to_all
+(``moe/sharded_moe.py``).
+"""
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .gpt2 import GPT2Config, PRESETS as GPT2_PRESETS, _layer_norm, _dropout, \
+    _attention_jnp
+
+
+@dataclasses.dataclass
+class GPT2MoEConfig(GPT2Config):
+    num_experts: int = 8
+    moe_every: int = 2          # an MoE FFN every k-th layer (reference style)
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    aux_loss_coef: float = 0.01
+    use_residual: bool = False  # PR-MoE (pyramid-residual)
+    noisy_gate_policy: Optional[str] = None
+
+
+MOE_PRESETS = {
+    "gpt2-moe-350m-16e": dict(n_embd=1024, n_layer=24, n_head=16,
+                              num_experts=16),
+    "gpt2-moe-tiny": dict(n_embd=128, n_layer=4, n_head=4, vocab_size=1024,
+                          max_seq=256, num_experts=4),
+}
+
+
+class _ExpertFFN:
+    """One expert: the GPT-2 FFN (fc → gelu → proj), layer protocol."""
+
+    def __init__(self, d, hidden, proj_std):
+        self.d, self.hidden, self.proj_std = d, hidden, proj_std
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        n = lambda k, s, std: jax.random.normal(k, s, jnp.float32) * std
+        return {"fc_w": n(k1, (self.d, self.hidden), 0.02),
+                "fc_b": jnp.zeros((self.hidden,), jnp.float32),
+                "proj_w": n(k2, (self.hidden, self.d), self.proj_std),
+                "proj_b": jnp.zeros((self.d,), jnp.float32)}
+
+    def apply(self, params, x, rng=None):
+        h = x @ params["fc_w"].astype(x.dtype) + params["fc_b"].astype(x.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        return h @ params["proj_w"].astype(x.dtype) + params["proj_b"].astype(x.dtype)
+
+
+class GPT2MoE:
+    """Decoder LM with alternating dense/MoE FFN blocks."""
+
+    def __init__(self, config: Optional[GPT2MoEConfig] = None,
+                 preset: str = None, dtype=jnp.bfloat16, **overrides):
+        if config is None:
+            base = dict(MOE_PRESETS[preset or "gpt2-moe-tiny"])
+            base.update(overrides)
+            config = GPT2MoEConfig(**base)
+        self.config = config
+        self.dtype = dtype
+        c = config
+        proj_std = 0.02 / np.sqrt(2.0 * c.n_layer)
+        from ..moe.layer import MoE
+        self._expert = _ExpertFFN(c.n_embd, 4 * c.n_embd, proj_std)
+        self._moe = MoE(hidden_size=c.n_embd, expert=self._expert,
+                        num_experts=c.num_experts, k=c.top_k,
+                        capacity_factor=c.capacity_factor,
+                        min_capacity=c.min_capacity,
+                        use_residual=c.use_residual,
+                        noisy_gate_policy=c.noisy_gate_policy)
+
+    def is_moe_layer(self, i):
+        # last layer of every `moe_every` window hosts the experts
+        return (i + 1) % self.config.moe_every == 0
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng):
+        c = self.config
+        D, V, T = c.n_embd, c.vocab_size, c.max_seq
+        k = jax.random.split(rng, 4 + c.n_layer)
+        std, proj_std = 0.02, 0.02 / np.sqrt(2.0 * c.n_layer)
+        n = lambda key, shape, s=std: jax.random.normal(key, shape, jnp.float32) * s
+        layers = []
+        for i in range(c.n_layer):
+            ki = jax.random.split(k[4 + i], 6)
+            layer = {
+                "ln1_scale": jnp.ones((D,), jnp.float32),
+                "ln1_bias": jnp.zeros((D,), jnp.float32),
+                "qkv_w": n(ki[0], (D, 3 * D)),
+                "qkv_b": jnp.zeros((3 * D,), jnp.float32),
+                "proj_w": n(ki[1], (D, D), proj_std),
+                "proj_b": jnp.zeros((D,), jnp.float32),
+                "ln2_scale": jnp.ones((D,), jnp.float32),
+                "ln2_bias": jnp.zeros((D,), jnp.float32),
+            }
+            if self.is_moe_layer(i):
+                layer["moe"] = self._moe.init(ki[2])
+            else:
+                layer["ffn"] = self._expert.init(ki[3])
+            layers.append(layer)
+        return {
+            "wte": n(k[0], (V, D)),
+            "wpe": n(k[1], (T, D), 0.01),
+            "layers": layers,
+            "lnf_scale": jnp.ones((D,), jnp.float32),
+            "lnf_bias": jnp.zeros((D,), jnp.float32),
+        }
+
+    # ------------------------------------------------- tensor-parallel specs
+    def partition_specs(self, params):
+        specs = {"wte": P("tensor", None), "wpe": P(),
+                 "lnf_scale": P(), "lnf_bias": P(), "layers": []}
+        for i, layer in enumerate(params["layers"]):
+            s = {"ln1_scale": P(), "ln1_bias": P(),
+                 "qkv_w": P(None, "tensor"), "qkv_b": P("tensor"),
+                 "proj_w": P("tensor", None), "proj_b": P(),
+                 "ln2_scale": P(), "ln2_bias": P()}
+            if "moe" in layer:
+                s["moe"] = self._moe.partition_specs(layer["moe"])
+            else:
+                s["ffn"] = {"fc_w": P(None, "tensor"), "fc_b": P("tensor"),
+                            "proj_w": P("tensor", None), "proj_b": P()}
+            specs["layers"].append(s)
+        return specs
+
+    # --------------------------------------------------------------- forward
+    def _apply_with_aux(self, params, tokens, rng, deterministic):
+        c = self.config
+        B, T = tokens.shape
+        assert T <= c.max_seq
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        dtype = self.dtype
+
+        pos = jnp.arange(T)
+        x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[pos]
+        x = _dropout(x, c.embd_pdrop, jax.random.fold_in(rng, 17), deterministic)
+        causal = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
+        D, H, hd = c.n_embd, c.n_head, c.head_dim
+
+        aux_total = jnp.float32(0.0)
+        for i, p in enumerate(params["layers"]):
+            r = jax.random.fold_in(rng, 100 + i)
+            r1, r2, r3, r4 = jax.random.split(r, 4)
+            h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
+            qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+            q, k_, v = jnp.split(qkv, 3, axis=-1)
+            f = lambda t: t.reshape(B, T, H, hd)
+            attn = _attention_jnp(f(q), f(k_), f(v), causal, c.attn_pdrop, r1,
+                                  deterministic)
+            attn = attn.reshape(B, T, D)
+            attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+            x = x + _dropout(attn, c.resid_pdrop, r2, deterministic)
+
+            h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
+            if "moe" in p:
+                out, l_aux, _ = self._moe.apply(p["moe"], h, rng=r4,
+                                                train=not deterministic)
+                aux_total = aux_total + l_aux
+            else:
+                out = self._expert.apply(p["ffn"], h)
+            x = x + _dropout(out, c.resid_pdrop, r3, deterministic)
+
+        x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                        c.layer_norm_eps)
+        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                            params["wte"].astype(jnp.float32))
+        return logits, aux_total
+
+    def apply(self, params, tokens, rng=None, deterministic=True):
+        logits, _ = self._apply_with_aux(params, tokens, rng, deterministic)
+        return logits
+
+    # ------------------------------------------------------------------ loss
+    def loss(self, params, batch, rng):
+        from .gpt2 import GPT2
+        tokens, labels = GPT2._split_batch(batch)
+        logits, aux = self._apply_with_aux(params, tokens, rng,
+                                           deterministic=False)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll) + self.config.aux_loss_coef * aux
+
+    def num_params(self):
+        return sum(int(np.prod(np.shape(l) or (1,)))
+                   for l in jax.tree_util.tree_leaves(
+                       self.init(jax.random.PRNGKey(0))))

@@ -1,0 +1,3 @@
+from .transformer import DeepSpeedTransformerConfig, DeepSpeedTransformerLayer
+from .flash_attention import (flash_attention, sparse_flash_attention,
+                              attention_reference, sparse_attention_reference)

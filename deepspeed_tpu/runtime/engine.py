@@ -811,12 +811,15 @@ class DeepSpeedEngine:
 
         from ..checkpoint.serialization import restore_like
         model_tree, meta = load_tree(os.path.join(path, MODEL_FILE), with_meta=True)
-        params = restore_like(self.state.params, model_tree["params"])
-        params = jax.device_put(
-            jax.tree_util.tree_map(lambda x, p: np.asarray(x).astype(p.dtype),
-                                   params, self.state.params),
-            self._param_sh)
-        state = self.state._replace(params=params)
+        state = self.state
+        if self._offload is None:
+            # (offload path uploads once from the restored host master below)
+            params = restore_like(self.state.params, model_tree["params"])
+            params = jax.device_put(
+                jax.tree_util.tree_map(lambda x, p: np.asarray(x).astype(p.dtype),
+                                       params, self.state.params),
+                self._param_sh)
+            state = state._replace(params=params)
         if state.master is not None:
             # keep the fp32 master coherent with the loaded params NOW; if
             # optimizer states are loaded below this is overwritten with the

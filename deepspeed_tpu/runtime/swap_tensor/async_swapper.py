@@ -26,9 +26,11 @@ class AsyncTensorSwapper:
     def _ensure_pool(self, numel, dtype):
         need = aligned_numel(numel, np.dtype(dtype).itemsize)
         if self._pool is None or self._buffer_numel is None \
-                or need > self._buffer_numel:
+                or need > self._buffer_numel \
+                or self._pool.buffers[0].data.dtype != np.dtype(dtype):
             # grow-on-demand double buffer (reference allocates from the
-            # engine's pinned aio buffers; host RAM here)
+            # engine's pinned aio buffers; host RAM here); re-made on dtype
+            # change — np.copyto into a mismatched pool would silently cast
             self._flush_pending()
             self._buffer_numel = need
             self._pool = SwapBufferPool(self.buffer_count, need, dtype)

@@ -20,14 +20,8 @@ class OptimizerSwapper:
     """Base: per-(group, tensor-name) files, sync swap in/out."""
 
     def __init__(self, swap_config, aio_config, nvme_path, rank=0):
-        from ...ops.aio import AsyncIOHandle
-        aio = dict(aio_config or {})
-        self.aio_handle = AsyncIOHandle(
-            block_size=aio.get("block_size", 1048576),
-            queue_depth=aio.get("queue_depth", 8),
-            single_submit=aio.get("single_submit", False),
-            overlap_events=aio.get("overlap_events", True),
-            thread_count=aio.get("thread_count", 1))
+        from .utils import make_aio_handle
+        self.aio_handle = make_aio_handle(aio_config)
         self.swap_folder = os.path.join(nvme_path, "zero_stage_optimizer",
                                         f"rank{rank}")
         os.makedirs(self.swap_folder, exist_ok=True)
@@ -73,19 +67,13 @@ class PipelinedOptimizerSwapper(OptimizerSwapper):
 
     def __init__(self, swap_config, aio_config, nvme_path, rank=0):
         super().__init__(swap_config, aio_config, nvme_path, rank)
-        from ...ops.aio import AsyncIOHandle
-        aio = dict(aio_config or {})
-        self.aio_read_handle = AsyncIOHandle(
-            block_size=aio.get("block_size", 1048576),
-            queue_depth=aio.get("queue_depth", 8),
-            single_submit=aio.get("single_submit", False),
-            overlap_events=aio.get("overlap_events", True),
-            thread_count=aio.get("thread_count", 1))
+        from .utils import make_aio_handle
+        self.aio_read_handle = make_aio_handle(aio_config)
         self._read_bufs = {}   # group -> {name: array} prefetch in flight
         self._reads_pending = set()
 
     def prefetch_group(self, group, names):
-        if group in self._read_bufs or (group,) and group in self._reads_pending:
+        if group in self._read_bufs or group in self._reads_pending:
             return
         bufs = {}
         for name in names:

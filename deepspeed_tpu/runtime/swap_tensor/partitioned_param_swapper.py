@@ -21,20 +21,9 @@ from ...utils.logging import logger
 class AsyncPartitionedParameterSwapper:
     def __init__(self, ds_config_aio, nvme_path, dtype=np.float32,
                  buffer_count=5, buffer_numel=int(1e8), rank=0):
-        from ...ops.aio import AsyncIOHandle
-        aio = dict(ds_config_aio or {})
-        self.aio_read_handle = AsyncIOHandle(
-            block_size=aio.get("block_size", 1048576),
-            queue_depth=aio.get("queue_depth", 8),
-            single_submit=aio.get("single_submit", False),
-            overlap_events=aio.get("overlap_events", True),
-            thread_count=aio.get("thread_count", 1))
-        self.aio_write_handle = AsyncIOHandle(
-            block_size=aio.get("block_size", 1048576),
-            queue_depth=aio.get("queue_depth", 8),
-            single_submit=aio.get("single_submit", False),
-            overlap_events=aio.get("overlap_events", True),
-            thread_count=aio.get("thread_count", 1))
+        from .utils import make_aio_handle
+        self.aio_read_handle = make_aio_handle(ds_config_aio)
+        self.aio_write_handle = make_aio_handle(ds_config_aio)
         self.dtype = np.dtype(dtype)
         self.swap_folder = os.path.join(
             nvme_path, "zero_stage_3", f"{self.dtype.name}params", f"rank{rank}")

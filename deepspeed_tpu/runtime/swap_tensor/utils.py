@@ -76,3 +76,16 @@ class SwapBufferPool:
 def make_swap_path(folder, name):
     os.makedirs(folder, exist_ok=True)
     return os.path.join(folder, f"{name}.swp")
+
+
+def make_aio_handle(aio_config):
+    """One AsyncIOHandle from the ``aio`` config dict (shared defaults —
+    reference ``aio`` config keys, ``runtime/constants.py AIO_DEFAULT_DICT``)."""
+    from ...ops.aio import AsyncIOHandle
+    aio = dict(aio_config or {})
+    return AsyncIOHandle(
+        block_size=aio.get("block_size", 1048576),
+        queue_depth=aio.get("queue_depth", 8),
+        single_submit=aio.get("single_submit", False),
+        overlap_events=aio.get("overlap_events", True),
+        thread_count=aio.get("thread_count", 1))

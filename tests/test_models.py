@@ -1,0 +1,128 @@
+"""Model-family tests: BERT encoder + GPT-2 MoE.
+
+Parity model: reference vendored-model numerics tests
+(``tests/unit/modeling.py`` BERT, ``tests/unit/test_moe.py``) — tiny
+presets trained a few steps, loss decreases, TP/EP specs resolve.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build
+from deepspeed_tpu.models.bert import Bert
+from deepspeed_tpu.models.gpt2_moe import GPT2MoE
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+from simple_model import base_config
+
+
+def test_build_factory_knows_all_families():
+    assert build("bert-tiny", dtype=jnp.float32).config.n_layer == 4
+    assert build("gpt2-tiny").config.n_layer == 4
+    assert build("gpt2-moe-tiny").config.num_experts == 4
+    with pytest.raises(ValueError):
+        build("nope-7b")
+
+
+def _mlm_batch(rng, B=8, T=32, V=1024):
+    ids = rng.randint(0, V, size=(B, T)).astype(np.int32)
+    labels = np.full((B, T), -100, np.int32)
+    mask_pos = rng.rand(B, T) < 0.15
+    labels[mask_pos] = ids[mask_pos]
+    attn = np.ones((B, T), np.int32)
+    attn[:, T - 4:] = 0  # padding tail
+    return {"input_ids": ids, "labels": labels, "attention_mask": attn}
+
+
+def test_bert_forward_shapes_and_mask():
+    model = Bert(preset="bert-tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = _mlm_batch(rng)
+    hidden = model.apply(params, batch["input_ids"],
+                         attention_mask=batch["attention_mask"])
+    assert hidden.shape == (8, 32, 128)
+    logits = model.mlm_logits(params, hidden)
+    assert logits.shape == (8, 32, 1024)
+    # masked positions cannot attend: changing a padded token's id must not
+    # change unpadded outputs
+    ids2 = batch["input_ids"].copy()
+    ids2[:, -1] = (ids2[:, -1] + 1) % 1024
+    h2 = model.apply(params, ids2, attention_mask=batch["attention_mask"])
+    np.testing.assert_allclose(np.asarray(hidden[:, :28]),
+                               np.asarray(h2[:, :28]), atol=1e-5)
+
+
+def test_bert_mlm_training_loss_decreases(devices):
+    model = Bert(preset="bert-tiny", dtype=jnp.float32)
+    rng = np.random.RandomState(1)
+    batches = [_mlm_batch(rng) for _ in range(12)]
+    engine, _, _, _ = ds.initialize(
+        config=base_config(micro=1, over={
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}),
+        model=model, mesh=make_mesh({"data": 8}))
+    losses = [float(engine.train_batch(iter([b]))) for b in batches]
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_bert_ignore_index_loss():
+    model = Bert(preset="bert-tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(2)
+    b = _mlm_batch(rng)
+    # all labels ignored → loss well-defined (0 via safe denom)
+    b_ignored = dict(b, labels=np.full_like(b["labels"], -100))
+    loss = float(model.loss(params, b_ignored, jax.random.PRNGKey(0)))
+    assert np.isfinite(loss)
+
+
+def test_bert_num_params_matches_tree():
+    model = Bert(preset="bert-tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    actual = sum(int(np.prod(np.shape(l) or (1,)))
+                 for l in jax.tree_util.tree_leaves(params))
+    assert model.num_params() == actual
+
+
+def test_bert_tp_specs_cover_params():
+    model = Bert(preset="bert-tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    specs = model.partition_specs(params)
+    # same tree structure
+    jax.tree_util.tree_map(lambda p, s: None, params, specs,
+                           is_leaf=lambda x: isinstance(
+                               x, jax.sharding.PartitionSpec))
+
+
+def test_gpt2_moe_alternating_layers():
+    model = GPT2MoE(preset="gpt2-moe-tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    kinds = ["moe" if "moe" in l else "ffn" for l in params["layers"]]
+    assert kinds == ["ffn", "moe", "ffn", "moe"]
+
+
+def test_gpt2_moe_trains_and_uses_aux_loss(devices):
+    model = GPT2MoE(preset="gpt2-moe-tiny", dtype=jnp.float32,
+                    embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+    rng = np.random.RandomState(3)
+    fixed = rng.randint(0, 1024, size=(8, 33)).astype(np.int32)
+    engine, _, _, _ = ds.initialize(
+        config=base_config(micro=2, over={
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}),
+        model=model, mesh=make_mesh({"data": 2, "expert": 4}))
+    # memorize one fixed batch — loss must drop monotonically-ish
+    losses = [float(engine.train_batch(iter([fixed]))) for _ in range(12)]
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_gpt2_moe_aux_loss_contributes():
+    m0 = GPT2MoE(preset="gpt2-moe-tiny", dtype=jnp.float32, aux_loss_coef=0.0)
+    m1 = GPT2MoE(preset="gpt2-moe-tiny", dtype=jnp.float32, aux_loss_coef=1.0)
+    params = m0.init(jax.random.PRNGKey(0))
+    toks = np.random.RandomState(4).randint(0, 1024, size=(2, 17)).astype(np.int32)
+    l0 = float(m0.loss(params, toks, jax.random.PRNGKey(1)))
+    l1 = float(m1.loss(params, toks, jax.random.PRNGKey(1)))
+    assert l1 > l0  # aux loss is strictly positive with random gating
