@@ -54,19 +54,7 @@ PRESETS = {
 }
 
 
-def _layer_norm(x, scale, bias, eps):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
-    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
-
-
-def _dropout(x, rate, rng, deterministic):
-    if deterministic or rate == 0.0:
-        return x
-    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
-    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+from .gpt2 import _layer_norm, _dropout
 
 
 class Bert:

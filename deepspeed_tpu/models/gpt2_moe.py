@@ -21,8 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .gpt2 import GPT2Config, PRESETS as GPT2_PRESETS, _layer_norm, _dropout, \
-    _attention_jnp
+from .gpt2 import GPT2, GPT2Config, PRESETS as GPT2_PRESETS, _layer_norm, \
+    _dropout, _attention_jnp
 
 
 @dataclasses.dataclass
@@ -91,6 +91,9 @@ class GPT2MoE:
         # last layer of every `moe_every` window hosts the experts
         return (i + 1) % self.config.moe_every == 0
 
+    # attention dispatch (flash/jnp by config) shared with the dense model
+    _attend = GPT2._attend
+
     # ------------------------------------------------------------------ init
     def init(self, rng):
         c = self.config
@@ -155,28 +158,34 @@ class GPT2MoE:
         causal = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
         D, H, hd = c.n_embd, c.n_head, c.head_dim
 
-        aux_total = jnp.float32(0.0)
-        for i, p in enumerate(params["layers"]):
-            r = jax.random.fold_in(rng, 100 + i)
+        def block(p, x, r, is_moe):
             r1, r2, r3, r4 = jax.random.split(r, 4)
             h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
             qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
             q, k_, v = jnp.split(qkv, 3, axis=-1)
             f = lambda t: t.reshape(B, T, H, hd)
-            attn = _attention_jnp(f(q), f(k_), f(v), causal, c.attn_pdrop, r1,
-                                  deterministic)
+            attn = self._attend(f(q), f(k_), f(v), causal, r1, deterministic)
             attn = attn.reshape(B, T, D)
             attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
             x = x + _dropout(attn, c.resid_pdrop, r2, deterministic)
 
             h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
-            if "moe" in p:
+            if is_moe:
                 out, l_aux, _ = self._moe.apply(p["moe"], h, rng=r4,
                                                 train=not deterministic)
-                aux_total = aux_total + l_aux
             else:
                 out = self._expert.apply(p["ffn"], h)
-            x = x + _dropout(out, c.resid_pdrop, r3, deterministic)
+                l_aux = jnp.float32(0.0)
+            return x + _dropout(out, c.resid_pdrop, r3, deterministic), l_aux
+
+        if c.remat:
+            block = jax.checkpoint(block, static_argnums=(3,))
+
+        aux_total = jnp.float32(0.0)
+        for i, p in enumerate(params["layers"]):
+            r = jax.random.fold_in(rng, 100 + i)
+            x, l_aux = block(p, x, r, "moe" in p)
+            aux_total = aux_total + l_aux
 
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
                         c.layer_norm_eps)
@@ -199,6 +208,6 @@ class GPT2MoE:
         return -jnp.mean(ll) + self.config.aux_loss_coef * aux
 
     def num_params(self):
-        return sum(int(np.prod(np.shape(l) or (1,)))
-                   for l in jax.tree_util.tree_leaves(
-                       self.init(jax.random.PRNGKey(0))))
+        shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        return sum(int(np.prod(l.shape or (1,)))
+                   for l in jax.tree_util.tree_leaves(shapes))

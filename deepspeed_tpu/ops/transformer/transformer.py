@@ -140,7 +140,7 @@ class DeepSpeedTransformerLayer:
         q, k, v = shape(q), shape(k), shape(v)
 
         use_flash = (mask is None and cfg.attn_dropout_ratio == 0.0
-                     and _flash_ok(S, hd))
+                     and _flash_ok())
         if use_flash:
             from .flash_attention import flash_attention
             ctx = flash_attention(q, k, v, causal=False,
@@ -220,7 +220,7 @@ class DeepSpeedTransformerLayer:
         return self.apply(params, hidden_states, **kw)
 
 
-def _flash_ok(seq, head_dim):
+def _flash_ok():
     """Pallas flash path: TPU backend (the kernel pads ragged seq/head
     shapes internally; see flash_attention._fwd)."""
     try:

@@ -1,0 +1,10 @@
+"""deepspeed.zero namespace (reference ``deepspeed/runtime/zero/__init__.py``):
+``Init``, ``GatheredParameters``, ``TiledLinear``, stage configs and the
+sharding-placement rules that replace the reference's hook machinery."""
+
+from .config import DeepSpeedZeroConfig
+from .init_context import (Init, GatheredParameters,
+                           register_external_parameter,
+                           unregister_external_parameter)
+from .tiling import TiledLinear, TiledLinearReturnBias
+from . import partition

@@ -1,0 +1,156 @@
+"""zero.Init / GatheredParameters — sharded construction & gathered access.
+
+Parity: reference ``runtime/zero/partition_parameters.py`` —
+
+- ``Init`` (:555): a context that intercepts ``nn.Module.__init__`` so every
+  parameter is partitioned the moment it is created (a 100B model never
+  materializes replicated).  TPU re-design: parameter *creation* is a pure
+  ``init(rng)`` function, so interception becomes compilation — ``Init.
+  initialize(model, rng)`` jits the init function with fsdp ``out_shardings``;
+  XLA materializes every leaf directly as its shard on its device.  No hook
+  machinery, same memory guarantee.
+- ``GatheredParameters`` (:1529): gather the full values of (some) partitioned
+  params for reading or in-place modification, re-partitioning on exit.  Here
+  the gather is a host fetch (numpy copies, writable) and the re-partition is
+  a ``device_put`` back to the original shardings on exit.
+- ``register_external_parameter`` (:115): the reference needs this because its
+  hooks only see the owning module's own params; with whole-pytree sharding
+  there is nothing to register — kept as a no-op for API compatibility.
+"""
+
+import contextlib
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import partition as zpart
+from ...utils.logging import logger
+
+
+class Init:
+    """Construct model parameters directly sharded over the fsdp axis.
+
+    Usage (reference: ``with deepspeed.zero.Init(): model = MyModel()``)::
+
+        ctx = zero.Init(mesh=mesh)
+        params = ctx.initialize(model, jax.random.PRNGKey(0))
+
+    or as a context manager wrapping explicit init calls::
+
+        with zero.Init(mesh=mesh) as zinit:
+            params = zinit.initialize(model, rng)
+    """
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None, mesh=None,
+                 persistence_threshold=0):
+        from ...parallel import mesh as M
+        if mesh is None:
+            gm = M.get_global_mesh()
+            mesh = gm.mesh if gm is not None else M.make_mesh()
+        self.mesh = mesh
+        self.enabled = enabled
+        self.dtype = dtype
+        self.persistence_threshold = persistence_threshold
+        self.remote_device = remote_device  # "cpu"/"nvme" → host-resident init
+        self._mesh_ctx = M.MeshContext(self.mesh)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def specs_for(self, params_shape_tree, tp_specs=None):
+        """fsdp PartitionSpecs for an init shape tree (stage-3 placement)."""
+        return zpart.param_specs(
+            params_shape_tree, stage=3, fsdp_size=self._mesh_ctx.fsdp_size,
+            persistence_threshold=self.persistence_threshold, tp_specs=tp_specs)
+
+    def initialize(self, model_or_init_fn, rng):
+        """Run ``init`` under jit with sharded outputs: each param leaf
+        materializes as its shard — the full model never exists replicated
+        (the reference's whole ``InsertPostInitMethodToModuleSubClasses``
+        apparatus, done by the compiler)."""
+        init_fn = (model_or_init_fn.init if hasattr(model_or_init_fn, "init")
+                   else model_or_init_fn)
+        if not self.enabled:
+            return init_fn(rng)
+        tp_specs = getattr(model_or_init_fn, "partition_specs", None)
+        shapes = jax.eval_shape(init_fn, rng)
+        if callable(tp_specs):
+            # spec fns usually accept the (shape) pytree or nothing
+            try:
+                tp_specs = tp_specs(shapes)
+            except TypeError:
+                tp_specs = tp_specs()
+        specs = self.specs_for(shapes, tp_specs=tp_specs)
+        shardings = zpart.to_named(specs, self.mesh)
+        if self.remote_device in ("cpu", "nvme"):
+            # host-resident construction (ZeRO-Infinity remote_device): init
+            # on host, never touching device HBM
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                params = jax.jit(init_fn)(rng)
+            return jax.tree_util.tree_map(np.asarray, params)
+        with jax.set_mesh(self.mesh):
+            return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+class GatheredParameters:
+    """Gather shards to writable host arrays; re-shard on exit.
+
+    Reference semantics (``partition_parameters.py:1529``): inside the
+    context the full parameter values are visible; with ``modifier_rank``
+    set, in-place modifications are re-partitioned on exit.
+
+    Usage::
+
+        gp = zero.GatheredParameters(params, mesh=mesh)
+        with gp as full:           # full: pytree of writable numpy arrays
+            full["wte"][:] = 0.0
+        params = gp.result         # re-sharded device pytree
+    """
+
+    def __init__(self, params, modifier_rank=0, fwd_module=None, enabled=True,
+                 mesh=None):
+        self.params = params
+        self.enabled = enabled
+        self.modifier_rank = modifier_rank
+        self.mesh = mesh
+        self.result = params
+        self._shardings = jax.tree_util.tree_map(
+            lambda x: getattr(x, "sharding", None), params)
+
+    def __enter__(self):
+        if not self.enabled:
+            return self.params
+        self._host = jax.tree_util.tree_map(
+            lambda x: np.array(x), self.params)  # gathered + writable copies
+        return self._host
+
+    def __exit__(self, exc_type, exc, tb):
+        if not self.enabled or exc_type is not None:
+            return False
+        if self.modifier_rank is None:
+            # read-only context: nothing to write back
+            self.result = self.params
+            return False
+        def put(h, x, sh):
+            arr = np.asarray(h, dtype=np.asarray(x).dtype)
+            return jax.device_put(arr, sh) if sh is not None else arr
+        self.result = jax.tree_util.tree_map(
+            put, self._host, self.params, self._shardings)
+        return False
+
+
+def register_external_parameter(module, parameter):
+    """No-op (reference ``partition_parameters.py:115``): with whole-pytree
+    sharding every parameter is visible to the step function; there is no
+    per-module hook scope to escape."""
+    return parameter
+
+
+def unregister_external_parameter(module, parameter):
+    return parameter

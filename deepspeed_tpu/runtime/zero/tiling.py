@@ -1,0 +1,122 @@
+"""TiledLinear — split a huge linear into tiles to cap working memory.
+
+Parity: reference ``runtime/zero/tiling.py:27`` (``TiledLinear``): a Linear
+with ``in_splits × out_splits`` sub-linears so ZeRO-3 only gathers one tile
+at a time, bounding live memory for layers too big to materialize whole
+(e.g. embedding projections of very large vocabularies).
+
+TPU re-design: params are stored pre-tiled as a stacked (in_splits,
+out_splits, tile_in, tile_out) array and the forward is a ``lax.scan`` over
+tiles with ``jax.checkpoint`` — under fsdp sharding XLA gathers one tile per
+scan iteration (the same bounded-live-memory guarantee the reference gets
+from per-tile ds params), and remat keeps only tile boundaries for backward.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class TiledLinear:
+    """Layer-protocol tiled linear: ``(.., in_features) → (.., out_features)``."""
+
+    def __init__(self, in_features, out_features, bias=True, in_splits=1,
+                 out_splits=1, input_is_already_split=False, combine_out_splits=True,
+                 linear_cls=None, init_linear=None, **kw):
+        assert in_features % in_splits == 0, \
+            f"in_features {in_features} not divisible by in_splits {in_splits}"
+        assert out_features % out_splits == 0, \
+            f"out_features {out_features} not divisible by out_splits {out_splits}"
+        self.in_features = in_features
+        self.out_features = out_features
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.tile_in = in_features // in_splits
+        self.tile_out = out_features // out_splits
+        self.use_bias = bias
+        self.input_is_already_split = input_is_already_split
+        self.combine_out_splits = combine_out_splits
+        self.init_linear = init_linear  # optional full (in, out) weight to copy
+
+    def init(self, rng):
+        k1, _ = jax.random.split(rng)
+        std = 1.0 / np.sqrt(self.in_features)
+        if self.init_linear is not None:
+            w = np.asarray(self.init_linear, np.float32)
+            assert w.shape == (self.in_features, self.out_features)
+            w = (w.reshape(self.in_splits, self.tile_in,
+                           self.out_splits, self.tile_out)
+                  .transpose(0, 2, 1, 3))
+            w = jnp.asarray(w)
+        else:
+            w = jax.random.uniform(
+                k1, (self.in_splits, self.out_splits, self.tile_in, self.tile_out),
+                jnp.float32, -std, std)
+        params = {"w": w}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.out_splits, self.tile_out), jnp.float32)
+        return params
+
+    def partition_specs(self, params=None):
+        """fsdp shards the tile grid's input axis; tensor TP can take out."""
+        specs = {"w": P(None, None, "tensor", None)}
+        if self.use_bias:
+            specs["b"] = P()
+        return specs
+
+    def apply(self, params, x, rng=None):
+        """Scan over in-tiles (outer) and out-tiles (inner): live memory is
+        one (tile_in, tile_out) weight + one (.., tile_out) partial."""
+        lead = x.shape[:-1]
+        xs = x.reshape(*lead, self.in_splits, self.tile_in)
+        xs = jnp.moveaxis(xs, -2, 0)               # (in_splits, .., tile_in)
+
+        w = params["w"]                            # (is, os, ti, to)
+
+        @jax.checkpoint
+        def in_tile(carry, inputs):
+            w_row, x_tile = inputs                 # (os, ti, to), (.., ti)
+            # contribution of this in-tile to every out-tile
+            part = jnp.einsum("...i,oij->o...j", x_tile,
+                              w_row.astype(x_tile.dtype))
+            return carry + part, None
+
+        zeros = jnp.zeros((self.out_splits, *lead, self.tile_out), x.dtype)
+        acc, _ = jax.lax.scan(in_tile, zeros, (w, xs))
+
+        if self.use_bias:
+            b = params["b"].astype(x.dtype)        # (os, to)
+            acc = acc + b.reshape(self.out_splits,
+                                  *(1,) * len(lead), self.tile_out)
+        if not self.combine_out_splits:
+            return acc
+        out = jnp.moveaxis(acc, 0, -2)             # (.., os, to)
+        return out.reshape(*lead, self.out_features)
+
+    def __call__(self, params, x, **kw):
+        return self.apply(params, x, **kw)
+
+    def full_weight(self, params):
+        """Reassemble the (in, out) weight (testing/checkpoint export)."""
+        w = np.asarray(params["w"])
+        return (w.transpose(0, 2, 1, 3)
+                 .reshape(self.in_features, self.out_features))
+
+
+class TiledLinearReturnBias(TiledLinear):
+    """Variant returning (out, bias) unadded (reference
+    ``tiling.py TiledLinearReturnBias`` used by Megatron layers)."""
+
+    def apply(self, params, x, rng=None):
+        bias = params.get("b")
+        saved = self.use_bias
+        self.use_bias = False
+        try:
+            out = super().apply({"w": params["w"]}, x, rng=rng)
+        finally:
+            self.use_bias = saved
+        if bias is not None:
+            bias = bias.reshape(self.out_features) if self.combine_out_splits \
+                else bias
+        return out, bias

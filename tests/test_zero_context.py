@@ -1,0 +1,139 @@
+"""zero.Init / GatheredParameters / TiledLinear tests.
+
+Parity model: reference ``tests/unit/test_zero_context.py`` (Init
+semantics, GatheredParameters read/modify) and ``test_zero_tiled.py``
+(TiledLinear numerics vs a plain Linear).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+from simple_model import SimpleModel
+
+
+def test_zero_init_materializes_sharded(devices):
+    mesh = make_mesh({"fsdp": 8})
+    model = SimpleModel(dim=8, hidden=64)
+    params = ds.zero.Init(mesh=mesh).initialize(model, jax.random.PRNGKey(0))
+    w = params["layer_0"]["w"]  # (8, 64): hidden axis divisible by 8
+    assert w.sharding.spec == P(None, "fsdp")
+    # each device holds 1/8 of the hidden axis
+    shard_shapes = {s.data.shape for s in w.addressable_shards}
+    assert shard_shapes == {(8, 8)}
+    # values identical to the unsharded init
+    ref = model.init(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(w),
+                               np.asarray(ref["layer_0"]["w"]), rtol=1e-6)
+
+
+def test_zero_init_disabled_passthrough(devices):
+    model = SimpleModel(dim=8)
+    params = ds.zero.Init(mesh=make_mesh({"fsdp": 8}),
+                          enabled=False).initialize(model, jax.random.PRNGKey(0))
+    ref = model.init(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(params["layer_0"]["w"]),
+                               np.asarray(ref["layer_0"]["w"]))
+
+
+def test_zero_init_remote_device_cpu(devices):
+    model = SimpleModel(dim=8)
+    params = ds.zero.Init(mesh=make_mesh({"fsdp": 8}),
+                          remote_device="cpu").initialize(
+        model, jax.random.PRNGKey(0))
+    assert isinstance(jax.tree_util.tree_leaves(params)[0], np.ndarray)
+
+
+def test_gathered_parameters_modify(devices):
+    mesh = make_mesh({"fsdp": 8})
+    model = SimpleModel(dim=8, hidden=64)
+    params = ds.zero.Init(mesh=mesh).initialize(model, jax.random.PRNGKey(0))
+    gp = ds.zero.GatheredParameters(params, mesh=mesh)
+    with gp as full:
+        assert isinstance(full["layer_0"]["w"], np.ndarray)
+        full["layer_0"]["w"][:] = 3.0
+    new = gp.result
+    # sharding preserved, values updated
+    assert new["layer_0"]["w"].sharding.spec == P(None, "fsdp")
+    np.testing.assert_array_equal(np.asarray(new["layer_0"]["w"]), 3.0)
+
+
+def test_gathered_parameters_read_only(devices):
+    mesh = make_mesh({"fsdp": 8})
+    model = SimpleModel(dim=8, hidden=64)
+    params = ds.zero.Init(mesh=mesh).initialize(model, jax.random.PRNGKey(0))
+    gp = ds.zero.GatheredParameters(params, mesh=mesh, modifier_rank=None)
+    with gp as full:
+        full["layer_0"]["w"][:] = 7.0  # local copy only
+    assert gp.result is params
+
+
+@pytest.mark.parametrize("in_splits,out_splits", [(1, 1), (2, 4), (4, 2)])
+def test_tiled_linear_matches_dense(in_splits, out_splits):
+    lin = ds.zero.TiledLinear(16, 32, in_splits=in_splits,
+                              out_splits=out_splits)
+    params = lin.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 16), jnp.float32)
+    out = lin.apply(params, x)
+    full_w = lin.full_weight(params)
+    expect = np.asarray(x) @ full_w + np.asarray(
+        params["b"]).reshape(32)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_tiled_linear_from_existing_weight():
+    w = np.random.RandomState(1).randn(8, 12).astype(np.float32)
+    lin = ds.zero.TiledLinear(8, 12, in_splits=2, out_splits=3, bias=False,
+                              init_linear=w)
+    params = lin.init(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(lin.full_weight(params), w, rtol=1e-6)
+    x = jnp.asarray(np.random.RandomState(2).randn(5, 8), jnp.float32)
+    np.testing.assert_allclose(np.asarray(lin.apply(params, x)),
+                               np.asarray(x) @ w, rtol=1e-5, atol=1e-5)
+
+
+def test_tiled_linear_return_bias():
+    lin = ds.zero.TiledLinearReturnBias(8, 12, in_splits=2, out_splits=3)
+    params = lin.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(3).randn(5, 8), jnp.float32)
+    out, bias = lin.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out) + np.asarray(bias),
+        np.asarray(x) @ lin.full_weight(params) +
+        np.asarray(params["b"]).reshape(12), rtol=1e-5, atol=1e-5)
+
+
+def test_tiled_linear_grad_flows():
+    lin = ds.zero.TiledLinear(16, 16, in_splits=4, out_splits=4)
+    params = lin.init(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 16), jnp.float32)
+    g = jax.grad(lambda p: jnp.sum(lin.apply(p, x) ** 2))(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+        assert np.abs(np.asarray(leaf)).sum() > 0
+
+
+def test_register_external_parameter_noop():
+    p = jnp.ones((3,))
+    assert ds.zero.register_external_parameter(None, p) is p
+    assert ds.zero.unregister_external_parameter(None, p) is p
+
+
+def test_zero_init_in_engine_e2e(devices):
+    """Init-sharded params flow into the engine unchanged (stage 3)."""
+    from simple_model import base_config, random_dataset
+    mesh = make_mesh({"fsdp": 8})
+    model = SimpleModel(dim=8, hidden=64)
+    params = ds.zero.Init(mesh=mesh).initialize(model, jax.random.PRNGKey(0))
+    engine, _, _, _ = ds.initialize(
+        config=base_config(micro=4, over={"zero_optimization": {"stage": 3}}),
+        model=model, params=jax.tree_util.tree_map(np.asarray, params),
+        loss_fn=model.loss, training_data=random_dataset(n=64), mesh=mesh)
+    l0 = float(engine.train_batch())
+    l5 = [float(engine.train_batch()) for _ in range(5)][-1]
+    assert l5 < l0
