@@ -1,4 +1,4 @@
-"""Model zoo: GPT-2 family (flagship), BERT encoder, MoE GPT."""
+"""Model zoo: GPT-2 family (flagship), BERT encoder, MoE GPT, GPT-J/NeoX."""
 
 from .gpt2 import GPT2, GPT2Config, PRESETS as GPT2_PRESETS
 
@@ -14,6 +14,12 @@ def build(name, **overrides):
         if name.startswith("bert"):
             from .bert import Bert
             return Bert(preset=name, **overrides)
+        if name.startswith("gptj"):
+            from .gptj import GPTJ
+            return GPTJ(preset=name, **overrides)
+        if name.startswith("gptneox"):
+            from .gptj import GPTNeoX
+            return GPTNeoX(preset=name, **overrides)
     except ImportError as e:
         raise ValueError(f"Model family for {name!r} is not available: {e}") from e
     raise ValueError(f"Unknown model preset {name!r}; GPT-2 presets: "

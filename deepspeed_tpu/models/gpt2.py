@@ -46,6 +46,10 @@ class GPT2Config:
     remat: bool = True
     # attention implementation: "auto" picks pallas flash on TPU, jnp elsewhere
     attention_impl: str = "auto"
+    # GPT-Neo compatibility knobs (HFGPTNEOLayerPolicy): no score scaling and
+    # a local attention window on alternating (odd) layers
+    scale_attn: bool = True
+    local_attn_window: Optional[int] = None
 
     @property
     def head_dim(self):
@@ -78,11 +82,12 @@ def _dropout(x, rate, rng, deterministic):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _attention_jnp(q, k, v, causal_mask, attn_drop, rng, deterministic):
+def _attention_jnp(q, k, v, causal_mask, attn_drop, rng, deterministic,
+                   scale=None):
     """Reference jnp attention: fp32 softmax, bf16 matmuls (XLA fuses)."""
     head_dim = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-    scores = scores / np.sqrt(head_dim)
+    scores = scores * (1.0 / np.sqrt(head_dim) if scale is None else scale)
     scores = jnp.where(causal_mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)
     probs = _dropout(probs, attn_drop, rng, deterministic).astype(q.dtype)
@@ -157,7 +162,8 @@ class GPT2:
         }
 
     # --------------------------------------------------------------- forward
-    def _block(self, x, layer_params, rng, deterministic, causal_mask):
+    def _block(self, x, layer_params, rng, deterministic, causal_mask,
+               is_local=None):
         c = self.config
         B, T, D = x.shape
         H, hd = c.n_head, c.head_dim
@@ -170,7 +176,14 @@ class GPT2:
         q = q.reshape(B, T, H, hd)
         k = k.reshape(B, T, H, hd)
         v = v.reshape(B, T, H, hd)
-        attn = self._attend(q, k, v, causal_mask, r1, deterministic)
+        mask = causal_mask
+        if c.local_attn_window is not None and is_local is not None:
+            # GPT-Neo: odd layers attend within a sliding window
+            pos = jnp.arange(T)
+            local = (pos[None, :] > pos[:, None] - c.local_attn_window)
+            local_mask = causal_mask & local[None, None]
+            mask = jnp.where(is_local, local_mask, causal_mask)
+        attn = self._attend(q, k, v, mask, r1, deterministic)
         attn = attn.reshape(B, T, D)
         attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
         x = x + _dropout(attn, c.resid_pdrop, r2, deterministic)
@@ -186,20 +199,30 @@ class GPT2:
         c = self.config
         impl = c.attention_impl
         wants_dropout = c.attn_pdrop > 0.0 and not deterministic
+        # flash path covers the standard scaled-causal case only
+        nonstandard = not c.scale_attn or c.local_attn_window is not None
         if impl == "auto":
             from ..ops import flash_attention_available
             # the pallas kernel has no in-kernel dropout yet; fall back to the
             # jnp path when attention dropout is active
             impl = ("flash" if flash_attention_available() and not wants_dropout
-                    else "jnp")
+                    and not nonstandard else "jnp")
         if impl == "flash":
-            if wants_dropout:
+            if nonstandard:
                 from ..utils.logging import warning_once
-                warning_once("attention_impl='flash' has no in-kernel dropout; "
-                             "attn_pdrop is ignored on this path")
-            from ..ops.transformer.flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=True)
-        return _attention_jnp(q, k, v, causal_mask, c.attn_pdrop, rng, deterministic)
+                warning_once("attention_impl='flash' does not support "
+                             "scale_attn=False / local_attn_window; using the "
+                             "jnp path")
+            else:
+                if wants_dropout:
+                    from ..utils.logging import warning_once
+                    warning_once("attention_impl='flash' has no in-kernel "
+                                 "dropout; attn_pdrop is ignored on this path")
+                from ..ops.transformer.flash_attention import flash_attention
+                return flash_attention(q, k, v, causal=True)
+        return _attention_jnp(q, k, v, causal_mask, c.attn_pdrop, rng,
+                              deterministic,
+                              scale=None if c.scale_attn else 1.0)
 
     def apply(self, params, tokens, rng=None, deterministic=True):
         """tokens: (B, T) int32 → logits (B, T, V)."""
@@ -219,14 +242,19 @@ class GPT2:
         if c.remat:
             block = jax.checkpoint(block, static_argnums=(3,))
 
+        # GPT-Neo layer pattern: odd layers are local-window
+        local_flags = jnp.arange(c.n_layer) % 2 == 1
+
         def scan_body(carry, xs):
             h = carry
-            layer_params, layer_rng = xs
-            h = block(h, layer_params, layer_rng, deterministic, causal_mask)
+            layer_params, layer_rng, is_local = xs
+            h = block(h, layer_params, layer_rng, deterministic, causal_mask,
+                      is_local)
             return h, None
 
         layer_rngs = jax.random.split(jax.random.fold_in(rng, 31), c.n_layer)
-        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+        x, _ = jax.lax.scan(scan_body, x,
+                            (params["blocks"], layer_rngs, local_flags))
 
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], c.layer_norm_eps)
         # tied output head: bf16 operands, fp32 accumulation — full MXU rate
@@ -249,7 +277,8 @@ class GPT2:
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
-    def _block_with_cache(self, x, layer_params, cache_k, cache_v, index):
+    def _block_with_cache(self, x, layer_params, cache_k, cache_v, index,
+                          is_local=None):
         """One block over ``x: (B, T, D)`` attending to cache[:index] + x.
 
         Returns (y, new_cache_k, new_cache_v).  Static cache length; key
@@ -274,10 +303,15 @@ class GPT2:
             cache_v, v.astype(cache_v.dtype), (0, index, 0, 0))
 
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k).astype(jnp.float32)
-        scores = scores / np.sqrt(hd)
+        if c.scale_attn:
+            scores = scores / np.sqrt(hd)
         q_pos = index + jnp.arange(T)[:, None]          # (T, 1)
         k_pos = jnp.arange(S)[None, :]                  # (1, S)
         valid = k_pos <= q_pos                          # causal within cache
+        if c.local_attn_window is not None and is_local is not None:
+            # GPT-Neo local layers: same sliding window as apply()
+            local = valid & (k_pos > q_pos - c.local_attn_window)
+            valid = jnp.where(is_local, local, valid)
         scores = jnp.where(valid[None, None], scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v).reshape(B, T, D)
@@ -305,14 +339,18 @@ class GPT2:
         pos = index + jnp.arange(T)
         x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[pos]
 
+        local_flags = jnp.arange(c.n_layer) % 2 == 1
+
         def scan_body(carry, xs):
             h = carry
-            layer_params, ck, cv = xs
-            h, ck, cv = self._block_with_cache(h, layer_params, ck, cv, index)
+            layer_params, ck, cv, is_local = xs
+            h, ck, cv = self._block_with_cache(h, layer_params, ck, cv, index,
+                                               is_local)
             return h, (ck, cv)
 
         x, (new_k, new_v) = jax.lax.scan(
-            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+            scan_body, x, (params["blocks"], cache["k"], cache["v"],
+                           local_flags))
 
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], c.layer_norm_eps)
         logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),

@@ -1,0 +1,241 @@
+"""GPT-J / GPT-NeoX family — rotary-embedding decoder LMs.
+
+Role parity: the reference's inference injection policies ``HFGPTJLayerPolicy``
+and ``GPTNEOXLayerPolicy`` (``module_inject/replace_policy.py``) and the
+rotary kernel (``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu``).
+Both architectures share one implementation with config switches:
+
+- GPT-J: ONE LayerNorm per block, parallel attention+MLP residual,
+  interleaved (non-neox) rotary over ``rotary_dim`` features, untied lm_head
+  with bias, no qkv biases.
+- GPT-NeoX: TWO LayerNorms (input + post-attention), optional parallel
+  residual (``use_parallel_residual``), neox-style rotary over
+  ``rotary_pct`` of the head dim, qkv biases, untied embed_out.
+
+Same TPU shape as GPT-2 (``models/gpt2.py``): stacked block params +
+``lax.scan``, remat, fp32 LN/softmax, Megatron TP specs.
+"""
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .gpt2 import _layer_norm, _dropout, _attention_jnp
+from .rotary import rotary_freqs, apply_rotary_pos_emb
+
+
+@dataclasses.dataclass
+class GPTJConfig:
+    vocab_size: int = 50400
+    max_seq: int = 2048
+    n_embd: int = 4096
+    n_layer: int = 28
+    n_head: int = 16
+    rotary_dim: Optional[int] = 64     # None → rotary_pct of head_dim
+    rotary_pct: float = 1.0
+    rotary_base: float = 10000.0
+    neox_style: bool = False           # False: GPT-J interleaved pairs
+    parallel_residual: bool = True
+    dual_layernorm: bool = False       # True: NeoX input+post-attn LNs
+    qkv_bias: bool = False             # NeoX: True
+    gelu_approximate: bool = True      # GPT-J gelu_new; NeoX exact gelu
+    layer_norm_eps: float = 1e-5
+    embd_pdrop: float = 0.0
+    attn_pdrop: float = 0.0
+    resid_pdrop: float = 0.0
+    remat: bool = True
+    # NOTE: the attention core is always the jnp path here — rotary q/k feed
+    # a standard scaled-causal attention; a flash variant with pre-rotated
+    # inputs is possible but not yet wired (no attention_impl knob to avoid
+    # advertising a switch that does nothing)
+
+    @property
+    def head_dim(self):
+        assert self.n_embd % self.n_head == 0
+        return self.n_embd // self.n_head
+
+    @property
+    def effective_rotary_dim(self):
+        if self.rotary_dim is not None:
+            return self.rotary_dim
+        return int(self.head_dim * self.rotary_pct)
+
+
+PRESETS = {
+    "gptj-6b": dict(),
+    "gptj-tiny": dict(vocab_size=1024, max_seq=256, n_embd=128, n_layer=4,
+                      n_head=4, rotary_dim=16),
+    "gptneox-20b": dict(vocab_size=50432, n_embd=6144, n_layer=44, n_head=64,
+                        rotary_dim=None, rotary_pct=0.25, neox_style=True,
+                        dual_layernorm=True, qkv_bias=True, gelu_approximate=False),
+    "gptneox-tiny": dict(vocab_size=1024, max_seq=256, n_embd=128, n_layer=4,
+                         n_head=4, rotary_dim=None, rotary_pct=0.25,
+                         neox_style=True, dual_layernorm=True, qkv_bias=True,
+                         gelu_approximate=False),
+}
+
+
+class GPTJ:
+    """Rotary decoder LM (params: dict pytree with scanned block stacks)."""
+
+    def __init__(self, config: Optional[GPTJConfig] = None, preset: str = None,
+                 dtype=jnp.bfloat16, **overrides):
+        if config is None:
+            base = dict(PRESETS[preset or "gptj-6b"])
+            base.update(overrides)
+            config = GPTJConfig(**base)
+        self.config = config
+        self.dtype = dtype
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng):
+        c = self.config
+        D, L, V = c.n_embd, c.n_layer, c.vocab_size
+        k = jax.random.split(rng, 8)
+        std = 0.02
+        proj_std = std / np.sqrt(2.0 * L)
+        n = lambda key, shape, s=std: jax.random.normal(key, shape, jnp.float32) * s
+        blocks = {
+            "ln1_scale": jnp.ones((L, D), jnp.float32),
+            "ln1_bias": jnp.zeros((L, D), jnp.float32),
+            "qkv_w": n(k[0], (L, D, 3 * D)),
+            "proj_w": n(k[1], (L, D, D), proj_std),
+            "proj_b": jnp.zeros((L, D), jnp.float32),
+            "fc_w": n(k[2], (L, D, 4 * D)),
+            "fc_b": jnp.zeros((L, 4 * D), jnp.float32),
+            "fc_proj_w": n(k[3], (L, 4 * D, D), proj_std),
+            "fc_proj_b": jnp.zeros((L, D), jnp.float32),
+        }
+        if c.qkv_bias:
+            blocks["qkv_b"] = jnp.zeros((L, 3 * D), jnp.float32)
+        if c.dual_layernorm:
+            blocks["ln2_scale"] = jnp.ones((L, D), jnp.float32)
+            blocks["ln2_bias"] = jnp.zeros((L, D), jnp.float32)
+        return {
+            "wte": n(k[4], (V, D)),
+            "blocks": blocks,
+            "lnf_scale": jnp.ones((D,), jnp.float32),
+            "lnf_bias": jnp.zeros((D,), jnp.float32),
+            "lm_head_w": n(k[5], (D, V)),
+            "lm_head_b": jnp.zeros((V,), jnp.float32),
+        }
+
+    # ------------------------------------------------- tensor-parallel specs
+    def partition_specs(self, params=None):
+        c = self.config
+        blocks = {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "qkv_w": P(None, None, "tensor"),
+            "proj_w": P(None, "tensor", None), "proj_b": P(),
+            "fc_w": P(None, None, "tensor"),
+            "fc_b": P(None, "tensor"),
+            "fc_proj_w": P(None, "tensor", None), "fc_proj_b": P(),
+        }
+        if c.qkv_bias:
+            blocks["qkv_b"] = P(None, "tensor")
+        if c.dual_layernorm:
+            blocks["ln2_scale"] = P()
+            blocks["ln2_bias"] = P()
+        return {"wte": P("tensor", None), "blocks": blocks,
+                "lnf_scale": P(), "lnf_bias": P(),
+                "lm_head_w": P(None, "tensor"), "lm_head_b": P("tensor")}
+
+    # --------------------------------------------------------------- forward
+    def _block(self, x, p, rng, deterministic, causal_mask, cos, sin, positions):
+        c = self.config
+        B, T, D = x.shape
+        H, hd = c.n_head, c.head_dim
+        r1, r2, r3 = jax.random.split(rng, 3)
+
+        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
+        qkv = h @ p["qkv_w"].astype(h.dtype)
+        if c.qkv_bias:
+            qkv = qkv + p["qkv_b"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        f = lambda t: t.reshape(B, T, H, hd)
+        q, k, v = f(q), f(k), f(v)
+        q = apply_rotary_pos_emb(q, cos, sin, positions, c.neox_style)
+        k = apply_rotary_pos_emb(k, cos, sin, positions, c.neox_style)
+        attn = _attention_jnp(q, k, v, causal_mask, c.attn_pdrop, r1,
+                              deterministic)
+        attn = attn.reshape(B, T, D)
+        attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+        attn = _dropout(attn, c.resid_pdrop, r2, deterministic)
+
+        def mlp(m_in):
+            m = m_in @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
+            m = jax.nn.gelu(m, approximate=c.gelu_approximate)
+            m = m @ p["fc_proj_w"].astype(h.dtype) + p["fc_proj_b"].astype(h.dtype)
+            return _dropout(m, c.resid_pdrop, r3, deterministic)
+
+        if c.parallel_residual:
+            # GPT-J/NeoX parallel form: x + attn(ln1(x)) + mlp(ln?(x))
+            m_in = (_layer_norm(x, p["ln2_scale"], p["ln2_bias"],
+                                c.layer_norm_eps) if c.dual_layernorm else h)
+            return x + attn + mlp(m_in)
+        # sequential (NeoX use_parallel_residual=False)
+        x = x + attn
+        m_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps) \
+            if c.dual_layernorm else x
+        return x + mlp(m_in)
+
+    def apply(self, params, tokens, rng=None, deterministic=True):
+        c = self.config
+        B, T = tokens.shape
+        assert T <= c.max_seq, f"sequence length {T} exceeds max_seq {c.max_seq}"
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        dtype = self.dtype
+
+        x = params["wte"].astype(dtype)[tokens]
+        x = _dropout(x, c.embd_pdrop, jax.random.fold_in(rng, 17), deterministic)
+        causal_mask = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
+        cos, sin = rotary_freqs(c.effective_rotary_dim, c.max_seq, c.rotary_base)
+        positions = jnp.arange(T)
+
+        block = self._block
+        if c.remat:
+            block = jax.checkpoint(block, static_argnums=(3,))
+
+        def scan_body(h, xs):
+            layer_params, layer_rng = xs
+            return block(h, layer_params, layer_rng, deterministic,
+                         causal_mask, cos, sin, positions), None
+
+        layer_rngs = jax.random.split(jax.random.fold_in(rng, 31), c.n_layer)
+        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+
+        x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                        c.layer_norm_eps)
+        logits = jnp.einsum("btd,dv->btv", x, params["lm_head_w"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits + params["lm_head_b"]
+
+    # ------------------------------------------------------------------ loss
+    def loss(self, params, batch, rng):
+        from .gpt2 import GPT2
+        tokens, labels = GPT2._split_batch(batch)
+        logits = self.apply(params, tokens, rng=rng, deterministic=False)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    def num_params(self):
+        shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        return sum(int(np.prod(l.shape or (1,)))
+                   for l in jax.tree_util.tree_leaves(shapes))
+
+    def flops_per_token(self):
+        c = self.config
+        return 6 * self.num_params() + 12 * c.n_layer * c.n_embd * c.max_seq
+
+
+class GPTNeoX(GPTJ):
+    """GPT-NeoX preset wrapper (same implementation, NeoX switches)."""
+
+    def __init__(self, config=None, preset=None, dtype=jnp.bfloat16, **overrides):
+        super().__init__(config=config, preset=preset or "gptneox-20b",
+                         dtype=dtype, **overrides)

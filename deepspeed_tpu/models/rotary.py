@@ -1,0 +1,46 @@
+"""Rotary position embeddings.
+
+Parity: reference ``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu``
+(the rotary kernel used by the GPT-J/GPT-NeoX inference paths).  On TPU the
+rotation is two fused elementwise multiplies — XLA fuses them into the
+surrounding QKV computation, so no custom kernel is needed.
+
+Two layouts exist in the wild:
+
+- ``neox_style=True`` (GPT-NeoX, LLaMA): rotate_half — the feature dim is
+  split into two contiguous halves.
+- ``neox_style=False`` (GPT-J): interleaved even/odd pairs.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def rotary_freqs(rotary_dim, max_seq, base=10000.0, dtype=jnp.float32):
+    """(max_seq, rotary_dim/2) angle table."""
+    inv = 1.0 / (base ** (np.arange(0, rotary_dim, 2) / rotary_dim))
+    t = np.arange(max_seq)
+    ang = np.einsum("t,f->tf", t, inv)
+    return jnp.asarray(np.cos(ang), dtype), jnp.asarray(np.sin(ang), dtype)
+
+
+def apply_rotary_pos_emb(x, cos, sin, positions, neox_style=True):
+    """Rotate the first ``2*cos.shape[-1]`` features of ``x``.
+
+    x: (B, T, H, d); positions: (T,) or (B, T) absolute positions.
+    """
+    r2 = cos.shape[-1]          # rotary_dim / 2
+    rot, rest = x[..., :2 * r2], x[..., 2 * r2:]
+    c = cos[positions][..., None, :].astype(x.dtype)   # (.., T, 1, r2)
+    s = sin[positions][..., None, :].astype(x.dtype)
+    if c.ndim == 3:             # positions was (T,): add batch axis
+        c, s = c[None], s[None]
+    if neox_style:
+        x1, x2 = rot[..., :r2], rot[..., r2:]
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    else:
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        out = jnp.stack([o1, o2], axis=-1).reshape(rot.shape)
+    return jnp.concatenate([out, rest], axis=-1) if rest.shape[-1] else out

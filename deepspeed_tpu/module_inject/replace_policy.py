@@ -87,5 +87,366 @@ class HFGPT2LayerPolicy(DSPolicy):
         return model, params
 
 
+class HFBertLayerPolicy(DSPolicy):
+    """HF ``BertModel``/``BertForMaskedLM`` → :class:`~deepspeed_tpu.models.bert.Bert`.
+
+    Parity: reference ``HFBertLayerPolicy`` (``replace_policy.py:50``).
+    HF stores Linear weights (out, in) — transposed into this framework's
+    (in, out) orientation; q/k/v concatenate into the fused qkv."""
+
+    @staticmethod
+    def match(hf_model) -> bool:
+        return type(hf_model).__name__ in ("BertModel", "BertForMaskedLM",
+                                           "BertForPreTraining")
+
+    @staticmethod
+    def convert(hf_model, dtype=None):
+        import jax
+        import jax.numpy as jnp
+        from ..models.bert import Bert, BertConfig
+
+        bert = hf_model.bert if hasattr(hf_model, "bert") else hf_model
+        hc = hf_model.config
+        config = BertConfig(
+            vocab_size=hc.vocab_size, max_seq=hc.max_position_embeddings,
+            type_vocab_size=hc.type_vocab_size, hidden_size=hc.hidden_size,
+            intermediate_size=hc.intermediate_size,
+            n_layer=hc.num_hidden_layers, n_head=hc.num_attention_heads,
+            hidden_dropout=hc.hidden_dropout_prob,
+            attn_dropout=hc.attention_probs_dropout_prob,
+            layer_norm_eps=hc.layer_norm_eps)
+        model = Bert(config, dtype=dtype or jnp.bfloat16)
+
+        emb = bert.embeddings
+        layers = bert.encoder.layer
+        stack = lambda get: np.stack([get(l) for l in layers])
+        qkv_w = lambda l: np.concatenate(
+            [_t(l.attention.self.query.weight).T,
+             _t(l.attention.self.key.weight).T,
+             _t(l.attention.self.value.weight).T], axis=1)
+        qkv_b = lambda l: np.concatenate(
+            [_t(l.attention.self.query.bias), _t(l.attention.self.key.bias),
+             _t(l.attention.self.value.bias)])
+        params = {
+            "word_embeddings": _t(emb.word_embeddings.weight),
+            "position_embeddings": _t(emb.position_embeddings.weight),
+            "token_type_embeddings": _t(emb.token_type_embeddings.weight),
+            "emb_ln_scale": _t(emb.LayerNorm.weight),
+            "emb_ln_bias": _t(emb.LayerNorm.bias),
+            "blocks": {
+                "attn_qkvw": stack(qkv_w),
+                "attn_qkvb": stack(qkv_b),
+                "attn_ow": stack(lambda l: _t(l.attention.output.dense.weight).T),
+                "attn_ob": stack(lambda l: _t(l.attention.output.dense.bias)),
+                "attn_nw": stack(lambda l: _t(l.attention.output.LayerNorm.weight)),
+                "attn_nb": stack(lambda l: _t(l.attention.output.LayerNorm.bias)),
+                "inter_w": stack(lambda l: _t(l.intermediate.dense.weight).T),
+                "inter_b": stack(lambda l: _t(l.intermediate.dense.bias)),
+                "output_w": stack(lambda l: _t(l.output.dense.weight).T),
+                "output_b": stack(lambda l: _t(l.output.dense.bias)),
+                "norm_w": stack(lambda l: _t(l.output.LayerNorm.weight)),
+                "norm_b": stack(lambda l: _t(l.output.LayerNorm.bias)),
+            },
+        }
+        D = hc.hidden_size
+        if hasattr(hf_model, "cls"):   # MLM head present
+            pred = hf_model.cls.predictions
+            params.update({
+                "mlm_dense_w": _t(pred.transform.dense.weight).T,
+                "mlm_dense_b": _t(pred.transform.dense.bias),
+                "mlm_ln_scale": _t(pred.transform.LayerNorm.weight),
+                "mlm_ln_bias": _t(pred.transform.LayerNorm.bias),
+                "mlm_bias": _t(pred.bias),
+            })
+        else:
+            params.update({
+                "mlm_dense_w": np.eye(D, dtype=np.float32),
+                "mlm_dense_b": np.zeros((D,), np.float32),
+                "mlm_ln_scale": np.ones((D,), np.float32),
+                "mlm_ln_bias": np.zeros((D,), np.float32),
+                "mlm_bias": np.zeros((hc.vocab_size,), np.float32),
+            })
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        return model, params
+
+
+class HFGPTNEOLayerPolicy(DSPolicy):
+    """HF ``GPTNeoForCausalLM`` → GPT-2 family with Neo knobs
+    (no score scaling, local-window attention on odd layers).
+
+    Parity: reference ``HFGPTNEOLayerPolicy`` (``replace_policy.py:102``)."""
+
+    @staticmethod
+    def match(hf_model) -> bool:
+        return type(hf_model).__name__ in ("GPTNeoForCausalLM", "GPTNeoModel")
+
+    @staticmethod
+    def convert(hf_model, dtype=None):
+        import jax
+        import jax.numpy as jnp
+        from ..models.gpt2 import GPT2, GPT2Config
+
+        tr = hf_model.transformer if hasattr(hf_model, "transformer") else hf_model
+        hc = hf_model.config
+        # the framework's GPT-Neo support hardcodes the standard alternating
+        # global/local pattern (odd layers local); any other attention_types
+        # layout would convert silently wrong — reject it
+        pattern = list(hc.attention_layers)
+        expected = ["global" if i % 2 == 0 else "local"
+                    for i in range(hc.num_layers)]
+        window = hc.window_size
+        if pattern == ["global"] * hc.num_layers:
+            window = None                      # all-global → plain GPT-2 mask
+        elif pattern != expected:
+            raise NotImplementedError(
+                f"GPT-Neo attention_types pattern {pattern} is not the "
+                "alternating global/local layout this conversion supports")
+        config = GPT2Config(
+            vocab_size=hc.vocab_size, max_seq=hc.max_position_embeddings,
+            n_embd=hc.hidden_size, n_layer=hc.num_layers,
+            n_head=hc.num_heads, layer_norm_eps=hc.layer_norm_epsilon,
+            embd_pdrop=hc.embed_dropout, attn_pdrop=hc.attention_dropout,
+            resid_pdrop=hc.resid_dropout,
+            scale_attn=False, local_attn_window=window)
+        model = GPT2(config, dtype=dtype or jnp.bfloat16)
+
+        blocks = tr.h
+        D = hc.hidden_size
+        stack = lambda get: np.stack([get(b) for b in blocks])
+        # HF Neo: separate q/k/v Linears (out,in), no qkv biases
+        qkv_w = lambda b: np.concatenate(
+            [_t(b.attn.attention.q_proj.weight).T,
+             _t(b.attn.attention.k_proj.weight).T,
+             _t(b.attn.attention.v_proj.weight).T], axis=1)
+        params = {
+            "wte": _t(tr.wte.weight),
+            "wpe": _t(tr.wpe.weight),
+            "blocks": {
+                "ln1_scale": stack(lambda b: _t(b.ln_1.weight)),
+                "ln1_bias": stack(lambda b: _t(b.ln_1.bias)),
+                "qkv_w": stack(qkv_w),
+                "qkv_b": np.zeros((hc.num_layers, 3 * D), np.float32),
+                "proj_w": stack(lambda b: _t(b.attn.attention.out_proj.weight).T),
+                "proj_b": stack(lambda b: _t(b.attn.attention.out_proj.bias)),
+                "ln2_scale": stack(lambda b: _t(b.ln_2.weight)),
+                "ln2_bias": stack(lambda b: _t(b.ln_2.bias)),
+                "fc_w": stack(lambda b: _t(b.mlp.c_fc.weight).T),
+                "fc_b": stack(lambda b: _t(b.mlp.c_fc.bias)),
+                "fc_proj_w": stack(lambda b: _t(b.mlp.c_proj.weight).T),
+                "fc_proj_b": stack(lambda b: _t(b.mlp.c_proj.bias)),
+            },
+            "lnf_scale": _t(tr.ln_f.weight),
+            "lnf_bias": _t(tr.ln_f.bias),
+        }
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        return model, params
+
+
+class HFGPTJLayerPolicy(DSPolicy):
+    """HF ``GPTJForCausalLM`` → :class:`~deepspeed_tpu.models.gptj.GPTJ`.
+
+    Parity: reference ``HFGPTJLayerPolicy`` (``replace_policy.py:143``)."""
+
+    @staticmethod
+    def match(hf_model) -> bool:
+        return type(hf_model).__name__ in ("GPTJForCausalLM", "GPTJModel")
+
+    @staticmethod
+    def convert(hf_model, dtype=None):
+        import jax
+        import jax.numpy as jnp
+        from ..models.gptj import GPTJ, GPTJConfig
+
+        tr = hf_model.transformer if hasattr(hf_model, "transformer") else hf_model
+        hc = hf_model.config
+        config = GPTJConfig(
+            vocab_size=hc.vocab_size, max_seq=hc.n_positions,
+            n_embd=hc.n_embd, n_layer=hc.n_layer, n_head=hc.n_head,
+            rotary_dim=hc.rotary_dim, neox_style=False,
+            parallel_residual=True, dual_layernorm=False, qkv_bias=False,
+            layer_norm_eps=hc.layer_norm_epsilon)
+        model = GPTJ(config, dtype=dtype or jnp.bfloat16)
+
+        blocks = tr.h
+        L, D, V = hc.n_layer, hc.n_embd, hc.vocab_size
+        stack = lambda get: np.stack([get(b) for b in blocks])
+        qkv_w = lambda b: np.concatenate(
+            [_t(b.attn.q_proj.weight).T, _t(b.attn.k_proj.weight).T,
+             _t(b.attn.v_proj.weight).T], axis=1)
+        has_lm = hasattr(hf_model, "lm_head")
+        params = {
+            "wte": _t(tr.wte.weight),
+            "blocks": {
+                "ln1_scale": stack(lambda b: _t(b.ln_1.weight)),
+                "ln1_bias": stack(lambda b: _t(b.ln_1.bias)),
+                "qkv_w": stack(qkv_w),
+                "proj_w": stack(lambda b: _t(b.attn.out_proj.weight).T),
+                "proj_b": np.zeros((L, D), np.float32),  # GPT-J out_proj: no bias
+                "fc_w": stack(lambda b: _t(b.mlp.fc_in.weight).T),
+                "fc_b": stack(lambda b: _t(b.mlp.fc_in.bias)),
+                "fc_proj_w": stack(lambda b: _t(b.mlp.fc_out.weight).T),
+                "fc_proj_b": stack(lambda b: _t(b.mlp.fc_out.bias)),
+            },
+            "lnf_scale": _t(tr.ln_f.weight),
+            "lnf_bias": _t(tr.ln_f.bias),
+            "lm_head_w": (_t(hf_model.lm_head.weight).T if has_lm
+                          else _t(tr.wte.weight).T),
+            "lm_head_b": (_t(hf_model.lm_head.bias) if has_lm
+                          and hf_model.lm_head.bias is not None
+                          else np.zeros((V,), np.float32)),
+        }
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        return model, params
+
+
+class GPTNEOXLayerPolicy(DSPolicy):
+    """HF ``GPTNeoXForCausalLM`` → :class:`~deepspeed_tpu.models.gptj.GPTNeoX`.
+
+    Parity: reference ``GPTNEOXLayerPolicy`` (``replace_policy.py:186``).
+    HF NeoX fuses qkv HEAD-INTERLEAVED — (H, 3, hd, D) — reordered here into
+    the concatenated [Q|K|V] layout this framework uses."""
+
+    @staticmethod
+    def match(hf_model) -> bool:
+        return type(hf_model).__name__ in ("GPTNeoXForCausalLM", "GPTNeoXModel")
+
+    @staticmethod
+    def convert(hf_model, dtype=None):
+        import jax
+        import jax.numpy as jnp
+        from ..models.gptj import GPTNeoX, GPTJConfig
+
+        tr = hf_model.gpt_neox if hasattr(hf_model, "gpt_neox") else hf_model
+        hc = hf_model.config
+        config = GPTJConfig(
+            vocab_size=hc.vocab_size, max_seq=hc.max_position_embeddings,
+            n_embd=hc.hidden_size, n_layer=hc.num_hidden_layers,
+            n_head=hc.num_attention_heads, rotary_dim=None,
+            rotary_pct=hc.rotary_pct, rotary_base=hc.rotary_emb_base,
+            neox_style=True,
+            parallel_residual=getattr(hc, "use_parallel_residual", True),
+            dual_layernorm=True, qkv_bias=True,
+            gelu_approximate=hc.hidden_act in ("gelu_new", "gelu_fast",
+                                               "gelu_pytorch_tanh"),
+            layer_norm_eps=hc.layer_norm_eps)
+        model = GPTNeoX(config, dtype=dtype or jnp.bfloat16)
+
+        H = hc.num_attention_heads
+        D = hc.hidden_size
+        hd = D // H
+
+        def qkv_w(layer):
+            w = _t(layer.attention.query_key_value.weight)     # (3D, D)
+            w = w.reshape(H, 3, hd, D).transpose(1, 0, 2, 3)    # (3, H, hd, D)
+            return w.reshape(3 * D, D).T                        # (D, 3D)
+
+        def qkv_b(layer):
+            b = _t(layer.attention.query_key_value.bias)
+            return b.reshape(H, 3, hd).transpose(1, 0, 2).reshape(3 * D)
+
+        layers = tr.layers
+        stack = lambda get: np.stack([get(l) for l in layers])
+        has_head = hasattr(hf_model, "embed_out")
+        params = {
+            "wte": _t(tr.embed_in.weight),
+            "blocks": {
+                "ln1_scale": stack(lambda l: _t(l.input_layernorm.weight)),
+                "ln1_bias": stack(lambda l: _t(l.input_layernorm.bias)),
+                "ln2_scale": stack(lambda l: _t(l.post_attention_layernorm.weight)),
+                "ln2_bias": stack(lambda l: _t(l.post_attention_layernorm.bias)),
+                "qkv_w": stack(qkv_w),
+                "qkv_b": stack(qkv_b),
+                "proj_w": stack(lambda l: _t(l.attention.dense.weight).T),
+                "proj_b": stack(lambda l: _t(l.attention.dense.bias)),
+                "fc_w": stack(lambda l: _t(l.mlp.dense_h_to_4h.weight).T),
+                "fc_b": stack(lambda l: _t(l.mlp.dense_h_to_4h.bias)),
+                "fc_proj_w": stack(lambda l: _t(l.mlp.dense_4h_to_h.weight).T),
+                "fc_proj_b": stack(lambda l: _t(l.mlp.dense_4h_to_h.bias)),
+            },
+            "lnf_scale": _t(tr.final_layer_norm.weight),
+            "lnf_bias": _t(tr.final_layer_norm.bias),
+            "lm_head_w": (_t(hf_model.embed_out.weight).T if has_head
+                          else _t(tr.embed_in.weight).T),
+            "lm_head_b": np.zeros((hc.vocab_size,), np.float32),
+        }
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        return model, params
+
+
+class MegatronLayerPolicy(DSPolicy):
+    """Megatron-LM GPT-2 state dict → GPT-2 family.
+
+    Parity: reference ``MegatronLayerPolicy`` (``replace_policy.py:158``).
+    Consumes the state dict produced by ``SDLoaderFactory``/
+    ``MegatronSDLoader`` (already TP-merged; see
+    ``runtime/state_dict_factory.py``).  Megatron fuses qkv head-interleaved
+    like NeoX; ``version`` 0 keeps the [Q|K|V] layout."""
+
+    version = 0
+
+    @staticmethod
+    def match(hf_model) -> bool:
+        # matched explicitly via policy=, not by module class
+        return False
+
+    @classmethod
+    def convert_state_dict(cls, sd, *, n_embd, n_layer, n_head, vocab_size,
+                           max_seq, dtype=None, version=None):
+        import jax
+        import jax.numpy as jnp
+        from ..models.gpt2 import GPT2, GPT2Config
+
+        version = cls.version if version is None else version
+        config = GPT2Config(vocab_size=vocab_size, max_seq=max_seq,
+                            n_embd=n_embd, n_layer=n_layer, n_head=n_head)
+        model = GPT2(config, dtype=dtype or jnp.bfloat16)
+        D, H = n_embd, n_head
+        hd = D // H
+
+        def g(key):
+            v = sd[key]
+            # torch tensors (possibly CUDA/bf16) or plain arrays
+            return _t(v) if hasattr(v, "detach") else np.asarray(v, np.float32)
+
+        def de_interleave_w(w):
+            if version == 0:
+                return w.T
+            return w.reshape(H, 3, hd, D).transpose(1, 0, 2, 3).reshape(3 * D, D).T
+
+        def de_interleave_b(b):
+            if version == 0:
+                return b
+            return b.reshape(H, 3, hd).transpose(1, 0, 2).reshape(3 * D)
+
+        pre = "transformer.layers."
+        stack = lambda fmt, fn=lambda x: x: np.stack(
+            [fn(g(pre + f"{i}." + fmt)) for i in range(n_layer)])
+        params = {
+            "wte": g("word_embeddings.weight")[:vocab_size],
+            "wpe": g("position_embeddings.weight"),
+            "blocks": {
+                "ln1_scale": stack("input_layernorm.weight"),
+                "ln1_bias": stack("input_layernorm.bias"),
+                "qkv_w": stack("attention.query_key_value.weight",
+                               de_interleave_w),
+                "qkv_b": stack("attention.query_key_value.bias",
+                               de_interleave_b),
+                "proj_w": stack("attention.dense.weight", lambda w: w.T),
+                "proj_b": stack("attention.dense.bias"),
+                "ln2_scale": stack("post_attention_layernorm.weight"),
+                "ln2_bias": stack("post_attention_layernorm.bias"),
+                "fc_w": stack("mlp.dense_h_to_4h.weight", lambda w: w.T),
+                "fc_b": stack("mlp.dense_h_to_4h.bias"),
+                "fc_proj_w": stack("mlp.dense_4h_to_h.weight", lambda w: w.T),
+                "fc_proj_b": stack("mlp.dense_4h_to_h.bias"),
+            },
+            "lnf_scale": g("transformer.final_layernorm.weight"),
+            "lnf_bias": g("transformer.final_layernorm.bias"),
+        }
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        return model, params
+
+
 # ordered registry (parity: reference ``replace_policies`` list)
-replace_policies = [HFGPT2LayerPolicy]
+replace_policies = [HFBertLayerPolicy, HFGPT2LayerPolicy, HFGPTNEOLayerPolicy,
+                    HFGPTJLayerPolicy, GPTNEOXLayerPolicy]

@@ -97,6 +97,53 @@ def test_bert_tp_specs_cover_params():
                                x, jax.sharding.PartitionSpec))
 
 
+def test_build_rotary_families():
+    gj = build("gptj-tiny", dtype=jnp.float32)
+    nx = build("gptneox-tiny", dtype=jnp.float32)
+    assert gj.config.neox_style is False and nx.config.neox_style is True
+    assert nx.config.dual_layernorm and nx.config.qkv_bias
+
+
+def test_rotary_embedding_properties():
+    from deepspeed_tpu.models.rotary import rotary_freqs, apply_rotary_pos_emb
+    cos, sin = rotary_freqs(16, 64)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 4, 32), jnp.float32)
+    for style in (True, False):
+        out = apply_rotary_pos_emb(x, cos, sin, jnp.arange(8), style)
+        assert out.shape == x.shape
+        # rotation preserves the norm of the rotated feature block
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(out[..., :16]), axis=-1),
+            np.linalg.norm(np.asarray(x[..., :16]), axis=-1), rtol=1e-5)
+        # features beyond rotary_dim pass through untouched
+        np.testing.assert_array_equal(np.asarray(out[..., 16:]),
+                                      np.asarray(x[..., 16:]))
+        # position 0 is the identity rotation
+        np.testing.assert_allclose(np.asarray(out[:, 0]),
+                                   np.asarray(x[:, 0]), rtol=1e-6)
+
+
+def test_gptj_trains(devices):
+    model = build("gptj-tiny", dtype=jnp.float32)
+    rng = np.random.RandomState(5)
+    fixed = rng.randint(0, 1024, size=(8, 33)).astype(np.int32)
+    engine, _, _, _ = ds.initialize(
+        config=base_config(micro=1, over={
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}),
+        model=model, mesh=make_mesh({"data": 8}))
+    losses = [float(engine.train_batch(iter([fixed]))) for _ in range(10)]
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_gptneox_tp_specs_cover_params():
+    model = build("gptneox-tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    specs = model.partition_specs(params)
+    jax.tree_util.tree_map(lambda p, s: None, params, specs,
+                           is_leaf=lambda x: isinstance(
+                               x, jax.sharding.PartitionSpec))
+
+
 def test_gpt2_moe_alternating_layers():
     model = GPT2MoE(preset="gpt2-moe-tiny", dtype=jnp.float32)
     params = model.init(jax.random.PRNGKey(0))
