@@ -69,13 +69,50 @@ class InferenceEngine:
             else:
                 assert hasattr(model, "init"), "need params=, checkpoint=, or model.init"
                 params = model.init(jax.random.PRNGKey(rng_seed))
-        if self.dtype is not None:
+        # ---- int8 weight quantization (reference: quantization_setting +
+        # int8 inference gemms; here dequant fuses into the jitted matmuls) --
+        from ..module_inject.module_quantize import _is_quantized_leaf
+        # params may arrive pre-quantized (QuantizedModel + int8 tree)
+        self.quantized = any(
+            _is_quantized_leaf(x) for x in jax.tree_util.tree_leaves(
+                params, is_leaf=_is_quantized_leaf)
+            if isinstance(x, dict))
+        if self.dtype is not None and not self.quantized:
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(self.dtype) if hasattr(p, "astype") else p, params)
+        wants_q = (quantization_setting is not None or dtype == jnp.int8) \
+            and not self.quantized
+        act_dtype = jnp.bfloat16 if dtype in (None, jnp.int8) else dtype
+        if wants_q:
+            from ..module_inject.module_quantize import (quantize_param_tree,
+                                                         QuantizedModel)
+            if isinstance(quantization_setting, (tuple, list)):
+                # reference API shape: (mlp_extra_grouping, quantize_groups)
+                _mlp_extra, groups = quantization_setting
+            elif isinstance(quantization_setting, int):
+                groups = quantization_setting
+            elif quantization_setting is None:
+                groups = 1
+            else:
+                raise ValueError("quantization_setting must be int, "
+                                 "(mlp_extra_grouping, groups), or None; got "
+                                 f"{quantization_setting!r}")
+            params, _ = quantize_param_tree(params, bits=8, groups=max(1, groups))
+            self.quantized = True
+        if self.quantized:
+            from ..module_inject.module_quantize import QuantizedModel
+            if not isinstance(model, QuantizedModel):
+                # activations run in act_dtype; params keep int8 storage
+                if hasattr(model, "dtype"):
+                    model.dtype = act_dtype
+                self.module = model = QuantizedModel(model, act_dtype)
+            self.dtype = None      # params already hold their storage dtypes
 
-        tp_specs = getattr(model, "partition_specs", None)
-        if callable(tp_specs):
-            tp_specs = tp_specs(params)
+        tp_specs = None
+        if not self.quantized:     # quantized dict leaves replicate (no TP slicing)
+            tp_specs = getattr(model, "partition_specs", None)
+            if callable(tp_specs):
+                tp_specs = tp_specs(params)
         if tp_specs is not None:
             sh = jax.tree_util.tree_map(
                 lambda sp: NamedSharding(self.mesh, sp), tp_specs,

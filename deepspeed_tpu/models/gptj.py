@@ -214,6 +214,90 @@ class GPTJ:
                             preferred_element_type=jnp.float32)
         return logits + params["lm_head_b"]
 
+    # ------------------------------------------------------- KV-cache decode
+    def init_cache(self, batch_size: int, max_len: Optional[int] = None,
+                   dtype=None):
+        """Empty KV cache pytree (same layout as GPT2.init_cache; role
+        parity: reference inference ``layer_past`` KV tensors)."""
+        c = self.config
+        max_len = max_len or c.max_seq
+        dtype = dtype or self.dtype
+        shape = (c.n_layer, batch_size, max_len, c.n_head, c.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "index": jnp.zeros((), jnp.int32)}
+
+    def _block_with_cache(self, x, p, cache_k, cache_v, index, cos, sin):
+        c = self.config
+        B, T, D = x.shape
+        H, hd = c.n_head, c.head_dim
+        S = cache_k.shape[1]
+
+        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
+        qkv = h @ p["qkv_w"].astype(h.dtype)
+        if c.qkv_bias:
+            qkv = qkv + p["qkv_b"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        f = lambda t: t.reshape(B, T, H, hd)
+        q, k, v = f(q), f(k), f(v)
+        positions = index + jnp.arange(T)
+        q = apply_rotary_pos_emb(q, cos, sin, positions, c.neox_style)
+        k = apply_rotary_pos_emb(k, cos, sin, positions, c.neox_style)
+
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, k.astype(cache_k.dtype), (0, index, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, v.astype(cache_v.dtype), (0, index, 0, 0))
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k).astype(jnp.float32)
+        scores = scores / np.sqrt(hd)
+        q_pos = index + jnp.arange(T)[:, None]
+        k_pos = jnp.arange(S)[None, :]
+        valid = k_pos <= q_pos
+        scores = jnp.where(valid[None, None], scores,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v).reshape(B, T, D)
+        attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+
+        def mlp(m_in):
+            m = m_in @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
+            m = jax.nn.gelu(m, approximate=c.gelu_approximate)
+            return m @ p["fc_proj_w"].astype(h.dtype) \
+                + p["fc_proj_b"].astype(h.dtype)
+
+        if c.parallel_residual:
+            m_in = (_layer_norm(x, p["ln2_scale"], p["ln2_bias"],
+                                c.layer_norm_eps) if c.dual_layernorm else h)
+            return x + attn + mlp(m_in), cache_k, cache_v
+        x = x + attn
+        m_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps) \
+            if c.dual_layernorm else x
+        return x + mlp(m_in), cache_k, cache_v
+
+    def apply_with_cache(self, params, tokens, cache):
+        """Forward ``tokens: (B, T)`` starting at ``cache['index']``; returns
+        ``(logits, new_cache)`` (prefill and per-token decode)."""
+        c = self.config
+        index = cache["index"]
+        x = params["wte"].astype(self.dtype)[tokens]
+        cos, sin = rotary_freqs(c.effective_rotary_dim, c.max_seq, c.rotary_base)
+
+        def scan_body(carry, xs):
+            h = carry
+            layer_params, ck, cv = xs
+            h, ck, cv = self._block_with_cache(h, layer_params, ck, cv, index,
+                                               cos, sin)
+            return h, (ck, cv)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+        x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                        c.layer_norm_eps)
+        logits = jnp.einsum("btd,dv->btv", x, params["lm_head_w"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits + params["lm_head_b"], \
+            {"k": new_k, "v": new_v, "index": index + tokens.shape[1]}
+
     # ------------------------------------------------------------------ loss
     def loss(self, params, batch, rng):
         from .gpt2 import GPT2

@@ -1,0 +1,126 @@
+"""Contiguous memory allocator with defragmentation.
+
+Parity: reference ``runtime/zero/contiguous_memory_allocator.py`` (283 LoC):
+a flat pre-allocated buffer handing out tensor-sized sub-views, with
+``release`` + assignment tracking and a compaction pass (``defragment``)
+that migrates live tensors to the front so large requests never fail from
+fragmentation.
+
+TPU placement note: device HBM is managed by XLA (arena allocation inside
+compiled programs — the reference's device-side fragmentation problem does
+not exist under jit).  This allocator manages HOST arenas: the offload
+tier's pinned staging buffers and NVMe swap pools, which have exactly the
+reference's lifetime/fragmentation pattern (many differently-sized
+sub-buffers with interleaved release).
+"""
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class ContiguousMemoryAllocator:
+    def __init__(self, size, dtype=np.float32, name="host_arena"):
+        self.buffer = np.zeros(size, dtype)
+        self.size = size
+        self.name = name
+
+        # address → numel of free/allocated blocks (reference keeps the same
+        # two maps plus tensor-id indirection so defrag can move live views)
+        self.contiguous_sizes = {0: size}          # free blocks
+        self.tensor_addresses = {}                  # tensor_id → address
+        self.tensor_sizes = {}                      # tensor_id → numel
+        self.tensor_map = {}                        # tensor_id → ndarray view
+        self.total_free = size
+        self._next_id = 0
+
+    # ---------------------------------------------------------------- alloc
+    def allocate_tensor(self, numel):
+        """A view of ``numel`` elements; defragments when no single free
+        block fits but the total free space does (reference behavior)."""
+        assert numel <= self.total_free, \
+            f"{self.name}: requested {numel} > free {self.total_free}"
+        if self._largest_free() < numel:
+            logger.info(f"{self.name}: defragmenting "
+                        f"(free={self.total_free}, need={numel})")
+            self.defragment()
+        addr = self._find_block(numel)
+        assert addr is not None
+        self._carve(addr, numel)
+        tid = self._next_id
+        self._next_id += 1
+        view = self.buffer[addr:addr + numel]
+        self.tensor_addresses[tid] = addr
+        self.tensor_sizes[tid] = numel
+        self.tensor_map[tid] = view
+        self.total_free -= numel
+        return tid, view
+
+    def release_tensor(self, tid):
+        addr = self.tensor_addresses.pop(tid)
+        numel = self.tensor_sizes.pop(tid)
+        self.tensor_map.pop(tid)
+        self.total_free += numel
+        self._free(addr, numel)
+
+    def get_tensor(self, tid):
+        return self.tensor_map[tid]
+
+    # ------------------------------------------------------------- defrag
+    def defragment(self):
+        """Compact live tensors to the front (copies preserve contents; the
+        returned views are refreshed in ``tensor_map``)."""
+        order = sorted(self.tensor_addresses.items(), key=lambda kv: kv[1])
+        cursor = 0
+        for tid, addr in order:
+            numel = self.tensor_sizes[tid]
+            if addr != cursor:
+                # memmove-safe: destination is always left of source
+                self.buffer[cursor:cursor + numel] = self.buffer[addr:addr + numel]
+                self.tensor_addresses[tid] = cursor
+                self.tensor_map[tid] = self.buffer[cursor:cursor + numel]
+            cursor += numel
+        self.contiguous_sizes = ({cursor: self.size - cursor}
+                                 if cursor < self.size else {})
+
+    # ------------------------------------------------------------- helpers
+    def _largest_free(self):
+        return max(self.contiguous_sizes.values(), default=0)
+
+    def _find_block(self, numel):
+        for addr in sorted(self.contiguous_sizes):
+            if self.contiguous_sizes[addr] >= numel:
+                return addr
+        return None
+
+    def _carve(self, addr, numel):
+        block = self.contiguous_sizes.pop(addr)
+        if block > numel:
+            self.contiguous_sizes[addr + numel] = block - numel
+
+    def _free(self, addr, numel):
+        self.contiguous_sizes[addr] = numel
+        # merge adjacent free blocks
+        merged = {}
+        for a in sorted(self.contiguous_sizes):
+            n = self.contiguous_sizes[a]
+            if merged:
+                last = max(merged)
+                if last + merged[last] == a:
+                    merged[last] += n
+                    continue
+            merged[a] = n
+        self.contiguous_sizes = merged
+
+    def print_allocation(self, resolution=200):
+        """ASCII map of the arena (reference debugging helper)."""
+        cell = max(1, self.size // resolution)
+        marks = ["."] * (self.size // cell + 1)
+        for tid, addr in self.tensor_addresses.items():
+            for i in range(addr // cell,
+                           (addr + self.tensor_sizes[tid]) // cell + 1):
+                if i < len(marks):
+                    marks[i] = "x"
+        line = "".join(marks)
+        logger.info(f"{self.name}: [{line}] free={self.total_free}/{self.size}")
+        return line

@@ -1,0 +1,44 @@
+"""Debug name maps for parameters/modules.
+
+Parity: reference ``utils/debug.py`` (``debug_param2name_id_shape`` etc. —
+human-readable identification of params inside hook callbacks).  With pytree
+params, identification is by path string; these helpers produce the same
+kind of compact diagnostic labels.
+"""
+
+import jax
+
+module_names = {}
+param_names = {}
+
+
+def build_param_names(params, prefix=""):
+    """path-string → leaf map (call once to register names for debugging)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out = {}
+    for path, leaf in flat:
+        name = prefix + jax.tree_util.keystr(path)
+        out[name] = leaf
+        # keep the leaf alive alongside its name: a freed id() can be
+        # recycled by CPython and would mislabel an unrelated array
+        param_names[id(leaf)] = (name, leaf)
+    return out
+
+
+def _name_of(leaf):
+    entry = param_names.get(id(leaf))
+    return entry[0] if entry is not None and entry[1] is leaf else "<unregistered>"
+
+
+def debug_param2name_id_shape(leaf):
+    return f"name={_name_of(leaf)} id={id(leaf)} shape={getattr(leaf, 'shape', ())}"
+
+
+def debug_param2name_id_numel(leaf):
+    return f"name={_name_of(leaf)} id={id(leaf)} numel={getattr(leaf, 'size', 0)}"
+
+
+def printflock(*msgs):
+    """Interleaving-safe print (reference uses an flock; one process per
+    host on TPU makes plain print safe, kept for API parity)."""
+    print(*msgs, flush=True)

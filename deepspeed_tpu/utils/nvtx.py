@@ -1,0 +1,22 @@
+"""Profiler range annotation.
+
+Parity: reference ``utils/nvtx.py`` (``instrument_w_nvtx`` :9 wraps hot
+functions in ``torch.cuda.nvtx.range``).  On TPU the equivalent is
+``jax.named_scope``/``jax.profiler.TraceAnnotation``: scopes show up in
+xplane traces captured by ``jax.profiler`` instead of nsight.
+"""
+
+import functools
+
+import jax
+
+
+def instrument_w_nvtx(func):
+    """Decorate ``func`` so its execution appears as a named range in
+    profiler traces (host side) and in the HLO scope tree (traced side)."""
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            with jax.named_scope(func.__name__):
+                return func(*args, **kwargs)
+    return wrapped

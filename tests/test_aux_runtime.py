@@ -204,3 +204,40 @@ def test_sparse_allreduce(devices):
         for j in range(2):
             expected[idx[r, j]] += vals[r, j] / 8
     np.testing.assert_allclose(out[:16], expected, rtol=1e-5, atol=1e-6)
+
+
+def test_weight_quantization_class():
+    import jax, jax.numpy as jnp, numpy as np
+    from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+    params = {"w": jnp.asarray(np.random.RandomState(0).randn(64, 128),
+                               jnp.float32),
+              "b": jnp.zeros((128,), jnp.float32)}
+    wq = WeightQuantization(mlp_extra_grouping=True)
+    qp, stats = wq.model_quantize(params, groups=2)
+    assert qp["w"]["q"].dtype == jnp.int8
+    assert qp["b"].dtype == jnp.float32  # small 1-D stays fp
+    deq = WeightQuantization.dequantize(qp, jnp.float32)
+    err = np.abs(np.asarray(deq["w"]) - np.asarray(params["w"])).max()
+    assert err < np.abs(np.asarray(params["w"])).max() / 50
+
+
+def test_instrument_w_nvtx_passthrough():
+    from deepspeed_tpu.utils.nvtx import instrument_w_nvtx
+    import jax.numpy as jnp
+
+    @instrument_w_nvtx
+    def f(x):
+        return x * 2
+
+    assert float(f(jnp.float32(3.0))) == 6.0
+
+
+def test_debug_name_maps():
+    import jax.numpy as jnp
+    from deepspeed_tpu.utils import debug
+    params = {"layer": {"w": jnp.ones((2, 2))}}
+    names = debug.build_param_names(params)
+    key = next(iter(names))
+    assert "layer" in key and "w" in key
+    leaf = names[key]
+    assert "shape=(2, 2)" in debug.debug_param2name_id_shape(leaf)

@@ -1,0 +1,118 @@
+"""Int8 weight-quantized inference tests.
+
+Parity model: reference ``tests/unit/test_quantize.py`` + int8 inference
+kernel coverage — quantized forward close to full-precision, 4× weight
+storage reduction, cache decode works through the quantized wrapper.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import build
+from deepspeed_tpu.module_inject.module_quantize import (
+    quantize_param_tree, dequantize_tree, quantize_transformer_layer,
+    QuantizedModel, _is_quantized_leaf)
+from deepspeed_tpu.inference.engine import InferenceEngine
+
+
+def _tiny():
+    model = build("gpt2-tiny", dtype=jnp.float32,
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def test_quantize_tree_shrinks_and_roundtrips():
+    model, params = _tiny()
+    qtree, stats = quantize_param_tree(params, bits=8, groups=4)
+    assert stats["bytes_after"] < stats["bytes_before"] / 3
+    big_leaves = [l for l in jax.tree_util.tree_leaves(
+        params) if getattr(l, "ndim", 0) >= 2 and l.size >= 4096]
+    q_leaves = []
+    jax.tree_util.tree_map(
+        lambda x: q_leaves.append(x) if _is_quantized_leaf(x) else None,
+        qtree, is_leaf=_is_quantized_leaf)
+    assert len(q_leaves) == len(big_leaves)
+    for q in q_leaves:
+        assert q["q"].dtype == jnp.int8
+    deq = dequantize_tree(qtree, jnp.float32)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(deq)):
+        if a.ndim >= 2 and a.size >= 4096:
+            err = np.abs(np.asarray(a) - np.asarray(b)).max()
+            scale = np.abs(np.asarray(a)).max()
+            assert err <= scale / 100  # int8 groupwise: ~1% of range
+
+
+def test_quantized_forward_close_to_fp():
+    model, params = _tiny()
+    ids = np.random.RandomState(0).randint(0, 1024, (2, 16)).astype(np.int32)
+    ref = np.asarray(model.apply(params, jnp.asarray(ids)))
+    qmodel, qparams = quantize_transformer_layer(model, params, groups=8)
+    out = np.asarray(qmodel.apply(qparams, jnp.asarray(ids)))
+    # logits shift but ranking should broadly agree
+    agree = (out.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree > 0.9, f"argmax agreement {agree}"
+
+
+def test_quantized_inference_engine_generates():
+    model, params = _tiny()
+    eng = InferenceEngine(model=model, params=params, quantization_setting=8)
+    assert eng.quantized
+    ids = np.random.RandomState(1).randint(0, 1024, (1, 8)).astype(np.int32)
+    out = eng.generate(ids, max_new_tokens=4)
+    assert out.shape == (1, 12)
+    # greedy decode matches the unquantized wrapper's own greedy decode
+    eng2 = InferenceEngine(model=QuantizedModel(model, jnp.float32),
+                           params=eng.params)
+    out2 = eng2.generate(ids, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_engine_accepts_prequantized_params():
+    # WeightQuantization flow: quantize offline, hand the int8 tree + RAW
+    # model to the engine — it must wrap the model itself
+    from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+    model, params = _tiny()
+    qp, _ = WeightQuantization().model_quantize(params, groups=4)
+    eng = InferenceEngine(model=model, params=qp)
+    assert eng.quantized
+    ids = np.random.RandomState(4).randint(0, 1024, (1, 8)).astype(np.int32)
+    logits = eng.forward(jnp.asarray(ids))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_engine_tuple_quantization_setting():
+    model, params = _tiny()
+    eng = InferenceEngine(model=model, params=params,
+                          quantization_setting=(True, 8))
+    assert eng.quantized
+    with pytest.raises(ValueError):
+        InferenceEngine(model=model, params=params,
+                        quantization_setting="8bits")
+
+
+def test_gptj_cache_generate():
+    model = build("gptj-tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = InferenceEngine(model=model, params=params)
+    ids = np.random.RandomState(2).randint(0, 1024, (2, 6)).astype(np.int32)
+    out = eng.generate(ids, max_new_tokens=5)
+    assert out.shape == (2, 11)
+    # cache decode consistent with full forward
+    full = model.apply(params, out[:, :-1])
+    cache = model.init_cache(2, max_len=16, dtype=jnp.float32)
+    logits, _ = model.apply_with_cache(params, out[:, :-1], cache)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_gptneox_cache_generate():
+    model = build("gptneox-tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = InferenceEngine(model=model, params=params)
+    ids = np.random.RandomState(3).randint(0, 1024, (1, 4)).astype(np.int32)
+    out = eng.generate(ids, max_new_tokens=3)
+    assert out.shape == (1, 7)
