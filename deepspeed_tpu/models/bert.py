@@ -69,6 +69,10 @@ class Bert:
             config = BertConfig(**base)
         self.config = config
         self.dtype = dtype
+        # set by sparse_attention_utils.replace_model_self_attention; blocks
+        # then route attention through the block-sparse kernel (reference:
+        # BertSparseSelfAttention swap-in)
+        self.sparse_self_attention = None
 
     # ------------------------------------------------------------------ init
     def init(self, rng):
@@ -144,13 +148,29 @@ class Bert:
             q, k, v = jnp.split(qkv, 3, axis=-1)
             f = lambda t: t.reshape(B, T, H, hd)
             q, k, v = f(q), f(k), f(v)
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-            scores = scores / np.sqrt(hd)
-            if mask is not None:
-                scores = scores + mask.astype(scores.dtype)
-            probs = jax.nn.softmax(scores, axis=-1)
-            probs = _dropout(probs, c.attn_dropout, r1, deterministic).astype(h.dtype)
-            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+            if self.sparse_self_attention is not None:
+                from ..utils.logging import warning_once
+                if c.attn_dropout > 0.0 and not deterministic:
+                    warning_once("sparse attention has no in-kernel dropout; "
+                                 "attn_dropout is ignored on this path")
+                kp = mask[:, 0, 0, :] if mask is not None else None
+                if kp is not None:
+                    warning_once("sparse attention with a padding mask uses "
+                                 "the dense fallback (in-kernel padding mask "
+                                 "is future work); prefer unpadded block-"
+                                 "aligned batches for the Pallas kernel")
+                ctx = self.sparse_self_attention(
+                    q, k, v, causal=False, key_padding_mask=kp)
+                ctx = ctx.reshape(B, T, D)
+            else:
+                scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+                scores = scores / np.sqrt(hd)
+                if mask is not None:
+                    scores = scores + mask.astype(scores.dtype)
+                probs = jax.nn.softmax(scores, axis=-1)
+                probs = _dropout(probs, c.attn_dropout, r1,
+                                 deterministic).astype(h.dtype)
+                ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
             out = ctx @ p["attn_ow"].astype(h.dtype) + p["attn_ob"].astype(h.dtype)
             return _dropout(out, c.hidden_dropout, r2, deterministic)
 

@@ -277,20 +277,18 @@ class GPT2:
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
-    def _block_with_cache(self, x, layer_params, cache_k, cache_v, index,
-                          is_local=None):
-        """One block over ``x: (B, T, D)`` attending to cache[:index] + x.
+    def _cached_attention(self, p, h, cache_k, cache_v, index, is_local=None):
+        """Shared cached-attention core (qkv, cache update, masked softmax,
+        output proj) — used by this model AND GPT2MoE's decode path so the
+        scale_attn / local-window semantics cannot drift between them.
 
-        Returns (y, new_cache_k, new_cache_v).  Static cache length; key
-        positions ≥ index+T are masked.
-        """
+        ``h``: normalized block input (B, T, D).  Returns
+        (attn_out (B, T, D), new_cache_k, new_cache_v)."""
         c = self.config
-        B, T, D = x.shape
+        B, T, D = h.shape
         H, hd = c.n_head, c.head_dim
-        p = layer_params
         S = cache_k.shape[1]
 
-        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
         qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, hd)
@@ -316,6 +314,20 @@ class GPT2:
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v).reshape(B, T, D)
         attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+        return attn, cache_k, cache_v
+
+    def _block_with_cache(self, x, layer_params, cache_k, cache_v, index,
+                          is_local=None):
+        """One block over ``x: (B, T, D)`` attending to cache[:index] + x.
+
+        Returns (y, new_cache_k, new_cache_v).  Static cache length; key
+        positions ≥ index+T are masked.
+        """
+        c = self.config
+        p = layer_params
+        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
+        attn, cache_k, cache_v = self._cached_attention(
+            p, h, cache_k, cache_v, index, is_local)
         x = x + attn
 
         h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)

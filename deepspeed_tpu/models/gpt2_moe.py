@@ -31,6 +31,7 @@ class GPT2MoEConfig(GPT2Config):
     moe_every: int = 2          # an MoE FFN every k-th layer (reference style)
     top_k: int = 1
     capacity_factor: float = 1.25
+    eval_capacity_factor: Optional[float] = None   # None → capacity_factor
     min_capacity: int = 4
     aux_loss_coef: float = 0.01
     use_residual: bool = False  # PR-MoE (pyramid-residual)
@@ -83,6 +84,10 @@ class GPT2MoE:
         self._moe = MoE(hidden_size=c.n_embd, expert=self._expert,
                         num_experts=c.num_experts, k=c.top_k,
                         capacity_factor=c.capacity_factor,
+                        eval_capacity_factor=(c.eval_capacity_factor
+                                              if c.eval_capacity_factor
+                                              is not None
+                                              else c.capacity_factor),
                         min_capacity=c.min_capacity,
                         use_residual=c.use_residual,
                         noisy_gate_policy=c.noisy_gate_policy)
@@ -196,6 +201,57 @@ class GPT2MoE:
     def apply(self, params, tokens, rng=None, deterministic=True):
         logits, _ = self._apply_with_aux(params, tokens, rng, deterministic)
         return logits
+
+    # ------------------------------------------------------- KV-cache decode
+    # (role parity: reference ``ops/transformer/inference/moe_inference.py``
+    # DeepSpeedMoEInference — expert layers served through the same gate +
+    # dispatch path at decode time, dense layers as usual)
+    def init_cache(self, batch_size: int, max_len: Optional[int] = None,
+                   dtype=None):
+        c = self.config
+        max_len = max_len or c.max_seq
+        dtype = dtype or self.dtype
+        shape = (c.n_layer, batch_size, max_len, c.n_head, c.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "index": jnp.zeros((), jnp.int32)}
+
+    # cached-attention core shared with the dense model (scale_attn /
+    # local-window semantics live in ONE place)
+    _cached_attention = GPT2._cached_attention
+
+    def apply_with_cache(self, params, tokens, cache):
+        c = self.config
+        index = cache["index"]
+        dtype = self.dtype
+
+        pos = index + jnp.arange(tokens.shape[1])
+        x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[pos]
+        new_k, new_v = [], []
+        for i, p in enumerate(params["layers"]):
+            h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
+            attn, ck, cv = self._cached_attention(
+                p, h, cache["k"][i], cache["v"][i], index)
+            new_k.append(ck)
+            new_v.append(cv)
+            x = x + attn
+
+            h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
+            if "moe" in p:
+                # fixed key: eval-mode gating is deterministic (RTS thinning
+                # only randomizes during training in spirit; any key works)
+                out, _, _ = self._moe.apply(p["moe"], h,
+                                            rng=jax.random.PRNGKey(0),
+                                            train=False)
+            else:
+                out = self._expert.apply(p["ffn"], h)
+            x = x + out
+
+        x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                        c.layer_norm_eps)
+        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                            params["wte"].astype(jnp.float32))
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v),
+                        "index": index + tokens.shape[1]}
 
     # ------------------------------------------------------------------ loss
     def loss(self, params, batch, rng):

@@ -1,0 +1,8 @@
+from .sparsity_config import (SparsityConfig, DenseSparsityConfig,
+                              FixedSparsityConfig, VariableSparsityConfig,
+                              BigBirdSparsityConfig,
+                              BSLongformerSparsityConfig)
+from .sparse_self_attention import SparseSelfAttention, BertSparseSelfAttention
+from .sparse_attention_utils import (replace_model_self_attention,
+                                     extend_position_embedding,
+                                     pad_to_block_size, unpad_sequence_output)

@@ -53,7 +53,7 @@ def _interpret():
 
 # =============================================================== forward kernel
 def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
-                seq_len, use_layout=False):
+                seq_len, use_layout=False, n_heads=1):
     """Grid: (BH, nq, nk) with nk innermost (revisits scratch).
 
     With ``use_layout`` a block-layout ref (SMEM scalar per (head, qi, ki))
@@ -79,7 +79,9 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
     if causal:
         should_compute = ki * block_k <= qi * block_q + (block_q - 1)
     if layout_ref is not None:
-        should_compute = jnp.logical_and(should_compute, layout_ref[0, 0, 0] > 0)
+        h_idx = pl.program_id(0) % n_heads
+        should_compute = jnp.logical_and(should_compute,
+                                         layout_ref[h_idx, qi, ki] > 0)
 
     @pl.when(should_compute)
     def _():
@@ -153,14 +155,15 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
     ]
     args = (q, k, v)
     if layout is not None:
-        in_specs = [pl.BlockSpec(
-            (1, 1, 1), lambda b, i, j: (b % n_heads, i, j),
-            memory_space=pltpu.SMEM)] + in_specs
+        # whole layout in SMEM (tiny int32 table); kernels index it with
+        # program ids — per-block blocking would violate Mosaic lane tiling
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
         args = (layout,) + args
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          seq_len=T, use_layout=layout is not None),
+                          seq_len=T, use_layout=layout is not None,
+                          n_heads=n_heads or 1),
         grid=(BH, nq, nk),
         in_specs=in_specs,
         out_specs=[
@@ -185,7 +188,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
 
 # ============================================================== backward kernels
 def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
-                     seq_len, use_layout=False):
+                     seq_len, use_layout=False, n_heads=1):
     """Grid: (BH, nk, nq) with nq innermost; accumulates dK/dV for one k block."""
     if use_layout:
         (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -206,7 +209,9 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
     if causal:
         should_compute = qi * block_q + (block_q - 1) >= ki * block_k
     if layout_ref is not None:
-        should_compute = jnp.logical_and(should_compute, layout_ref[0, 0, 0] > 0)
+        h_idx = pl.program_id(0) % n_heads
+        should_compute = jnp.logical_and(should_compute,
+                                         layout_ref[h_idx, qi, ki] > 0)
 
     @pl.when(should_compute)
     def _():
@@ -248,7 +253,7 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
 
 
 def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
-                   seq_len, use_layout=False):
+                   seq_len, use_layout=False, n_heads=1):
     """Grid: (BH, nq, nk) with nk innermost; accumulates dQ for one q block."""
     if use_layout:
         (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -267,7 +272,9 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
     if causal:
         should_compute = ki * block_k <= qi * block_q + (block_q - 1)
     if layout_ref is not None:
-        should_compute = jnp.logical_and(should_compute, layout_ref[0, 0, 0] > 0)
+        h_idx = pl.program_id(0) % n_heads
+        should_compute = jnp.logical_and(should_compute,
+                                         layout_ref[h_idx, qi, ki] > 0)
 
     @pl.when(should_compute)
     def _():
@@ -338,14 +345,13 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
     ]
     dkdv_args = (q, k, v, dout, lse, delta)
     if layout is not None:
-        dkdv_specs = [pl.BlockSpec(
-            (1, 1, 1), lambda b, j, i: (b % n_heads, i, j),
-            memory_space=pltpu.SMEM)] + dkdv_specs
+        dkdv_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dkdv_specs
         dkdv_args = (layout,) + dkdv_args
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          seq_len=T, use_layout=layout is not None),
+                          seq_len=T, use_layout=layout is not None,
+                          n_heads=n_heads or 1),
         grid=(BH, nk, nq),
         in_specs=dkdv_specs,
         out_specs=[
@@ -377,14 +383,13 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
     ]
     dq_args = (q, k, v, dout, lse, delta)
     if layout is not None:
-        dq_specs = [pl.BlockSpec(
-            (1, 1, 1), lambda b, i, j: (b % n_heads, i, j),
-            memory_space=pltpu.SMEM)] + dq_specs
+        dq_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dq_specs
         dq_args = (layout,) + dq_args
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          seq_len=T, use_layout=layout is not None),
+                          seq_len=T, use_layout=layout is not None,
+                          n_heads=n_heads or 1),
         grid=(BH, nq, nk),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),

@@ -449,9 +449,16 @@ class DeepSpeedEngine:
         """Device half of the offload step: grads (unscaled, clipped, sharded)
         + metrics; the optimizer update happens on the host
         (reference: backward populates the fp32 cpu partition,
-        ``stage_1_and_2.py:1008-1160``)."""
+        ``stage_1_and_2.py:1008-1160``).  Grads cross to the host in the
+        16-bit compute dtype — the reference also moves 16-bit grads over
+        PCIe and upcasts on the CPU (half the transfer bytes)."""
         grads, _, _, metrics = self._grads_and_metrics(
             state, state.params, batch, rng)
+        if self.compute_dtype == jnp.bfloat16:
+            # bf16 spans the fp32 exponent range so no new inf can appear
+            # after the overflow check; fp16 (max 65504) must stay fp32 —
+            # casting could mint inf that bypasses the skip-step logic
+            grads = tree_cast(grads, jnp.bfloat16)
         return grads, metrics
 
     def _host_offload_update(self, grads, metrics):
