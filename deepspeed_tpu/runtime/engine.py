@@ -213,6 +213,15 @@ class DeepSpeedEngine:
             self._setup_tensorboard()
         if self.config.memory_breakdown:
             see_memory_usage("Engine initialized", force=True)
+        if self.config.prescale_gradients or \
+                self.config.gradient_predivide_factor != 1.0:
+            # reference: sum-allreduce with pre/post division to control
+            # overflow (engine.py allreduce_gradients). Here the loss is a
+            # mean over the GLOBAL batch, so XLA's reduction is already the
+            # average — prescaling is implicit and numerically equivalent.
+            log_dist("prescale_gradients/gradient_predivide_factor: XLA "
+                     "mean-reduction already averages gradients; keys accepted "
+                     "as no-ops", ranks=[0])
         log_dist(f"DeepSpeedEngine ready: zero_stage={self.zero_stage} "
                  f"dtype={self.config.precision_dtype} mesh={dict(self.mesh.shape)} "
                  f"micro_batch={self.train_micro_batch_size_per_gpu()} "
