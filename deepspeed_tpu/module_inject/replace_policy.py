@@ -14,7 +14,13 @@ import numpy as np
 
 
 def _t(x):
-    """torch tensor → numpy fp32 (detached, CPU)."""
+    """torch tensor → numpy fp32 (detached, CPU).
+
+    NOTE: for fp32 CPU tensors this is a zero-copy VIEW of the live torch
+    buffer (``.float()`` is a no-op, ``.numpy()`` shares memory).  Every
+    policy's exit point therefore materializes owned copies with
+    ``jnp.array`` — otherwise converted params would silently track later
+    torch mutations (e.g. continuing to train the source model)."""
     return np.asarray(x.detach().cpu().float().numpy())
 
 
@@ -83,7 +89,8 @@ class HFGPT2LayerPolicy(DSPolicy):
             "lnf_bias": _t(tr.ln_f.bias),
         }
         import jax
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        # jnp.array: forced copy — some leaves are views of torch buffers (_t)
+        params = jax.tree_util.tree_map(jnp.array, params)
         return model, params
 
 
@@ -166,7 +173,8 @@ class HFBertLayerPolicy(DSPolicy):
                 "mlm_ln_bias": np.zeros((D,), np.float32),
                 "mlm_bias": np.zeros((hc.vocab_size,), np.float32),
             })
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        # jnp.array: forced copy — some leaves are views of torch buffers (_t)
+        params = jax.tree_util.tree_map(jnp.array, params)
         return model, params
 
 
@@ -238,7 +246,8 @@ class HFGPTNEOLayerPolicy(DSPolicy):
             "lnf_scale": _t(tr.ln_f.weight),
             "lnf_bias": _t(tr.ln_f.bias),
         }
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        # jnp.array: forced copy — some leaves are views of torch buffers (_t)
+        params = jax.tree_util.tree_map(jnp.array, params)
         return model, params
 
 
@@ -295,7 +304,8 @@ class HFGPTJLayerPolicy(DSPolicy):
                           and hf_model.lm_head.bias is not None
                           else np.zeros((V,), np.float32)),
         }
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        # jnp.array: forced copy — some leaves are views of torch buffers (_t)
+        params = jax.tree_util.tree_map(jnp.array, params)
         return model, params
 
 
@@ -369,7 +379,8 @@ class GPTNEOXLayerPolicy(DSPolicy):
                           else _t(tr.embed_in.weight).T),
             "lm_head_b": np.zeros((hc.vocab_size,), np.float32),
         }
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        # jnp.array: forced copy — some leaves are views of torch buffers (_t)
+        params = jax.tree_util.tree_map(jnp.array, params)
         return model, params
 
 
@@ -443,7 +454,8 @@ class MegatronLayerPolicy(DSPolicy):
             "lnf_scale": g("transformer.final_layernorm.weight"),
             "lnf_bias": g("transformer.final_layernorm.bias"),
         }
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        # jnp.array: forced copy — some leaves are views of torch buffers (_t)
+        params = jax.tree_util.tree_map(jnp.array, params)
         return model, params
 
 
