@@ -309,7 +309,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
 
 
 def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
-         n_heads=None):
+         n_heads=None, dlse=None):
     q, k, v, out, lse = residuals
     BH, T, d = q.shape
     block_q, block_k = _auto_blocks(T, d, block_q, block_k)
@@ -325,6 +325,11 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
 
     # delta_i = rowsum(dO * O) — cheap, fused by XLA
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        # lse is ALSO a primal output (flash_attention_with_lse):
+        # ∂lse/∂s = p, so the lse cotangent enters as ds += p·dlse — i.e. the
+        # kernels' ds = p·(dp − delta) absorbs it via delta ← delta − dlse
+        delta = delta - dlse.astype(jnp.float32)
     if Tp != T:
         pad2 = lambda x: jnp.pad(x, ((0, 0), (0, Tp - T)))
         q, k, v, dout = (_pad_t(a, Tp) for a in (q, k, v, dout))
@@ -437,6 +442,42 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None,
     out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v),
                       float(sm_scale), bool(causal), int(block_q), int(block_k))
     return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse_bhtd(q, k, v, sm_scale, causal, block_q, block_k):
+    return _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+
+
+def _flash_lse_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd_rule(sm_scale, causal, block_q, block_k, residuals, cts):
+    dout, dlse = cts
+    return _bwd(sm_scale, causal, block_q, block_k, residuals, dout,
+                dlse=dlse)
+
+
+_flash_lse_bhtd.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def flash_attention_with_lse(q, k, v, *, causal=True, sm_scale=None,
+                             block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention returning ``(out (B,T,H,d), lse (B,H,T))`` with BOTH
+    outputs differentiable — the building block for ring attention, where
+    per-device partial results merge via their logsumexp statistics."""
+    B, T, H, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    block_q, block_k = _auto_blocks(T, d, block_q, block_k)
+    to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    out, lse = _flash_lse_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v),
+                               float(sm_scale), bool(causal), int(block_q),
+                               int(block_k))
+    return (out.reshape(B, H, T, d).transpose(0, 2, 1, 3),
+            lse.reshape(B, H, T))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))

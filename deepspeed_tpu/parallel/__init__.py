@@ -1,0 +1,4 @@
+from . import mesh
+from . import collectives
+from .sequence_parallel import (ring_attention, ring_flash_attention,
+                                ulysses_attention)

@@ -95,6 +95,20 @@ def ring_attention_inner(q, k, v, *, axis_name: str = "seq",
     return out.transpose(0, 2, 1, 3)
 
 
+
+def _seq_sharded(inner_fn, mesh, axis_name, batch_spec):
+    """shard_map an inner per-shard attention over the seq axis (shared by
+    ring/ring-flash/Ulysses wrappers)."""
+    if mesh is None:
+        am = jax.sharding.get_abstract_mesh()
+        assert not am.empty, "sequence-parallel attention needs a mesh"
+        mesh = am
+    b = tuple(batch_spec)[0] if len(tuple(batch_spec)) else None
+    spec = P(b, axis_name, None, None)
+    return shard_map(inner_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)
+
+
 def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
                    axis_name: str = "seq", causal: bool = True,
                    sm_scale: Optional[float] = None,
@@ -105,16 +119,93 @@ def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
     :func:`ring_attention_inner`.  ``batch_spec`` optionally shards B (e.g.
     ``P(('data','fsdp'))`` when composing with data parallelism).
     """
-    if mesh is None:
-        am = jax.sharding.get_abstract_mesh()
-        assert not am.empty, "ring_attention needs a mesh (pass mesh= or set one)"
-        mesh = am
-    b = tuple(batch_spec)[0] if len(tuple(batch_spec)) else None
-    spec = P(b, axis_name, None, None)
     fn = functools.partial(ring_attention_inner, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    return _seq_sharded(fn, mesh, axis_name, batch_spec)(q, k, v)
+
+
+# ------------------------------------------------------- ring × flash kernel
+def ring_flash_attention_inner(q, k, v, *, axis_name: str = "seq",
+                               causal: bool = True,
+                               sm_scale: Optional[float] = None):
+    """Ring attention whose per-block compute is the Pallas flash kernel.
+
+    The intra-chip score matrix never leaves VMEM (flash) while K/V shards
+    rotate over ICI (ppermute) — the intended long-context composition:
+    per-rotation partial results carry (out, lse) and merge by logsumexp
+    (``flash_attention_with_lse`` makes lse differentiable, so the whole
+    ring backpropagates through the merge weights).
+
+    Block kinds per rotation (no in-kernel cross-shard offsets needed):
+      src <  my → fully visible   (flash, causal=False)
+      src == my → diagonal        (flash, causal=True)
+      src >  my → fully masked    (skipped: -inf lse)
+    """
+    from ..ops.transformer.flash_attention import flash_attention_with_lse
+
+    B, T_loc, H, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+
+    def full_block(kv):
+        k_cur, v_cur = kv
+        return flash_attention_with_lse(q, k_cur, v_cur, causal=False,
+                                        sm_scale=sm_scale)
+
+    def diag_block(kv):
+        k_cur, v_cur = kv
+        return flash_attention_with_lse(q, k_cur, v_cur, causal=True,
+                                        sm_scale=sm_scale)
+
+    def skip_block(kv):
+        return (jnp.zeros((B, T_loc, H, d), q.dtype),
+                jnp.full((B, H, T_loc), NEG_INF, jnp.float32))
+
+    def merge(o, lse, kv, i):
+        """Attend one block and fold it into the fp32 (o, lse) partials."""
+        src = (my - i) % n
+        if causal:
+            o_b, lse_b = lax.cond(
+                src == my, diag_block,
+                lambda kv: lax.cond(src < my, full_block, skip_block, kv), kv)
+        else:
+            o_b, lse_b = full_block(kv)
+        # logsumexp merge (weights differentiable; NEG_INF is a finite
+        # sentinel, so exp(lse - new_lse) underflows to exactly 0 for
+        # never-touched rows — no special-casing needed)
+        new_lse = jnp.logaddexp(lse, lse_b)
+        to_bthd = lambda w: w.transpose(0, 2, 1)[..., None]   # (B,T,H,1)
+        o = (o * to_bthd(jnp.exp(lse - new_lse))
+             + o_b.astype(jnp.float32) * to_bthd(jnp.exp(lse_b - new_lse)))
+        return o, new_lse
+
+    def step(carry, i):
+        o, lse, k_cur, v_cur = carry
+        o, lse = merge(o, lse, (k_cur, v_cur), i)
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        return (o, lse,
+                lax.ppermute(k_cur, axis_name, perm),
+                lax.ppermute(v_cur, axis_name, perm)), None
+
+    # fp32 accumulator (n bf16 rescale/adds would compound rounding error)
+    o0 = jnp.zeros((B, T_loc, H, d), jnp.float32)
+    lse0 = jnp.full((B, H, T_loc), NEG_INF, jnp.float32)
+    # n-1 rotations; the last block is consumed without a dead final rotate
+    (o, lse, k_last, v_last), _ = lax.scan(step, (o0, lse0, k, v),
+                                           jnp.arange(n - 1))
+    o, lse = merge(o, lse, (k_last, v_last), jnp.int32(n - 1))
+    return o.astype(q.dtype)
+
+
+def ring_flash_attention(q, k, v, *, mesh: Optional[Mesh] = None,
+                         axis_name: str = "seq", causal: bool = True,
+                         sm_scale: Optional[float] = None, batch_spec=P()):
+    """Flash-kernel ring attention over global (B, T, H, d) arrays."""
+    fn = functools.partial(ring_flash_attention_inner, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
+    return _seq_sharded(fn, mesh, axis_name, batch_spec)(q, k, v)
 
 
 # ------------------------------------------------------------------- Ulysses
@@ -160,13 +251,6 @@ def ulysses_attention(q, k, v, *, mesh: Optional[Mesh] = None,
                       attn_fn: Optional[Callable] = None,
                       batch_spec=P()):
     """Ulysses attention over global (B, T, H, d) arrays (see inner)."""
-    if mesh is None:
-        am = jax.sharding.get_abstract_mesh()
-        assert not am.empty, "ulysses_attention needs a mesh"
-        mesh = am
-    b = tuple(batch_spec)[0] if len(tuple(batch_spec)) else None
-    spec = P(b, axis_name, None, None)
     fn = functools.partial(ulysses_attention_inner, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale, attn_fn=attn_fn)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    return _seq_sharded(fn, mesh, axis_name, batch_spec)(q, k, v)
