@@ -220,17 +220,27 @@ def ulysses_attention_inner(q, k, v, *, axis_name: str = "seq",
     group, and re-shards back.  Requires H divisible by the axis extent.
     """
     if attn_fn is None:
-        def attn_fn(q, k, v, *, causal, sm_scale):
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                           preferred_element_type=jnp.float32)
-            s = s * (sm_scale if sm_scale is not None
-                     else 1.0 / np.sqrt(q.shape[-1]))
-            if causal:
-                T = q.shape[1]
-                mask = jnp.tril(jnp.ones((T, T), bool))
-                s = jnp.where(mask[None, None], s, NEG_INF)
-            p = jax.nn.softmax(s, axis=-1)
-            return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+        from ..ops import flash_attention_available
+        if flash_attention_available():
+            # after the all_to_all each device holds full-sequence shards per
+            # head group — exactly the flash kernel's shape
+            from ..ops.transformer.flash_attention import flash_attention
+
+            def attn_fn(q, k, v, *, causal, sm_scale):
+                return flash_attention(q, k, v, causal=causal,
+                                       sm_scale=sm_scale)
+        else:
+            def attn_fn(q, k, v, *, causal, sm_scale):
+                s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                               preferred_element_type=jnp.float32)
+                s = s * (sm_scale if sm_scale is not None
+                         else 1.0 / np.sqrt(q.shape[-1]))
+                if causal:
+                    T = q.shape[1]
+                    mask = jnp.tril(jnp.ones((T, T), bool))
+                    s = jnp.where(mask[None, None], s, NEG_INF)
+                p = jax.nn.softmax(s, axis=-1)
+                return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
     n = lax.axis_size(axis_name)
     assert q.shape[2] % n == 0, \
