@@ -201,6 +201,19 @@ class GPT2:
         wants_dropout = c.attn_pdrop > 0.0 and not deterministic
         # flash path covers the standard scaled-causal case only
         nonstandard = not c.scale_attn or c.local_attn_window is not None
+        if impl in ("ring", "ring_flash", "ulysses"):
+            # sequence parallelism: attention over the mesh `seq` axis
+            # (engine-level long context; NEW vs the reference vintage)
+            if nonstandard or wants_dropout:
+                from ..utils.logging import warning_once
+                warning_once(f"attention_impl={impl!r} ignores attn dropout "
+                             "and GPT-Neo attention knobs")
+            from ..parallel import sequence_parallel as sp
+            from ..parallel.mesh import batch_spec
+            fn = {"ring": sp.ring_attention,
+                  "ring_flash": sp.ring_flash_attention,
+                  "ulysses": sp.ulysses_attention}[impl]
+            return fn(q, k, v, causal=True, batch_spec=batch_spec())
         if impl == "auto":
             from ..ops import flash_attention_available
             # the pallas kernel has no in-kernel dropout yet; fall back to the

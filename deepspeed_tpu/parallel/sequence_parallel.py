@@ -96,15 +96,24 @@ def ring_attention_inner(q, k, v, *, axis_name: str = "seq",
 
 
 
-def _seq_sharded(inner_fn, mesh, axis_name, batch_spec):
+def _seq_sharded(inner_fn, mesh, axis_name, batch_spec, head_axis="tensor"):
     """shard_map an inner per-shard attention over the seq axis (shared by
-    ring/ring-flash/Ulysses wrappers)."""
+    ring/ring-flash/Ulysses wrappers).
+
+    The head axis carries ``head_axis`` ('tensor'): attention is
+    embarrassingly parallel over heads, so tensor-parallel runs keep their
+    head sharding instead of all-gathering QKV (each tensor rank attends its
+    own head group)."""
     if mesh is None:
         am = jax.sharding.get_abstract_mesh()
         assert not am.empty, "sequence-parallel attention needs a mesh"
         mesh = am
     b = tuple(batch_spec)[0] if len(tuple(batch_spec)) else None
-    spec = P(b, axis_name, None, None)
+    try:
+        has_head_axis = head_axis in dict(mesh.shape)
+    except Exception:
+        has_head_axis = False
+    spec = P(b, axis_name, head_axis if has_head_axis else None, None)
     return shard_map(inner_fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)
 
