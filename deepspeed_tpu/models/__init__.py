@@ -20,6 +20,11 @@ def build(name, **overrides):
         if name.startswith("gptneox"):
             from .gptj import GPTNeoX
             return GPTNeoX(preset=name, **overrides)
+        if name.startswith("cifar"):
+            from .cifar import CifarCNN
+            return CifarCNN(preset=name, **overrides)
+    except KeyError as e:
+        raise ValueError(f"Unknown preset {name!r} for its model family") from e
     except ImportError as e:
         raise ValueError(f"Model family for {name!r} is not available: {e}") from e
     raise ValueError(f"Unknown model preset {name!r}; GPT-2 presets: "

@@ -173,3 +173,20 @@ def test_gpt2_moe_aux_loss_contributes():
     l0 = float(m0.loss(params, toks, jax.random.PRNGKey(1)))
     l1 = float(m1.loss(params, toks, jax.random.PRNGKey(1)))
     assert l1 > l0  # aux loss is strictly positive with random gating
+
+
+def test_cifar_cnn_trains(devices):
+    from deepspeed_tpu.models.cifar import CifarCNN
+    model = CifarCNN(preset="cifar-cnn-tiny")
+    rng = np.random.RandomState(9)
+    images = rng.rand(64, 32, 32, 3).astype(np.float32)
+    labels = (images[:, :8, :8].mean((1, 2, 3)) * 20).astype(np.int32) % 10
+    engine, _, _, _ = ds.initialize(
+        config=base_config(micro=8, over={
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}),
+        model=model, training_data=(images, labels),
+        mesh=make_mesh({"data": 8}))
+    losses = [float(engine.train_batch()) for _ in range(15)]
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+    acc = float(model.accuracy(engine.state.params, images, labels))
+    assert acc > 0.2  # well above chance after a few steps
