@@ -94,6 +94,43 @@ def _attention_jnp(q, k, v, causal_mask, attn_drop, rng, deterministic,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def gpt2_block_forward(c, p, x, rng, deterministic, causal_mask, attend,
+                       is_local=None):
+    """One GPT-2 block (LN → attn → residual → LN → MLP → residual).
+
+    SHARED by the scanned model (GPT2._block) and the pipelined layer
+    (models/gpt2_pipe.GPT2Block) so the forward math cannot drift between
+    the DP and PP paths.  ``attend(q, k, v, mask, rng, deterministic)``.
+    """
+    B, T, D = x.shape
+    H, hd = c.n_head, c.head_dim
+    r1, r2, r3 = jax.random.split(rng, 3)
+
+    h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
+    qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, hd)
+    k = k.reshape(B, T, H, hd)
+    v = v.reshape(B, T, H, hd)
+    mask = causal_mask
+    if c.local_attn_window is not None and is_local is not None:
+        # GPT-Neo: odd layers attend within a sliding window
+        pos = jnp.arange(T)
+        local = (pos[None, :] > pos[:, None] - c.local_attn_window)
+        local_mask = causal_mask & local[None, None]
+        mask = jnp.where(is_local, local_mask, causal_mask)
+    attn = attend(q, k, v, mask, r1, deterministic)
+    attn = attn.reshape(B, T, D)
+    attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+    x = x + _dropout(attn, c.resid_pdrop, r2, deterministic)
+
+    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
+    h = h @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ p["fc_proj_w"].astype(h.dtype) + p["fc_proj_b"].astype(h.dtype)
+    return x + _dropout(h, c.resid_pdrop, r3, deterministic)
+
+
 class GPT2:
     """Decoder-only LM. Params are a dict pytree with scanned block stacks."""
 
@@ -164,36 +201,9 @@ class GPT2:
     # --------------------------------------------------------------- forward
     def _block(self, x, layer_params, rng, deterministic, causal_mask,
                is_local=None):
-        c = self.config
-        B, T, D = x.shape
-        H, hd = c.n_head, c.head_dim
-        p = layer_params
-        r1, r2, r3 = jax.random.split(rng, 3)
-
-        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
-        qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, H, hd)
-        k = k.reshape(B, T, H, hd)
-        v = v.reshape(B, T, H, hd)
-        mask = causal_mask
-        if c.local_attn_window is not None and is_local is not None:
-            # GPT-Neo: odd layers attend within a sliding window
-            pos = jnp.arange(T)
-            local = (pos[None, :] > pos[:, None] - c.local_attn_window)
-            local_mask = causal_mask & local[None, None]
-            mask = jnp.where(is_local, local_mask, causal_mask)
-        attn = self._attend(q, k, v, mask, r1, deterministic)
-        attn = attn.reshape(B, T, D)
-        attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
-        x = x + _dropout(attn, c.resid_pdrop, r2, deterministic)
-
-        h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
-        h = h @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
-        h = jax.nn.gelu(h, approximate=True)
-        h = h @ p["fc_proj_w"].astype(h.dtype) + p["fc_proj_b"].astype(h.dtype)
-        x = x + _dropout(h, c.resid_pdrop, r3, deterministic)
-        return x
+        return gpt2_block_forward(self.config, layer_params, x, rng,
+                                  deterministic, causal_mask, self._attend,
+                                  is_local=is_local)
 
     def _attend(self, q, k, v, causal_mask, rng, deterministic):
         c = self.config

@@ -203,3 +203,19 @@ def test_pipe_forbids_forward(devices):
     engine, _, _, _ = deepspeed.initialize(model=model, config=config)
     with pytest.raises(NotImplementedError):
         engine.forward(None)
+
+
+def test_gpt2_pipeline_trains(devices):
+    """The PP×DP graded config: pipelined GPT-2 over pipe=2 × data=4."""
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline
+    model = gpt2_pipeline(preset="gpt2-tiny", num_stages=2,
+                          dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    seq = rng.integers(0, 1024, (8, 33)).astype(np.int32)
+    batch = (seq[:, :-1], seq[:, 1:])
+    engine, _, _, _ = deepspeed.initialize(
+        config=CONFIG(1, gas=4), model=model,
+        mesh=make_mesh({"pipe": 2, "data": 4}))
+    losses = [float(engine.train_batch(iter([batch] * 4))) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-2:]) < np.mean(losses[:2])
