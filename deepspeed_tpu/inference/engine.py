@@ -58,6 +58,7 @@ class InferenceEngine:
                 mesh = M.make_mesh({"data": -1})
         self.mesh = mesh
         self.mp_world_size = M.mesh_axis_size(mesh, "tensor")
+        dtype = _normalize_dtype(dtype)
         self.dtype = dtype
         if dtype is not None and hasattr(model, "dtype"):
             model.dtype = {np.float32: jnp.float32}.get(dtype, dtype)
@@ -77,7 +78,11 @@ class InferenceEngine:
             _is_quantized_leaf(x) for x in jax.tree_util.tree_leaves(
                 params, is_leaf=_is_quantized_leaf)
             if isinstance(x, dict))
-        if self.dtype is not None and not self.quantized:
+        # dtype=int8 means "quantize", not "cast": a float->int8 astype would
+        # truncate weights (mostly in [-1, 1]) to 0/±1 and destroy the model
+        # before quantize_param_tree ever saw it.
+        if (self.dtype is not None and self.dtype != jnp.int8
+                and not self.quantized):
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(self.dtype) if hasattr(p, "astype") else p, params)
         wants_q = (quantization_setting is not None or dtype == jnp.int8) \
@@ -113,6 +118,11 @@ class InferenceEngine:
             tp_specs = getattr(model, "partition_specs", None)
             if callable(tp_specs):
                 tp_specs = tp_specs(params)
+        elif self.mp_world_size > 1:
+            logger.warning(
+                "InferenceEngine: int8-quantized params replicate across the "
+                f"tensor axis (mp_size={self.mp_world_size}) — model "
+                "parallelism is not applied to quantized leaves yet")
         if tp_specs is not None:
             sh = jax.tree_util.tree_map(
                 lambda sp: NamedSharding(self.mesh, sp), tp_specs,
@@ -206,6 +216,24 @@ class InferenceEngine:
 
     def profile_model_time(self, *a, **k):
         logger.warning("profile_model_time: use jax.profiler traces on TPU")
+
+
+def _normalize_dtype(dtype):
+    """Map torch/numpy dtype spellings onto jnp dtypes — reference users call
+    ``init_inference(dtype=torch.int8)`` (``deepspeed/inference/engine.py:23``)."""
+    if dtype is None:
+        return None
+    try:
+        import torch
+        torch_map = {torch.float32: jnp.float32, torch.float16: jnp.float16,
+                     torch.bfloat16: jnp.bfloat16, torch.int8: jnp.int8}
+        if isinstance(dtype, torch.dtype):
+            return torch_map[dtype]
+    except ImportError:
+        pass
+    if dtype is np.float32:
+        return jnp.float32
+    return dtype
 
 
 def _is_torch_module(model):

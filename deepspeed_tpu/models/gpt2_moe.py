@@ -210,6 +210,11 @@ class GPT2MoE:
                    dtype=None):
         c = self.config
         max_len = max_len or c.max_seq
+        # position/rotary tables only have max_seq rows; beyond that JAX
+        # gather CLAMPS the index and decoding goes silently wrong
+        assert max_len <= c.max_seq, (
+            f"init_cache max_len={max_len} exceeds config.max_seq="
+            f"{c.max_seq}; raise max_seq when building the model")
         dtype = dtype or self.dtype
         shape = (c.n_layer, batch_size, max_len, c.n_head, c.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),

@@ -71,15 +71,16 @@ class GPT2Block:
         c = self.c
         T = x.shape[1]
         causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+        # Layer protocol: rng=None is the engine's "deterministic" signal
+        # (eval_batch) — dropout must not run there.
+        deterministic = rng is None
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-        def attend(q, k, v, mask, r, deterministic):
-            return _attention_jnp(q, k, v, mask, c.attn_pdrop, r,
-                                  deterministic)
+        def attend(q, k, v, mask, r, det):
+            return _attention_jnp(q, k, v, mask, c.attn_pdrop, r, det)
 
-        # deterministic=False: dropout active when the config requests it
-        # (gpt2_pipeline zeroes the pdrops explicitly and loudly otherwise)
-        return gpt2_block_forward(c, params, x, rng, False, causal, attend)
+        return gpt2_block_forward(c, params, x, rng, deterministic, causal,
+                                  attend)
 
 
 class GPT2Head:

@@ -113,9 +113,14 @@ class PipelineEngine(DeepSpeedEngine):
         return grads, scaled_loss
 
     # ------------------------------------------------------- fused pipeline
-    def _pipeline_loss(self, params, batch, rng):
+    def _pipeline_loss(self, params, batch, rng, train=True):
         """Mean loss over M micro-batches, computed by the collective
-        pipeline.  ``batch`` leaves are (M, micro_batch, ...)."""
+        pipeline.  ``batch`` leaves are (M, micro_batch, ...).
+
+        ``train=False`` passes ``rng=None`` to every layer — the layer
+        protocol's "deterministic" signal — so eval never runs dropout
+        (reference ``eval_batch`` puts the module in eval mode,
+        ``pipe/engine.py:382``)."""
         module = self.module
         S = self.num_stages
         inputs, labels = _split_labels(batch)
@@ -135,7 +140,8 @@ class PipelineEngine(DeepSpeedEngine):
             def chunk_body(lo, hi):
                 def run(h, t):
                     for j in range(lo, hi):
-                        r = jax.random.fold_in(key, (t * S + s) * 131 + j)
+                        r = (jax.random.fold_in(key, (t * S + s) * 131 + j)
+                             if train else None)
                         h = module.slot_apply(j, local[j], h, r)
                     return h
                 return run
@@ -156,8 +162,9 @@ class PipelineEngine(DeepSpeedEngine):
             def load_mb(t):
                 return jax.tree_util.tree_map(lambda a: a[t], inp)
 
-            x0_probe = module.prologue_apply(other_p, load_mb(0),
-                                             rng=jax.random.fold_in(key, 7))
+            x0_probe = module.prologue_apply(
+                other_p, load_mb(0),
+                rng=jax.random.fold_in(key, 7) if train else None)
             zero_h = jnp.zeros_like(x0_probe)
 
             def tick(carry, t):
@@ -166,8 +173,9 @@ class PipelineEngine(DeepSpeedEngine):
                 perm = [(i, (i + 1) % S) for i in range(S)]
                 x_recv = lax.ppermute(y_prev, "pipe", perm)
                 # first stage loads micro-batch t instead
-                x0 = module.prologue_apply(other_p, load_mb(jnp.clip(t, 0, M - 1)),
-                                           rng=jax.random.fold_in(key, t * 7 + 1))
+                x0 = module.prologue_apply(
+                    other_p, load_mb(jnp.clip(t, 0, M - 1)),
+                    rng=jax.random.fold_in(key, t * 7 + 1) if train else None)
                 x_in = jnp.where(s == 0, x0, x_recv)
                 y = stage_body(x_in, t)
                 return y, y
@@ -182,8 +190,9 @@ class PipelineEngine(DeepSpeedEngine):
             # vmapped application instead of per-tick masked compute.
             ys_valid = ys[S - 1:]                       # (M, mb, ...)
             def one_loss(i, y):
-                out = module.epilogue_apply(other_p, y,
-                                            rng=jax.random.fold_in(key, i * 7 + 3))
+                out = module.epilogue_apply(
+                    other_p, y,
+                    rng=jax.random.fold_in(key, i * 7 + 3) if train else None)
                 lb = jax.tree_util.tree_map(lambda a: a[i], lab)
                 return module.compute_loss(out, lb).astype(jnp.float32)
             losses = jax.vmap(one_loss)(jnp.arange(M), ys_valid)
@@ -204,7 +213,7 @@ class PipelineEngine(DeepSpeedEngine):
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         if self._jit_eval is None:
             def eval_fn(params, b, r):
-                return self._pipeline_loss(params, b, r)
+                return self._pipeline_loss(params, b, r, train=False)
             self._jit_eval = jax.jit(eval_fn)
         # promote a single micro-batch to a stack of one
         batch = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[None], batch)

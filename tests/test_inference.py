@@ -135,3 +135,11 @@ def test_hf_injection_generate(devices):
             torch.tensor(prompt.astype(np.int64)), max_new_tokens=5,
             do_sample=False, pad_token_id=0).numpy()
     np.testing.assert_array_equal(out, ref)
+
+
+def test_init_cache_rejects_max_len_beyond_max_seq(devices):
+    """Positions past max_seq would clamp into the last rotary/wpe row and
+    decode silently wrong — init_cache must refuse instead."""
+    model = _tiny_model()
+    with pytest.raises(AssertionError, match="max_seq"):
+        model.init_cache(1, max_len=model.config.max_seq + 1)

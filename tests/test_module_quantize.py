@@ -116,3 +116,34 @@ def test_gptneox_cache_generate():
     ids = np.random.RandomState(3).randint(0, 1024, (1, 4)).astype(np.int32)
     out = eng.generate(ids, max_new_tokens=3)
     assert out.shape == (1, 7)
+
+
+def test_engine_dtype_int8_quantizes_not_casts():
+    """dtype=jnp.int8 must mean 'quantize the weights', never a raw astype —
+    a float->int8 cast truncates [-1,1] weights to 0/±1 and destroys the
+    model (reference users call ``init_inference(dtype=torch.int8)``,
+    ``deepspeed/inference/engine.py:23``)."""
+    model, params = _tiny()
+    ids = np.random.RandomState(2).randint(0, 1024, (2, 12)).astype(np.int32)
+
+    eng_dtype = InferenceEngine(model=model, params=params, dtype=jnp.int8)
+    assert eng_dtype.quantized
+    out_dtype = np.asarray(eng_dtype.forward(jnp.asarray(ids)))
+
+    eng_q = InferenceEngine(model=model, params=params,
+                            quantization_setting=1)
+    out_q = np.asarray(eng_q.forward(jnp.asarray(ids)))
+    np.testing.assert_allclose(out_dtype, out_q, rtol=1e-3, atol=1e-3)
+
+    # and the logits must still broadly agree with the float model
+    ref = np.asarray(model.apply(params, jnp.asarray(ids)))
+    agree = (out_dtype.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree > 0.9, f"argmax agreement {agree} — weights were destroyed?"
+
+
+def test_engine_torch_int8_dtype_spelling():
+    """torch.int8 is accepted and routed through quantization."""
+    torch = pytest.importorskip("torch")
+    model, params = _tiny()
+    eng = InferenceEngine(model=model, params=params, dtype=torch.int8)
+    assert eng.quantized

@@ -219,3 +219,20 @@ def test_gpt2_pipeline_trains(devices):
     losses = [float(engine.train_batch(iter([batch] * 4))) for _ in range(8)]
     assert np.isfinite(losses).all()
     assert np.mean(losses[-2:]) < np.mean(losses[:2])
+
+
+def test_pipe_eval_is_deterministic_despite_dropout(devices):
+    """eval_batch must not run dropout (reference eval-mode semantics) —
+    repeated evals with different rngs agree, and match the train-path loss
+    computed with dropout disabled."""
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline
+    model = gpt2_pipeline(preset="gpt2-tiny", num_stages=2, dtype=jnp.float32,
+                          attn_pdrop=0.5, resid_pdrop=0.5)
+    config = dict(CONFIG(1, gas=1), mesh={"axes": {"pipe": 2, "data": 4}})
+    engine, _, _, _ = deepspeed.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+    seq = rng.integers(0, 1024, (4, 17)).astype(np.int32)
+    batch = (seq[:, :-1], seq[:, 1:])
+    l1 = float(engine.eval_batch(batch, rng=jax.random.PRNGKey(1)))
+    l2 = float(engine.eval_batch(batch, rng=jax.random.PRNGKey(2)))
+    assert l1 == l2, f"eval loss depends on rng → dropout ran: {l1} vs {l2}"
