@@ -106,29 +106,33 @@ def gpt2_block_forward(c, p, x, rng, deterministic, causal_mask, attend,
     H, hd = c.n_head, c.head_dim
     r1, r2, r3 = jax.random.split(rng, 3)
 
-    h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
-    qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, T, H, hd)
-    k = k.reshape(B, T, H, hd)
-    v = v.reshape(B, T, H, hd)
-    mask = causal_mask
-    if c.local_attn_window is not None and is_local is not None:
-        # GPT-Neo: odd layers attend within a sliding window
-        pos = jnp.arange(T)
-        local = (pos[None, :] > pos[:, None] - c.local_attn_window)
-        local_mask = causal_mask & local[None, None]
-        mask = jnp.where(is_local, local_mask, causal_mask)
-    attn = attend(q, k, v, mask, r1, deterministic)
-    attn = attn.reshape(B, T, D)
-    attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
-    x = x + _dropout(attn, c.resid_pdrop, r2, deterministic)
+    # named_scope: the flops profiler attributes compiled work to these
+    # module scopes (reference per-module hooks, profiler.py:230)
+    with jax.named_scope("attention"):
+        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
+        qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, hd)
+        k = k.reshape(B, T, H, hd)
+        v = v.reshape(B, T, H, hd)
+        mask = causal_mask
+        if c.local_attn_window is not None and is_local is not None:
+            # GPT-Neo: odd layers attend within a sliding window
+            pos = jnp.arange(T)
+            local = (pos[None, :] > pos[:, None] - c.local_attn_window)
+            local_mask = causal_mask & local[None, None]
+            mask = jnp.where(is_local, local_mask, causal_mask)
+        attn = attend(q, k, v, mask, r1, deterministic)
+        attn = attn.reshape(B, T, D)
+        attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+        x = x + _dropout(attn, c.resid_pdrop, r2, deterministic)
 
-    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
-    h = h @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    h = h @ p["fc_proj_w"].astype(h.dtype) + p["fc_proj_b"].astype(h.dtype)
-    return x + _dropout(h, c.resid_pdrop, r3, deterministic)
+    with jax.named_scope("mlp"):
+        h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
+        h = h @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        h = h @ p["fc_proj_w"].astype(h.dtype) + p["fc_proj_b"].astype(h.dtype)
+        return x + _dropout(h, c.resid_pdrop, r3, deterministic)
 
 
 class GPT2:
@@ -256,9 +260,12 @@ class GPT2:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         dtype = self.dtype
 
-        pos = jnp.arange(T)
-        x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[pos]
-        x = _dropout(x, c.embd_pdrop, jax.random.fold_in(rng, 17), deterministic)
+        with jax.named_scope("embedding"):
+            pos = jnp.arange(T)
+            x = (params["wte"].astype(dtype)[tokens]
+                 + params["wpe"].astype(dtype)[pos])
+            x = _dropout(x, c.embd_pdrop, jax.random.fold_in(rng, 17),
+                         deterministic)
         causal_mask = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
 
         block = self._block
@@ -276,15 +283,19 @@ class GPT2:
             return h, None
 
         layer_rngs = jax.random.split(jax.random.fold_in(rng, 31), c.n_layer)
-        x, _ = jax.lax.scan(scan_body, x,
-                            (params["blocks"], layer_rngs, local_flags))
+        with jax.named_scope("blocks"):
+            x, _ = jax.lax.scan(scan_body, x,
+                                (params["blocks"], layer_rngs, local_flags))
 
-        x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], c.layer_norm_eps)
-        # tied output head: bf16 operands, fp32 accumulation — full MXU rate
-        # (a pure-fp32 matmul here runs at half rate and is ~25% of 125M FLOPs)
-        logits = jnp.einsum("btd,vd->btv", x,
-                            params["wte"].astype(x.dtype),
-                            preferred_element_type=jnp.float32)
+        with jax.named_scope("lm_head"):
+            x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                            c.layer_norm_eps)
+            # tied output head: bf16 operands, fp32 accumulation — full MXU
+            # rate (a pure-fp32 matmul here runs at half rate and is ~25% of
+            # 125M FLOPs)
+            logits = jnp.einsum("btd,vd->btv", x,
+                                params["wte"].astype(x.dtype),
+                                preferred_element_type=jnp.float32)
         return logits
 
     # ------------------------------------------------------- KV-cache decode

@@ -38,6 +38,11 @@ class GPT2Embedding:
         return (params["wte"].astype(self.dtype)[tokens]
                 + params["wpe"].astype(self.dtype)[jnp.arange(T)])
 
+    def partition_specs(self):
+        """Vocab-parallel embedding (Megatron ``VocabParallelEmbedding``):
+        XLA turns the sharded-table gather into local lookup + collective."""
+        return {"wte": P("tensor", None), "wpe": P()}
+
 
 class GPT2Block:
     """One causal transformer block (layer protocol, (B,T,D) → (B,T,D))."""
@@ -82,6 +87,19 @@ class GPT2Block:
         return gpt2_block_forward(c, params, x, rng, deterministic, causal,
                                   attend)
 
+    def partition_specs(self):
+        """Megatron column→row sharding inside the block (PP×TP): attention
+        and MLP each do one column-parallel then one row-parallel matmul, so
+        the only tensor collective per sub-block is the output reduce."""
+        return {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "qkv_w": P(None, "tensor"), "qkv_b": P("tensor"),
+            "proj_w": P("tensor", None), "proj_b": P(),
+            "ln2_scale": P(), "ln2_bias": P(),
+            "fc_w": P(None, "tensor"), "fc_b": P("tensor"),
+            "fc_proj_w": P("tensor", None), "fc_proj_b": P(),
+        }
+
 
 class GPT2Head:
     """Epilogue: hidden → logits (untied head; PP keeps the embedding on
@@ -104,6 +122,17 @@ class GPT2Head:
                         c.layer_norm_eps)
         return jnp.einsum("btd,dv->btv", x, params["head_w"].astype(x.dtype),
                           preferred_element_type=jnp.float32)
+
+    def partition_specs(self):
+        """Row-parallel LM head: the CONTRACTING (n_embd) dim shards over
+        'tensor', so logits are replicated after the reduce and the softmax
+        sees a full vocab row.  (Megatron's vocab-parallel column head —
+        ``P(None, 'tensor')`` — trips an XLA SPMD-partitioner CHECK
+        (spmd_partitioner_util.cc:495) when partitioned inside the
+        manual-'pipe' shard_map region, so the row layout is the TPU-safe
+        choice here.)"""
+        return {"lnf_scale": P(), "lnf_bias": P(),
+                "head_w": P("tensor", None)}
 
 
 def lm_loss(logits, labels):

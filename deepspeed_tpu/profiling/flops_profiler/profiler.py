@@ -76,19 +76,114 @@ def jaxpr_flops(jaxpr) -> dict:
                     for item in param:
                         if hasattr(item, "jaxpr"):
                             visit(item.jaxpr)
-            if name == "dot_general":
-                counts[name] = counts.get(name, 0) + _dot_general_flops(eqn)
-            elif name == "conv_general_dilated":
-                counts[name] = counts.get(name, 0) + _conv_flops(eqn)
-            elif name in _ELEMENTWISE:
-                size = int(np.prod(eqn.outvars[0].aval.shape, initial=1))
-                counts[name] = counts.get(name, 0) + size
-            elif name in _REDUCTIONS:
-                size = int(np.prod(eqn.invars[0].aval.shape, initial=1))
-                counts[name] = counts.get(name, 0) + size
+            fl = _eqn_flops(eqn)
+            if fl:
+                counts[name] = counts.get(name, 0) + fl
 
     visit(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
     return counts
+
+
+# -------------------------------------------------- per-module scope tree
+def _eqn_flops(eqn) -> int:
+    name = eqn.primitive.name
+    if name == "dot_general":
+        return _dot_general_flops(eqn)
+    if name == "conv_general_dilated":
+        return _conv_flops(eqn)
+    if name in _ELEMENTWISE:
+        return int(np.prod(eqn.outvars[0].aval.shape, initial=1))
+    if name in _REDUCTIONS:
+        return int(np.prod(eqn.invars[0].aval.shape, initial=1))
+    return 0
+
+
+class ModuleNode:
+    """One node of the per-module profile tree (reference: per-``nn.Module``
+    hook accounting, ``profiler.py:60-120``; here a ``jax.named_scope``)."""
+
+    __slots__ = ("name", "flops", "ops", "children")
+
+    def __init__(self, name):
+        self.name = name
+        self.flops = 0
+        self.ops: dict = {}       # primitive name -> flops (this scope only)
+        self.children: dict = {}  # scope name -> ModuleNode
+
+    def child(self, name):
+        if name not in self.children:
+            self.children[name] = ModuleNode(name)
+        return self.children[name]
+
+    @property
+    def macs(self):
+        return self.flops // 2
+
+    def as_dict(self):
+        return {"flops": self.flops, "macs": self.macs,
+                "ops": dict(self.ops),
+                "children": {k: v.as_dict() for k, v in self.children.items()}}
+
+
+def _scope_path(eqn):
+    s = str(eqn.source_info.name_stack)
+    return [p for p in s.split("/") if p] if s else []
+
+
+def module_tree(jaxpr, scale: int = 1) -> ModuleNode:
+    """Walk a (closed) jaxpr attributing analytic flops to the
+    ``jax.named_scope`` tree.
+
+    Control-flow handling (the TPU analogue of the reference's per-module
+    hooks, which see every eager call):
+
+    - ``scan``: body flops × trip count, attributed under the scan's scope —
+      a scanned layer stack reports the whole stack's flops;
+    - ``while``: body counted once (trip count is dynamic);
+    - ``cond``: the most expensive branch (upper bound);
+    - ``pjit``/``remat``/``custom_*``: descend transparently.
+    """
+    root = ModuleNode("model")
+
+    def add(path, prim, fl):
+        node = root
+        node.flops += fl
+        for part in path:
+            node = node.child(part)
+            node.flops += fl
+        node.ops[prim] = node.ops.get(prim, 0) + fl
+
+    def visit(jx, prefix, scale):
+        for eqn in jx.eqns:
+            path = prefix + _scope_path(eqn)
+            name = eqn.primitive.name
+            if name == "scan":
+                visit(eqn.params["jaxpr"].jaxpr, path,
+                      scale * int(eqn.params["length"]))
+            elif name == "while":
+                visit(eqn.params["body_jaxpr"].jaxpr, path, scale)
+            elif name == "cond":
+                best, best_fl = None, -1
+                for br in eqn.params["branches"]:
+                    t = module_tree(br, scale)
+                    if t.flops > best_fl:
+                        best, best_fl = br, t.flops
+                if best is not None:
+                    visit(best.jaxpr, path, scale)
+            elif "jaxpr" in eqn.params and hasattr(eqn.params["jaxpr"], "eqns"):
+                visit(eqn.params["jaxpr"], path, scale)
+            elif "jaxpr" in eqn.params and hasattr(eqn.params["jaxpr"], "jaxpr"):
+                visit(eqn.params["jaxpr"].jaxpr, path, scale)
+            elif "call_jaxpr" in eqn.params:
+                cj = eqn.params["call_jaxpr"]
+                visit(cj.jaxpr if hasattr(cj, "jaxpr") else cj, path, scale)
+            else:
+                fl = _eqn_flops(eqn) * scale
+                if fl:
+                    add(path, name, fl)
+
+    visit(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr, [], scale)
+    return root
 
 
 # ------------------------------------------------------------- formatting
@@ -145,6 +240,7 @@ class FlopsProfiler:
         self._duration = 0.0
         self._breakdown = {}
         self._bytes = None
+        self._tree: Optional[ModuleNode] = None
 
     # -- direct profiling of a callable ------------------------------------
     def profile_callable(self, fn: Callable, *args, **kwargs):
@@ -159,10 +255,19 @@ class FlopsProfiler:
         self._flops = int(ca.get("flops", 0) or 0)
         self._bytes = ca.get("bytes accessed")
         try:
-            self._breakdown = jaxpr_flops(jax.make_jaxpr(fn)(*args, **kwargs)) \
-                if not hasattr(fn, "lower") else {}
+            jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+            self._tree = module_tree(jaxpr)
+            acc: dict = {}
+            def collect(node):
+                for k, v in node.ops.items():
+                    acc[k] = acc.get(k, 0) + v
+                for ch in node.children.values():
+                    collect(ch)
+            collect(self._tree)
+            self._breakdown = acc
         except Exception:
             self._breakdown = {}
+            self._tree = None
         if self._flops == 0 and self._breakdown:
             self._flops = sum(self._breakdown.values())
         self._macs = self._flops // 2
@@ -216,6 +321,11 @@ class FlopsProfiler:
     def get_total_params(self, as_string=False):
         return params_to_string(self._params) if as_string else self._params
 
+    def get_module_profile(self):
+        """The per-module tree as nested dicts (reference: per-module
+        ``__flops__``/``__macs__`` attributes readable after profiling)."""
+        return self._tree.as_dict() if self._tree is not None else None
+
     # -- report (reference :230 print_model_profile) ------------------------
     def print_model_profile(self, profile_step=1, module_depth=-1,
                             top_modules=1, detailed=True, output_file=None):
@@ -240,6 +350,34 @@ class FlopsProfiler:
         if self._bytes:
             add(f"bytes accessed (HBM model):                       "
                 f"{number_to_string(float(self._bytes))}B")
+        if self._tree is not None and self._tree.children:
+            # ---- aggregated per-module profile (reference :477
+            # print_model_aggregated_profile: depth-limited, top-k modules)
+            total = self._tree.flops or 1
+            dur = self._duration
+
+            add("\n----------------------------- Aggregated Profile per "
+                "Module -----------------------------")
+            add("module flops are analytic (jaxpr walk over named_scope "
+                "attribution); latency is\nattributed proportional to flops "
+                "(fused XLA programs have no per-module timers)")
+
+            def emit(node, depth, indent):
+                kids = sorted(node.children.values(), key=lambda n: -n.flops)
+                shown = kids if top_modules < 0 else kids[:top_modules]
+                for ch in shown:
+                    lat = dur * ch.flops / total if dur else 0.0
+                    add(f"{indent}{ch.name}: "
+                        f"{flops_to_string(ch.flops)}, "
+                        f"{macs_to_string(ch.macs)}, "
+                        f"{100.0 * ch.flops / total:.2f}% flops, "
+                        f"latency {duration_to_string(lat)}")
+                    if module_depth < 0 or depth + 1 < module_depth:
+                        emit(ch, depth + 1, indent + "  ")
+                if len(kids) > len(shown):
+                    add(f"{indent}... ({len(kids) - len(shown)} more)")
+
+            emit(self._tree, 0, "  ")
         if detailed and self._breakdown:
             add("\nper-primitive analytic flops:")
             total = sum(self._breakdown.values()) or 1

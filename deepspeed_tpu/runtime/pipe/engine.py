@@ -13,22 +13,31 @@ schedule — every tick of every stage — is a single ``lax.scan`` inside a
   ``schedule.py``); total ticks = M + S - 1;
 - stage-to-stage transfer = ``ppermute`` ring rotation (the p2p of
   ``pipe/p2p.py:48,69``), which XLA overlaps with compute over ICI;
-- the backward pipeline is NOT hand-written: ``jax.grad`` through the scan +
-  ppermute yields exactly the reverse schedule, with grad transfers as the
-  transposed ppermutes (reference ``_exec_send_grads``/``_exec_recv_grads``);
+- TRAINING runs the 1F1B timetable with a HAND-WRITTEN backward: each scan
+  tick performs (at most) one forward micro-batch AND one backward
+  micro-batch per stage.  Stage ``s`` forwards micro-batch ``f`` at tick
+  ``f + s`` and backwards micro-batch ``b`` at tick ``b + 2S - 1 - s`` —
+  the cotangent produced by stage ``s+1`` at tick ``t`` arrives at stage
+  ``s`` exactly at tick ``t + 1``.  Saved state is a circular buffer of
+  ``num_pipe_buffers = 2S`` boundary activations per stage (the reference's
+  ``schedule.py:243 num_pipe_buffers`` bound), so live memory is **O(S),
+  independent of M** — the 1F1B property the reference's ``TrainSchedule``
+  (``schedule.py:182``) exists to provide.  Backward recomputes the stage
+  body from the saved boundary input (1F1B + activation checkpointing);
+- forward sends are ``ppermute`` ring rotations (the p2p of
+  ``pipe/p2p.py:48,69``); backward cotangent sends are the reverse rotation
+  (reference ``_exec_send_grads``/``_exec_recv_grads``); XLA overlaps both
+  with compute over ICI;
 - tied-weight gradient reduction (reference ``_exec_reduce_tied_grads`` :240)
-  falls out of autodiff: prologue/epilogue params enter the shard_map
-  replicated over 'pipe', so their cotangents are psum'd automatically;
+  is a psum over 'pipe' of the prologue/epilogue cotangents (stage 0
+  contributes the embedding-use grads, stage S-1 the head-use grads);
 - the first-iteration tensor-shape handshake (``:836 _send_tensor_meta``)
   disappears — shapes are static under jit;
 - loss aggregation from the last stage (``:552 _aggregate_total_loss``) is a
   masked psum.
 
-Memory: activations live at stage boundaries for all M in-flight
-micro-batches (GPipe profile).  ``activation_checkpoint_interval != 0`` remats
-the stage body so only the boundary activations persist — the same highwater
-the reference's 1F1B + activation checkpointing achieves, without interleaved
-manual backward.
+EVALUATION (forward only) keeps the simpler all-forward scan
+(``_pipeline_loss``), which needs no saved activations at all.
 """
 
 import jax
@@ -98,19 +107,205 @@ class PipelineEngine(DeepSpeedEngine):
                                  stages=self.num_stages, stage_id=stage_id)
 
     # ------------------------------------------------------------- gradients
+    @property
+    def num_pipe_buffers(self):
+        """1F1B live-activation bound per stage (reference
+        ``schedule.py:243``): independent of micro-batch count M."""
+        return 2 * self.num_stages
+
     def _grad_fn(self, base, batch, rng, cur_scale):
-        """Pipelined forward + autodiff backward (replaces the gas scan)."""
+        """Pipelined 1F1B forward/backward (replaces the gas scan).
+
+        The cast (master→compute) and the sharding constraint are linear /
+        identity maps, so gradients w.r.t. ``base`` equal the hand-computed
+        gradients w.r.t. the casted params, cast back to fp32.
+        """
         dtype = self.compute_dtype
         needs_master = dtype != jnp.float32
-
-        def total_loss(base_params):
-            p = tree_cast(base_params, dtype) if needs_master else base_params
-            p = zpart.constrain(p, self._param_specs, self.mesh)
-            return self._pipeline_loss(p, batch, rng) * cur_scale
-
-        scaled_loss, grads = jax.value_and_grad(total_loss)(base)
-        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        p = tree_cast(base, dtype) if needs_master else base
+        p = zpart.constrain(p, self._param_specs, self.mesh)
+        scaled_loss, grads = self._pipeline_grads(p, batch, rng, cur_scale)
         return grads, scaled_loss
+
+    def _pipeline_grads(self, params, batch, rng, cur_scale):
+        """Hand-scheduled 1F1B: returns ``(mean_loss * cur_scale, grads)``
+        with fp32 grads structured like ``params``.
+
+        Timetable (stage ``s`` of ``S``, micro-batch index in ``[0, M)``,
+        ticks ``t in [0, M + 2S - 1)``):
+
+        - forward of micro-batch ``f`` runs at tick ``t = f + s``;
+        - backward of micro-batch ``b`` runs at tick ``t = b + 2S - 1 - s``;
+        - both transfers are one-tick ppermutes, so activations/cotangents
+          arrive exactly when consumed.
+
+        A micro-batch's boundary input is held for ``2(S - s) - 1`` ticks in a
+        ``2S``-slot circular buffer; the stage body is recomputed from it in
+        backward (activation checkpointing), so live activation memory is
+        O(S·micro) while the reference's GPipe profile is O(M·micro).
+        """
+        module = self.module
+        S = self.num_stages
+        B = self.num_pipe_buffers
+        inputs, labels = _split_labels(batch)
+        M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+        T = M + 2 * S - 1
+        interval = int(module.activation_checkpoint_interval)
+        L = module.layers_per_stage
+
+        def per_stage(stages_local, other_p, inp, lab, key):
+            s = lax.axis_index("pipe")
+            local = jax.tree_util.tree_map(lambda a: a[0], stages_local)
+            is_last = s == S - 1
+
+            def _vary_one(a):
+                if "pipe" in getattr(jax.typeof(a), "vma", frozenset()):
+                    return a        # pcast rejects varying→varying
+                return lax.pcast(a, ("pipe",), to="varying")
+            varying = lambda v: jax.tree_util.tree_map(_vary_one, v)
+            # CRITICAL: differentiate w.r.t. a pipe-VARYING view of the
+            # replicated prologue/epilogue params.  vjp w.r.t. an invariant
+            # input inserts an implicit psum over 'pipe' at the use site —
+            # inside the per-stage conds below that psum would be executed by
+            # only some stages (deadlock).  With a varying view the cotangent
+            # stays local; the single explicit psum happens after the scan.
+            other_v = varying(other_p)
+
+            def load_mb(tree, f):
+                return jax.tree_util.tree_map(lambda a: a[f], tree)
+
+            # rngs depend only on (micro-batch, stage, layer-slot) — NEVER the
+            # tick — so backward recompute sees identical dropout masks.
+            def r_for(f, slot):
+                return jax.random.fold_in(key, (f * S + s) * (L + 2) + slot)
+
+            def stage_fwd(local_p, other_p2, x_recv, f):
+                """Stage forward incl. prologue/input-select; differentiable
+                w.r.t. (local_p, other_p2, x_recv).  The ``where`` masks the
+                prologue's cotangent to stage 0 automatically."""
+                x0 = module.prologue_apply(other_p2, load_mb(inp, f),
+                                           rng=r_for(f, L))
+                h = jnp.where(s == 0, x0, x_recv)
+
+                def chunk(lo, hi):
+                    def run(h2, f2):
+                        for j in range(lo, hi):
+                            h2 = module.slot_apply(j, local_p[j], h2,
+                                                   r_for(f2, j))
+                        return h2
+                    return run
+
+                step_sz = interval if interval > 0 else L
+                for lo in range(0, L, step_sz):
+                    c = chunk(lo, min(lo + step_sz, L))
+                    if interval > 0:
+                        c = jax.checkpoint(c)
+                    h = c(h, f)
+                return h
+
+            def head_loss(other_p2, y, b):
+                """Epilogue + loss on the last stage; scaled seed for the
+                mean over M micro-batches."""
+                out = module.epilogue_apply(other_p2, y, rng=r_for(b, L + 1))
+                lb = load_mb(lab, b)
+                loss = module.compute_loss(out, lb).astype(jnp.float32)
+                return loss * (cur_scale / M)
+
+            # shape/dtype protos (never executed on real data), typed as
+            # pipe-varying so cond branches / scan carries agree (shard_map
+            # vma typing)
+            x_proto = jax.eval_shape(
+                lambda op: module.prologue_apply(op, load_mb(inp, 0),
+                                                 rng=r_for(0, L)), other_p)
+            zero_x = varying(jnp.zeros(x_proto.shape, x_proto.dtype))
+            zeros_local = varying(jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), local))
+            zeros_other = varying(jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), other_p))
+            zero_f32 = varying(jnp.float32(0.0))
+
+            def tick(carry, t):
+                # UNIFORM execution: every device runs the identical op
+                # sequence every tick, with inactive work masked by `where`.
+                # No `lax.cond` on stage-dependent predicates: the auto-axis
+                # (data/tensor) collectives XLA inserts inside a branch would
+                # then be executed by only some pipe stages — deadlock.
+                buf, y_send, g_send, gl, go, lacc = carry
+                # receives: activation from s-1 (down ring), cotangent from
+                # s+1 (up ring) — both from the PREVIOUS tick's sends.
+                down = [(i, (i + 1) % S) for i in range(S)]
+                up = [((i + 1) % S, i) for i in range(S)]
+                x_recv = lax.ppermute(y_send, "pipe", down)
+                g_recv = lax.ppermute(g_send, "pipe", up)
+
+                # ---------------- forward: micro-batch f = t - s ------------
+                f = t - s
+                f_act = (f >= 0) & (f < M)
+                fc = jnp.clip(f, 0, M - 1)
+                y = stage_fwd(local, other_v, x_recv, fc)
+                # save the boundary input; OOB index B drops the write on
+                # inactive ticks (no full-buffer select)
+                slot = jnp.where(f_act, fc % B, B)
+                buf = buf.at[slot].set(x_recv, mode="drop")
+
+                # ---------------- backward: micro-batch b = t-(2S-1)+s ------
+                b = t - (2 * S - 1) + s
+                b_act = (b >= 0) & (b < M)
+                bc = jnp.clip(b, 0, M - 1)
+
+                x_saved = buf[bc % B]
+                y_r, vjp_fn = jax.vjp(
+                    lambda lp, op, xr: stage_fwd(lp, op, xr, bc),
+                    local, other_v, x_saved)
+                # seed: last stage differentiates epilogue+loss; other stages
+                # use the received cotangent.  The head runs on every stage
+                # (masked) to keep the op sequence uniform.
+                sl, (g_oe, g_y_last) = jax.value_and_grad(
+                    head_loss, argnums=(0, 1))(other_v, y_r, bc)
+                g_y = jnp.where(is_last, g_y_last.astype(y_r.dtype), g_recv)
+                d_local, d_other, d_x = vjp_fn(g_y)
+
+                mask = lambda z: jax.tree_util.tree_map(
+                    lambda a: jnp.where(b_act, a.astype(jnp.float32), 0.0), z)
+                gl = jax.tree_util.tree_map(jnp.add, gl, mask(d_local))
+                go = jax.tree_util.tree_map(jnp.add, go, mask(d_other))
+                go = jax.tree_util.tree_map(
+                    lambda a, e: a + jnp.where(b_act & is_last,
+                                               e.astype(jnp.float32), 0.0),
+                    go, g_oe)
+                lacc = lacc + jnp.where(b_act & is_last, sl, 0.0)
+                # mask sends so bubble-tick garbage never reaches active ticks
+                y_send_n = jnp.where(f_act, y, 0.0).astype(y.dtype)
+                g_send_n = jnp.where(b_act, d_x, 0.0).astype(d_x.dtype)
+                return (buf, y_send_n, g_send_n, gl, go, lacc), None
+
+            carry0 = (
+                varying(jnp.zeros((B,) + x_proto.shape, x_proto.dtype)),
+                zero_x,                              # y_send
+                zero_x,                              # g_send
+                zeros_local, zeros_other, zero_f32)
+            (_, _, _, gl, go, lacc), _ = lax.scan(
+                tick, carry0, jnp.arange(T))
+
+            # stage grads: re-add the stage axis; shard_map concatenates over
+            # 'pipe'.  Prologue/epilogue grads: psum reduces the per-stage
+            # contributions (stage 0 / stage S-1; zeros elsewhere) — the
+            # reference's tied-grad allreduce (pipe/module.py:419).
+            gl = jax.tree_util.tree_map(lambda a: a[None], gl)
+            go = lax.psum(go, "pipe")
+            scaled_loss = lax.psum(jnp.where(is_last, lacc, 0.0), "pipe")
+            return scaled_loss, gl, go
+
+        fn = jax.shard_map(per_stage, mesh=self.mesh,
+                           in_specs=(P("pipe"), P(), P(), P(), P()),
+                           out_specs=(P(), P("pipe"), P()),
+                           axis_names={"pipe"})
+        stages = params["stages"]
+        other = {k: v for k, v in params.items() if k != "stages"}
+        scaled_loss, g_stages, g_other = fn(stages, other, inputs, labels, rng)
+        grads = dict(g_other)
+        grads["stages"] = g_stages
+        return scaled_loss, grads
 
     # ------------------------------------------------------- fused pipeline
     def _pipeline_loss(self, params, batch, rng, train=True):

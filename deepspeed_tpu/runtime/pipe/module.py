@@ -255,20 +255,45 @@ class PipelineModule:
                     and self.epilogue.tied_to is not None)
 
     def partition_specs(self, params=None):
-        """'pipe' sharding on the leading stage axis of every stacked slot;
-        prologue/epilogue replicated over 'pipe' (engine composes fsdp/tensor
-        on the remaining axes)."""
+        """'pipe' sharding on the leading stage axis of every stacked slot,
+        composed with each layer's own tensor-parallel specs when the layer
+        declares ``partition_specs()`` (Megatron column/row sharding inside a
+        stage → PP×TP); prologue/epilogue use their layer's specs directly
+        (replicated over 'pipe')."""
         if params is None:
             params = jax.eval_shape(self.init, jax.random.PRNGKey(0))
-        def spec_of(path0, leaf):
-            ndim = len(np.shape(leaf))
-            if path0 == "stages":
-                return P("pipe", *([None] * (ndim - 1)))
-            return P()
         out = {}
-        for key, sub in params.items():
-            out[key] = jax.tree_util.tree_map(
-                lambda l, k=key: spec_of(k, l), sub)
+
+        slots = params["stages"]
+        stage0 = self.forward_funcs[self.parts[0]:self.parts[1]]
+        stage_specs = []
+        for j, slot in enumerate(slots):
+            layer = stage0[j]
+            tp = (layer.partition_specs() if hasattr(layer, "partition_specs")
+                  else None)
+            def compose(leaf, path_spec):
+                ndim = len(np.shape(leaf))
+                rest = (tuple(path_spec) + (None,) * (ndim - 1 - len(path_spec))
+                        if path_spec is not None else (None,) * (ndim - 1))
+                return P("pipe", *rest)
+            if tp is None:
+                stage_specs.append(jax.tree_util.tree_map(
+                    lambda l: compose(l, None), slot))
+            else:
+                stage_specs.append(jax.tree_util.tree_map(
+                    lambda l, sp: compose(l, sp), slot, tp))
+        out["stages"] = stage_specs
+
+        for key, edge in (("prologue", self.prologue), ("epilogue", self.epilogue)):
+            if key not in params:
+                continue
+            layer = getattr(edge, "layer", edge)
+            tp = (layer.partition_specs() if hasattr(layer, "partition_specs")
+                  else None)
+            if tp is None:
+                out[key] = jax.tree_util.tree_map(lambda l: P(), params[key])
+            else:
+                out[key] = tp
         return out
 
     # Applied by PipelineEngine inside its shard_map region:

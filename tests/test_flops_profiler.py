@@ -55,6 +55,50 @@ def test_get_model_profile_gpt2():
     assert flops > 0.1 * approx, (flops, approx)
 
 
+def test_module_profile_tree_gpt2():
+    """Per-module attribution (reference print_model_profile:230 —
+    module_depth/top_modules semantics): a depth-2 tree for GPT-2 with
+    per-scope flops that add up."""
+    cfg = GPT2Config(vocab_size=256, max_seq=64, n_embd=64, n_layer=3,
+                     n_head=4, embd_pdrop=0, attn_pdrop=0, resid_pdrop=0,
+                     attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 32), jnp.int32)
+
+    prof = FlopsProfiler(model=model)
+    prof.profile_callable(lambda p, t: model.apply(p, t), params, tokens)
+    tree = prof.get_module_profile()
+    assert tree is not None
+
+    # depth 1: the model's named_scope sections
+    kids = tree["children"]
+    assert {"embedding", "blocks", "lm_head"} <= set(kids), kids.keys()
+    # depth 2: block internals, through the scanned layer stack
+    blocks = kids["blocks"]["children"]
+    assert {"attention", "mlp"} <= set(blocks), blocks.keys()
+
+    B, T, D, L, V = 2, 32, cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    # scan scaling: mlp flops = L * (2 matmuls: 2*B*T*D*4D each) + elementwise
+    mlp_matmul = L * 2 * (2 * B * T * D * 4 * D)
+    got_mlp = blocks["mlp"]["flops"]
+    assert abs(got_mlp - mlp_matmul) / mlp_matmul < 0.2, (got_mlp, mlp_matmul)
+    # attention qkv+proj matmuls + attention itself
+    attn_min = L * (2 * B * T * D * 3 * D + 2 * B * T * D * D) * 2 // 2
+    assert blocks["attention"]["flops"] > attn_min * 0.8
+    # head: one (B*T, D) x (D, V) matmul
+    head = kids["lm_head"]["flops"]
+    assert abs(head - 2 * B * T * D * V) / (2 * B * T * D * V) < 0.2, head
+    # parents accumulate children
+    assert tree["flops"] >= kids["blocks"]["flops"] + head
+
+    # print path: module_depth / top_modules honored
+    txt = prof.print_model_profile(module_depth=1, top_modules=2,
+                                   output_file=None)
+    assert "Aggregated Profile per Module" in txt
+    assert "blocks" in txt
+
+
 def test_engine_flops_profiler_prints(devices, capsys):
     model = SimpleModel(dim=8)
     cfg = base_config(micro=4, over={

@@ -221,6 +221,76 @@ def test_gpt2_pipeline_trains(devices):
     assert np.mean(losses[-2:]) < np.mean(losses[:2])
 
 
+def test_pipe_1f1b_memory_bounded(devices):
+    """1F1B property: live activation memory is O(S), independent of the
+    micro-batch count M (reference ``schedule.py:243 num_pipe_buffers``).
+    A GPipe profile stacks O(M) boundary activations; compiled temp memory
+    would grow ~linearly in M.  Here quadrupling M must grow temps by far
+    less than the activation the GPipe stack would add."""
+    DIM_BIG, MB = 256, 32
+
+    def temp_bytes(gas):
+        specs = [LayerSpec(L.Linear, DIM_BIG, DIM_BIG, init_std=0.1)
+                 for _ in range(4)]
+        model = PipelineModule(layers=specs, num_stages=2, loss_fn=mse_loss,
+                               partition_method="uniform")
+        config = {
+            "train_micro_batch_size_per_gpu": MB // 4,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000,
+            "mesh": {"axes": {"pipe": 2, "data": 4}},
+        }
+        engine, _, _, _ = deepspeed.initialize(model=model, config=config)
+        rng = np.random.default_rng(0)
+        mb = (rng.standard_normal((MB, DIM_BIG)).astype(np.float32),
+              rng.standard_normal((MB, DIM_BIG)).astype(np.float32))
+        batch = engine._stack_microbatches([mb] * gas)
+        key = jax.random.PRNGKey(0)
+        lowered = engine._jit_train_step.lower(engine.state, batch, key)
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    t_small, t_big = temp_bytes(4), temp_bytes(16)
+    act_bytes = MB * DIM_BIG * 4          # one boundary activation (fp32)
+    # GPipe stacking would add >= (16-4) extra boundary activations of temp
+    gpipe_growth = 12 * act_bytes
+    growth = t_big - t_small
+    assert growth < gpipe_growth / 2, (
+        f"temp memory grew {growth}B when M went 4→16; a bounded 1F1B "
+        f"schedule must not stack O(M) activations (GPipe ≈ +{gpipe_growth}B)")
+
+
+def test_pipe_tensor_parallel_composition(devices):
+    """PP×TP×DP 3D composition: pipelined GPT-2 with Megatron column/row
+    specs inside each stage must train and match the PP×DP loss sequence
+    (parallelism modes must not change the math)."""
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline
+
+    def run(mesh_axes, steps=4):
+        model = gpt2_pipeline(preset="gpt2-tiny", num_stages=2,
+                              dtype=jnp.float32, attn_pdrop=0.0,
+                              resid_pdrop=0.0)
+        engine, _, _, _ = deepspeed.initialize(
+            config=CONFIG(1, gas=2), model=model,
+            mesh=make_mesh(mesh_axes))
+        # sanity: TP specs actually reached the engine's param shardings
+        if mesh_axes.get("tensor", 1) > 1:
+            sp = model.partition_specs()
+            assert any("tensor" in str(s)
+                       for s in jax.tree_util.tree_leaves(sp["stages"][0],
+                                is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))), sp
+        rng = np.random.default_rng(0)
+        seq = rng.integers(0, 1024, (4, 33)).astype(np.int32)
+        batch = (seq[:, :-1], seq[:, 1:])
+        return [float(engine.train_batch(iter([batch] * 2)))
+                for _ in range(steps)]
+
+    base = run({"pipe": 2, "data": 4})
+    tp = run({"pipe": 2, "tensor": 2, "data": 2})
+    np.testing.assert_allclose(base, tp, rtol=2e-3,
+                               err_msg=f"{base} vs {tp}")
+
+
 def test_pipe_eval_is_deterministic_despite_dropout(devices):
     """eval_batch must not run dropout (reference eval-mode semantics) —
     repeated evals with different rngs agree, and match the train-path loss
