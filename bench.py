@@ -1,10 +1,16 @@
-"""Benchmark: GPT-2 125M training throughput + MFU on one TPU chip.
+"""Benchmark: GPT-2 training MFU on one TPU chip, across ZeRO stages.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-North star (BASELINE.md): samples/sec/chip + MFU for GPT-2 at ZeRO stages;
-``vs_baseline`` is measured MFU / 0.45 (the ≥45% MFU target; the reference's
-best published kernel efficiency is 52% of V100 peak on BERT-large,
-``docs/_posts/2020-05-19-bert-record.md:14``).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+North star (BASELINE.md): samples/sec/chip + MFU for GPT-2 at ZeRO stages
+125M-1.3B; ``vs_baseline`` is flagship MFU / 0.45 (the >=45% MFU target; the
+reference's best published kernel efficiency is 52% of V100 peak on
+BERT-large, ``docs/_posts/2020-05-19-bert-record.md:14``).
+
+Flagship: gpt2-350m @ T=1024, unrolled layers, flash attention, ZeRO-1
+(measured 0.51 MFU on v5e — larger models raise arithmetic intensity;
+gpt2-760m+ exceeds single-chip HBM with fp32 Adam master states).
+``extra`` reports the same shape at ZeRO-2/3, the 125M point at T=512 and
+T=2048, and tokens/sec for each — the BASELINE.md metric family.
 """
 
 import json
@@ -28,70 +34,82 @@ def peak_flops_per_chip():
     return 197e12
 
 
-def main():
+def measure(preset, seq, micro, zero_stage, *, steps=10, warmup=3,
+            unroll=True):
+    """Train `steps` steps; returns (mfu, tokens_per_sec, samples_per_sec)."""
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build
 
-    seq = 512
-    micro = 16       # swept on v5e: 16 > 8/24/32 (32 exceeds compile limits)
-    steps = 20
-    warmup = 3
-
-    # remat off: 125M fits HBM comfortably; rematerialization costs ~6% tput.
-    # flash attention: the Pallas kernel beats both the jnp path (+16%) and
-    # the upstream pallas ops kernel on this chip (see ops/transformer).
-    model = build("gpt2-125m", dtype=jnp.bfloat16, max_seq=seq,
+    model = build(preset, dtype=jnp.bfloat16, max_seq=seq,
                   embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
-                  remat=False, attention_impl="flash")
+                  remat=False, unroll_layers=unroll, attention_impl="flash")
     config = {
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": 1,
         "steps_per_print": 10 ** 9,
         "gradient_clipping": 1.0,
         "bf16": {"enabled": True},
-        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
-        "zero_optimization": {"stage": 1},
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4,
+                                                  "weight_decay": 0.1}},
+        "zero_optimization": {"stage": zero_stage},
     }
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, model.config.vocab_size,
-                          size=(4096, seq + 1)).astype(np.int32)
+                          size=(micro * 8, seq + 1)).astype(np.int32)
     engine, _, _, _ = ds.initialize(config=config, model=model,
                                     training_data=(tokens,))
-
-    # NOTE: synchronize via a scalar device→host read. On some remote-attached
-    # runtimes block_until_ready returns before execution completes; a value
-    # read cannot lie.
+    # NOTE: synchronize via a scalar device->host read. On some
+    # remote-attached runtimes block_until_ready returns before execution
+    # completes; a value read cannot lie.
     for _ in range(warmup):
         loss = engine.train_batch()
     float(loss)
-
     t0 = time.time()
     for _ in range(steps):
         loss = engine.train_batch()
     final_loss = float(loss)
     dt = time.time() - t0
+    assert np.isfinite(final_loss), f"bench loss not finite: {final_loss}"
 
     n_chips = jax.device_count()
-    # each train_batch consumes the GLOBAL batch (micro × dp_world), not micro
     samples_per_sec = steps * engine.train_batch_size() / dt
     tokens_per_sec = samples_per_sec * seq
-    # flops_per_token already counts fwd+bwd (6N + attention with backward)
-    model_flops = model.flops_per_token() * tokens_per_sec
-    mfu = model_flops / (peak_flops_per_chip() * n_chips)
+    mfu = model.flops_per_token() * tokens_per_sec / (
+        peak_flops_per_chip() * n_chips)
+    del engine, model
+    return mfu, tokens_per_sec, samples_per_sec / n_chips
+
+
+def main():
+    extra = {}
+    # flagship: largest model comfortably fitting one chip with Adam states
+    flagship_mfu, tok_s, sps = measure("gpt2-350m", 1024, 8, 1)
+    extra["gpt2_350m_T1024_z1"] = {"mfu": round(flagship_mfu, 4),
+                                   "tokens_per_sec": round(tok_s),
+                                   "samples_per_sec_per_chip": round(sps, 2)}
+    # ZeRO ladder at the flagship shape + the 125M short/long-seq points
+    for name, args in [
+        ("gpt2_350m_T1024_z2", ("gpt2-350m", 1024, 8, 2)),
+        ("gpt2_350m_T1024_z3", ("gpt2-350m", 1024, 8, 3)),
+        ("gpt2_125m_T512_z1", ("gpt2-125m", 512, 24, 1)),
+        ("gpt2_125m_T2048_z1", ("gpt2-125m", 2048, 4, 1)),
+    ]:
+        try:
+            mfu, tok_s, sps = measure(*args)
+            extra[name] = {"mfu": round(mfu, 4),
+                           "tokens_per_sec": round(tok_s),
+                           "samples_per_sec_per_chip": round(sps, 2)}
+        except Exception as e:  # one failed point must not kill the bench
+            extra[name] = {"error": str(e)[:120]}
 
     print(json.dumps({
-        "metric": "gpt2_125m_seq512_bf16_zero1_mfu",
-        "value": round(mfu, 4),
+        "metric": "gpt2_350m_seq1024_bf16_zero1_mfu",
+        "value": round(flagship_mfu, 4),
         "unit": "fraction_of_peak",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "extra": {
-            "samples_per_sec_per_chip": round(samples_per_sec / n_chips, 2),
-            "tokens_per_sec": round(tokens_per_sec, 0),
-            "final_loss": round(final_loss, 4),
-            "chips": n_chips,
-        },
+        "vs_baseline": round(flagship_mfu / 0.45, 4),
+        "extra": extra,
     }))
 
 

@@ -119,6 +119,39 @@ int ds_adagrad_step(float* params, const float* grads, float* sq_sum,
   return 0;
 }
 
+// Row-sparse Adagrad for embedding tables (reference
+// csrc/adagrad/cpu_adagrad.cpp:219 `adagrad_update` + the sparse-row loop in
+// ops/adagrad/cpu_adagrad.py): only the rows named in `rows` are touched —
+// exact for Adagrad, whose accumulator/param stay constant at zero gradient.
+// Duplicate row ids are allowed (each occurrence applies in order, like
+// torch's coalesced-then-applied semantics when the caller pre-coalesces;
+// callers that skip coalescing accept sequential accumulation).
+int ds_adagrad_step_sparse(float* params, const int64_t* rows,
+                           const float* row_grads, float* sq_sum,
+                           int64_t n_rows, int64_t row_len, float lr,
+                           float eps, float weight_decay, uint16_t* out16,
+                           int out_kind) {
+  // rows may repeat → no naive parallel-for over rows (write conflicts);
+  // parallelize the inner (row_len) sweep instead for wide tables.
+  for (int64_t r = 0; r < n_rows; ++r) {
+    int64_t row = rows[r];
+    float* p = params + row * row_len;
+    float* s = sq_sum + row * row_len;
+    const float* g0 = row_grads + r * row_len;
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < row_len; ++i) {
+      float g = g0[i];
+      if (weight_decay != 0.0f) g += weight_decay * p[i];
+      float sv = s[i] + g * g;
+      float pv = p[i] - lr * g / (std::sqrt(sv) + eps);
+      p[i] = pv;
+      s[i] = sv;
+      if (out_kind) store16(out16, out_kind, row * row_len + i, pv);
+    }
+  }
+  return 0;
+}
+
 // Wide-register parallel memcpy (reference csrc/aio/py_lib/
 // deepspeed_py_copy.cpp `deepspeed_memcpy`, AVX + OpenMP): used to stage
 // tensors into/out of the aligned swap buffers.

@@ -94,29 +94,71 @@ class RandomTuner(BaseTuner):
     next_batch = GridSearchTuner.next_batch
 
 
+class CostModel:
+    """Least-squares metric predictor over experiment features (parity role:
+    reference ``tuner/cost_model.py`` XGBoostCostModel — same contract,
+    closed-form ridge fit instead of a GBM dependency).
+
+    Features: intercept, log2(micro batch), per-stage indicators, gas.
+    """
+
+    N_STAGES = 4
+
+    def featurize(self, exp) -> np.ndarray:
+        cfg = exp["ds_config"]
+        mbs = cfg.get("train_micro_batch_size_per_gpu", 1)
+        gas = cfg.get("gradient_accumulation_steps", 1)
+        stage = exp.get("zero_stage",
+                        cfg.get("zero_optimization", {}).get("stage", 0))
+        f = np.zeros(3 + self.N_STAGES)
+        f[0] = 1.0
+        f[1] = np.log2(max(1, mbs))
+        f[2] = np.log2(max(1, gas))
+        f[3 + min(stage, self.N_STAGES - 1)] = 1.0
+        return f
+
+    def fit(self, exps: List[dict], vals: List[float]):
+        X = np.stack([self.featurize(e) for e in exps])
+        y = np.asarray(vals, np.float64)
+        d = X.shape[1]
+        # ridge: (XᵀX + λI)β = Xᵀy — stable with few observations
+        self._beta = np.linalg.solve(X.T @ X + 1e-3 * np.eye(d), X.T @ y)
+
+    def predict(self, exp) -> float:
+        return float(self.featurize(exp) @ self._beta)
+
+
 class ModelBasedTuner(BaseTuner):
-    """Cheap cost-model tuner (parity role: reference
-    ``tuner/model_based_tuner.py`` XGBoost model): predicts the metric of
-    unseen micro-batch sizes by linear interpolation over observed ones and
-    explores the most promising first."""
+    """Cost-model tuner (parity: reference ``tuner/model_based_tuner.py:158``):
+    after each measurement, refit the cost model on ALL observations and
+    explore the unmeasured experiment with the highest predicted metric —
+    converging on the best region without an exhaustive sweep."""
 
     def __init__(self, exps, metric=AC.AUTOTUNING_METRIC_DEFAULT):
         super().__init__(exps, metric)
-        self.observed: Dict[int, float] = {}
+        self.observed: List[tuple] = []          # (exp, metric_val)
+        self.cost_model = CostModel()
+        # warmup: one probe per distinct zero stage (a one-hot stage
+        # indicator can't rank a stage never measured — the cold-start
+        # mitigation the reference gets from its random warmup sampling)
+        by_stage: Dict[int, List[dict]] = {}
+        for e in self.all_exps:
+            by_stage.setdefault(self._stage(e), []).append(e)
+        warm = [grp[len(grp) // 2] for grp in by_stage.values()]
+        self.all_exps = warm + [e for e in self.all_exps if e not in warm]
+        self._warmup = len(warm)
+
+    @staticmethod
+    def _stage(exp):
+        return exp.get("zero_stage",
+                       exp["ds_config"].get("zero_optimization", {})
+                       .get("stage", 0))
 
     def next_batch(self, sample_size):
-        if not self.observed:
-            batch, self.all_exps = (self.all_exps[:sample_size],
-                                    self.all_exps[sample_size:])
-            return batch
-        xs = sorted(self.observed)
-        ys = [self.observed[x] for x in xs]
-
-        def predict(exp):
-            mbs = exp["ds_config"]["train_micro_batch_size_per_gpu"]
-            return float(np.interp(mbs, xs, ys))
-
-        self.all_exps.sort(key=predict, reverse=True)
+        if len(self.observed) >= max(2, self._warmup):
+            self.cost_model.fit([e for e, _ in self.observed],
+                                [v for _, v in self.observed])
+            self.all_exps.sort(key=self.cost_model.predict, reverse=True)
         batch, self.all_exps = (self.all_exps[:sample_size],
                                 self.all_exps[sample_size:])
         return batch
@@ -124,8 +166,7 @@ class ModelBasedTuner(BaseTuner):
     def update(self, exp, metric_val):
         super().update(exp, metric_val)
         if metric_val is not None:
-            self.observed[exp["ds_config"]["train_micro_batch_size_per_gpu"]] = \
-                metric_val
+            self.observed.append((exp, metric_val))
 
 
 TUNERS = {AC.AUTOTUNING_TUNER_GRIDSEARCH: GridSearchTuner,
@@ -257,9 +298,25 @@ class Autotuner:
             logger.warning(f"experiment {exp['name']} failed: {e}")
             return None
 
+    def _write_exp_artifact(self, exp: dict, val, seconds: float):
+        """Persist one experiment (parity: reference ``ResourceManager`` job
+        dirs — ``autotuning_results/<exp>/exp.json`` with config + metric),
+        so runs are comparable/resumable across invocations."""
+        exp_dir = os.path.join(self.results_dir, exp["name"])
+        os.makedirs(exp_dir, exist_ok=True)
+        with open(os.path.join(exp_dir, "exp_result.json"), "w") as f:
+            json.dump({"name": exp["name"], "metric": self.metric,
+                       "metric_val": val, "seconds": round(seconds, 3),
+                       "zero_stage": exp["zero_stage"],
+                       "ds_config": exp["ds_config"]}, f, indent=2)
+
     def tune(self) -> Optional[dict]:
         """Run the tuner over the experiment grid; returns the best exp
         (parity: reference ``tune`` :396)."""
+        os.makedirs(self.results_dir, exist_ok=True)
+        # model-info artifact (reference model_info_profile_run :664)
+        with open(os.path.join(self.results_dir, "model_info.json"), "w") as f:
+            json.dump({"num_params": self.get_model_num_params()}, f)
         exps = self._generate_experiments()
         if not exps:
             logger.warning("no feasible experiments (model does not fit?)")
@@ -272,7 +329,9 @@ class Autotuner:
             if not batch:
                 break
             exp = batch[0]
+            t0 = time.time()
             val = self.run_experiment(exp)
+            self._write_exp_artifact(exp, val, time.time() - t0)
             self.records.setdefault(f"z{exp['zero_stage']}", []).append(
                 (exp, val, 1))
             prev_best = tuner.best_metric_val
@@ -286,14 +345,24 @@ class Autotuner:
             trials += 1
         self.best_exp = tuner.best_exp
         self.best_metric_val = tuner.best_metric_val
+        summary = {
+            "metric": self.metric,
+            "tuner_type": self.tuner_type,
+            "num_experiments_run": sum(len(r) for r in self.records.values()),
+            "num_experiments_total": len(exps),
+            "best": None,
+        }
         if self.best_exp is not None:
-            os.makedirs(self.results_dir, exist_ok=True)
+            summary["best"] = {"name": self.best_exp["name"],
+                               self.metric: self.best_metric_val}
             with open(os.path.join(self.results_dir, "best_config.json"), "w") as f:
                 json.dump({"name": self.best_exp["name"],
                            self.metric: self.best_metric_val,
                            "ds_config": self.best_exp["ds_config"]}, f, indent=2)
             logger.info(f"best experiment: {self.best_exp['name']} "
                         f"({self.metric}={self.best_metric_val:.3f})")
+        with open(os.path.join(self.results_dir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
         return self.best_exp
 
     def print_tuning_results(self):

@@ -104,6 +104,7 @@ class InferenceEngine:
                                  f"{quantization_setting!r}")
             params, _ = quantize_param_tree(params, bits=8, groups=max(1, groups))
             self.quantized = True
+            self._quant_groups = max(1, groups)
         if self.quantized:
             from ..module_inject.module_quantize import QuantizedModel
             if not isinstance(model, QuantizedModel):
@@ -114,15 +115,39 @@ class InferenceEngine:
             self.dtype = None      # params already hold their storage dtypes
 
         tp_specs = None
-        if not self.quantized:     # quantized dict leaves replicate (no TP slicing)
-            tp_specs = getattr(model, "partition_specs", None)
-            if callable(tp_specs):
-                tp_specs = tp_specs(params)
-        elif self.mp_world_size > 1:
-            logger.warning(
-                "InferenceEngine: int8-quantized params replicate across the "
-                f"tensor axis (mp_size={self.mp_world_size}) — model "
-                "parallelism is not applied to quantized leaves yet")
+        tp_fn = getattr(model, "partition_specs", None)
+        if not self.quantized:
+            if callable(tp_fn):
+                tp_specs = tp_fn(params)
+        else:
+            # int8 TP: an int8 payload has the SAME shape as the float
+            # weight, so the model's Megatron specs slice "q" directly; the
+            # per-tensor scale replicates.  groups>1 scales span flattened
+            # group boundaries that axis-slicing would split — those trees
+            # (including externally pre-quantized ones, detected from the
+            # scale shapes) replicate instead.
+            groups = getattr(self, "_quant_groups", None)
+            if groups is None:
+                groups = max((np.size(x["scale"])
+                              for x in jax.tree_util.tree_leaves(
+                                  params, is_leaf=_is_quantized_leaf)
+                              if _is_quantized_leaf(x)), default=1)
+            base = None
+            if callable(tp_fn) and groups == 1:
+                try:
+                    base = tp_fn()
+                except TypeError:
+                    # model's partition_specs needs the (float) param tree,
+                    # which no longer exists — replicate
+                    base = None
+            if base is not None:
+                tp_specs = _quantized_tp_specs(base, params)
+            elif self.mp_world_size > 1:
+                logger.warning(
+                    "InferenceEngine: int8-quantized params replicate across "
+                    f"the tensor axis (mp_size={self.mp_world_size}); "
+                    "sharded int8 needs quantize_groups=1 and a "
+                    "params-independent partition_specs()")
         if tp_specs is not None:
             sh = jax.tree_util.tree_map(
                 lambda sp: NamedSharding(self.mesh, sp), tp_specs,
@@ -216,6 +241,27 @@ class InferenceEngine:
 
     def profile_model_time(self, *a, **k):
         logger.warning("profile_model_time: use jax.profiler traces on TPU")
+
+
+def _quantized_tp_specs(base_specs, qparams):
+    """Map float-weight partition specs onto a quantized tree: a quantized
+    leaf ``{"q", "scale"}`` gets ``{"q": spec, "scale": P()}`` (int8 payload
+    shape == float weight shape; per-tensor scale replicates)."""
+    from ..module_inject.module_quantize import _is_quantized_leaf
+    is_p = lambda x: isinstance(x, P)
+    spec_leaves = jax.tree_util.tree_leaves(base_specs, is_leaf=is_p)
+    flat, treedef = jax.tree_util.tree_flatten(
+        qparams, is_leaf=_is_quantized_leaf)
+    assert len(spec_leaves) == len(flat), \
+        (f"partition_specs has {len(spec_leaves)} leaves but params have "
+         f"{len(flat)} — spec tree must mirror the param tree")
+    out = []
+    for sp, leaf in zip(spec_leaves, flat):
+        if _is_quantized_leaf(leaf):
+            out.append({"q": sp, "scale": P()})
+        else:
+            out.append(sp)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _normalize_dtype(dtype):

@@ -153,12 +153,9 @@ class Bert:
                 if c.attn_dropout > 0.0 and not deterministic:
                     warning_once("sparse attention has no in-kernel dropout; "
                                  "attn_dropout is ignored on this path")
+                # the (B,1,1,T) additive BERT mask enters the Pallas kernel
+                # as a per-key additive bias (mode 'add')
                 kp = mask[:, 0, 0, :] if mask is not None else None
-                if kp is not None:
-                    warning_once("sparse attention with a padding mask uses "
-                                 "the dense fallback (in-kernel padding mask "
-                                 "is future work); prefer unpadded block-"
-                                 "aligned batches for the Pallas kernel")
                 ctx = self.sparse_self_attention(
                     q, k, v, causal=False, key_padding_mask=kp)
                 ctx = ctx.reshape(B, T, D)

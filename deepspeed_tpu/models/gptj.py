@@ -48,10 +48,12 @@ class GPTJConfig:
     attn_pdrop: float = 0.0
     resid_pdrop: float = 0.0
     remat: bool = True
-    # NOTE: the attention core is always the jnp path here — rotary q/k feed
-    # a standard scaled-causal attention; a flash variant with pre-rotated
-    # inputs is possible but not yet wired (no attention_impl knob to avoid
-    # advertising a switch that does nothing)
+    # attention core: rotary q/k feed a STANDARD scaled-causal attention, so
+    # the Pallas flash kernel applies directly to the pre-rotated inputs
+    # (reference applies rotary in-kernel, apply_rotary_pos_emb.cu:378 —
+    # here rotation is a cheap elementwise op XLA fuses into the qkv matmul,
+    # and the kernel sees ordinary q/k).  "auto" picks flash on TPU.
+    attention_impl: str = "auto"
 
     @property
     def head_dim(self):
@@ -160,8 +162,7 @@ class GPTJ:
         q, k, v = f(q), f(k), f(v)
         q = apply_rotary_pos_emb(q, cos, sin, positions, c.neox_style)
         k = apply_rotary_pos_emb(k, cos, sin, positions, c.neox_style)
-        attn = _attention_jnp(q, k, v, causal_mask, c.attn_pdrop, r1,
-                              deterministic)
+        attn = self._attend(q, k, v, causal_mask, r1, deterministic)
         attn = attn.reshape(B, T, D)
         attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
         attn = _dropout(attn, c.resid_pdrop, r2, deterministic)
@@ -182,6 +183,25 @@ class GPTJ:
         m_in = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps) \
             if c.dual_layernorm else x
         return x + mlp(m_in)
+
+    def _attend(self, q, k, v, causal_mask, rng, deterministic):
+        """Rotary inputs → standard causal attention core (flash on TPU)."""
+        c = self.config
+        impl = c.attention_impl
+        wants_dropout = c.attn_pdrop > 0.0 and not deterministic
+        if impl == "auto":
+            from ..ops import flash_attention_available
+            impl = ("flash" if flash_attention_available() and not wants_dropout
+                    else "jnp")
+        if impl == "flash":
+            if wants_dropout:
+                from ..utils.logging import warning_once
+                warning_once("attention_impl='flash' has no in-kernel dropout; "
+                             "attn_pdrop is ignored on this path")
+            from ..ops.transformer.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=True)
+        return _attention_jnp(q, k, v, causal_mask, c.attn_pdrop, rng,
+                              deterministic)
 
     def apply(self, params, tokens, rng=None, deterministic=True):
         c = self.config
@@ -308,9 +328,11 @@ class GPTJ:
         from .gpt2 import GPT2
         tokens, labels = GPT2._split_batch(batch)
         logits = self.apply(params, tokens, rng=rng, deterministic=False)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        # lse − label_logit (no (B,T,V) log-softmax materialization)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        label_logit = jnp.take_along_axis(
+            logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return jnp.mean(lse - label_logit)
 
     def num_params(self):
         shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))

@@ -88,7 +88,8 @@ class MoE:
                  eval_capacity_factor: float = 1.0, min_capacity: int = 4,
                  use_residual: bool = False,
                  noisy_gate_policy: Optional[str] = None,
-                 drop_tokens: bool = True, use_rts: bool = True):
+                 drop_tokens: bool = True, use_rts: bool = True,
+                 max_capacity: Optional[int] = None):
         self.hidden_size = hidden_size
         self.num_experts = num_experts
         # ep_size is advisory here: actual expert parallelism is the mesh's
@@ -107,7 +108,7 @@ class MoE:
         self.moe_layer = MOELayer(
             TopKGate(hidden_size, num_experts, k, capacity_factor,
                      eval_capacity_factor, min_capacity, noisy_gate_policy,
-                     drop_tokens, use_rts),
+                     drop_tokens, use_rts, max_capacity=max_capacity),
             Experts(expert, num_experts))
 
     def init(self, rng):

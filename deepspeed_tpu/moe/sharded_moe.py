@@ -58,11 +58,20 @@ def _keep_topc_per_expert(priority, mask, capacity: int):
 def top1gating(logits, capacity_factor: float, min_capacity: int,
                *, rng=None, used_token=None,
                noisy_gate_policy: Optional[str] = None,
-               drop_tokens: bool = True, use_rts: bool = True):
+               drop_tokens: bool = True, use_rts: bool = True,
+               max_capacity: Optional[int] = None):
     """Top-1 gating (reference ``sharded_moe.py:172-275``).
 
     logits: (S, E) fp32.  Returns ``(l_aux, combine_weights (S,E,C),
     dispatch_mask (S,E,C) bool, exp_counts (E,))``.
+
+    ``drop_tokens=False``: the reference sizes capacity with a runtime
+    max-allreduce over actual expert load (:213-217); XLA static shapes
+    forbid that, so the worst case is ``capacity = tokens`` — an S×E×S
+    dispatch tensor.  ``max_capacity`` bounds it: capacity =
+    ``min(tokens, max_capacity)``, and if an expert's demand exceeds the
+    bound the lowest-priority overflow IS dropped (choose the bound from
+    the observed ``exp_counts`` high-water mark).
     """
     logits = logits.astype(jnp.float32)
     num_tokens, num_experts = logits.shape
@@ -79,8 +88,10 @@ def top1gating(logits, capacity_factor: float, min_capacity: int,
     if drop_tokens:
         capacity = compute_capacity(num_tokens, num_experts, capacity_factor,
                                     min_capacity)
+    elif max_capacity is not None:
+        capacity = min(num_tokens, int(max_capacity))
     else:
-        capacity = num_tokens  # static worst case (see module docstring)
+        capacity = num_tokens  # static worst case (see docstring)
 
     indices1_s = jnp.argmax(logits_w_noise if noisy_gate_policy == "RSample"
                             else gates, axis=1)
@@ -183,7 +194,8 @@ class TopKGate:
     def __init__(self, model_dim: int, num_experts: int, k: int = 1,
                  capacity_factor: float = 1.0, eval_capacity_factor: float = 1.0,
                  min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
-                 drop_tokens: bool = True, use_rts: bool = True):
+                 drop_tokens: bool = True, use_rts: bool = True,
+                 max_capacity: Optional[int] = None):
         if k not in (1, 2):
             raise ValueError("Only top-1 and top-2 gatings are supported.")
         self.model_dim = model_dim
@@ -195,6 +207,7 @@ class TopKGate:
         self.noisy_gate_policy = noisy_gate_policy
         self.drop_tokens = drop_tokens
         self.use_rts = use_rts
+        self.max_capacity = max_capacity
 
     def init(self, rng):
         scale = 1.0 / math.sqrt(self.model_dim)
@@ -219,5 +232,6 @@ class TopKGate:
             return top1gating(logits, cf, self.min_capacity, rng=rng,
                               used_token=used_token,
                               noisy_gate_policy=noisy,
-                              drop_tokens=self.drop_tokens, use_rts=self.use_rts)
+                              drop_tokens=self.drop_tokens, use_rts=self.use_rts,
+                              max_capacity=self.max_capacity)
         return top2gating(logits, cf, self.min_capacity, rng=rng)

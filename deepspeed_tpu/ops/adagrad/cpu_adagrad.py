@@ -1,17 +1,30 @@
-"""Adagrad optimizer.
+"""Adagrad optimizer — device pytree path + native host path.
 
 Parity: reference ``deepspeed/ops/adagrad/cpu_adagrad.py`` (DeepSpeedCPUAdagrad
-bound to the AVX kernel ``csrc/adagrad/cpu_adagrad.cpp:219-226``).  The update
-math is identical; "CPU" in the reference name refers to the offload execution
-tier — here the same class runs on-device by default and participates in the
-host-offload tier via the engine's offload configs (see
-``runtime/swap_tensor``).
+bound to the AVX kernel ``csrc/adagrad/cpu_adagrad.cpp:219-226``, including the
+sparse-embedding row loop).  The update math is identical.  Two tiers here:
+
+- device (default): pure-jnp ``update`` over the params pytree (jit/SPMD);
+- host (offload): ``step_flat`` / ``step_sparse`` run the native kernel
+  (``csrc/adam/ds_cpu_adam.cpp`` ``ds_adagrad_step`` /
+  ``ds_adagrad_step_sparse``, OpenMP + auto-vectorized) over flat fp32
+  numpy buffers — ``step_sparse`` touches ONLY the rows named by an
+  (indices, values) embedding gradient, the reference's sparse path.
 """
 
+import ctypes
 from typing import NamedTuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
+
+from ..op_builder import CPUAdagradBuilder
+
+_builder = CPUAdagradBuilder()
+_f32p = ctypes.POINTER(ctypes.c_float)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u16p = ctypes.POINTER(ctypes.c_uint16)
 
 
 class AdagradState(NamedTuple):
@@ -25,6 +38,53 @@ class DeepSpeedCPUAdagrad:
         self.lr = lr
         self.eps = eps
         self.weight_decay = weight_decay
+        self._lib = _builder.load(verbose=False) \
+            if _builder.is_compatible() else None
+
+    @property
+    def is_native(self):
+        return self._lib is not None
+
+    # ------------------------------------------------- host (offload) tier
+    def step_flat(self, params, grads, sq_sum, lr=None):
+        """In-place dense Adagrad over flat fp32 numpy buffers (native
+        kernel; numpy fallback keeps the tier functional without g++)."""
+        lr = self.lr if lr is None else float(lr)
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        grads = np.ascontiguousarray(grads, np.float32)
+        if self._lib is not None:
+            self._lib.ds_adagrad_step(
+                params.ctypes.data_as(_f32p), grads.ctypes.data_as(_f32p),
+                sq_sum.ctypes.data_as(_f32p), params.size, lr, self.eps,
+                self.weight_decay, _u16p(), 0)
+            return
+        g = grads + self.weight_decay * params if self.weight_decay else grads
+        sq_sum += np.square(g)
+        params -= lr * g / (np.sqrt(sq_sum) + self.eps)
+
+    def step_sparse(self, params2d, rows, row_grads, sq_sum2d, lr=None):
+        """Row-sparse Adagrad on a (rows, dim) table: update ONLY the rows in
+        ``rows`` with gradients ``row_grads`` (n, dim) — the reference's
+        sparse-embedding path (``cpu_adagrad.py`` sparse branch).  Exact:
+        Adagrad leaves zero-gradient rows untouched."""
+        lr = self.lr if lr is None else float(lr)
+        assert params2d.ndim == 2 and params2d.dtype == np.float32
+        rows = np.ascontiguousarray(rows, np.int64)
+        row_grads = np.ascontiguousarray(row_grads, np.float32)
+        assert row_grads.shape == (rows.size, params2d.shape[1])
+        if self._lib is not None:
+            self._lib.ds_adagrad_step_sparse(
+                params2d.ctypes.data_as(_f32p), rows.ctypes.data_as(_i64p),
+                row_grads.ctypes.data_as(_f32p),
+                sq_sum2d.ctypes.data_as(_f32p), rows.size,
+                params2d.shape[1], lr, self.eps, self.weight_decay,
+                _u16p(), 0)
+            return
+        for r, g in zip(rows, row_grads):          # numpy fallback
+            if self.weight_decay:
+                g = g + self.weight_decay * params2d[r]
+            sq_sum2d[r] += np.square(g)
+            params2d[r] -= lr * g / (np.sqrt(sq_sum2d[r]) + self.eps)
 
     def init(self, params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)

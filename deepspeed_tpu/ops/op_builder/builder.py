@@ -174,6 +174,11 @@ class CPUAdamBuilder(OpBuilder):
         lib.ds_adagrad_step.argtypes = [f32p, f32p, f32p, c_i64, c_f32, c_f32,
                                         c_f32, u16p, c_int]
         lib.ds_adagrad_step.restype = c_int
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.ds_adagrad_step_sparse.argtypes = [f32p, i64p, f32p, f32p, c_i64,
+                                               c_i64, c_f32, c_f32, c_f32,
+                                               u16p, c_int]
+        lib.ds_adagrad_step_sparse.restype = c_int
         lib.ds_memcpy.argtypes = [c_void, c_void, c_i64]
         lib.ds_memcpy.restype = c_int
         lib.ds_fp32_to_bf16.argtypes = [f32p, u16p, c_i64]

@@ -11,8 +11,10 @@ compression is future work).
 Mask semantics parity (reference ``sparse_self_attention.py:46-75``):
 ``key_padding_mask`` (B, T) over keys and ``attn_mask`` (T, T) are honored
 with 'add' (additive scores) or 'mul' (multiplicative, 0 = masked) modes.
-Masked calls run a dense jnp path with the layout applied as an element mask
-— the pallas kernel has no mask operand yet.
+Masked calls run IN-KERNEL: the masks become additive score biases the
+pallas flash kernel applies before its online softmax (reference
+``softmax_kernels.cu`` masked attn_softmax) — padding no longer drops to a
+dense path.  ``_masked_dense`` remains as the numerics oracle for tests.
 """
 
 import numpy as np
@@ -20,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from .sparsity_config import SparsityConfig, FixedSparsityConfig
-from ..transformer.flash_attention import sparse_flash_attention
+from ..transformer.flash_attention import sparse_flash_attention, NEG_INF
 
 
 class SparseSelfAttention:
@@ -61,11 +63,22 @@ class SparseSelfAttention:
                   if causal is None and
                   hasattr(self.sparsity_config, "attention") else bool(causal))
         layout = jnp.asarray(self.get_layout(T))
-        if key_padding_mask is None and attn_mask is None:
-            return sparse_flash_attention(query, key, value, layout,
-                                          causal=causal, sm_scale=sm_scale)
-        return self._masked_dense(query, key, value, layout, causal, sm_scale,
-                                  key_padding_mask, attn_mask)
+        kb = self._to_additive(key_padding_mask, self.key_padding_mask_mode)
+        ab = self._to_additive(attn_mask, self.attn_mask_mode)
+        return sparse_flash_attention(query, key, value, layout,
+                                      causal=causal, sm_scale=sm_scale,
+                                      key_padding_bias=kb, attn_bias=ab)
+
+    @staticmethod
+    def _to_additive(mask, mode):
+        """'add' masks are already additive scores; 'mul' masks (0 = masked)
+        become 0 / NEG_INF biases for the kernel."""
+        if mask is None:
+            return None
+        mask = jnp.asarray(mask)
+        if mode == "add":
+            return mask.astype(jnp.float32)
+        return jnp.where(mask != 0, 0.0, NEG_INF).astype(jnp.float32)
 
     def _masked_dense(self, q, k, v, layout, causal, sm_scale,
                       key_padding_mask, attn_mask):

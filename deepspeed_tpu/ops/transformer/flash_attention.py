@@ -52,19 +52,35 @@ def _interpret():
 
 
 # =============================================================== forward kernel
+def _unpack_in_refs(refs, use_layout, use_kbias, use_abias):
+    """Input refs in call order: [layout] q k v [extras...] [kb] [ab] rest."""
+    idx = 0
+    layout_ref = refs[idx] if use_layout else None
+    idx += int(use_layout)
+    return layout_ref, idx
+
+
 def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
-                seq_len, use_layout=False, n_heads=1):
+                seq_len, use_layout=False, n_heads=1, use_kbias=False,
+                use_abias=False):
     """Grid: (BH, nq, nk) with nk innermost (revisits scratch).
 
     With ``use_layout`` a block-layout ref (SMEM scalar per (head, qi, ki))
     gates whole blocks — this is the block-sparse attention path (reference
     ``ops/sparse_attention/matmul.py`` SDD/DSD/DDS Triton kernels; here the
-    same flash kernel simply skips disallowed blocks)."""
-    if use_layout:
-        layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-        layout_ref = None
+    same flash kernel simply skips disallowed blocks).
+
+    ``use_kbias``/``use_abias``: additive score biases — (B, T) over keys
+    (padding) and (T, T) shared across batch (attention mask) — applied
+    in-kernel (reference ``softmax_kernels.cu`` attn_softmax masked paths)."""
+    layout_ref, idx = _unpack_in_refs(refs, use_layout, use_kbias, use_abias)
+    q_ref, k_ref, v_ref = refs[idx:idx + 3]
+    idx += 3
+    kb_ref = refs[idx] if use_kbias else None
+    idx += int(use_kbias)
+    ab_ref = refs[idx] if use_abias else None
+    idx += int(use_abias)
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[idx:idx + 5]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -91,6 +107,10 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        if use_kbias:
+            s = s + kb_ref[0, 0]              # (1, bk) broadcast over rows
+        if use_abias:
+            s = s + ab_ref[0, 0]              # (bq, bk)
 
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -121,6 +141,22 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
                                       (block_q, MIN_LANES))
 
 
+def _tile_kbias(kb, T, Tp, block_k):
+    """(B, T) additive key bias → (B, nk, 1, block_k) tile-major view whose
+    trailing block dims EQUAL the array dims (always Mosaic-legal, any
+    block size)."""
+    B = kb.shape[0]
+    kb = jnp.pad(kb.astype(jnp.float32), ((0, 0), (0, Tp - T)))
+    return kb.reshape(B, Tp // block_k, 1, block_k)
+
+
+def _tile_abias(ab, T, Tp, block_q, block_k):
+    """(T, T) additive score bias → (nq, nk, block_q, block_k) tiles."""
+    ab = jnp.pad(ab.astype(jnp.float32), ((0, Tp - T), (0, Tp - T)))
+    return (ab.reshape(Tp // block_q, block_q, Tp // block_k, block_k)
+            .transpose(0, 2, 1, 3))
+
+
 def _pad_t(x, Tp):
     T = x.shape[1]
     if T == Tp:
@@ -129,10 +165,12 @@ def _pad_t(x, Tp):
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
-         n_heads=None):
+         n_heads=None, k_bias=None, attn_bias=None):
     """q,k,v: (BH, T, d) → (out (BH, T, d), lse (BH, T)).
 
-    ``layout``: optional (n_heads, nq, nk) int32 block mask (block-sparse)."""
+    ``layout``: optional (n_heads, nq, nk) int32 block mask (block-sparse).
+    ``k_bias``: optional (B, T) additive key bias (padding mask).
+    ``attn_bias``: optional (T, T) additive score bias (attention mask)."""
     BH, T, d = q.shape
     block_q, block_k = _auto_blocks(T, d, block_q, block_k)
     block_q = min(block_q, T)
@@ -159,11 +197,23 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
         # program ids — per-block blocking would violate Mosaic lane tiling
         in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
         args = (layout,) + args
+    H = n_heads or 1
+    if k_bias is not None:                    # (B, T) → (B, nk, 1, bk)
+        k_bias = _tile_kbias(k_bias, T, Tp, block_k)
+        in_specs.append(pl.BlockSpec((1, 1, 1, block_k),
+                                     lambda b, i, j: (jax.lax.div(b, H), j, 0, 0)))
+        args = args + (k_bias,)
+    if attn_bias is not None:                 # (T, T) → (nq, nk, bq, bk)
+        attn_bias = _tile_abias(attn_bias, T, Tp, block_q, block_k)
+        in_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
+                                     lambda b, i, j: (i, j, 0, 0)))
+        args = args + (attn_bias,)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
                           seq_len=T, use_layout=layout is not None,
-                          n_heads=n_heads or 1),
+                          n_heads=H, use_kbias=k_bias is not None,
+                          use_abias=attn_bias is not None),
         grid=(BH, nq, nk),
         in_specs=in_specs,
         out_specs=[
@@ -188,15 +238,17 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
 
 # ============================================================== backward kernels
 def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
-                     seq_len, use_layout=False, n_heads=1):
+                     seq_len, use_layout=False, n_heads=1, use_kbias=False,
+                     use_abias=False):
     """Grid: (BH, nk, nq) with nq innermost; accumulates dK/dV for one k block."""
-    if use_layout:
-        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-        layout_ref = None
+    layout_ref, idx = _unpack_in_refs(refs, use_layout, use_kbias, use_abias)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[idx:idx + 6]
+    idx += 6
+    kb_ref = refs[idx] if use_kbias else None
+    idx += int(use_kbias)
+    ab_ref = refs[idx] if use_abias else None
+    idx += int(use_abias)
+    dk_ref, dv_ref, dk_acc, dv_acc = refs[idx:idx + 4]
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -224,6 +276,10 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
+        if use_kbias:
+            s = s + kb_ref[0, 0]
+        if use_abias:
+            s = s + ab_ref[0, 0]
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -253,14 +309,17 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
 
 
 def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
-                   seq_len, use_layout=False, n_heads=1):
+                   seq_len, use_layout=False, n_heads=1, use_kbias=False,
+                   use_abias=False):
     """Grid: (BH, nq, nk) with nk innermost; accumulates dQ for one q block."""
-    if use_layout:
-        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-         dq_acc) = refs
-    else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
-        layout_ref = None
+    layout_ref, idx = _unpack_in_refs(refs, use_layout, use_kbias, use_abias)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[idx:idx + 6]
+    idx += 6
+    kb_ref = refs[idx] if use_kbias else None
+    idx += int(use_kbias)
+    ab_ref = refs[idx] if use_abias else None
+    idx += int(use_abias)
+    dq_ref, dq_acc = refs[idx:idx + 2]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -287,6 +346,10 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
+        if use_kbias:
+            s = s + kb_ref[0, 0]
+        if use_abias:
+            s = s + ab_ref[0, 0]
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -309,7 +372,7 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
 
 
 def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
-         n_heads=None, dlse=None):
+         n_heads=None, dlse=None, k_bias=None, attn_bias=None):
     q, k, v, out, lse = residuals
     BH, T, d = q.shape
     block_q, block_k = _auto_blocks(T, d, block_q, block_k)
@@ -348,7 +411,21 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
         stat_spec_ji,                                              # lse
         stat_spec_ji,                                              # delta
     ]
+    H = n_heads or 1
+    if k_bias is not None:
+        k_bias = _tile_kbias(k_bias, k_bias.shape[1], Tp, block_k)
+    if attn_bias is not None:
+        attn_bias = _tile_abias(attn_bias, attn_bias.shape[0], Tp,
+                                block_q, block_k)
     dkdv_args = (q, k, v, dout, lse, delta)
+    if k_bias is not None:
+        dkdv_specs.append(pl.BlockSpec((1, 1, 1, block_k),
+                                       lambda b, j, i: (jax.lax.div(b, H), j, 0, 0)))
+        dkdv_args = dkdv_args + (k_bias,)
+    if attn_bias is not None:
+        dkdv_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
+                                       lambda b, j, i: (i, j, 0, 0)))
+        dkdv_args = dkdv_args + (attn_bias,)
     if layout is not None:
         dkdv_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dkdv_specs
         dkdv_args = (layout,) + dkdv_args
@@ -356,7 +433,8 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
         functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
                           seq_len=T, use_layout=layout is not None,
-                          n_heads=n_heads or 1),
+                          n_heads=H, use_kbias=k_bias is not None,
+                          use_abias=attn_bias is not None),
         grid=(BH, nk, nq),
         in_specs=dkdv_specs,
         out_specs=[
@@ -387,6 +465,14 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
         stat_spec_ij,
     ]
     dq_args = (q, k, v, dout, lse, delta)
+    if k_bias is not None:
+        dq_specs.append(pl.BlockSpec((1, 1, 1, block_k),
+                                     lambda b, i, j: (jax.lax.div(b, H), j, 0, 0)))
+        dq_args = dq_args + (k_bias,)
+    if attn_bias is not None:
+        dq_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
+                                     lambda b, i, j: (i, j, 0, 0)))
+        dq_args = dq_args + (attn_bias,)
     if layout is not None:
         dq_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dq_specs
         dq_args = (layout,) + dq_args
@@ -394,7 +480,8 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
                           seq_len=T, use_layout=layout is not None,
-                          n_heads=n_heads or 1),
+                          n_heads=H, use_kbias=k_bias is not None,
+                          use_abias=attn_bias is not None),
         grid=(BH, nq, nk),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -428,11 +515,18 @@ _flash_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q, k, v, *, causal=True, sm_scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    key_padding_bias=None, attn_bias=None):
     """Flash attention over (B, T, H, d) tensors (the model layout).
 
     Returns (B, T, H, d).  fp32 softmax statistics, input-dtype matmuls.
+    ``key_padding_bias`` (B, T) and ``attn_bias`` (T, T) are ADDITIVE score
+    biases applied in-kernel (use ``NEG_INF`` entries to mask) — the
+    reference's masked softmax kernels (``softmax_kernels.cu``).
     """
+    if key_padding_bias is not None or attn_bias is not None:
+        return _biased_call(q, k, v, None, key_padding_bias, attn_bias,
+                            sm_scale, causal, block_q, block_k)
     B, T, H, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(d)
@@ -504,7 +598,8 @@ _sparse_bhtd.defvjp(_sparse_fwd_rule, _sparse_bwd_rule)
 
 
 def sparse_flash_attention(q, k, v, layout, *, causal=True, sm_scale=None,
-                           block_q=None, block_k=None):
+                           block_q=None, block_k=None,
+                           key_padding_bias=None, attn_bias=None):
     """Block-sparse flash attention over (B, T, H, d).
 
     ``layout``: (n_heads_or_1, nq, nk) int block mask from a SparsityConfig
@@ -530,10 +625,72 @@ def sparse_flash_attention(q, k, v, layout, *, causal=True, sm_scale=None,
     if Lh == 1 and H > 1:
         layout = jnp.broadcast_to(layout, (H, nq, nk))
     layout = jnp.asarray(layout, jnp.int32)
+    if key_padding_bias is not None or attn_bias is not None:
+        return _biased_call(q, k, v, layout, key_padding_bias, attn_bias,
+                            sm_scale, causal, block_q, block_k)
     to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
     out = _sparse_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), layout,
                        float(sm_scale), bool(causal), int(block_q),
                        int(block_k), int(H))
+    return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
+# ----------------------------------------------------- biased (masked) paths
+@functools.lru_cache(maxsize=None)
+def _make_biased_bhtd(has_layout, has_kb, has_ab):
+    """custom_vjp'd flash attention with optional in-kernel additive biases.
+
+    One cached instance per (layout?, key-bias?, attn-bias?) combination so
+    unused operands never materialize.  Bias cotangents are zeros: masks are
+    constants (the reference's mask tensors carry no grad either)."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+    def f(q, k, v, layout, kb, ab, sm_scale, causal, block_q, block_k, H):
+        out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                      layout=layout if has_layout else None, n_heads=H,
+                      k_bias=kb if has_kb else None,
+                      attn_bias=ab if has_ab else None)
+        return out
+
+    def fwd_rule(q, k, v, layout, kb, ab, sm_scale, causal, block_q, block_k, H):
+        out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        layout=layout if has_layout else None, n_heads=H,
+                        k_bias=kb if has_kb else None,
+                        attn_bias=ab if has_ab else None)
+        return out, (q, k, v, out, lse, layout, kb, ab)
+
+    def bwd_rule(sm_scale, causal, block_q, block_k, H, res, dout):
+        q, k, v, out, lse, layout, kb, ab = res
+        dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k,
+                          (q, k, v, out, lse), dout,
+                          layout=layout if has_layout else None, n_heads=H,
+                          k_bias=kb if has_kb else None,
+                          attn_bias=ab if has_ab else None)
+        return (dq, dk, dv, None, jnp.zeros_like(kb), jnp.zeros_like(ab))
+
+    f.defvjp(fwd_rule, bwd_rule)
+    return f
+
+
+def _biased_call(q, k, v, layout, key_padding_bias, attn_bias, sm_scale,
+                 causal, block_q, block_k):
+    """(B, T, H, d) entry shared by the dense and block-sparse biased paths."""
+    B, T, H, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    block_q, block_k = _auto_blocks(T, d, block_q, block_k)
+    has_layout = layout is not None
+    has_kb = key_padding_bias is not None
+    has_ab = attn_bias is not None
+    dummy_i = jnp.zeros((1, 1, 1), jnp.int32)
+    dummy_f = jnp.zeros((1, 1), jnp.float32)
+    fn = _make_biased_bhtd(has_layout, has_kb, has_ab)
+    to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    out = fn(to_bhtd(q), to_bhtd(k), to_bhtd(v),
+             layout if has_layout else dummy_i,
+             jnp.asarray(key_padding_bias, jnp.float32) if has_kb else dummy_f,
+             jnp.asarray(attn_bias, jnp.float32) if has_ab else dummy_f,
+             float(sm_scale), bool(causal), int(block_q), int(block_k), int(H))
     return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
 
 

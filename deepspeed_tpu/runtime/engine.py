@@ -165,6 +165,27 @@ class DeepSpeedEngine:
                 optimizer_params=self.config.optimizer_params,
                 compute_dtype_name=self.config.precision_dtype)
 
+        # ---- sparse embedding gradients (reference engine.py:2227
+        # sparse_allreduce_no_retain) -----------------------------------------
+        # In-SPMD, gradient reduction is XLA's (sharding constraints), so the
+        # wire where sparsity pays is the offload d2h transfer: declared
+        # embedding leaves cross as (row indices, row values) instead of the
+        # dense (vocab, dim) tensor.  Opt-in via the model's
+        # ``sparse_grad_paths()`` — correctness requires the leaf to be used
+        # ONLY as a lookup table (a tied LM head makes its grad dense).
+        self._sparse_grad_paths = ()
+        if self.config.sparse_gradients_enabled:
+            declared = getattr(self.module, "sparse_grad_paths", None)
+            if callable(declared):
+                self._sparse_grad_paths = tuple(tuple(p) for p in declared())
+            if not self._sparse_grad_paths:
+                log_dist("sparse_gradients enabled but the model declares no "
+                         "sparse_grad_paths(); gradients stay dense", ranks=[0])
+            elif self._offload is None:
+                log_dist("sparse_gradients: in-SPMD reduction is handled by "
+                         "XLA sharding; the sparse wire format applies to the "
+                         "offload d2h path only", ranks=[0])
+
         # ---- initial device state -----------------------------------------
         self.state = self._init_state(params0)
         self._needs_master = self.compute_dtype != jnp.float32
@@ -468,7 +489,53 @@ class DeepSpeedEngine:
             # after the overflow check; fp16 (max 65504) must stay fp32 —
             # casting could mint inf that bypasses the skip-step logic
             grads = tree_cast(grads, jnp.bfloat16)
+        if self._sparse_grad_paths:
+            grads = self._sparsify_grads(grads, batch)
         return grads, metrics
+
+    def _sparsify_grads(self, grads, batch):
+        """Replace declared embedding-grad leaves with row-sparse
+        (indices, values) pairs for the d2h wire.
+
+        The static row bound defaults to the TOTAL integer-id count in the
+        batch — safe (a lookup touches at most one row per id) but counts
+        non-lookup int leaves like labels too (2× buffers for
+        (inputs, labels) batches).  A model can tighten it by declaring
+        ``sparse_grad_row_bound(batch) -> int`` (count only the ids that
+        actually feed its lookups); under-declaring silently DROPS gradient
+        rows, so only lookup-fed leaves may be excluded."""
+        from .sparse_tensor import SparseTensor
+        bound_fn = getattr(self.module, "sparse_grad_row_bound", None)
+        if callable(bound_fn):
+            tokens = int(bound_fn(batch))
+        else:
+            tokens = sum(int(np.prod(l.shape)) for l in
+                         jax.tree_util.tree_leaves(batch)
+                         if jnp.issubdtype(jnp.asarray(l).dtype, jnp.integer))
+        if tokens == 0:
+            return grads
+
+        def replace(tree, path):
+            key = path[0]
+            sub = tree[key]
+            if len(path) == 1:
+                assert np.ndim(sub) == 2, \
+                    f"sparse_grad_paths leaf {path} must be 2-D (rows, dim)"
+                rows = sub.shape[0]
+                if tokens >= rows:
+                    return tree  # dense is smaller; keep it
+                st = SparseTensor.from_dense(sub, max_rows=tokens)
+                out = dict(tree)
+                out[key] = {"sparse_indices": st.indices,
+                            "sparse_values": st.values}
+                return out
+            out = dict(tree)
+            out[key] = replace(sub, path[1:])
+            return out
+
+        for path in self._sparse_grad_paths:
+            grads = replace(grads, path)
+        return grads
 
     def _host_offload_update(self, grads, metrics):
         """Host half of the offload step: d2h grads → native fused Adam on

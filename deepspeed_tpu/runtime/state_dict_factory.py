@@ -78,15 +78,72 @@ class SDLoaderBase:
 
 
 class MegatronSDLoader(SDLoaderBase):
-    """Merges multi-file tensor-parallel shard dumps (parity: reference
-    ``MegatronSDLoader`` :195 — qkv/mlp merge rules)."""
+    """Merges/splits multi-file tensor-parallel shard dumps (parity:
+    reference ``MegatronSDLoader`` :195-453 — version-aware qkv rules +
+    column/row merge axes)."""
 
-    # substrings → concat axis (Megatron column-parallel outputs on the last
-    # axis, row-parallel inputs on the first weight axis)
-    COLUMN_PARALLEL = ("qkv", "query_key_value", "fc_w", "dense_h_to_4h",
-                       "attention.query", "wte")
+    # substrings → parallel class.  QKV is handled separately (fused
+    # query-key-value layouts vary across Megatron checkpoint versions).
+    QKV = ("query_key_value", "qkv")
+    COLUMN_PARALLEL = ("fc_w", "dense_h_to_4h", "attention.query", "wte",
+                       "word_embeddings")
     ROW_PARALLEL = ("proj_w", "dense_4h_to_h", "attention.dense", "fc_proj_w")
 
+    SUPPORTED_QKV_VERSIONS = (0, 1.0, 2.0)
+
+    @staticmethod
+    def _out_axis(name, arr):
+        """Torch/Megatron dumps store (out, in) → output axis 0; this
+        framework's matmul weights store (in, out) → output axis -1.  The
+        torch-style dotted names mark the layout.  Embedding tables shard
+        VOCAB-parallel on axis 0 in BOTH layouts (``wte: P('tensor', None)``,
+        models/gpt2.py partition_specs)."""
+        torch_style = ("query_key_value" in name or "dense" in name
+                       or "word_embeddings" in name or "attention." in name)
+        embedding = "wte" in name or "word_embeddings" in name
+        return 0 if torch_style or embedding or arr.ndim == 1 \
+            else arr.ndim - 1
+
+    # ------------------------------------------------ qkv (version-aware)
+    def merge_query_key_value(self, param_list, ckpt_ver, axis=0):
+        """Merge fused-qkv shards (reference :224-257).
+
+        version 0:        [(3·np·hn), h]  — components grouped q|k|v per
+                          shard: split each shard in 3, concat per component
+                          across shards, then concat the components;
+        version 1.0/2.0:  [(np·hn·3), h] / [(np·3·hn), h] — heads are the
+                          outer grouping: plain concat across shards.
+        """
+        if ckpt_ver not in self.SUPPORTED_QKV_VERSIONS:
+            raise AssertionError(
+                f"checkpoint version: {ckpt_ver} is not supported")
+        arrs = [np.asarray(p) for p in param_list]
+        if ckpt_ver == 0:
+            assert arrs[0].shape[axis] % 3 == 0
+            split_tensors = [np.split(a, 3, axis=axis) for a in arrs]
+            comps = [np.concatenate([t[i] for t in split_tensors], axis=axis)
+                     for i in range(3)]
+            return np.concatenate(comps, axis=axis)
+        return np.concatenate(arrs, axis=axis)
+
+    def split_query_key_value(self, param, num_to_split, offset, ckpt_ver,
+                              axis=0):
+        """Slice one mp_rank's fused-qkv shard back out (reference :264-300)."""
+        if ckpt_ver not in self.SUPPORTED_QKV_VERSIONS:
+            raise AssertionError(
+                f"checkpoint version: {ckpt_ver} is not supported")
+        arr = np.asarray(param)
+        if ckpt_ver == 0:
+            assert arr.shape[axis] % 3 == 0
+            comps = np.split(arr, 3, axis=axis)
+            assert comps[0].shape[axis] % num_to_split == 0
+            picked = [np.split(c, num_to_split, axis=axis)[offset]
+                      for c in comps]
+            return np.concatenate(picked, axis=axis)
+        assert arr.shape[axis] % num_to_split == 0
+        return np.split(arr, num_to_split, axis=axis)[offset]
+
+    # --------------------------------------------------------------- merge
     def merge_state_dict(self, mp_world_size, mp_rank):
         trees = []
         meta = None
@@ -94,24 +151,60 @@ class MegatronSDLoader(SDLoaderBase):
             t, m = self._load_one(path)
             trees.append(t)
             meta = meta or m
+        version = self.version if self.version is not None else 1.0
 
         def merge(key_path, leaves):
-            name = "/".join(key_path)
+            name = "/".join(str(k) for k in key_path)
             a0 = np.asarray(leaves[0])
             if all(np.asarray(l).shape == a0.shape for l in leaves[1:]):
+                if any(s in name for s in self.QKV):
+                    return self.merge_query_key_value(
+                        leaves, version, axis=self._out_axis(name, a0))
                 if any(s in name for s in self.COLUMN_PARALLEL):
                     return np.concatenate([np.asarray(l) for l in leaves],
-                                          axis=a0.ndim - 1)
+                                          axis=self._out_axis(name, a0))
                 if any(s in name for s in self.ROW_PARALLEL):
-                    axis = max(0, a0.ndim - 2)
+                    axis = 1 if self._out_axis(name, a0) == 0 \
+                        else max(0, a0.ndim - 2)
                     return np.concatenate([np.asarray(l) for l in leaves],
                                           axis=axis)
             # replicated leaves (layernorms, biases of row-parallel): take one
             return a0
 
         merged = _tree_merge(trees, merge)
-        logger.info(f"merged {len(trees)} checkpoint shards")
+        logger.info(f"merged {len(trees)} checkpoint shards "
+                    f"(qkv version {version})")
         return self.ckpt_list[0], merged, meta
+
+    # --------------------------------------------------------------- split
+    def get_split_state_dict(self, mp_world_size, mp_rank):
+        """One mp_rank's shard of the (merged) full tree — the reference's
+        split path (:374-453) for exporting to a LARGER tensor-parallel
+        degree."""
+        _, full, meta = self.load(1, 0)
+        version = self.version if self.version is not None else 1.0
+
+        def split(key_path, leaves):
+            name = "/".join(str(k) for k in key_path)
+            arr = np.asarray(leaves[0])
+            if any(s in name for s in self.QKV):
+                return self.split_query_key_value(
+                    arr, mp_world_size, mp_rank, version,
+                    axis=self._out_axis(name, arr))
+            if any(s in name for s in self.COLUMN_PARALLEL):
+                axis = self._out_axis(name, arr)
+                if arr.shape[axis] % mp_world_size == 0:
+                    return np.split(arr, mp_world_size, axis=axis)[mp_rank]
+                return arr
+            if any(s in name for s in self.ROW_PARALLEL):
+                axis = 1 if self._out_axis(name, arr) == 0 \
+                    else max(0, arr.ndim - 2)
+                if arr.ndim >= 2 and arr.shape[axis] % mp_world_size == 0:
+                    return np.split(arr, mp_world_size, axis=axis)[mp_rank]
+                return arr
+            return arr
+
+        return _tree_merge([full], split), meta
 
 
 def _tree_merge(trees, fn, path=()):

@@ -80,12 +80,23 @@ class HostOffloadOptimizer:
 
     # ------------------------------------------------------------ flattening
     def flatten_grads(self, grads_tree):
-        """Device grads pytree → flat host fp32 (the d2h transfer)."""
+        """Device grads pytree → flat host fp32 (the d2h transfer).
+
+        A leaf may arrive row-sparse as ``{"sparse_indices", "sparse_values"}``
+        (engine ``sparse_gradients`` wire format, reference
+        ``sparse_allreduce_no_retain`` engine.py:2227): only the touched rows
+        cross the wire; the host scatters them into the flat buffer."""
         leaves = self.treedef.flatten_up_to(grads_tree)
         flat = np.empty(self.numel, np.float32)
         for leaf, off, shape in zip(leaves, self.offsets, self.shapes):
             n = int(np.prod(shape or (1,)))
-            flat[off:off + n] = np.asarray(leaf, np.float32).ravel()
+            if isinstance(leaf, dict) and "sparse_indices" in leaf:
+                seg = flat[off:off + n].reshape(shape)
+                seg[...] = 0.0
+                np.add.at(seg, np.asarray(leaf["sparse_indices"]),
+                          np.asarray(leaf["sparse_values"], np.float32))
+            else:
+                flat[off:off + n] = np.asarray(leaf, np.float32).ravel()
         return flat
 
     def payload_tree(self):

@@ -1,0 +1,83 @@
+"""Host-op microbench: kernel-AIO file throughput + native CPU Adam/Adagrad.
+
+Role parity: the reference's ``csrc/aio/py_test/ds_aio_basic.py`` perf
+harness and the cpu-adam perf notes.  Prints one JSON line per op so rounds
+can be compared.
+
+Run:  python examples/bench_host_ops.py [--mb 256] [--path /tmp/ds_aio_bench]
+"""
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def bench_aio(nbytes, path, queue_depth=8, block_size=1 << 20,
+              single_submit=False, overlap_events=True):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
+                      single_submit=single_submit,
+                      overlap_events=overlap_events)
+    data = np.random.randint(0, 256, nbytes, np.uint8)
+    t0 = time.time()
+    assert h.sync_pwrite(data, path) == nbytes
+    t_write = time.time() - t0
+    out = np.zeros(nbytes, np.uint8)
+    t0 = time.time()
+    assert h.sync_pread(out, path) == nbytes
+    t_read = time.time() - t0
+    os.unlink(path)
+    return {"write_GBps": round(nbytes / t_write / 1e9, 3),
+            "read_GBps": round(nbytes / t_read / 1e9, 3)}
+
+
+def bench_cpu_adam(n):
+    from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+    opt = DeepSpeedCPUAdam(lr=1e-3)
+    p = np.random.randn(n).astype(np.float32)
+    g = np.random.randn(n).astype(np.float32)
+    m, v = opt.init_buffers(n)
+    out16 = np.empty(n, np.uint16)
+    opt.step_flat(p, g, m, v, 1)                       # warm
+    t0 = time.time()
+    steps = 5
+    for s in range(2, 2 + steps):
+        opt.step_flat(p, g, m, v, s, out16=out16, out_dtype="bfloat16")
+    dt = (time.time() - t0) / steps
+    return {"native": opt.is_native,
+            "params_per_sec_M": round(n / dt / 1e6, 1)}
+
+
+def bench_cpu_adagrad(n):
+    from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
+    opt = DeepSpeedCPUAdagrad(lr=1e-2)
+    p = np.random.randn(n).astype(np.float32)
+    g = np.random.randn(n).astype(np.float32)
+    s = np.zeros(n, np.float32)
+    opt.step_flat(p, g, s)
+    t0 = time.time()
+    steps = 5
+    for _ in range(steps):
+        opt.step_flat(p, g, s)
+    dt = (time.time() - t0) / steps
+    return {"native": opt.is_native,
+            "params_per_sec_M": round(n / dt / 1e6, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=256, help="aio file size (MiB)")
+    ap.add_argument("--path", default="/tmp/ds_aio_bench.bin")
+    ap.add_argument("--params", type=int, default=32 * 1024 * 1024)
+    args = ap.parse_args()
+
+    print(json.dumps({"op": "aio", **bench_aio(args.mb << 20, args.path)}))
+    print(json.dumps({"op": "cpu_adam", **bench_cpu_adam(args.params)}))
+    print(json.dumps({"op": "cpu_adagrad", **bench_cpu_adagrad(args.params)}))
+
+
+if __name__ == "__main__":
+    main()

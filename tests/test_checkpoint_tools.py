@@ -125,3 +125,60 @@ def test_sd_loader_merges_column_and_row_shards(tmp_path):
     assert tree["fc_w"].shape == (4, 16)
     assert tree["proj_w"].shape == (16, 4)
     assert tree["ln"].shape == (4,)
+
+
+def test_sd_loader_qkv_version0_merge(tmp_path):
+    """Version-0 Megatron fused qkv: per-shard [q|k|v] layout — the merge
+    must interleave per COMPONENT, not plain-concat (reference
+    state_dict_factory.py:224-257)."""
+    # shard r holds q_r|k_r|v_r, each of 2 rows: distinguishable values
+    def shard(r):
+        q = np.full((2, 4), 10 * r + 0, np.float32)
+        k = np.full((2, 4), 10 * r + 1, np.float32)
+        v = np.full((2, 4), 10 * r + 2, np.float32)
+        return {"transformer": {"attention": {
+            "query_key_value": np.concatenate([q, k, v], axis=0)}}}
+    p0, p1 = str(tmp_path / "q0.msgpack"), str(tmp_path / "q1.msgpack")
+    save_tree(p0, {"params": shard(0)})
+    save_tree(p1, {"params": shard(1)})
+
+    loader = SDLoaderFactory.get_sd_loader([p0, p1], version=0)
+    _, tree, _ = loader.load(mp_world_size=1, mp_rank=0)
+    merged = tree["transformer"]["attention"]["query_key_value"]
+    assert merged.shape == (12, 4)
+    # q of BOTH shards first, then k, then v
+    expect = np.concatenate([
+        np.full((2, 4), 0), np.full((2, 4), 10),    # q0, q1
+        np.full((2, 4), 1), np.full((2, 4), 11),    # k0, k1
+        np.full((2, 4), 2), np.full((2, 4), 12),    # v0, v1
+    ]).astype(np.float32)
+    np.testing.assert_array_equal(merged, expect)
+
+    # split is the exact inverse
+    loader1 = SDLoaderFactory.get_sd_loader([p0, p1], version=0)
+    sd0, _ = loader1.get_split_state_dict(2, 0)
+    sd1, _ = loader1.get_split_state_dict(2, 1)
+    np.testing.assert_array_equal(
+        sd0["transformer"]["attention"]["query_key_value"],
+        shard(0)["transformer"]["attention"]["query_key_value"])
+    np.testing.assert_array_equal(
+        sd1["transformer"]["attention"]["query_key_value"],
+        shard(1)["transformer"]["attention"]["query_key_value"])
+
+
+def test_sd_loader_qkv_version2_merge_and_unknown_version(tmp_path):
+    """Version 1.0/2.0 fused qkv is a plain concat; unknown versions must
+    fail loudly (reference asserts)."""
+    import pytest
+    shard0 = {"qkv_w": np.ones((4, 6), np.float32)}       # (in, out) layout
+    shard1 = {"qkv_w": np.ones((4, 6), np.float32) * 2}
+    p0, p1 = str(tmp_path / "v0.msgpack"), str(tmp_path / "v1.msgpack")
+    save_tree(p0, {"params": shard0})
+    save_tree(p1, {"params": shard1})
+    loader = SDLoaderFactory.get_sd_loader([p0, p1], version=2.0)
+    _, tree, _ = loader.load(mp_world_size=1, mp_rank=0)
+    assert tree["qkv_w"].shape == (4, 12)                 # out axis = last
+
+    bad = SDLoaderFactory.get_sd_loader([p0, p1], version=9.9)
+    with pytest.raises(AssertionError, match="not supported"):
+        bad.load(mp_world_size=1, mp_rank=0)

@@ -191,3 +191,41 @@ def test_cifar_cnn_trains(devices):
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
     acc = float(model.accuracy(engine.state.params, images, labels))
     assert acc > 0.2  # well above chance after a few steps
+
+
+def test_gptj_flash_attention_matches_jnp():
+    """Verdict #4: rotary models get the fast path — flash on pre-rotated
+    q/k must reproduce the jnp attention logits, fwd AND grad."""
+    import jax
+    mj = build("gptj-tiny", dtype=jnp.float32, attention_impl="jnp")
+    mf = build("gptj-tiny", dtype=jnp.float32, attention_impl="flash")
+    params = mj.init(jax.random.PRNGKey(0))
+    ids = np.random.RandomState(0).randint(0, 1024, (2, 32)).astype(np.int32)
+    lj = np.asarray(mj.apply(params, jnp.asarray(ids)))
+    lf = np.asarray(mf.apply(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(lf, lj, atol=2e-4, rtol=2e-4)
+
+    batch = jnp.asarray(np.random.RandomState(1).randint(
+        0, 1024, (2, 33)).astype(np.int32))
+    gj = jax.grad(lambda p: mj.loss(p, batch, jax.random.PRNGKey(2)))(params)
+    gf = jax.grad(lambda p: mf.loss(p, batch, jax.random.PRNGKey(2)))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(gj),
+                    jax.tree_util.tree_leaves(gf)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=5e-4, rtol=5e-3)
+
+
+def test_gptneox_flash_trains(devices):
+    """NeoX (partial-rotary, dual-LN) trains through the flash path."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    model = build("gptneox-tiny", dtype=jnp.float32, attention_impl="flash")
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 1024, size=(64, 33)).astype(np.int32)
+    engine, _, _, _ = ds.initialize(
+        config={"train_micro_batch_size_per_gpu": 4, "steps_per_print": 1000,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        model=model, training_data=(tokens,), mesh=make_mesh({"data": 8}))
+    losses = [float(engine.train_batch()) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses

@@ -147,3 +147,46 @@ def test_engine_torch_int8_dtype_spelling():
     model, params = _tiny()
     eng = InferenceEngine(model=model, params=params, dtype=torch.int8)
     assert eng.quantized
+
+
+def test_int8_tensor_parallel_slicing(devices):
+    """int8 weights must SHARD over the tensor axis when quantize_groups=1
+    (verdict #4: mp_size>1 + quantized used to silently replicate).  Logits
+    must match the single-device quantized engine."""
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    model, params = _tiny()
+    ids = np.random.RandomState(2).randint(0, 1024, (2, 12)).astype(np.int32)
+
+    eng1 = InferenceEngine(model=model, params=params, quantization_setting=1)
+    ref = np.asarray(eng1.forward(jnp.asarray(ids)))
+
+    model2, params2 = _tiny()
+    mesh = make_mesh({"data": 4, "tensor": 2})
+    eng2 = InferenceEngine(model=model2, params=params2,
+                           quantization_setting=1, mesh=mesh)
+    assert eng2.quantized and eng2.mp_world_size == 2
+    # at least one int8 payload is actually tensor-sharded
+    sharded = []
+    def check(x):
+        if isinstance(x, dict) and "q" in x:
+            spec = x["q"].sharding.spec
+            sharded.append(any("tensor" in str(s) for s in spec))
+    jax.tree_util.tree_map(check, eng2.params, is_leaf=_is_quantized_leaf)
+    assert any(sharded), "no int8 payload sharded over the tensor axis"
+    out = np.asarray(eng2.forward(jnp.asarray(ids)))
+    # TP partial-sum ordering drifts logits slightly through 4 layers of
+    # layernorm; ranking must be stable and values close
+    np.testing.assert_allclose(out, ref, atol=1e-2)
+    agree = (out.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree > 0.99, f"argmax agreement {agree}"
+
+
+def test_int8_groups_gt1_replicates_with_warning(devices):
+    """groups>1 scales can't slice; params replicate (documented fallback)."""
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    model, params = _tiny()
+    mesh = make_mesh({"data": 4, "tensor": 2})
+    eng = InferenceEngine(model=model, params=params,
+                          quantization_setting=8, mesh=mesh)
+    ids = np.random.RandomState(3).randint(0, 1024, (1, 8)).astype(np.int32)
+    assert eng.generate(ids, max_new_tokens=2).shape == (1, 10)

@@ -161,3 +161,92 @@ def test_sparse_self_attention_module():
     assert 0.0 < attn.density(128) <= 1.0
     # layout cache reused
     assert attn.get_layout(128) is attn.get_layout(128)
+
+
+# ------------------------------------------------ in-kernel masks (no fallback)
+def test_masked_call_stays_on_kernel_path(monkeypatch):
+    """A padded call must NOT route through the dense fallback — the masks
+    enter the Pallas kernel as additive biases (reference softmax_kernels.cu
+    masked attn_softmax)."""
+    q, k, v = make_qkv(T=128, H=4)
+    cfg = FixedSparsityConfig(num_heads=4, block=32, num_local_blocks=2)
+    attn = SparseSelfAttention(cfg, key_padding_mask_mode="mul")
+    called = []
+    monkeypatch.setattr(
+        SparseSelfAttention, "_masked_dense",
+        lambda self, *a, **kw: called.append(1))
+    kp = jnp.ones((1, 128), jnp.int32).at[:, 100:].set(0)
+    out = attn(q, k, v, causal=False, key_padding_mask=kp)
+    assert not called, "masked call fell back to the dense path"
+    assert out.shape == q.shape
+
+
+@pytest.mark.parametrize("kp_mode,am_mode", [("mul", "mul"), ("add", "add")])
+def test_kernel_masks_match_dense_oracle(kp_mode, am_mode):
+    """Kernel numerics with key-padding + attention masks == the dense
+    oracle, in both 'add' and 'mul' mask modes."""
+    B, T, H = 2, 128, 2
+    q, k, v = make_qkv(B=B, T=T, H=H)
+    cfg = FixedSparsityConfig(num_heads=H, block=32, num_local_blocks=2,
+                              num_global_blocks=1)
+    attn = SparseSelfAttention(cfg, key_padding_mask_mode=kp_mode,
+                               attn_mask_mode=am_mode)
+    layout = jnp.asarray(attn.get_layout(T))
+    rng = np.random.default_rng(0)
+    if kp_mode == "mul":
+        kp = jnp.asarray(rng.integers(0, 2, (B, T)).astype(np.int32))
+        am = jnp.asarray((rng.random((T, T)) > 0.1).astype(np.int32))
+    else:
+        kp = jnp.asarray(np.where(rng.integers(0, 2, (B, T)), 0.0,
+                                  -1e9).astype(np.float32))
+        am = jnp.asarray(np.where(rng.random((T, T)) > 0.1, 0.0,
+                                  -1e9).astype(np.float32))
+    out = attn(q, k, v, causal=False, key_padding_mask=kp, attn_mask=am)
+    ref = attn._masked_dense(q, k, v, layout, False, None, kp, am)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_kernel_masked_backward_matches_oracle():
+    """Gradients through the masked kernel path match the dense oracle —
+    BERT trains with real padding through the kernel."""
+    B, T, H = 2, 64, 2
+    q, k, v = make_qkv(B=B, T=T, H=H, d=16)
+    cfg = FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=2,
+                              num_global_blocks=1)
+    attn = SparseSelfAttention(cfg, key_padding_mask_mode="mul")
+    layout = jnp.asarray(attn.get_layout(T))
+    kp = jnp.ones((B, T), jnp.int32).at[:, 48:].set(0)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(jnp.square(
+            attn(q, k, v, causal=False, key_padding_mask=kp)))
+
+    def loss_oracle(q, k, v):
+        return jnp.sum(jnp.square(attn._masked_dense(
+            q, k, v, layout, False, None, kp, None)))
+
+    gs = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gs, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4,
+                                   rtol=2e-4, err_msg=f"d{name}")
+
+
+def test_flash_attention_with_padding_bias():
+    """The dense flash kernel also accepts the additive biases."""
+    from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+    B, T, H, d = 2, 128, 2, 16
+    q, k, v = make_qkv(B=B, T=T, H=H, d=d)
+    kp = jnp.where(jnp.arange(T)[None, :] < 100, 0.0, -1e9) * \
+        jnp.ones((B, 1), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, key_padding_bias=kp)
+    # oracle: causal + key mask
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    s = s + kp[:, None, None, :]
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
