@@ -132,13 +132,16 @@ int ds_adagrad_step_sparse(float* params, const int64_t* rows,
                            float eps, float weight_decay, uint16_t* out16,
                            int out_kind) {
   // rows may repeat → no naive parallel-for over rows (write conflicts);
-  // parallelize the inner (row_len) sweep instead for wide tables.
+  // parallelize the inner (row_len) sweep instead for wide tables.  One
+  // enclosing parallel region reuses the thread team across rows (a
+  // fork/join per row would dominate at typical embedding dims).
+#pragma omp parallel
   for (int64_t r = 0; r < n_rows; ++r) {
     int64_t row = rows[r];
     float* p = params + row * row_len;
     float* s = sq_sum + row * row_len;
     const float* g0 = row_grads + r * row_len;
-#pragma omp parallel for schedule(static)
+#pragma omp for schedule(static)
     for (int64_t i = 0; i < row_len; ++i) {
       float g = g0[i];
       if (weight_decay != 0.0f) g += weight_decay * p[i];

@@ -260,15 +260,39 @@ class AioHandle {
     return true;
   }
 
-  // POSIX fallback (sandboxes without io_setup).
+  // POSIX fallback (sandboxes without io_setup): keep the old
+  // segment-level fan-out — block_size segments across a local thread team
+  // — so the fallback path retains multi-threaded throughput.
   void posix_transfer(Request& req, int fd) {
+    int64_t nseg = req.count > 0 ? (req.count + block_size_ - 1) / block_size_ : 0;
+    int nthreads = static_cast<int>(std::min<int64_t>(num_threads_, nseg));
+    if (nthreads <= 1) {
+      posix_range(req, fd, 0, req.count);
+      return;
+    }
+    std::atomic<int64_t> next_seg{0};
+    std::vector<std::thread> team;
+    auto work = [&] {
+      for (;;) {
+        int64_t seg = next_seg.fetch_add(1);
+        if (seg >= nseg || req.failed.load()) return;
+        int64_t off = seg * block_size_;
+        posix_range(req, fd, off, std::min(block_size_, req.count - off));
+      }
+    };
+    for (int t = 1; t < nthreads; ++t) team.emplace_back(work);
+    work();
+    for (auto& t : team) t.join();
+  }
+
+  void posix_range(Request& req, int fd, int64_t start, int64_t len) {
     int64_t moved = 0;
-    while (moved < req.count) {
+    while (moved < len) {
       ssize_t n = req.is_read
-                      ? ::pread(fd, req.buf + moved, req.count - moved,
-                                req.offset + moved)
-                      : ::pwrite(fd, req.buf + moved, req.count - moved,
-                                 req.offset + moved);
+                      ? ::pread(fd, req.buf + start + moved, len - moved,
+                                req.offset + start + moved)
+                      : ::pwrite(fd, req.buf + start + moved, len - moved,
+                                 req.offset + start + moved);
       if (n <= 0) {
         req.failed.store(true);
         return;

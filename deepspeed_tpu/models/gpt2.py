@@ -99,6 +99,35 @@ def _attention_jnp(q, k, v, causal_mask, attn_drop, rng, deterministic,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def flash_or_jnp_attention(q, k, v, causal_mask, attn_pdrop, rng,
+                           deterministic, impl, *, scale=None,
+                           nonstandard=False):
+    """Shared standard-causal attention dispatch: resolve 'auto', warn for
+    unsupported flash combinations, run the Pallas kernel or the jnp oracle.
+    Used by every rotary/dense decoder family so the selection logic cannot
+    drift between models."""
+    wants_dropout = attn_pdrop > 0.0 and not deterministic
+    if impl == "auto":
+        from ..ops import flash_attention_available
+        impl = ("flash" if flash_attention_available() and not wants_dropout
+                and not nonstandard else "jnp")
+    if impl == "flash":
+        if nonstandard:
+            from ..utils.logging import warning_once
+            warning_once("attention_impl='flash' does not support "
+                         "scale_attn=False / local_attn_window; using the "
+                         "jnp path")
+        else:
+            if wants_dropout:
+                from ..utils.logging import warning_once
+                warning_once("attention_impl='flash' has no in-kernel "
+                             "dropout; attn_pdrop is ignored on this path")
+            from ..ops.transformer.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=True)
+    return _attention_jnp(q, k, v, causal_mask, attn_pdrop, rng,
+                          deterministic, scale=scale)
+
+
 def gpt2_block_forward(c, p, x, rng, deterministic, causal_mask, attend,
                        is_local=None):
     """One GPT-2 block (LN → attn → residual → LN → MLP → residual).
@@ -233,28 +262,9 @@ class GPT2:
                   "ring_flash": sp.ring_flash_attention,
                   "ulysses": sp.ulysses_attention}[impl]
             return fn(q, k, v, causal=True, batch_spec=batch_spec())
-        if impl == "auto":
-            from ..ops import flash_attention_available
-            # the pallas kernel has no in-kernel dropout yet; fall back to the
-            # jnp path when attention dropout is active
-            impl = ("flash" if flash_attention_available() and not wants_dropout
-                    and not nonstandard else "jnp")
-        if impl == "flash":
-            if nonstandard:
-                from ..utils.logging import warning_once
-                warning_once("attention_impl='flash' does not support "
-                             "scale_attn=False / local_attn_window; using the "
-                             "jnp path")
-            else:
-                if wants_dropout:
-                    from ..utils.logging import warning_once
-                    warning_once("attention_impl='flash' has no in-kernel "
-                                 "dropout; attn_pdrop is ignored on this path")
-                from ..ops.transformer.flash_attention import flash_attention
-                return flash_attention(q, k, v, causal=True)
-        return _attention_jnp(q, k, v, causal_mask, c.attn_pdrop, rng,
-                              deterministic,
-                              scale=None if c.scale_attn else 1.0)
+        return flash_or_jnp_attention(
+            q, k, v, causal_mask, c.attn_pdrop, rng, deterministic, impl,
+            scale=None if c.scale_attn else 1.0, nonstandard=nonstandard)
 
     def apply(self, params, tokens, rng=None, deterministic=True):
         """tokens: (B, T) int32 → logits (B, T, V)."""

@@ -186,22 +186,10 @@ class GPTJ:
 
     def _attend(self, q, k, v, causal_mask, rng, deterministic):
         """Rotary inputs → standard causal attention core (flash on TPU)."""
+        from .gpt2 import flash_or_jnp_attention
         c = self.config
-        impl = c.attention_impl
-        wants_dropout = c.attn_pdrop > 0.0 and not deterministic
-        if impl == "auto":
-            from ..ops import flash_attention_available
-            impl = ("flash" if flash_attention_available() and not wants_dropout
-                    else "jnp")
-        if impl == "flash":
-            if wants_dropout:
-                from ..utils.logging import warning_once
-                warning_once("attention_impl='flash' has no in-kernel dropout; "
-                             "attn_pdrop is ignored on this path")
-            from ..ops.transformer.flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=True)
-        return _attention_jnp(q, k, v, causal_mask, c.attn_pdrop, rng,
-                              deterministic)
+        return flash_or_jnp_attention(q, k, v, causal_mask, c.attn_pdrop,
+                                      rng, deterministic, c.attention_impl)
 
     def apply(self, params, tokens, rng=None, deterministic=True):
         c = self.config

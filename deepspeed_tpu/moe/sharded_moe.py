@@ -207,6 +207,11 @@ class TopKGate:
         self.noisy_gate_policy = noisy_gate_policy
         self.drop_tokens = drop_tokens
         self.use_rts = use_rts
+        if max_capacity is not None and k != 1:
+            raise ValueError(
+                "max_capacity bounds the drop_tokens=False top-1 gate; "
+                "top-2 gating always sizes capacity from capacity_factor "
+                f"(got k={k})")
         self.max_capacity = max_capacity
 
     def init(self, rng):

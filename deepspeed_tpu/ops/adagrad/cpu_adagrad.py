@@ -51,6 +51,8 @@ class DeepSpeedCPUAdagrad:
         kernel; numpy fallback keeps the tier functional without g++)."""
         lr = self.lr if lr is None else float(lr)
         assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        assert sq_sum.dtype == np.float32 and sq_sum.flags["C_CONTIGUOUS"], \
+            "sq_sum must be contiguous float32 (np.zeros defaults to float64)"
         grads = np.ascontiguousarray(grads, np.float32)
         if self._lib is not None:
             self._lib.ds_adagrad_step(
@@ -68,7 +70,11 @@ class DeepSpeedCPUAdagrad:
         sparse-embedding path (``cpu_adagrad.py`` sparse branch).  Exact:
         Adagrad leaves zero-gradient rows untouched."""
         lr = self.lr if lr is None else float(lr)
-        assert params2d.ndim == 2 and params2d.dtype == np.float32
+        assert params2d.ndim == 2 and params2d.dtype == np.float32 \
+            and params2d.flags["C_CONTIGUOUS"]
+        assert sq_sum2d.dtype == np.float32 \
+            and sq_sum2d.flags["C_CONTIGUOUS"], \
+            "sq_sum must be contiguous float32 (np.zeros defaults to float64)"
         rows = np.ascontiguousarray(rows, np.int64)
         row_grads = np.ascontiguousarray(row_grads, np.float32)
         assert row_grads.shape == (rows.size, params2d.shape[1])

@@ -52,12 +52,20 @@ def _interpret():
 
 
 # =============================================================== forward kernel
-def _unpack_in_refs(refs, use_layout, use_kbias, use_abias):
-    """Input refs in call order: [layout] q k v [extras...] [kb] [ab] rest."""
+def _unpack_in_refs(refs, use_layout, n_main, use_kbias, use_abias):
+    """Unpack input refs in call order ``[layout] main... [kb] [ab]``;
+    returns ``(layout_ref, main_refs, kb_ref, ab_ref, next_idx)`` where
+    ``next_idx`` points at the first output ref."""
     idx = 0
     layout_ref = refs[idx] if use_layout else None
     idx += int(use_layout)
-    return layout_ref, idx
+    main = refs[idx:idx + n_main]
+    idx += n_main
+    kb_ref = refs[idx] if use_kbias else None
+    idx += int(use_kbias)
+    ab_ref = refs[idx] if use_abias else None
+    idx += int(use_abias)
+    return layout_ref, main, kb_ref, ab_ref, idx
 
 
 def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
@@ -73,13 +81,8 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
     ``use_kbias``/``use_abias``: additive score biases — (B, T) over keys
     (padding) and (T, T) shared across batch (attention mask) — applied
     in-kernel (reference ``softmax_kernels.cu`` attn_softmax masked paths)."""
-    layout_ref, idx = _unpack_in_refs(refs, use_layout, use_kbias, use_abias)
-    q_ref, k_ref, v_ref = refs[idx:idx + 3]
-    idx += 3
-    kb_ref = refs[idx] if use_kbias else None
-    idx += int(use_kbias)
-    ab_ref = refs[idx] if use_abias else None
-    idx += int(use_abias)
+    layout_ref, (q_ref, k_ref, v_ref), kb_ref, ab_ref, idx = \
+        _unpack_in_refs(refs, use_layout, 3, use_kbias, use_abias)
     o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[idx:idx + 5]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -241,13 +244,9 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
                      seq_len, use_layout=False, n_heads=1, use_kbias=False,
                      use_abias=False):
     """Grid: (BH, nk, nq) with nq innermost; accumulates dK/dV for one k block."""
-    layout_ref, idx = _unpack_in_refs(refs, use_layout, use_kbias, use_abias)
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[idx:idx + 6]
-    idx += 6
-    kb_ref = refs[idx] if use_kbias else None
-    idx += int(use_kbias)
-    ab_ref = refs[idx] if use_abias else None
-    idx += int(use_abias)
+    layout_ref, (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), \
+        kb_ref, ab_ref, idx = \
+        _unpack_in_refs(refs, use_layout, 6, use_kbias, use_abias)
     dk_ref, dv_ref, dk_acc, dv_acc = refs[idx:idx + 4]
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -312,13 +311,9 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
                    seq_len, use_layout=False, n_heads=1, use_kbias=False,
                    use_abias=False):
     """Grid: (BH, nq, nk) with nk innermost; accumulates dQ for one q block."""
-    layout_ref, idx = _unpack_in_refs(refs, use_layout, use_kbias, use_abias)
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[idx:idx + 6]
-    idx += 6
-    kb_ref = refs[idx] if use_kbias else None
-    idx += int(use_kbias)
-    ab_ref = refs[idx] if use_abias else None
-    idx += int(use_abias)
+    layout_ref, (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), \
+        kb_ref, ab_ref, idx = \
+        _unpack_in_refs(refs, use_layout, 6, use_kbias, use_abias)
     dq_ref, dq_acc = refs[idx:idx + 2]
     qi = pl.program_id(1)
     ki = pl.program_id(2)

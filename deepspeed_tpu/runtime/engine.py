@@ -725,6 +725,18 @@ class DeepSpeedEngine:
             prof._bytes = ca.get("bytes accessed")
             prof._duration = self.tput_timer.avg_step_time() if hasattr(
                 self.tput_timer, "avg_step_time") else 0.0
+            if self.config.flops_profiler.detailed:
+                # per-module tree via named_scope attribution (the model's
+                # scopes; optimizer/infra ops stay at the root)
+                from ..profiling.flops_profiler.profiler import module_tree
+                raw_fn = (self._grad_only_step if self._offload is not None
+                          else self._train_step)
+                try:
+                    with jax.set_mesh(self.mesh):
+                        jaxpr = jax.make_jaxpr(raw_fn)(self.state, batch, rng)
+                    prof._tree = module_tree(jaxpr)
+                except Exception:
+                    prof._tree = None
         except Exception as e:
             logger.warning(f"flops profiler cost analysis failed: {e}")
         prof.print_model_profile(

@@ -163,7 +163,9 @@ class MegatronSDLoader(SDLoaderBase):
                 if any(s in name for s in self.COLUMN_PARALLEL):
                     return np.concatenate([np.asarray(l) for l in leaves],
                                           axis=self._out_axis(name, a0))
-                if any(s in name for s in self.ROW_PARALLEL):
+                if any(s in name for s in self.ROW_PARALLEL) and a0.ndim >= 2:
+                    # 1-D row-parallel leaves (biases) are replicated —
+                    # fall through to take-one
                     axis = 1 if self._out_axis(name, a0) == 0 \
                         else max(0, a0.ndim - 2)
                     return np.concatenate([np.asarray(l) for l in leaves],
