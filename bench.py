@@ -35,7 +35,7 @@ def peak_flops_per_chip():
 
 
 def measure(preset, seq, micro, zero_stage, *, steps=10, warmup=3,
-            unroll=True):
+            unroll=True, remat=False):
     """Train `steps` steps; returns (mfu, tokens_per_sec, samples_per_sec)."""
     import jax
     import jax.numpy as jnp
@@ -44,7 +44,7 @@ def measure(preset, seq, micro, zero_stage, *, steps=10, warmup=3,
 
     model = build(preset, dtype=jnp.bfloat16, max_seq=seq,
                   embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
-                  remat=False, unroll_layers=unroll, attention_impl="flash")
+                  remat=remat, unroll_layers=unroll, attention_impl="flash")
     config = {
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": 1,
@@ -89,15 +89,19 @@ def main():
     extra["gpt2_350m_T1024_z1"] = {"mfu": round(flagship_mfu, 4),
                                    "tokens_per_sec": round(tok_s),
                                    "samples_per_sec_per_chip": round(sps, 2)}
-    # ZeRO ladder at the flagship shape + the 125M short/long-seq points
-    for name, args in [
-        ("gpt2_350m_T1024_z2", ("gpt2-350m", 1024, 8, 2)),
-        ("gpt2_350m_T1024_z3", ("gpt2-350m", 1024, 8, 3)),
-        ("gpt2_125m_T512_z1", ("gpt2-125m", 512, 24, 1)),
-        ("gpt2_125m_T2048_z1", ("gpt2-125m", 2048, 4, 1)),
+    # ZeRO ladder at the flagship shape, the 125M short/long-seq points,
+    # and the largest single-chip model (760M: Adam states + remat'd
+    # activations fill the 16GB HBM)
+    for name, args, kw in [
+        ("gpt2_350m_T1024_z2", ("gpt2-350m", 1024, 8, 2), {}),
+        ("gpt2_350m_T1024_z3", ("gpt2-350m", 1024, 8, 3), {}),
+        ("gpt2_125m_T512_z1", ("gpt2-125m", 512, 24, 1), {}),
+        ("gpt2_125m_T2048_z1", ("gpt2-125m", 2048, 4, 1), {}),
+        ("gpt2_760m_T1024_z1_remat", ("gpt2-760m", 1024, 4, 1),
+         {"remat": True}),
     ]:
         try:
-            mfu, tok_s, sps = measure(*args)
+            mfu, tok_s, sps = measure(*args, **kw)
             extra[name] = {"mfu": round(mfu, 4),
                            "tokens_per_sec": round(tok_s),
                            "samples_per_sec_per_chip": round(sps, 2)}

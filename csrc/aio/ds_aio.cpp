@@ -153,7 +153,9 @@ class AioHandle {
         req = std::move(queue_.front());
         queue_.pop_front();
       }
+      active_requests_.fetch_add(1);
       run_request(*req);
+      active_requests_.fetch_sub(1);
       {
         std::lock_guard<std::mutex> lk(done_mu_);
         req->done.store(true);
@@ -265,7 +267,11 @@ class AioHandle {
   // — so the fallback path retains multi-threaded throughput.
   void posix_transfer(Request& req, int fd) {
     int64_t nseg = req.count > 0 ? (req.count + block_size_ - 1) / block_size_ : 0;
-    int nthreads = static_cast<int>(std::min<int64_t>(num_threads_, nseg));
+    // share the thread budget across concurrently-running requests so the
+    // fallback never oversubscribes beyond ~num_threads_ total
+    int busy = active_requests_.load();
+    int budget = std::max(1, num_threads_ / std::max(1, busy));
+    int nthreads = static_cast<int>(std::min<int64_t>(budget, nseg));
     if (nthreads <= 1) {
       posix_range(req, fd, 0, req.count);
       return;
@@ -312,6 +318,7 @@ class AioHandle {
   std::condition_variable cv_;
   std::deque<std::shared_ptr<Request>> queue_;
   bool shutdown_ = false;
+  std::atomic<int> active_requests_{0};
 
   std::mutex done_mu_;
   std::condition_variable done_cv_;

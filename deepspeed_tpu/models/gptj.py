@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .gpt2 import _layer_norm, _dropout, _attention_jnp
+from .gpt2 import _layer_norm, _dropout
 from .rotary import rotary_freqs, apply_rotary_pos_emb
 
 

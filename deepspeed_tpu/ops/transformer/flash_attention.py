@@ -32,9 +32,16 @@ NEG_INF = -1e30
 
 
 def _auto_blocks(seq_len, head_dim, block_q, block_k):
-    """Measured on v5e: large blocks amortize the online-softmax scratch
-    revisits — 1024×1024 hits ~30 TF/s at T=4096 vs ~5 TF/s at 128×128.
-    Cap by head_dim to stay inside VMEM (score block is bq×bk fp32)."""
+    """Measured on v5e: large square blocks amortize the online-softmax
+    scratch revisits — 1024×1024 hits ~30 TF/s at T=4096 vs ~5 TF/s at
+    128×128.  Cap by head_dim to stay inside VMEM (score block is bq×bk
+    fp32).
+
+    NOTE (round-2 lesson): tall-q/narrow-k blocks (bq=T, bk=512) win a
+    STANDALONE fwd+bwd microbench by ~2× at T=1024, but LOSE ~3-7% MFU
+    inside the full training step (gpt2-350m 0.51→0.48) — XLA's scheduling
+    of the surrounding fusions changes.  Trust end-to-end model
+    measurements over kernel microbenches here."""
     cap = 512 if head_dim > 64 else 1024
     if block_q is None:
         block_q = min(cap, max(128, seq_len))

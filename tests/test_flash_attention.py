@@ -73,3 +73,16 @@ def test_single_block():
     ref = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_auto_blocks_heuristic():
+    """v5e-measured policy: large SQUARE blocks (end-to-end MFU beats the
+    tall-q microbench winner — see _auto_blocks NOTE); halved caps for
+    wide heads (VMEM)."""
+    from deepspeed_tpu.ops.transformer.flash_attention import _auto_blocks
+    assert _auto_blocks(512, 64, None, None) == (512, 512)
+    assert _auto_blocks(1024, 64, None, None) == (1024, 1024)
+    assert _auto_blocks(4096, 64, None, None) == (1024, 1024)
+    assert _auto_blocks(4096, 128, None, None) == (512, 512)
+    # explicit overrides pass through
+    assert _auto_blocks(4096, 64, 256, 128) == (256, 128)
