@@ -82,7 +82,11 @@ def measure(preset, seq, micro, zero_stage, *, steps=10, warmup=3,
     return mfu, tokens_per_sec, samples_per_sec / n_chips
 
 
+TIME_BUDGET_S = 18 * 60   # never run past this: the driver must see output
+
+
 def main():
+    t_start = time.time()
     extra = {}
     # flagship: largest model comfortably fitting one chip with Adam states
     flagship_mfu, tok_s, sps = measure("gpt2-350m", 1024, 8, 1)
@@ -100,6 +104,9 @@ def main():
         ("gpt2_760m_T1024_z1_remat", ("gpt2-760m", 1024, 4, 1),
          {"remat": True}),
     ]:
+        if time.time() - t_start > TIME_BUDGET_S:
+            extra[name] = {"skipped": "time budget"}
+            continue
         try:
             mfu, tok_s, sps = measure(*args, **kw)
             extra[name] = {"mfu": round(mfu, 4),

@@ -159,7 +159,7 @@ class InferenceEngine:
 
         self._jit_forward = None
         self._jit_prefill = None
-        self._decode_loops = {}    # (steps, temperature, do_sample, top_k) → fn
+        self._decode_loops = {}    # (steps, do_sample, top_k) → fn
         log_dist(f"InferenceEngine ready: tp={self.mp_world_size} "
                  f"mesh={dict(self.mesh.shape)}", ranks=[0])
 
@@ -230,7 +230,7 @@ class InferenceEngine:
                 # prev stacks the carry INPUT each step: first..t_{n-2}
                 return jnp.concatenate([prev.T, last[:, None]], axis=1)
 
-            loop = jax.jit(decode_loop, donate_argnums=(2,))
+            loop = jax.jit(decode_loop)
             if len(self._decode_loops) >= 8:   # bound the executable cache
                 self._decode_loops.pop(next(iter(self._decode_loops)))
             self._decode_loops[key] = loop

@@ -12,8 +12,11 @@ Run:  python examples/bench_collectives.py [--devices 8] [--sizes 1,8,64]
 
 import argparse
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def algo_bw(nbytes, seconds, world, coll):
