@@ -230,7 +230,12 @@ class InferenceEngine:
                 # prev stacks the carry INPUT each step: first..t_{n-2}
                 return jnp.concatenate([prev.T, last[:, None]], axis=1)
 
-            loop = jax.jit(decode_loop)
+            # donate the cache: XLA reuses its HBM for the scan's carried
+            # cache (without it, input + updated cache coexist — double the
+            # KV memory).  The 1-token path never touches the cache, where
+            # donation would only warn.
+            loop = jax.jit(decode_loop,
+                           donate_argnums=(2,) if max_new_tokens > 1 else ())
             if len(self._decode_loops) >= 8:   # bound the executable cache
                 self._decode_loops.pop(next(iter(self._decode_loops)))
             self._decode_loops[key] = loop

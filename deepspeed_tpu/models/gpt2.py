@@ -414,16 +414,31 @@ class GPT2:
 
         local_flags = jnp.arange(c.n_layer) % 2 == 1
 
-        def scan_body(carry, xs):
-            h = carry
-            layer_params, ck, cv, is_local = xs
-            h, ck, cv = self._block_with_cache(h, layer_params, ck, cv, index,
-                                               is_local)
-            return h, (ck, cv)
+        if c.unroll_layers:
+            # static layer indices: no per-layer dynamic-slice of the stacked
+            # weights/cache — the same single-chip win as the training path
+            ks, vs = [], []
+            for i in range(c.n_layer):
+                lp = jax.tree_util.tree_map(lambda a, i=i: a[i],
+                                            params["blocks"])
+                x, ck, cv = self._block_with_cache(
+                    x, lp, cache["k"][i], cache["v"][i], index,
+                    local_flags[i])
+                ks.append(ck)
+                vs.append(cv)
+            new_k = jnp.stack(ks)
+            new_v = jnp.stack(vs)
+        else:
+            def scan_body(carry, xs):
+                h = carry
+                layer_params, ck, cv, is_local = xs
+                h, ck, cv = self._block_with_cache(h, layer_params, ck, cv,
+                                                   index, is_local)
+                return h, (ck, cv)
 
-        x, (new_k, new_v) = jax.lax.scan(
-            scan_body, x, (params["blocks"], cache["k"], cache["v"],
-                           local_flags))
+            x, (new_k, new_v) = jax.lax.scan(
+                scan_body, x, (params["blocks"], cache["k"], cache["v"],
+                               local_flags))
 
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], c.layer_norm_eps)
         logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),

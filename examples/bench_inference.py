@@ -24,8 +24,12 @@ def main():
     ap.add_argument("--preset", default="gpt2-125m")
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--prompt", type=int, default=128)
-    ap.add_argument("--new", type=int, default=64)
+    # enough decode steps that steady-state time dwarfs remote-dispatch
+    # jitter (~100 ms) in the prefill-subtracted difference
+    ap.add_argument("--new", type=int, default=128)
     ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--unroll", action="store_true",
+                    help="unroll the layer loop (single-chip fast path)")
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -33,7 +37,8 @@ def main():
     from deepspeed_tpu.inference.engine import InferenceEngine
 
     model = build(args.preset, dtype=jnp.bfloat16,
-                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+                  unroll_layers=args.unroll)
     eng = InferenceEngine(model=model,
                           quantization_setting=1 if args.int8 else None)
     rng = np.random.default_rng(0)

@@ -123,3 +123,30 @@ def test_flops_accounting():
     n = m.num_params()
     assert 120e6 < n < 180e6  # 125M-class (plus embeddings)
     assert m.flops_per_token() > 6 * n
+
+
+def test_unrolled_cache_decode_matches_scanned():
+    """unroll_layers must not change the KV-cache forward (the single-chip
+    decode fast path is numerically the scanned path)."""
+    from deepspeed_tpu.models import build
+    m_scan = build("gpt2-tiny", dtype=jnp.float32, embd_pdrop=0,
+                   attn_pdrop=0, resid_pdrop=0)
+    m_unroll = build("gpt2-tiny", dtype=jnp.float32, embd_pdrop=0,
+                     attn_pdrop=0, resid_pdrop=0, unroll_layers=True)
+    params = m_scan.init(jax.random.PRNGKey(0))
+    ids = np.random.RandomState(0).randint(0, 1024, (2, 12)).astype(np.int32)
+    c1 = m_scan.init_cache(2, 20)
+    c2 = m_unroll.init_cache(2, 20)
+    l1, c1 = m_scan.apply_with_cache(params, jnp.asarray(ids), c1)
+    l2, c2 = m_unroll.apply_with_cache(params, jnp.asarray(ids), c2)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               atol=1e-5, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(c1),
+                    jax.tree_util.tree_leaves(c2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # decode continues identically from the checkpointed cache
+    nxt = np.random.RandomState(1).randint(0, 1024, (2, 1)).astype(np.int32)
+    d1, _ = m_scan.apply_with_cache(params, jnp.asarray(nxt), c1)
+    d2, _ = m_unroll.apply_with_cache(params, jnp.asarray(nxt), c2)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                               atol=1e-5, rtol=1e-5)
