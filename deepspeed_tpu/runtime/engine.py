@@ -113,6 +113,15 @@ class DeepSpeedEngine:
 
         # ---- model ---------------------------------------------------------
         self.module = model
+        if (self.zero_stage >= 3 and self.mesh_ctx.fsdp_size > 1
+                and getattr(getattr(model, "config", None),
+                            "unroll_layers", False)):
+            log_dist(
+                "unroll_layers with ZeRO-3 nearly doubles live memory: the "
+                "unrolled program gathers layers less incrementally than the "
+                "scanned one (measured 1.8x temp bytes on the fsdp mesh). "
+                "Prefer the scanned layer loop (unroll_layers=False) at "
+                "stage 3.", ranks=[0])
         self._loss_fn, params0, self._apply_fn, self._tp_specs = _resolve_model(
             model, loss_fn, params, apply_fn, rng_seed)
         params0 = tree_cast(params0, jnp.float32)
