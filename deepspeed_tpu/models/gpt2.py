@@ -99,6 +99,12 @@ def _attention_jnp(q, k, v, causal_mask, attn_drop, rng, deterministic,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def layer_slice(blocks, i):
+    """Static per-layer view of a stacked block pytree (the unrolled-loop
+    idiom shared by every scanned model family)."""
+    return jax.tree_util.tree_map(lambda a: a[i], blocks)
+
+
 def flash_or_jnp_attention(q, k, v, causal_mask, attn_pdrop, rng,
                            deterministic, impl, *, scale=None,
                            nonstandard=False):
@@ -301,8 +307,7 @@ class GPT2:
         with jax.named_scope("blocks"):
             if c.unroll_layers:
                 for i in range(c.n_layer):
-                    lp = jax.tree_util.tree_map(lambda a, i=i: a[i],
-                                                params["blocks"])
+                    lp = layer_slice(params["blocks"], i)
                     x = block(x, lp, layer_rngs[i], deterministic,
                               causal_mask, local_flags[i])
             else:
@@ -419,8 +424,7 @@ class GPT2:
             # weights/cache — the same single-chip win as the training path
             ks, vs = [], []
             for i in range(c.n_layer):
-                lp = jax.tree_util.tree_map(lambda a, i=i: a[i],
-                                            params["blocks"])
+                lp = layer_slice(params["blocks"], i)
                 x, ck, cv = self._block_with_cache(
                     x, lp, cache["k"][i], cache["v"][i], index,
                     local_flags[i])

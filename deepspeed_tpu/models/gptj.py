@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .gpt2 import _layer_norm, _dropout
+from .gpt2 import _layer_norm, _dropout, layer_slice
 from .rotary import rotary_freqs, apply_rotary_pos_emb
 
 
@@ -48,6 +48,8 @@ class GPTJConfig:
     attn_pdrop: float = 0.0
     resid_pdrop: float = 0.0
     remat: bool = True
+    # unrolled layer loop: single-chip throughput knob (see GPT2Config)
+    unroll_layers: bool = False
     # attention core: rotary q/k feed a STANDARD scaled-causal attention, so
     # the Pallas flash kernel applies directly to the pre-rotated inputs
     # (reference applies rotary in-kernel, apply_rotary_pos_emb.cu:378 —
@@ -208,13 +210,19 @@ class GPTJ:
         if c.remat:
             block = jax.checkpoint(block, static_argnums=(3,))
 
-        def scan_body(h, xs):
-            layer_params, layer_rng = xs
-            return block(h, layer_params, layer_rng, deterministic,
-                         causal_mask, cos, sin, positions), None
-
         layer_rngs = jax.random.split(jax.random.fold_in(rng, 31), c.n_layer)
-        x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+        if c.unroll_layers:
+            for i in range(c.n_layer):
+                lp = layer_slice(params["blocks"], i)
+                x = block(x, lp, layer_rngs[i], deterministic, causal_mask,
+                          cos, sin, positions)
+        else:
+            def scan_body(h, xs):
+                layer_params, layer_rng = xs
+                return block(h, layer_params, layer_rng, deterministic,
+                             causal_mask, cos, sin, positions), None
+
+            x, _ = jax.lax.scan(scan_body, x, (params["blocks"], layer_rngs))
 
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
                         c.layer_norm_eps)
@@ -295,15 +303,26 @@ class GPTJ:
         x = params["wte"].astype(self.dtype)[tokens]
         cos, sin = rotary_freqs(c.effective_rotary_dim, c.max_seq, c.rotary_base)
 
-        def scan_body(carry, xs):
-            h = carry
-            layer_params, ck, cv = xs
-            h, ck, cv = self._block_with_cache(h, layer_params, ck, cv, index,
-                                               cos, sin)
-            return h, (ck, cv)
+        if c.unroll_layers:
+            ks, vs = [], []
+            for i in range(c.n_layer):
+                lp = layer_slice(params["blocks"], i)
+                x, ck, cv = self._block_with_cache(
+                    x, lp, cache["k"][i], cache["v"][i], index, cos, sin)
+                ks.append(ck)
+                vs.append(cv)
+            new_k = jnp.stack(ks)
+            new_v = jnp.stack(vs)
+        else:
+            def scan_body(carry, xs):
+                h = carry
+                layer_params, ck, cv = xs
+                h, ck, cv = self._block_with_cache(h, layer_params, ck, cv,
+                                                   index, cos, sin)
+                return h, (ck, cv)
 
-        x, (new_k, new_v) = jax.lax.scan(
-            scan_body, x, (params["blocks"], cache["k"], cache["v"]))
+            x, (new_k, new_v) = jax.lax.scan(
+                scan_body, x, (params["blocks"], cache["k"], cache["v"]))
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
                         c.layer_norm_eps)
         logits = jnp.einsum("btd,dv->btv", x, params["lm_head_w"].astype(x.dtype),

@@ -33,6 +33,12 @@ def main():
     ap.add_argument("--tokens", default=None)
     ap.add_argument("--fsdp", type=int, default=1)
     ap.add_argument("--tensor", type=int, default=1)
+    ap.add_argument("--unroll", action="store_true",
+                    help="unroll the layer loop (best single-chip MFU; "
+                         "prefer the scanned loop with ZeRO-3)")
+    ap.add_argument("--no-remat", action="store_true",
+                    help="disable activation recompute (faster when the "
+                         "model fits HBM)")
     args = ap.parse_args()
 
     zero = {"stage": args.zero}
@@ -59,7 +65,8 @@ def main():
     }
 
     model = build(args.model, dtype=jnp.bfloat16, max_seq=args.seq,
-                  attention_impl="auto")
+                  attention_impl="auto", unroll_layers=args.unroll,
+                  remat=not args.no_remat)
     if args.tokens:
         tokens = np.load(args.tokens)
     else:

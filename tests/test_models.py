@@ -229,3 +229,26 @@ def test_gptneox_flash_trains(devices):
     losses = [float(engine.train_batch()) for _ in range(8)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_gptj_unrolled_matches_scanned():
+    """unroll_layers parity for the rotary family: forward AND cache decode
+    match the scanned path."""
+    import jax
+    ms = build("gptj-tiny", dtype=jnp.float32, attention_impl="jnp")
+    mu = build("gptj-tiny", dtype=jnp.float32, attention_impl="jnp",
+               unroll_layers=True)
+    params = ms.init(jax.random.PRNGKey(0))
+    ids = np.random.RandomState(0).randint(0, 1024, (2, 16)).astype(np.int32)
+    np.testing.assert_allclose(
+        np.asarray(ms.apply(params, jnp.asarray(ids))),
+        np.asarray(mu.apply(params, jnp.asarray(ids))),
+        atol=1e-5, rtol=1e-5)
+    c1, c2 = ms.init_cache(2, 20), mu.init_cache(2, 20)
+    l1, c1 = ms.apply_with_cache(params, jnp.asarray(ids), c1)
+    l2, c2 = mu.apply_with_cache(params, jnp.asarray(ids), c2)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               atol=1e-5, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(c1),
+                    jax.tree_util.tree_leaves(c2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
