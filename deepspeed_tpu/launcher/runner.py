@@ -45,6 +45,10 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=COORD_PORT_DEFAULT)
     parser.add_argument("--ssh_port", type=int, default=None)
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "openmpi"],
+                        help="Multi-node transport (reference --launcher: "
+                             "pdsh/openmpi/mvapich; here ssh is the default)")
     parser.add_argument("--autotuning", type=str, default="",
                         choices=["", "tune", "run"],
                         help="Run the autotuner instead of the job")
@@ -176,27 +180,22 @@ def main(args=None):
         result.wait()
         return result.returncode
 
-    # multi host: ssh fan-out, one process per host, jax.distributed env
+    # multi host: transport fan-out, one process per host, jax.distributed
+    # env (reference: PDSH/OpenMPI/MVAPICH runners, multinode_runner.py)
+    from .multinode_runner import RUNNERS
     hosts = list(active.keys())
     coordinator = args.master_addr or hosts[0]
     world = encode_world_info(active)
+    runner = RUNNERS[args.launcher](args, world)
+    if not runner.backend_exists():
+        logger.error(f"launcher backend {args.launcher!r} not found on PATH")
+        return 1
+    cmds = runner.get_cmd({"coordinator": f"{coordinator}:{args.master_port}"},
+                          active)
     procs = []
-    for proc_id, host in enumerate(hosts):
-        remote_env = {
-            "JAX_COORDINATOR_ADDRESS": f"{coordinator}:{args.master_port}",
-            "COORDINATOR_ADDRESS": f"{coordinator}:{args.master_port}",
-            "JAX_NUM_PROCESSES": str(len(hosts)),
-            "JAX_PROCESS_ID": str(proc_id),
-            "DS_WORLD_INFO": world,
-        }
-        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in remote_env.items())
-        remote_cmd = (f"cd {shlex.quote(os.getcwd())} && {exports} "
-                      f"{sys.executable} -u " +
-                      " ".join(map(shlex.quote, cmd_tail)))
-        ssh = ["ssh"] + (["-p", str(args.ssh_port)] if args.ssh_port else []) \
-            + [host, remote_cmd]
-        logger.info(f"[{host}] {' '.join(map(shlex.quote, ssh))}")
-        procs.append(subprocess.Popen(ssh, env=env))
+    for cmd in cmds:
+        logger.info(f"cmd = {' '.join(map(shlex.quote, cmd))}")
+        procs.append(subprocess.Popen(cmd, env=env))
     rc = 0
     for p in procs:
         p.wait()

@@ -123,3 +123,61 @@ def test_ds_elastic_runs(tmp_path):
                          cwd=os.path.dirname(os.path.dirname(__file__)))
     assert out.returncode == 0, out.stderr
     assert "final_batch_size" in out.stdout
+
+
+def test_multinode_runner_commands():
+    """Transport parity (reference multinode_runner.py): each runner builds
+    the expected fan-out command lines with the jax.distributed env."""
+    import argparse
+    from deepspeed_tpu.launcher.multinode_runner import (SSHRunner, PDSHRunner,
+                                                         OpenMPIRunner, RUNNERS)
+    assert set(RUNNERS) == {"ssh", "pdsh", "openmpi"}
+    args = argparse.Namespace(user_script="train.py", user_args=["--x", "1"],
+                              ssh_port=None)
+    env = {"coordinator": "worker-0:29500"}
+    active = {"worker-0": 4, "worker-1": 4}
+
+    ssh_cmds = SSHRunner(args, "w").get_cmd(env, active)
+    assert len(ssh_cmds) == 2 and ssh_cmds[0][0] == "ssh"
+    assert "JAX_PROCESS_ID=0" in ssh_cmds[0][-1]
+    assert "JAX_PROCESS_ID=1" in ssh_cmds[1][-1]
+    assert "JAX_COORDINATOR_ADDRESS=worker-0:29500" in ssh_cmds[0][-1]
+
+    pdsh_cmds = PDSHRunner(args, "w").get_cmd(env, active)
+    assert len(pdsh_cmds) == 1 and pdsh_cmds[0][0] == "pdsh"
+    assert "worker-0,worker-1" in pdsh_cmds[0]
+    shell = pdsh_cmds[0][-1]
+    # the id must be EXPORTED after the cd (a VAR=... prefix before 'cd'
+    # would never reach the user process), and a lookup miss must be fatal
+    assert "export JAX_PROCESS_ID;" in shell
+    assert shell.index("cd ") < shell.index("JAX_PROCESS_ID=$(")
+    assert "exit 1" in shell
+    # the shell actually resolves an id and exports it (run it with the
+    # local hostname patched into the table)
+    import socket, subprocess as sp
+    host_shell = shell.replace("worker-0", socket.gethostname())
+    host_shell = host_shell.split("exec ")[0] + "exec printenv JAX_PROCESS_ID"
+    out = sp.run(["bash", "-c", host_shell], capture_output=True, text=True)
+    assert out.stdout.strip() == "0", (out.stdout, out.stderr)
+
+    mpi_cmds = OpenMPIRunner(args, "w").get_cmd(env, active)
+    assert len(mpi_cmds) == 1 and mpi_cmds[0][0] == "mpirun"
+    assert "--npernode" in mpi_cmds[0]
+    assert any(x.startswith("JAX_COORDINATOR_ADDRESS=") for x in mpi_cmds[0])
+    # the wrapped shell exports the OMPI rank explicitly (JAX's auto-detect
+    # breaks on OpenMPI>=5) and execs the user script
+    assert mpi_cmds[0][-2] == "-c"
+    assert "JAX_PROCESS_ID=${OMPI_COMM_WORLD_RANK:?}" in mpi_cmds[0][-1]
+    assert "train.py" in mpi_cmds[0][-1]
+
+
+def test_launcher_flag_selects_runner(monkeypatch, tmp_path):
+    """--launcher pdsh errors cleanly when the backend binary is missing."""
+    from deepspeed_tpu.launcher import runner as R
+    hostfile = tmp_path / "hf"
+    hostfile.write_text("worker-0 slots=4\nworker-1 slots=4\n")
+    import shutil as _sh
+    monkeypatch.setattr(_sh, "which",
+                        lambda name: None if name == "pdsh" else "/usr/bin/x")
+    rc = R.main(["-H", str(hostfile), "--launcher", "pdsh", "train.py"])
+    assert rc == 1
