@@ -99,7 +99,14 @@ class PDSHRunner(MultiNodeRunner):
         hosts = list(active_resources.keys())
         coordinator = environment["coordinator"]
         remote_env = self._coordinator_env(coordinator, len(hosts))
-        host_ids = ";".join(f"{h}={i}" for i, h in enumerate(hosts))
+        # index by BOTH the hostfile spelling and its short form, and match
+        # the remote hostname both ways — FQDN hostfile + short gethostname
+        # (or vice versa) must still resolve
+        pairs = {}
+        for i, h in enumerate(hosts):
+            pairs.setdefault(h, str(i))
+            pairs.setdefault(h.split(".")[0], str(i))
+        host_ids = ";".join(f"{h}={i}" for h, i in pairs.items())
         lookup = ("python3 -c \"import socket,sys;"
                   f"m=dict(kv.split('=') for kv in '{host_ids}'.split(';'));"
                   "h=socket.gethostname();"

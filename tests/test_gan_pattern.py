@@ -1,0 +1,68 @@
+"""GAN training pattern (parity: reference tutorial ``gan.md`` / the
+DCGAN example): one engine, both networks in the param tree, opponent
+frozen via stop_gradient inside a single jitted loss.
+
+This also documents WHY the reference's two-engine pattern doesn't
+translate: loss closures capture the opponent's params at trace time.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+sg = jax.lax.stop_gradient
+
+
+def _apply_g(p, z):
+    return jnp.tanh(jax.nn.relu(z @ p["w1"]) @ p["w2"])
+
+
+def _apply_d(p, x):
+    return (jax.nn.relu(x @ p["w1"]) @ p["w2"])[:, 0]
+
+
+def _bce(logit, y):
+    return jnp.mean(jnp.clip(logit, 0) - logit * y +
+                    jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+
+def gan_loss(p, batch, rng):
+    x = batch[0] if isinstance(batch, (tuple, list)) else batch
+    z = jax.random.normal(rng, (x.shape[0], 8))
+    fake = _apply_g(p["g"], z)
+    d_term = 0.5 * (_bce(_apply_d(p["d"], x), jnp.ones(x.shape[0])) +
+                    _bce(_apply_d(p["d"], sg(fake)), jnp.zeros(x.shape[0])))
+    d_frozen = jax.tree_util.tree_map(sg, p["d"])
+    g_term = _bce(_apply_d(d_frozen, fake), jnp.ones(x.shape[0]))
+    return d_term + g_term
+
+
+def test_gan_single_engine_trains(devices):
+    k = jax.random.split(jax.random.PRNGKey(0), 4)
+    params = {"g": {"w1": jax.random.normal(k[0], (8, 32)) * 0.1,
+                    "w2": jax.random.normal(k[1], (32, 16)) * 0.1},
+              "d": {"w1": jax.random.normal(k[2], (16, 32)) * 0.1,
+                    "w2": jax.random.normal(k[3], (32, 1)) * 0.1}}
+    rng = np.random.default_rng(0)
+    # host snapshot BEFORE training: the engine's donated step consumes the
+    # original device buffers
+    d0 = np.asarray(params["d"]["w1"]).copy()
+    real = (rng.normal(0.5, 0.2, size=(256, 16)).astype(np.float32),)
+    engine, _, _, _ = ds.initialize(
+        config={"train_micro_batch_size_per_gpu": 8, "steps_per_print": 1000,
+                "optimizer": {"type": "Adam", "params": {"lr": 2e-3}}},
+        params=params, loss_fn=gan_loss, training_data=real,
+        mesh=make_mesh({"data": 8}))
+    losses = [float(engine.train_batch()) for _ in range(60)]
+    assert np.isfinite(losses).all()
+    # the generator's output distribution drifts toward the real mean (0.5):
+    # proof BOTH subtrees are learning (a frozen G would stay near 0)
+    z = jax.random.normal(jax.random.PRNGKey(9), (256, 8))
+    fake_mean = float(jnp.mean(_apply_g(engine.state.params["g"], z)))
+    assert abs(fake_mean - 0.5) < 0.15, fake_mean
+    # and D's params actually moved (not just G chasing a frozen D)
+    d1 = np.asarray(engine.state.params["d"]["w1"])
+    assert np.abs(d1 - d0).max() > 1e-3
