@@ -394,6 +394,16 @@ class DeepSpeedEngine:
 
         vgrad = jax.value_and_grad(micro_loss)
 
+        if gas == 1:
+            # no accumulation loop: the scan wrapper would zero-init and
+            # add-into a full fp32 grad tree (1.4GB at 350M) per step for
+            # nothing
+            mb = jax.tree_util.tree_map(lambda a: a[0], batch)
+            scaled_loss, grads = vgrad(base, mb, jax.random.fold_in(rng, 0))
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+            return grads, scaled_loss
+
         def body(carry, xs):
             gacc, lacc, idx = carry
             mb = xs
