@@ -321,6 +321,16 @@ class DeepSpeedConfig:
                                                          C.SPARSE_GRADIENTS_DEFAULT)
         self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING,
                                                   C.GRADIENT_CLIPPING_DEFAULT)
+        # reference "data_types": {"grad_accum_dtype": ...} — fp32 (default)
+        # accumulates exactly; bf16 halves the accumulator bandwidth of the
+        # gas scan (~9% step time at 350M/gas=2) at reduced summation
+        # precision.  Only meaningful when gradient_accumulation_steps > 1.
+        dt = get_dict_param(pd, C.DATA_TYPES, {}) or {}
+        self.grad_accum_dtype = get_scalar_param(dt, C.GRAD_ACCUM_DTYPE,
+                                                 C.GRAD_ACCUM_DTYPE_DEFAULT)
+        assert self.grad_accum_dtype in ("fp32", "bf16"), \
+            f"data_types.grad_accum_dtype must be fp32|bf16, got " \
+            f"{self.grad_accum_dtype!r}"
 
         optimizer_dict = get_dict_param(pd, C.OPTIMIZER, None)
         self.optimizer_name = None

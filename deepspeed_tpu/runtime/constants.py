@@ -89,6 +89,11 @@ GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
 GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
 
 SPARSE_GRADIENTS = "sparse_gradients"
+
+# reference "data_types" section (grad accumulation dtype)
+DATA_TYPES = "data_types"
+GRAD_ACCUM_DTYPE = "grad_accum_dtype"
+GRAD_ACCUM_DTYPE_DEFAULT = "fp32"
 SPARSE_GRADIENTS_DEFAULT = False
 
 COMMUNICATION_DATA_TYPE = "communication_data_type"

@@ -404,19 +404,24 @@ class DeepSpeedEngine:
                 lambda g: g.astype(jnp.float32), grads)
             return grads, scaled_loss
 
+        acc_dtype = (jnp.bfloat16 if self.config.grad_accum_dtype == "bf16"
+                     else jnp.float32)
+
         def body(carry, xs):
             gacc, lacc, idx = carry
             mb = xs
             r = jax.random.fold_in(rng, idx)
             scaled_loss, grads = vgrad(base, mb, r)
             grads = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), gacc, grads)
+                lambda a, g: a + g.astype(acc_dtype), gacc, grads)
             return (grads, lacc + scaled_loss, idx + 1), None
 
         zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), base)
+            lambda p: jnp.zeros(p.shape, acc_dtype), base)
         (grads, scaled_loss_sum, _), _ = jax.lax.scan(
             body, (zeros, jnp.float32(0.0), jnp.int32(0)), batch)
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)
         return grads, scaled_loss_sum
 
     def _grads_and_metrics(self, state: TrainState, base, batch, rng):

@@ -90,6 +90,13 @@ class PipelineEngine(DeepSpeedEngine):
              f"({model.num_stages}); set config mesh.axes.pipe")
         self.num_stages = model.num_stages
         self.micro_batches = self.gradient_accumulation_steps()
+        if self.config.grad_accum_dtype != "fp32":
+            from ...utils.logging import logger
+            logger.warning(
+                "data_types.grad_accum_dtype is ignored by the pipeline "
+                "engine: 1F1B accumulates per-tick gradients in fp32 (the "
+                "bf16 option applies to the gas scan of the non-pipeline "
+                "engine)")
 
     @staticmethod
     def _no_flat_loss(params, batch, rng):

@@ -194,3 +194,33 @@ def test_optimizer_variants(mesh8):
         cfg = base_config(optimizer=opt)
         _, losses = _train(cfg, mesh8, steps=10)
         assert losses[-1] < losses[0], f"{opt['type']} did not train: {losses}"
+
+
+def test_bf16_grad_accum_dtype_close_to_fp32(devices):
+    """data_types.grad_accum_dtype=bf16 (reference key) halves the gas-scan
+    accumulator bandwidth; updates must stay close to exact fp32
+    accumulation over a few steps."""
+    from simple_model import SimpleModel, random_dataset, base_config
+
+    def run(accum):
+        cfg = base_config(micro=4, gas=4, over={
+            "bf16": {"enabled": True},
+            "data_types": {"grad_accum_dtype": accum}})
+        engine, _, _, _ = ds.initialize(
+            config=cfg, model=SimpleModel(dim=8),
+            training_data=random_dataset(n=128),
+            mesh=make_mesh({"data": 8}))
+        return [float(engine.train_batch()) for _ in range(5)]
+
+    l32 = run("fp32")
+    l16 = run("bf16")
+    np.testing.assert_allclose(l16, l32, rtol=5e-2, err_msg=f"{l16} vs {l32}")
+
+
+def test_grad_accum_dtype_validation():
+    import pytest
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    with pytest.raises(AssertionError, match="grad_accum_dtype"):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "data_types": {"grad_accum_dtype": "fp8"}},
+                        world_size=1)
