@@ -27,6 +27,7 @@
 
 #include <atomic>
 #include <condition_variable>
+#include <cerrno>
 #include <cstdint>
 #include <cstring>
 #include <deque>
@@ -54,8 +55,13 @@ int sys_io_submit(aio_context_t ctx, long n, iocb** iocbs) {
   return static_cast<int>(::syscall(SYS_io_submit, ctx, n, iocbs));
 }
 int sys_io_getevents(aio_context_t ctx, long min_nr, long nr, io_event* ev) {
-  return static_cast<int>(
-      ::syscall(SYS_io_getevents, ctx, min_nr, nr, ev, nullptr));
+  // a benign signal mid-wait must not fail the whole request
+  int got;
+  do {
+    got = static_cast<int>(
+        ::syscall(SYS_io_getevents, ctx, min_nr, nr, ev, nullptr));
+  } while (got < 0 && errno == EINTR);
+  return got;
 }
 
 constexpr int64_t kDirectAlign = 512;  // logical-block alignment for O_DIRECT

@@ -145,7 +145,10 @@ class ModelBasedTuner(BaseTuner):
         for e in self.all_exps:
             by_stage.setdefault(self._stage(e), []).append(e)
         warm = [grp[len(grp) // 2] for grp in by_stage.values()]
-        self.all_exps = warm + [e for e in self.all_exps if e not in warm]
+        warm_ids = {id(e) for e in warm}
+        # identity, not ==: two equal-config experiments must both survive
+        self.all_exps = warm + [e for e in self.all_exps
+                                if id(e) not in warm_ids]
         self._warmup = len(warm)
 
     @staticmethod

@@ -176,31 +176,45 @@ class GPT2MoE:
 
             h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
             if is_moe:
-                out, l_aux, _ = self._moe.apply(p["moe"], h, rng=r4,
-                                                train=not deterministic)
+                out, l_aux, _, ovf = self._moe.apply(p["moe"], h, rng=r4,
+                                                     train=not deterministic,
+                                                     return_overflow=True)
             else:
                 out = self._expert.apply(p["ffn"], h)
                 l_aux = jnp.float32(0.0)
-            return x + _dropout(out, c.resid_pdrop, r3, deterministic), l_aux
+                ovf = jnp.int32(0)
+            return (x + _dropout(out, c.resid_pdrop, r3, deterministic),
+                    l_aux, ovf)
 
         if c.remat:
             block = jax.checkpoint(block, static_argnums=(3,))
 
         aux_total = jnp.float32(0.0)
+        ovf_total = jnp.int32(0)
         for i, p in enumerate(params["layers"]):
             r = jax.random.fold_in(rng, 100 + i)
-            x, l_aux = block(p, x, r, "moe" in p)
+            x, l_aux, ovf = block(p, x, r, "moe" in p)
             aux_total = aux_total + l_aux
+            ovf_total = ovf_total + ovf
 
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
                         c.layer_norm_eps)
         logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
                             params["wte"].astype(jnp.float32))
-        return logits, aux_total
+        return logits, aux_total, ovf_total
 
     def apply(self, params, tokens, rng=None, deterministic=True):
-        logits, _ = self._apply_with_aux(params, tokens, rng, deterministic)
+        logits, _, _ = self._apply_with_aux(params, tokens, rng, deterministic)
         return logits
+
+    def apply_with_metrics(self, params, tokens, rng=None, deterministic=True):
+        """(logits, {"moe_aux_loss", "moe_tokens_dropped"}) — the per-step
+        routing health signals (dropped = capacity-thinned token count summed
+        over MoE layers; nonzero under ``drop_tokens=False`` means the
+        ``nodrop_capacity`` bound was exceeded by routing skew)."""
+        logits, aux, ovf = self._apply_with_aux(params, tokens, rng,
+                                                deterministic)
+        return logits, {"moe_aux_loss": aux, "moe_tokens_dropped": ovf}
 
     # ------------------------------------------------------- KV-cache decode
     # (role parity: reference ``ops/transformer/inference/moe_inference.py``
@@ -262,8 +276,8 @@ class GPT2MoE:
     def loss(self, params, batch, rng):
         from .gpt2 import GPT2
         tokens, labels = GPT2._split_batch(batch)
-        logits, aux = self._apply_with_aux(params, tokens, rng,
-                                           deterministic=False)
+        logits, aux, _ = self._apply_with_aux(params, tokens, rng,
+                                              deterministic=False)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll) + self.config.aux_loss_coef * aux

@@ -16,8 +16,10 @@ Parity: reference ``deepspeed/moe/layer.py:18`` (``MoE``) and
 - PR-MoE residual path (``layer.py:154-161``): softmax-weighted sum of the
   expert output and a dense residual MLP via a learned 2-way coefficient.
 
-The functional ``apply`` returns ``(output, l_aux, exp_counts)`` exactly like
-the reference's ``MoE.forward``.
+``MoE.apply`` returns ``(output, l_aux, exp_counts)`` exactly like the
+reference's ``MoE.forward`` (``return_overflow=True`` appends the
+capacity-drop count); the internal ``MOELayer.apply`` always returns the
+4-tuple.
 """
 
 from typing import Optional
@@ -27,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .experts import Experts
-from .sharded_moe import TopKGate
+from .sharded_moe import TopKGate, tokens_overflowed
 from ..parallel.mesh import maybe_constrain
 from ..utils.logging import log_dist
 
@@ -69,7 +71,10 @@ class MOELayer:
         # token-sharded output is the reverse all-to-all (reference :542)
         combined = jnp.einsum("sec,ecm->sm",
                               combine_weights.astype(x.dtype), expert_output)
-        return combined.reshape(x.shape), l_aux, exp_counts
+        # capacity drops are detectable: exp_counts is pre-thinning demand
+        overflow = tokens_overflowed(
+            exp_counts, self.gate.capacity_for(reshaped.shape[0], train))
+        return combined.reshape(x.shape), l_aux, exp_counts, overflow
 
     def partition_specs(self, params):
         return {"gate": jax.tree_util.tree_map(lambda p: P(), params["gate"]),
@@ -123,9 +128,15 @@ class MoE:
                 "b": jnp.zeros((2,), jnp.float32)}
         return params
 
-    def apply(self, params, x, rng=None, used_token=None, train: bool = True):
-        """Returns ``(output, l_aux, exp_counts)`` (reference ``MoE.forward``)."""
-        output, l_aux, exp_counts = self.moe_layer.apply(
+    def apply(self, params, x, rng=None, used_token=None, train: bool = True,
+              return_overflow: bool = False):
+        """Returns ``(output, l_aux, exp_counts)`` (reference ``MoE.forward``).
+
+        ``return_overflow=True`` appends the number of tokens dropped by
+        capacity thinning this call (exact for top-1) — the runtime signal
+        for a too-small ``max_capacity`` / skewed routing under
+        ``drop_tokens=False``."""
+        output, l_aux, exp_counts, overflow = self.moe_layer.apply(
             params["moe"], x, rng=rng, used_token=used_token, train=train)
         if self.use_residual:
             out_mlp = self.expert.apply(params["mlp"], x, rng=rng)
@@ -135,6 +146,8 @@ class MoE:
                     + params["coefficient"]["b"].astype(x.dtype))
             coef = jax.nn.softmax(coef, axis=-1)
             output = output * coef[..., 0:1] + out_mlp * coef[..., 1:]
+        if return_overflow:
+            return output, l_aux, exp_counts, overflow
         return output, l_aux, exp_counts
 
     def partition_specs(self, params):

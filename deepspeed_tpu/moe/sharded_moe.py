@@ -13,8 +13,11 @@ TPU re-design notes (NOT a port):
   ``capacity = max(ceil(tokens/experts × capacity_factor), min_capacity)``
   (reference ``_capacity``, :149-160).  The reference's ``drop_tokens=False``
   mode discovers the needed capacity at runtime with an allreduce-MAX
-  (:213-217); here no-drop uses the static worst case ``capacity = tokens``
-  (correct for any routing, costs the padding the reference saves).
+  (:213-217); here no-drop defaults to ``nodrop_capacity`` —
+  ``NO_DROP_CAPACITY_MULT``× the balanced load — so extreme routing skew CAN
+  drop tokens, detectably via ``tokens_overflowed(exp_counts, capacity)``
+  (``MoE.apply(..., return_overflow=True)`` surfaces the count).  Pass
+  ``max_capacity=num_tokens`` for the guaranteed-no-drop worst case.
 - **Dispatch/combine are einsums** on a one-hot routing tensor, and expert
   parallelism is a *sharding* of the expert dimension over the ``expert`` mesh
   axis — the SPMD partitioner inserts the all-to-alls the reference wrote by
@@ -55,6 +58,33 @@ def _keep_topc_per_expert(priority, mask, capacity: int):
     return mask * keep
 
 
+# drop_tokens=False default capacity: this multiple of the balanced load
+# (tokens/experts).  The reference sizes no-drop capacity with a runtime
+# max-allreduce over actual expert load (sharded_moe.py:213-217); XLA's
+# static shapes forbid that, so we cap at 4x the balanced load — enough for
+# heavy imbalance — and make any overflow *detectable* via
+# ``tokens_overflowed`` instead of silently allocating an S×E×S dispatch.
+NO_DROP_CAPACITY_MULT = 4
+
+
+def nodrop_capacity(num_tokens: int, num_experts: int,
+                    max_capacity: Optional[int], min_capacity: int) -> int:
+    """Static capacity for ``drop_tokens=False`` gating."""
+    if max_capacity is not None:
+        return min(num_tokens, int(max_capacity))
+    cap = max(int(min_capacity),
+              -(-num_tokens * NO_DROP_CAPACITY_MULT // num_experts))
+    return min(num_tokens, cap)
+
+
+def tokens_overflowed(exp_counts, capacity: int):
+    """Tokens dropped by capacity thinning, from the PRE-thinning demand
+    counts the gates return: ``sum_e max(0, exp_counts[e] - capacity)``.
+    Exact for top-1 gating; an upper bound for top-2 (second-choice
+    assignments may be dropped without losing the token entirely)."""
+    return jnp.sum(jnp.maximum(exp_counts - capacity, 0))
+
+
 def top1gating(logits, capacity_factor: float, min_capacity: int,
                *, rng=None, used_token=None,
                noisy_gate_policy: Optional[str] = None,
@@ -65,13 +95,14 @@ def top1gating(logits, capacity_factor: float, min_capacity: int,
     logits: (S, E) fp32.  Returns ``(l_aux, combine_weights (S,E,C),
     dispatch_mask (S,E,C) bool, exp_counts (E,))``.
 
-    ``drop_tokens=False``: the reference sizes capacity with a runtime
-    max-allreduce over actual expert load (:213-217); XLA static shapes
-    forbid that, so the worst case is ``capacity = tokens`` — an S×E×S
-    dispatch tensor.  ``max_capacity`` bounds it: capacity =
-    ``min(tokens, max_capacity)``, and if an expert's demand exceeds the
-    bound the lowest-priority overflow IS dropped (choose the bound from
-    the observed ``exp_counts`` high-water mark).
+    ``drop_tokens=False``: capacity defaults to ``nodrop_capacity`` —
+    ``NO_DROP_CAPACITY_MULT``× the balanced load (or the explicit
+    ``max_capacity`` bound).  Demand beyond the cap IS dropped
+    (lowest-priority first); detect it with
+    ``tokens_overflowed(exp_counts, capacity)`` — ``exp_counts`` is the
+    pre-thinning demand, so the overflow count is exact.  Pass
+    ``max_capacity=num_tokens`` for the guaranteed-no-drop S×E×S worst
+    case the reference gets from its runtime max-allreduce (:213-217).
     """
     logits = logits.astype(jnp.float32)
     num_tokens, num_experts = logits.shape
@@ -88,10 +119,9 @@ def top1gating(logits, capacity_factor: float, min_capacity: int,
     if drop_tokens:
         capacity = compute_capacity(num_tokens, num_experts, capacity_factor,
                                     min_capacity)
-    elif max_capacity is not None:
-        capacity = min(num_tokens, int(max_capacity))
     else:
-        capacity = num_tokens  # static worst case (see docstring)
+        capacity = nodrop_capacity(num_tokens, num_experts, max_capacity,
+                                   min_capacity)
 
     indices1_s = jnp.argmax(logits_w_noise if noisy_gate_policy == "RSample"
                             else gates, axis=1)
@@ -213,12 +243,38 @@ class TopKGate:
                 "top-2 gating always sizes capacity from capacity_factor "
                 f"(got k={k})")
         self.max_capacity = max_capacity
+        if not drop_tokens and k == 1 and max_capacity is None:
+            # loud note: no-drop is CAPPED by default (the reference sizes it
+            # at runtime via allreduce-MAX, impossible under static shapes)
+            from ..utils.logging import logger
+            logger.warning(
+                "drop_tokens=False defaults to a capacity of "
+                f"{NO_DROP_CAPACITY_MULT}x the balanced load; routing skew "
+                "past that bound drops tokens. Monitor it via "
+                "MoE.apply(..., return_overflow=True) / tokens_overflowed(), "
+                "or pass max_capacity=<token count> for the guaranteed "
+                "no-drop worst case.")
 
     def init(self, rng):
         scale = 1.0 / math.sqrt(self.model_dim)
         w = jax.random.uniform(rng, (self.model_dim, self.num_experts),
                                jnp.float32, -scale, scale)
         return {"wg": w}
+
+    def capacity_for(self, num_tokens: int, train: bool = True) -> int:
+        """The static per-expert capacity ``apply`` will use for a batch of
+        ``num_tokens`` — pair with ``tokens_overflowed(exp_counts, cap)`` to
+        detect capacity drops (exact for top-1)."""
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 2:
+            # top2gating sizes capacity at 2x the factor (two slots/token)
+            return compute_capacity(num_tokens, self.num_experts, 2 * cf,
+                                    self.min_capacity)
+        if self.drop_tokens:
+            return compute_capacity(num_tokens, self.num_experts, cf,
+                                    self.min_capacity)
+        return nodrop_capacity(num_tokens, self.num_experts,
+                               self.max_capacity, self.min_capacity)
 
     def apply(self, params, x, rng=None, used_token=None, train: bool = True):
         x32 = x.reshape(-1, self.model_dim).astype(jnp.float32)

@@ -514,7 +514,11 @@ class DeepSpeedEngine:
             # casting could mint inf that bypasses the skip-step logic
             grads = tree_cast(grads, jnp.bfloat16)
         if self._sparse_grad_paths:
-            grads = self._sparsify_grads(grads, batch)
+            grads, rows_dropped = self._sparsify_grads(grads, batch)
+            # surfaced so an under-declared sparse_grad_row_bound is an
+            # ERROR (checked host-side in _host_offload_update), never a
+            # silent truncation of embedding gradients
+            metrics["sparse_rows_dropped"] = rows_dropped
         return grads, metrics
 
     def _sparsify_grads(self, grads, batch):
@@ -526,8 +530,10 @@ class DeepSpeedEngine:
         non-lookup int leaves like labels too (2× buffers for
         (inputs, labels) batches).  A model can tighten it by declaring
         ``sparse_grad_row_bound(batch) -> int`` (count only the ids that
-        actually feed its lookups); under-declaring silently DROPS gradient
-        rows, so only lookup-fed leaves may be excluded."""
+        actually feed its lookups).  Under-declaring would drop gradient
+        rows, so the true nonzero-row count is checked per leaf and
+        returned as ``rows_dropped`` — the engine raises on any nonzero
+        value rather than corrupting embedding training silently."""
         from .sparse_tensor import SparseTensor
         bound_fn = getattr(self.module, "sparse_grad_row_bound", None)
         if callable(bound_fn):
@@ -537,7 +543,8 @@ class DeepSpeedEngine:
                          jax.tree_util.tree_leaves(batch)
                          if jnp.issubdtype(jnp.asarray(l).dtype, jnp.integer))
         if tokens == 0:
-            return grads
+            return grads, jnp.int32(0)
+        dropped = [jnp.int32(0)]
 
         def replace(tree, path):
             key = path[0]
@@ -548,7 +555,10 @@ class DeepSpeedEngine:
                 rows = sub.shape[0]
                 if tokens >= rows:
                     return tree  # dense is smaller; keep it
-                st = SparseTensor.from_dense(sub, max_rows=tokens)
+                nz = jnp.any(sub != 0, axis=1)
+                nz_rows = jnp.sum(nz.astype(jnp.int32))
+                dropped[0] = dropped[0] + jnp.maximum(nz_rows - tokens, 0)
+                st = SparseTensor.from_dense(sub, max_rows=tokens, nz=nz)
                 out = dict(tree)
                 out[key] = {"sparse_indices": st.indices,
                             "sparse_values": st.values}
@@ -559,7 +569,7 @@ class DeepSpeedEngine:
 
         for path in self._sparse_grad_paths:
             grads = replace(grads, path)
-        return grads
+        return grads, dropped[0]
 
     def _host_offload_update(self, grads, metrics):
         """Host half of the offload step: d2h grads → native fused Adam on
@@ -568,6 +578,17 @@ class DeepSpeedEngine:
         state = self.state
         overflow = bool(metrics["overflow"]) if self.fp16_enabled else False
         ovf = jnp.asarray(int(overflow), jnp.int32)
+        # NOTE: checked only on non-overflow steps — a NaN/inf grad step makes
+        # every row "nonzero" through the NaN-propagating clip; that path must
+        # reach the skip-step logic below, not die here
+        if not overflow and "sparse_rows_dropped" in metrics:
+            n_dropped = int(metrics["sparse_rows_dropped"])
+            if n_dropped > 0:
+                raise RuntimeError(
+                    f"sparse_grad_row_bound under-declared: {n_dropped} "
+                    "nonzero gradient row(s) exceed the declared bound and "
+                    "would be dropped; raise the bound (or remove "
+                    "sparse_grad_row_bound to use the safe default)")
         if not overflow:
             flat = self._offload.flatten_grads(grads)
             lr = float(metrics["lr"])

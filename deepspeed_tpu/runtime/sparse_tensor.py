@@ -27,15 +27,22 @@ class SparseTensor:
         self.dense_size = tuple(dense_size)
 
     @classmethod
-    def from_dense(cls, dense, max_rows: Optional[int] = None):
+    def from_dense(cls, dense, max_rows: Optional[int] = None, nz=None):
         """Extract the nonzero rows (static count = ``max_rows``; XLA needs
-        static shapes, so the densest possible case bounds the buffer)."""
+        static shapes, so the densest possible case bounds the buffer).
+        ``nz`` — optional precomputed per-row nonzero mask (saves a second
+        full scan when the caller already needed it)."""
         dense = jnp.asarray(dense)
-        nz = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+        if nz is None:
+            nz = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
         k = max_rows if max_rows is not None else dense.shape[0]
-        # top-k on the nonzero mask gives the first k nonzero row indices
-        _, idx = lax.top_k(nz.astype(jnp.int32) +
-                           jnp.arange(dense.shape[0], 0, -1) * 1e-9, k)
+        # Integer keys: every nonzero row outranks every zero row, and
+        # earlier rows outrank later ones — exactly (no float-epsilon
+        # tie-break, which is unrepresentable near 1.0 in fp32), so top_k
+        # returns the FIRST k nonzero row indices deterministically.
+        rows = dense.shape[0]
+        keys = nz.astype(jnp.int32) * rows + jnp.arange(rows, 0, -1)
+        _, idx = lax.top_k(keys, k)
         idx = jnp.sort(idx)
         vals = dense[idx] * nz[idx].astype(dense.dtype)[:, None]
         return cls(idx, vals, dense.shape)

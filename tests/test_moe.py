@@ -89,6 +89,47 @@ def test_top1_no_drop_tokens():
     assert int(dm.astype(jnp.int32).sum()) == S
 
 
+def test_capacity_for_matches_gating():
+    """TopKGate.capacity_for reports the SAME capacity apply() uses, for all
+    three sizing modes — pairing it with tokens_overflowed must not produce
+    phantom overflow."""
+    from deepspeed_tpu.moe.sharded_moe import nodrop_capacity
+    S = 32
+    g1 = TopKGate(8, 4, k=1, capacity_factor=1.5, min_capacity=0)
+    assert g1.capacity_for(S) == compute_capacity(S, 4, 1.5, 0)
+    g2 = TopKGate(8, 4, k=2, capacity_factor=2.0, min_capacity=0)
+    # top2gating doubles the factor (two slots per token)
+    assert g2.capacity_for(S) == compute_capacity(S, 4, 4.0, 0)
+    gn = TopKGate(8, 8, k=1, capacity_factor=1.0, min_capacity=0,
+                  drop_tokens=False)
+    assert gn.capacity_for(S) == nodrop_capacity(S, 8, None, 0) == S // 2
+
+
+def test_nodrop_overflow_detected():
+    """drop_tokens=False with skewed routing past the nodrop_capacity bound
+    drops tokens — and the overflow count says exactly how many."""
+    from deepspeed_tpu.moe import tokens_overflowed
+    S, E, dim = 32, 8, 8
+    moe = MoE(dim, ExpertMLP(dim), num_experts=E, k=1, min_capacity=0,
+              drop_tokens=False, use_rts=False)
+    params = moe.init(jax.random.PRNGKey(0))
+    # force every token onto expert 0
+    params["moe"]["gate"]["wg"] = jnp.zeros((dim, E)).at[:, 0].set(10.0)
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (S, dim))) + 0.1
+    out, _, counts, ovf = moe.apply(params, x, rng=jax.random.PRNGKey(2),
+                                    return_overflow=True)
+    cap = moe.moe_layer.gate.capacity_for(S)
+    assert cap == S // 2                       # 4x balanced load, E=8
+    assert int(ovf) == S - cap                 # exact drop count surfaced
+    assert int(ovf) == int(tokens_overflowed(counts, cap))
+    # balanced routing: no overflow
+    params["moe"]["gate"]["wg"] = jax.random.normal(
+        jax.random.PRNGKey(3), (dim, E)) * 0.02
+    _, _, _, ovf0 = moe.apply(params, x, rng=jax.random.PRNGKey(2),
+                              return_overflow=True)
+    assert int(ovf0) <= int(ovf)
+
+
 def test_top2_normalized_combine():
     rng = jax.random.PRNGKey(4)
     S, E = 32, 4

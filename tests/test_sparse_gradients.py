@@ -127,6 +127,31 @@ def test_sparse_gradients_without_declaration_warns_and_stays_dense(devices):
     assert np.isfinite(float(engine.train_batch()))
 
 
+def test_underdeclared_row_bound_raises(devices):
+    """A sparse_grad_row_bound that undercounts must raise, never silently
+    drop gradient rows (VERDICT r2: engine.py footgun)."""
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1000,
+        "sparse_gradients": True,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    model = EmbedBagModel()
+    model.sparse_grad_row_bound = lambda batch: 2   # lies: 32 distinct ids
+    rng_np = np.random.default_rng(7)
+    tokens = np.arange(32, dtype=np.int32).reshape(4, 8) % V
+    tokens = np.tile(tokens, (8, 1))                # 32 rows for dp=8
+    target = rng_np.normal(size=(32,)).astype(np.float32)
+    engine, _, _, _ = ds.initialize(
+        config=cfg, model=model, training_data=(tokens, target),
+        mesh=make_mesh({"data": 8}))
+    with pytest.raises(RuntimeError, match="under-declared"):
+        engine.train_batch()
+
+
 def test_moe_nodrop_capacity_bound():
     """drop_tokens=False capacity is bounded by max_capacity instead of the
     S×E×S worst case (reference's runtime max-allreduce, sharded_moe.py:213,
@@ -137,7 +162,9 @@ def test_moe_nodrop_capacity_bound():
     logits = jax.random.normal(rng, (S, E))
     _, cw, dm, _ = top1gating(logits, 1.0, 4, rng=rng, drop_tokens=False,
                               use_rts=False)
-    assert cw.shape == (S, E, S)           # unbounded worst case
+    # default no-drop capacity: NO_DROP_CAPACITY_MULT(=4) x balanced load
+    # = 4*64/4 = 64 = S here, i.e. the full worst case at E=4
+    assert cw.shape == (S, E, S)
     _, cw2, dm2, _ = top1gating(logits, 1.0, 4, rng=rng, drop_tokens=False,
                                 use_rts=False, max_capacity=32)
     assert cw2.shape == (S, E, 32)
