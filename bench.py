@@ -6,11 +6,17 @@ North star (BASELINE.md): samples/sec/chip + MFU for GPT-2 at ZeRO stages
 reference's best published kernel efficiency is 52% of V100 peak on
 BERT-large, ``docs/_posts/2020-05-19-bert-record.md:14``).
 
-Flagship: gpt2-350m @ T=1024, unrolled layers, flash attention, ZeRO-1
-(measured 0.51 MFU on v5e — larger models raise arithmetic intensity;
-gpt2-760m+ exceeds single-chip HBM with fp32 Adam master states).
-``extra`` reports the same shape at ZeRO-2/3, the 125M point at T=512 and
-T=2048, and tokens/sec for each — the BASELINE.md metric family.
+Flagship: gpt2-350m @ T=1024, unrolled layers, flash attention, ZeRO-1.
+``extra`` carries the rest of the BASELINE metric family, including the
+graded ZeRO-Offload points (gpt2-1.3b z3 + host optimizer).  IMPORTANT
+context for the offload numbers: this harness reaches its TPU through a
+network tunnel moving ~0.01-0.03 GB/s device<->host (measured; reported in
+``extra.offload_tunnel``), vs the >=16 GB/s PCIe the reference's
+ZeRO-Offload numbers assume (``docs/_posts/2020-09-09-ZeRO-Offload.md``).
+The offload entries therefore report the measured number AND the component
+breakdown (device step, grad d2h, host Adam, param h2d) so the
+transfer-bound share is explicit; ``projected_mfu_pcie16`` rescales only
+the transfer terms to 16 GB/s — compute and host-Adam terms stay measured.
 """
 
 import json
@@ -34,17 +40,21 @@ def peak_flops_per_chip():
     return 197e12
 
 
+def _build(preset, seq, *, remat, unroll):
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import build
+    return build(preset, dtype=jnp.bfloat16, max_seq=seq,
+                 embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+                 remat=remat, unroll_layers=unroll, attention_impl="flash")
+
+
 def measure(preset, seq, micro, zero_stage, *, steps=10, warmup=3,
             unroll=True, remat=False):
     """Train `steps` steps; returns (mfu, tokens_per_sec, samples_per_sec)."""
     import jax
-    import jax.numpy as jnp
     import deepspeed_tpu as ds
-    from deepspeed_tpu.models import build
 
-    model = build(preset, dtype=jnp.bfloat16, max_seq=seq,
-                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
-                  remat=remat, unroll_layers=unroll, attention_impl="flash")
+    model = _build(preset, seq, remat=remat, unroll=unroll)
     config = {
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": 1,
@@ -82,11 +92,112 @@ def measure(preset, seq, micro, zero_stage, *, steps=10, warmup=3,
     return mfu, tokens_per_sec, samples_per_sec / n_chips
 
 
-TIME_BUDGET_S = 18 * 60   # never run past this: the driver must see output
+def measure_offload(preset, seq, micro, *, gas=1, steps=1, warmup=1,
+                    dpu=False, unroll=False):
+    """ZeRO-3 + host-offload optimizer point (graded config #3).
+
+    Returns a dict with measured mfu/tokens_per_sec plus the component
+    breakdown and the PCIe-16GB/s projection (see module docstring)."""
+    import jax
+    import deepspeed_tpu as ds
+
+    model = _build(preset, seq, remat=True, unroll=unroll)
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 10 ** 9,
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": True},
+        "data_types": {"grad_accum_dtype": "bf16"},
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4,
+                                                  "weight_decay": 0.1}},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu",
+                                  "delayed_param_update": dpu,
+                                  "delayed_param_update_warmup": 0}},
+    }
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, model.config.vocab_size,
+                          size=(micro * gas * 2, seq + 1)).astype(np.int32)
+    engine, _, _, _ = ds.initialize(config=config, model=model,
+                                    training_data=(tokens,))
+    # device-step time alone (for the breakdown): one grad step, synced
+    it = engine._data_iterator
+    batch = engine._stack_microbatches([next(it) for _ in range(gas)])
+    key = jax.random.PRNGKey(0)
+    import jax as _jax
+    with _jax.set_mesh(engine.mesh):
+        g, m, _ = engine._jit_grad_step(engine.state, batch, key)  # compile
+        float(m["loss"])
+        t0 = time.time()
+        g, m, _ = engine._jit_grad_step(engine.state, batch, key)
+        float(m["loss"])
+        t_dev = time.time() - t0
+    del g, m
+
+    # DPU steady state: keep the warmup's pending update in flight across
+    # the timing boundary — each timed step then pays max(device, host)
+    # with N dispatches AND N host applies inside the window (the apply of
+    # the last step's grads stays pending, the warmup's first apply was
+    # counted instead).  Sync mode has no pending; flush is a no-op.
+    loss = None
+    for _ in range(warmup):
+        loss = engine.train_batch()
+    if loss is not None:
+        float(loss)
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch()
+    if not dpu:
+        engine._flush_offload()
+        leaf = jax.tree_util.tree_leaves(engine.state.params)[0]
+        np.asarray(leaf[:1])      # final h2d landed (value read)
+    dt = time.time() - t0
+    assert np.isfinite(float(loss))
+    engine._flush_offload()
+
+    host = dict(getattr(engine._offload, "last_host_times", {}))
+    numel = engine._offload.numel
+    wire_gb = numel * 2 / 1e9     # bf16 each way
+    step_wall = dt / steps
+    samples_per_sec = engine.train_batch_size() / step_wall
+    tokens_per_sec = samples_per_sec * seq
+    mfu = model.flops_per_token() * tokens_per_sec / peak_flops_per_chip()
+
+    # PCIe projection: transfers rescaled to 16 GB/s, measured compute and
+    # host-Adam kept; DPU overlaps host behind device compute
+    adam_s = host.get("host_adam_s", 0.0)
+    pcie_xfer = 2 * wire_gb / 16.0
+    if dpu:
+        proj_wall = max(t_dev, adam_s + pcie_xfer)
+    else:
+        proj_wall = t_dev + adam_s + pcie_xfer
+    proj_mfu = mfu * step_wall / proj_wall if proj_wall > 0 else None
+
+    out = {
+        "mfu": round(mfu, 4),
+        "tokens_per_sec": round(tokens_per_sec),
+        "samples_per_sec_per_chip": round(samples_per_sec, 3),
+        "params_b": round(numel / 1e9, 3),
+        "step_wall_s": round(step_wall, 2),
+        "device_step_s": round(t_dev, 2),
+        "grad_d2h_flatten_s": round(host.get("grad_d2h_flatten_s", -1), 2),
+        "host_adam_s": round(adam_s, 2),
+        "wire_gb_each_way": round(wire_gb, 2),
+        "dpu": dpu,
+        "projected_mfu_pcie16": round(proj_mfu, 4) if proj_mfu else None,
+    }
+    del engine, model
+    return out
+
+
+TIME_BUDGET_S = 26 * 60   # never run past this: the driver must see output
 
 
 def main():
     t_start = time.time()
+    left = lambda: TIME_BUDGET_S - (time.time() - t_start)
     extra = {}
     # flagship: largest model comfortably fitting one chip with Adam states
     # (more measured steps than the extras: this is the graded headline)
@@ -94,18 +205,36 @@ def main():
     extra["gpt2_350m_T1024_z1"] = {"mfu": round(flagship_mfu, 4),
                                    "tokens_per_sec": round(tok_s),
                                    "samples_per_sec_per_chip": round(sps, 2)}
-    # ZeRO ladder at the flagship shape, the 125M short/long-seq points,
-    # and the largest single-chip model (760M: Adam states + remat'd
-    # activations fill the 16GB HBM)
+
+    # graded config #3: GPT-2 1.3B ZeRO-3 + host-offload optimizer.
+    # Transfer-bound on this tunnel (see module docstring) — the breakdown
+    # and the PCIe projection are part of the result.
+    try:
+        extra["gpt2_1300m_z3_offload"] = measure_offload(
+            "gpt2-1.3b", 1024, 4, steps=1, warmup=1, dpu=False)
+    except Exception as e:
+        extra["gpt2_1300m_z3_offload"] = {"error": str(e)[:160]}
+
+    # Measured DPU-overlap speedup lives in the committed OFFLOAD_BENCH.json
+    # (examples/bench_offload_dpu.py): demonstrating overlap on this tunnel
+    # needs gas~200 so device compute rivals the 30s+ host sweep — too slow
+    # to re-measure in every driver bench run.
+
+    # ZeRO ladder at the flagship shape + the 125M short/long-seq points +
+    # the largest single-chip model (760M: Adam states + remat'd
+    # activations fill the 16GB HBM).  NOTE: on ONE chip the z2/z3
+    # sharding constraints are no-ops — these points verify zero overhead
+    # in the degenerate case, not sharding benefit (that is the dryrun's
+    # and the offload points' job).
     for name, args, kw in [
+        ("gpt2_760m_T1024_z1_remat", ("gpt2-760m", 1024, 4, 1),
+         {"remat": True}),
         ("gpt2_350m_T1024_z2", ("gpt2-350m", 1024, 8, 2), {}),
         ("gpt2_350m_T1024_z3", ("gpt2-350m", 1024, 8, 3), {}),
         ("gpt2_125m_T512_z1", ("gpt2-125m", 512, 24, 1), {}),
         ("gpt2_125m_T2048_z1", ("gpt2-125m", 2048, 4, 1), {}),
-        ("gpt2_760m_T1024_z1_remat", ("gpt2-760m", 1024, 4, 1),
-         {"remat": True}),
     ]:
-        if time.time() - t_start > TIME_BUDGET_S:
+        if left() < 2 * 60:
             extra[name] = {"skipped": "time budget"}
             continue
         try:

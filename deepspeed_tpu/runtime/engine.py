@@ -25,6 +25,7 @@ gradient-accumulation boundary.
 
 import json
 import os
+import time
 from functools import partial
 from typing import Any, Callable, NamedTuple, Optional
 
@@ -74,7 +75,15 @@ def _resolve_model(model, loss_fn, params, apply_fn, rng_seed):
             loss_fn = model.loss
         if params is None:
             assert hasattr(model, "init"), "model must expose .init(rng) -> params"
-            params = model.init(jax.random.PRNGKey(rng_seed))
+            # jit the WHOLE init: eager per-leaf RNG ops are one device
+            # dispatch each — on a remote-attached chip (~0.5-1 s round-trip
+            # latency) a billion-param model's init takes tens of minutes
+            # eagerly vs one compile + one dispatch jitted
+            try:
+                params = jax.jit(model.init)(jax.random.PRNGKey(rng_seed))
+            except Exception:
+                # init closures that resist tracing (python-side state)
+                params = model.init(jax.random.PRNGKey(rng_seed))
         if apply_fn is None and hasattr(model, "apply"):
             apply_fn = model.apply
         tp_specs = getattr(model, "partition_specs", None)
@@ -124,7 +133,9 @@ class DeepSpeedEngine:
                 "stage 3.", ranks=[0])
         self._loss_fn, params0, self._apply_fn, self._tp_specs = _resolve_model(
             model, loss_fn, params, apply_fn, rng_seed)
-        params0 = tree_cast(params0, jnp.float32)
+        # one jitted cast, not one dispatch per leaf (dispatch latency on a
+        # remote-attached chip makes eager tree_map casts minutes-slow)
+        params0 = jax.jit(lambda t: tree_cast(t, jnp.float32))(params0)
 
         # ---- optimizer -----------------------------------------------------
         self.optimizer = self._configure_optimizer(optimizer)
@@ -173,6 +184,14 @@ class DeepSpeedEngine:
                 optimizer_name=name,
                 optimizer_params=self.config.optimizer_params,
                 compute_dtype_name=self.config.precision_dtype)
+        # one-step delayed parameter update (ZeRO-Offload DPU): device step
+        # k+1 overlaps the host optimizer+transfers for step k
+        off_cfg = self.config.zero_config.offload_optimizer
+        self._dpu = (self._offload is not None and off_cfg is not None
+                     and off_cfg.delayed_param_update)
+        self._dpu_warmup = (off_cfg.delayed_param_update_warmup
+                            if self._dpu else 0)
+        self._pending_offload = None   # (grads, metrics) awaiting host apply
 
         # ---- sparse embedding gradients (reference engine.py:2227
         # sparse_allreduce_no_retain) -----------------------------------------
@@ -322,7 +341,10 @@ class DeepSpeedEngine:
         dtype = self.compute_dtype
         needs_master = dtype != jnp.float32
 
-        params = jax.device_put(tree_cast(params0, dtype), self._param_sh)
+        # jit fuses the casts and materializes directly into the sharding
+        # (one dispatch; eager per-leaf casts pay per-leaf latency)
+        params = jax.jit(lambda t: tree_cast(t, dtype),
+                         out_shardings=self._param_sh)(params0)
 
         if self._offload is not None:
             # fp32 master + optimizer state live on the HOST (or NVMe); the
@@ -501,13 +523,30 @@ class DeepSpeedEngine:
 
     def _grad_only_step(self, state: TrainState, batch, rng):
         """Device half of the offload step: grads (unscaled, clipped, sharded)
-        + metrics; the optimizer update happens on the host
-        (reference: backward populates the fp32 cpu partition,
+        + metrics + the UPDATED loss-scale state; the optimizer update happens
+        on the host (reference: backward populates the fp32 cpu partition,
         ``stage_1_and_2.py:1008-1160``).  Grads cross to the host in the
         16-bit compute dtype — the reference also moves 16-bit grads over
-        PCIe and upcasts on the CPU (half the transfer bytes)."""
-        grads, _, _, metrics = self._grads_and_metrics(
+        PCIe and upcasts on the CPU (half the transfer bytes).
+
+        The dynamic loss scale updates IN-GRAPH (eagerly), not host-side
+        with the delayed param apply: under DPU the next step dispatches
+        before the previous host apply, and a host-side scale update would
+        reach it one step late — one overflow would then cost two skipped
+        steps and two halvings.  In-graph, the halved scale flows to the
+        next dispatch through device state with no host sync."""
+        grads, overflow, _, metrics = self._grads_and_metrics(
             state, state.params, batch, rng)
+        if self.fp16_enabled:
+            new_scale = ls.update_scale(
+                state.scale, overflow, dynamic=self._scaler.dynamic,
+                scale_factor=self._scaler.scale_factor,
+                scale_window=self._scaler.scale_window,
+                min_scale=self._scaler.min_scale,
+                delayed_shift=self._scaler.delayed_shift,
+                consecutive_hysteresis=self._scaler.consecutive_hysteresis)
+        else:
+            new_scale = state.scale
         if self.compute_dtype == jnp.bfloat16:
             # bf16 spans the fp32 exponent range so no new inf can appear
             # after the overflow check; fp16 (max 65504) must stay fp32 —
@@ -519,7 +558,7 @@ class DeepSpeedEngine:
             # ERROR (checked host-side in _host_offload_update), never a
             # silent truncation of embedding gradients
             metrics["sparse_rows_dropped"] = rows_dropped
-        return grads, metrics
+        return grads, metrics, new_scale
 
     def _sparsify_grads(self, grads, batch):
         """Replace declared embedding-grad leaves with row-sparse
@@ -590,26 +629,25 @@ class DeepSpeedEngine:
                     "would be dropped; raise the bound (or remove "
                     "sparse_grad_row_bound to use the safe default)")
         if not overflow:
+            t0 = time.time()
             flat = self._offload.flatten_grads(grads)
+            t1 = time.time()
             lr = float(metrics["lr"])
             self._offload.step(flat, int(state.optimizer_steps) + 1, lr)
+            t2 = time.time()
+            # h2d dispatch is async; its cost surfaces as next-step wait
             params = jax.device_put(self._offload.payload_tree(), self._param_sh)
+            self._offload.last_host_times = {
+                "grad_d2h_flatten_s": t1 - t0, "host_adam_s": t2 - t1}
         else:
             params = state.params
-        scale = state.scale
-        if self.fp16_enabled:
-            scale = ls.update_scale(
-                scale, jnp.asarray(overflow), dynamic=self._scaler.dynamic,
-                scale_factor=self._scaler.scale_factor,
-                scale_window=self._scaler.scale_window,
-                min_scale=self._scaler.min_scale,
-                delayed_shift=self._scaler.delayed_shift,
-                consecutive_hysteresis=self._scaler.consecutive_hysteresis)
+        # scale already advanced in-graph by _grad_only_step (kept as-is:
+        # under DPU `state` may carry newer scale than this pending step)
         self.state = TrainState(
             global_steps=state.global_steps + 1,
             optimizer_steps=state.optimizer_steps + (1 - ovf),
             skipped_steps=state.skipped_steps + ovf,
-            params=params, master=None, opt_state=None, scale=scale)
+            params=params, master=None, opt_state=None, scale=state.scale)
 
     # ------------------------------------------------------------- public API
     def train_batch(self, data_iter=None):
@@ -664,8 +702,25 @@ class DeepSpeedEngine:
         # constraints inside models (MoE expert axis, SP) bind to it
         with jax.set_mesh(self.mesh):
             if self._offload is not None:
-                grads, metrics = self._jit_grad_step(self.state, batch, rng)
-                self._host_offload_update(grads, metrics)
+                grads, metrics, new_scale = self._jit_grad_step(
+                    self.state, batch, rng)
+                # loss scale advances eagerly (device-graph dependency): the
+                # NEXT dispatch sees a post-overflow halving with no host sync
+                self.state = self.state._replace(scale=new_scale)
+                # queue grad d2h behind the device compute (async copy
+                # engine; overlaps the host work below)
+                self._offload.start_d2h(grads)
+                if self._dpu and self._global_steps_host >= self._dpu_warmup:
+                    # DPU steady state: while the device computes THIS
+                    # step's grads, the host applies the PREVIOUS step's —
+                    # params are one step stale (ZeRO-Offload paper §DPU;
+                    # the reference's overlap-centric design,
+                    # docs/_posts/2021-03-08-zero3-offload.md:72)
+                    if self._pending_offload is not None:
+                        self._host_offload_update(*self._pending_offload)
+                    self._pending_offload = (grads, metrics)
+                else:
+                    self._host_offload_update(grads, metrics)
             else:
                 self.state, metrics = self._jit_train_step(self.state, batch, rng)
         self._last_metrics = metrics
@@ -689,8 +744,16 @@ class DeepSpeedEngine:
         self._write_tensorboard(step_no, metrics)
         return metrics["loss"]
 
+    def _flush_offload(self):
+        """Apply a pending delayed-param update so exported / evaluated
+        parameters reflect every batch seen (DPU holds one step in flight)."""
+        if self._pending_offload is not None:
+            pending, self._pending_offload = self._pending_offload, None
+            self._host_offload_update(*pending)
+
     def eval_batch(self, batch, rng=None):
         """Loss without gradient/update (jitted separately)."""
+        self._flush_offload()
         if self._jit_eval is None:
             def eval_fn(params, mb, r):
                 return self._loss_fn(params, mb, r)
@@ -853,6 +916,7 @@ class DeepSpeedEngine:
 
     def module_state_dict(self):
         """Full (gathered) params as a host pytree of numpy arrays."""
+        self._flush_offload()
         return jax.tree_util.tree_map(np.asarray, self.state.params)
 
     # ----------------------------------------------------------- checkpoints
@@ -868,6 +932,7 @@ class DeepSpeedEngine:
         needs ``elastic_checkpoint`` machinery for this; here resharding is a
         device_put)."""
         from ..checkpoint.serialization import save_tree
+        self._flush_offload()
         tag = tag or f"global_step{self.global_steps}"
         path = self._get_ckpt_name(save_dir, tag)
         os.makedirs(path, exist_ok=True)
@@ -931,6 +996,7 @@ class DeepSpeedEngine:
         ``_zero3_consolidated_16bit_state_dict`` :3118 — with sharded state
         the gather here is just the host transfer in ``save_tree``)."""
         from ..checkpoint.serialization import save_tree
+        self._flush_offload()
         os.makedirs(save_dir, exist_ok=True)
         path = os.path.join(save_dir, save_filename)
         save_tree(path, {"params": self.state.params},
@@ -942,6 +1008,8 @@ class DeepSpeedEngine:
                         load_optimizer_states=True, load_lr_scheduler_states=True):
         """Parity: reference ``engine.py:2467``. Returns (path, client_state)."""
         from ..checkpoint.serialization import load_tree
+        # a pending delayed update is superseded by the loaded state
+        self._pending_offload = None
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILE)
             assert os.path.isfile(latest), f"missing {latest}; pass tag="

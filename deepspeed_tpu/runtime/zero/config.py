@@ -70,6 +70,17 @@ class DeepSpeedZeroOffloadOptimizerConfig:
         self.pipeline_read = get_scalar_param(param_dict, "pipeline_read", False)
         self.pipeline_write = get_scalar_param(param_dict, "pipeline_write", False)
         self.fast_init = get_scalar_param(param_dict, "fast_init", False)
+        # One-step delayed parameter update (the ZeRO-Offload paper's DPU;
+        # the reference's "communication overlap centric design",
+        # docs/_posts/2021-03-08-zero3-offload.md:72): the device computes
+        # step k+1's gradients with step k's parameters while the host runs
+        # step k's optimizer and uploads — hiding the full d2h/step/h2d
+        # latency behind device compute at the cost of one-step-stale
+        # parameters after the warmup window.
+        self.delayed_param_update = get_scalar_param(
+            param_dict, "delayed_param_update", False)
+        self.delayed_param_update_warmup = int(get_scalar_param(
+            param_dict, "delayed_param_update_warmup", 20))
 
     @property
     def pipeline(self):
@@ -79,7 +90,9 @@ class DeepSpeedZeroOffloadOptimizerConfig:
         return dict(device=self.device, nvme_path=self.nvme_path,
                     buffer_count=self.buffer_count, pin_memory=self.pin_memory,
                     pipeline_read=self.pipeline_read, pipeline_write=self.pipeline_write,
-                    fast_init=self.fast_init)
+                    fast_init=self.fast_init,
+                    delayed_param_update=self.delayed_param_update,
+                    delayed_param_update_warmup=self.delayed_param_update_warmup)
 
 
 class DeepSpeedZeroConfig:

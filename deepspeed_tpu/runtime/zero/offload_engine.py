@@ -45,6 +45,10 @@ class HostOffloadOptimizer:
         self.offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
         self.numel = int(self.offsets[-1])
         self.master = np.empty(self.numel, np.float32)
+        # start every d2h before consuming any: per-leaf sequential
+        # np.asarray pays one transfer LATENCY per leaf (~minutes for a
+        # billion-param tree on a remote-attached chip)
+        self.start_d2h(leaves)
         for leaf, off, n in zip(leaves, self.offsets, sizes):
             self.master[off:off + n] = np.asarray(leaf, np.float32).ravel()
 
@@ -79,6 +83,21 @@ class HostOffloadOptimizer:
                  f"native={self.opt.is_native}", ranks=[0])
 
     # ------------------------------------------------------------ flattening
+    @staticmethod
+    def start_d2h(grads_tree):
+        """Kick off the device→host DMA for every gradient leaf WITHOUT
+        blocking.  Called right after the grad step is dispatched, so the
+        transfers queue behind the device compute and run while the host
+        does other work (the reference overlaps per-bucket pinned d2h
+        copies with backward, ``stage_1_and_2.py:1008-1160``; here the
+        async copy engine provides the same pipelining).  The later
+        ``flatten_grads``'s ``np.asarray`` calls then find the bytes
+        already home (or in flight) instead of serializing one blocking
+        transfer per leaf."""
+        for leaf in jax.tree_util.tree_leaves(grads_tree):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+
     def flatten_grads(self, grads_tree):
         """Device grads pytree → flat host fp32 (the d2h transfer).
 

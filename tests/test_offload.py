@@ -95,6 +95,87 @@ def test_cpu_offload_checkpoint_roundtrip(tmp_path, devices):
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
 
+def _fixed_batches(n, seed=11):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(8, 8).astype(np.float32),
+             rng.randn(8, 8).astype(np.float32)) for _ in range(n)]
+
+
+def _dpu_cfg(warmup, lr=1e-2):
+    return {"optimizer": {"type": "Adam", "params": {"lr": lr}},
+            "zero_optimization": {
+                "stage": 2,
+                "offload_optimizer": {"device": "cpu",
+                                      "delayed_param_update": True,
+                                      "delayed_param_update_warmup": warmup}}}
+
+
+def _dpu_engine(warmup):
+    engine, _, _, _ = ds.initialize(
+        config=base_config(micro=4, over=_dpu_cfg(warmup)),
+        model=SimpleModel(dim=8), training_data=random_dataset(n=64),
+        mesh=make_mesh({"data": 2, "fsdp": 4}))
+    return engine
+
+
+def test_dpu_within_warmup_matches_sync(devices):
+    """Before the warmup boundary DPU must be byte-identical to the
+    synchronous offload path."""
+    batches = _fixed_batches(4)
+    e_sync, _, _, _ = ds.initialize(
+        config=base_config(micro=4, over={
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}}}),
+        model=SimpleModel(dim=8), training_data=random_dataset(n=64),
+        mesh=make_mesh({"data": 2, "fsdp": 4}))
+    e_dpu = _dpu_engine(warmup=100)   # never activates
+    assert e_dpu._dpu
+    l_sync = [float(e_sync.train_batch(iter(batches))) for _ in range(3)]
+    l_dpu = [float(e_dpu.train_batch(iter(batches))) for _ in range(3)]
+    np.testing.assert_allclose(l_sync, l_dpu, rtol=1e-6)
+    np.testing.assert_array_equal(e_sync._offload.master,
+                                  e_dpu._offload.master)
+
+
+def test_dpu_one_step_lag_semantics(devices):
+    """warmup=0: after the FIRST batch no update has been applied; after the
+    second, exactly the first batch's update has (one-step staleness —
+    ZeRO-Offload DPU)."""
+    batches = _fixed_batches(3)
+    e = _dpu_engine(warmup=0)
+    p0 = e._offload.master.copy()
+    e.train_batch(iter(batches))           # grads(p0, b0) -> pending
+    np.testing.assert_array_equal(e._offload.master, p0)   # nothing applied
+    e.train_batch(iter(batches[1:]))       # applies b0's update
+    # reference: synchronous engine, one step on the same first batch
+    e_ref, _, _, _ = ds.initialize(
+        config=base_config(micro=4, over={
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}}}),
+        model=SimpleModel(dim=8), training_data=random_dataset(n=64),
+        mesh=make_mesh({"data": 2, "fsdp": 4}))
+    e_ref.train_batch(iter(batches))
+    np.testing.assert_allclose(e._offload.master, e_ref._offload.master,
+                               rtol=1e-6)
+    # flush applies the pending second batch and clears it
+    e._flush_offload()
+    assert e._pending_offload is None
+    assert not np.array_equal(e._offload.master, e_ref._offload.master)
+
+
+def test_dpu_converges_and_checkpoint_flushes(tmp_path, devices):
+    e = _dpu_engine(warmup=2)
+    losses = [float(e.train_batch()) for _ in range(12)]
+    assert losses[-1] < losses[0]
+    assert e._pending_offload is not None     # steady state holds one step
+    e.save_checkpoint(str(tmp_path))          # must flush before export
+    assert e._pending_offload is None
+    # counters caught up: every batch became an optimizer step
+    assert int(e.state.optimizer_steps) == 12
+
+
 def test_cpu_offload_weight_decay_matches_device(devices):
     # decoupled decay must behave identically with and without offload
     cfg = {"optimizer": {"type": "Adam",
