@@ -149,4 +149,36 @@ class OpenMPIRunner(MultiNodeRunner):
         return [cmd + ["bash", "-c", inner]]
 
 
-RUNNERS = {r.name: r for r in (SSHRunner, PDSHRunner, OpenMPIRunner)}
+class MVAPICHRunner(MultiNodeRunner):
+    """Parity: reference ``MVAPICHRunner`` (:156) — mpirun_rsh with a
+    generated hostfile and env passed as KEY=VALUE arguments (mpirun_rsh
+    forwards no environment by default).  The per-rank id comes from
+    ``MV2_COMM_WORLD_RANK``, which MVAPICH2 sets for every launched
+    process."""
+
+    name = "mvapich"
+    HOSTFILE = "/tmp/deepspeed_mvapich_hostfile"
+
+    def backend_exists(self):
+        # the reference additionally greps `mpiname` for MVAPICH2; the
+        # binary check keeps this host-tool-free when absent
+        return shutil.which("mpirun_rsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        hosts = list(active_resources.keys())
+        coordinator = environment["coordinator"]
+        remote_env = self._coordinator_env(coordinator, len(hosts))
+        with open(self.HOSTFILE, "w") as f:
+            f.write("\n".join(hosts) + "\n")
+        cmd = ["mpirun_rsh", "-np", str(len(hosts)),
+               "-hostfile", self.HOSTFILE]
+        for k, v in remote_env.items():
+            cmd.append(f"{k}={v}")
+        inner = ("export JAX_PROCESS_ID=${MV2_COMM_WORLD_RANK:?}; "
+                 f"cd {shlex.quote(os.getcwd())} && exec " +
+                 " ".join(map(shlex.quote, self._user_cmd())))
+        return [cmd + ["bash", "-c", inner]]
+
+
+RUNNERS = {r.name: r for r in (SSHRunner, PDSHRunner, OpenMPIRunner,
+                               MVAPICHRunner)}

@@ -46,7 +46,7 @@ def parse_args(args=None):
     parser.add_argument("--ssh_port", type=int, default=None)
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "openmpi"],
+                        choices=["ssh", "pdsh", "openmpi", "mvapich"],
                         help="Multi-node transport (reference --launcher: "
                              "pdsh/openmpi/mvapich; here ssh is the default)")
     parser.add_argument("--autotuning", type=str, default="",

@@ -3,10 +3,12 @@
 Parity: reference ``ops/sparse_attention/sparse_self_attention.py:13`` — an
 attention layer that consumes a :class:`SparsityConfig` and computes
 block-sparse softmax(QKᵀ)V.  The reference dispatches to Triton SDD/DSD/DDS
-matmuls + block-sparse softmax; here the layout gates blocks of the pallas
-flash kernel (``sparse_flash_attention``), which skips disallowed blocks'
-compute (K/V tiles are still streamed by the block pipeline; LUT grid
-compression is future work).
+matmuls + block-sparse softmax driven by ``make_lut``
+(``ops/sparse_attention/matmul.py:288``); here the layout compiles into
+per-row live-block LUTs that size the pallas flash kernel's grid
+(``sparse_flash_attention``) — skipped blocks skip compute AND their K/V
+DMA, so HBM traffic scales with density.  TPU note: use layout blocks
+>= 128 (ideally 256-512) — MXU efficiency, not the kernel, sets that floor.
 
 Mask semantics parity (reference ``sparse_self_attention.py:46-75``):
 ``key_padding_mask`` (B, T) over keys and ``attn_mask`` (T, T) are honored

@@ -58,56 +58,109 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
+# ======================================================== sparse-layout LUTs
+@functools.lru_cache(maxsize=64)
+def _sparse_luts(layout_bytes, shape, causal, block_q, block_k):
+    """Grid-compression LUTs for a static block layout (reference: the
+    Triton kernels' ``make_lut``, ``ops/sparse_attention/matmul.py:288,429``
+    — there the LUT drives SDD/DSD tiles; here it drives the Pallas grid so
+    skipped blocks skip their K/V DMA entirely, not just their MXU time).
+
+    Returns ``(kmap (H,nq,Lk), klen (H,nq), qmap (H,nk,Lq), qlen (H,nk))``
+    int32 numpy arrays: per q-row the live k-blocks (causal-pruned) for the
+    forward/dQ grids, and the transpose for the dK/dV grid.
+
+    Rows shorter than the max pad by REPEATING their last live block: the
+    Pallas pipeline only issues a DMA when a block's index map value
+    CHANGES between grid steps, so padded slots re-visit an already-resident
+    block (zero HBM traffic) and their compute is gated off by the length.
+    This matters for patterns with global rows (Longformer/BigBird): one
+    dense global row forces the padded width to nk, but every other row
+    still moves only its live blocks."""
+    H, nq, nk = shape
+    layout = np.frombuffer(layout_bytes, np.int32).reshape(shape)
+    live = layout > 0
+    if causal:
+        qi = np.arange(nq)[:, None] * block_q + (block_q - 1)
+        ki = np.arange(nk)[None, :] * block_k
+        live = live & (ki <= qi)[None]
+    k_lists = [[np.nonzero(live[h, i])[0] for i in range(nq)]
+               for h in range(H)]
+    q_lists = [[np.nonzero(live[h, :, j])[0] for j in range(nk)]
+               for h in range(H)]
+    Lk = max(1, max(len(l) for rows in k_lists for l in [*rows]))
+    Lq = max(1, max(len(l) for rows in q_lists for l in [*rows]))
+
+    def fill(dst_map, dst_len, lists):
+        for h in range(H):
+            for i, l in enumerate(lists[h]):
+                dst_map[h, i, :len(l)] = l
+                dst_map[h, i, len(l):] = l[-1] if len(l) else 0
+                dst_len[h, i] = len(l)
+    kmap = np.zeros((H, nq, Lk), np.int32)
+    klen = np.zeros((H, nq), np.int32)
+    qmap = np.zeros((H, nk, Lq), np.int32)
+    qlen = np.zeros((H, nk), np.int32)
+    fill(kmap, klen, k_lists)
+    fill(qmap, qlen, q_lists)
+    return kmap, klen, qmap, qlen
+
+
 # =============================================================== forward kernel
-def _unpack_in_refs(refs, use_layout, n_main, use_kbias, use_abias):
-    """Unpack input refs in call order ``[layout] main... [kb] [ab]``;
-    returns ``(layout_ref, main_refs, kb_ref, ab_ref, next_idx)`` where
-    ``next_idx`` points at the first output ref."""
-    idx = 0
-    layout_ref = refs[idx] if use_layout else None
-    idx += int(use_layout)
-    main = refs[idx:idx + n_main]
-    idx += n_main
+def _unpack_in_refs(refs, n_main, use_kbias, use_abias):
+    """Unpack input refs in call order ``main... [kb] [ab]``; returns
+    ``(main_refs, kb_ref, ab_ref, next_idx)`` where ``next_idx`` points at
+    the first output ref."""
+    idx = n_main
+    main = refs[:n_main]
     kb_ref = refs[idx] if use_kbias else None
     idx += int(use_kbias)
     ab_ref = refs[idx] if use_abias else None
     idx += int(use_abias)
-    return layout_ref, main, kb_ref, ab_ref, idx
+    return main, kb_ref, ab_ref, idx
 
 
 def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
-                seq_len, use_layout=False, n_heads=1, use_kbias=False,
-                use_abias=False):
+                seq_len, n_heads=1, use_kbias=False,
+                use_abias=False, use_lut=False):
     """Grid: (BH, nq, nk) with nk innermost (revisits scratch).
 
-    With ``use_layout`` a block-layout ref (SMEM scalar per (head, qi, ki))
-    gates whole blocks — this is the block-sparse attention path (reference
-    ``ops/sparse_attention/matmul.py`` SDD/DSD/DDS Triton kernels; here the
-    same flash kernel simply skips disallowed blocks).
+    With ``use_lut`` (the block-sparse path; reference
+    ``ops/sparse_attention/matmul.py`` SDD/DSD/DDS Triton kernels + their
+    ``make_lut`` grid compression) the inner grid dim is the per-row
+    LIVE block count: two scalar-prefetch refs ``(kmap, klen)`` lead the
+    argument list, the j-th visited k block is ``kmap[h, qi, j]`` (the
+    BlockSpec index maps DMA exactly that block), and ``j < klen[h, qi]``
+    gates padding slots.  Skipped blocks never touch HBM.
 
     ``use_kbias``/``use_abias``: additive score biases — (B, T) over keys
     (padding) and (T, T) shared across batch (attention mask) — applied
     in-kernel (reference ``softmax_kernels.cu`` attn_softmax masked paths)."""
-    layout_ref, (q_ref, k_ref, v_ref), kb_ref, ab_ref, idx = \
-        _unpack_in_refs(refs, use_layout, 3, use_kbias, use_abias)
+    if use_lut:
+        kmap_ref, klen_ref = refs[:2]
+        refs = refs[2:]
+    (q_ref, k_ref, v_ref), kb_ref, ab_ref, idx = \
+        _unpack_in_refs(refs, 3, use_kbias, use_abias)
     o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[idx:idx + 5]
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    kj = pl.program_id(2)
 
-    @pl.when(ki == 0)
+    @pl.when(kj == 0)
     def _():
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: process only k blocks that intersect the lower triangle
-    should_compute = True
-    if causal:
-        should_compute = ki * block_k <= qi * block_q + (block_q - 1)
-    if layout_ref is not None:
+    if use_lut:
         h_idx = pl.program_id(0) % n_heads
-        should_compute = jnp.logical_and(should_compute,
-                                         layout_ref[h_idx, qi, ki] > 0)
+        ki = kmap_ref[h_idx, qi, kj]          # actual k-block index
+        should_compute = kj < klen_ref[h_idx, qi]
+    else:
+        ki = kj
+        # causal: process only k blocks that intersect the lower triangle
+        should_compute = True
+        if causal:
+            should_compute = ki * block_k <= qi * block_q + (block_q - 1)
 
     @pl.when(should_compute)
     def _():
@@ -142,7 +195,7 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
-    @pl.when(ki == num_k_blocks - 1)
+    @pl.when(kj == num_k_blocks - 1)
     def _():
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -174,14 +227,17 @@ def _pad_t(x, Tp):
     return jnp.pad(x, ((0, 0), (0, Tp - T), (0, 0)))
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
-         n_heads=None, k_bias=None, attn_bias=None):
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k,
+         n_heads=None, k_bias=None, attn_bias=None, kmap=None, klen=None):
     """q,k,v: (BH, T, d) → (out (BH, T, d), lse (BH, T)).
 
-    ``layout``: optional (n_heads, nq, nk) int32 block mask (block-sparse).
+    ``kmap``/``klen``: optional grid-compression LUT (``_sparse_luts``) —
+    the inner grid shrinks to the max live-block count and skipped blocks
+    skip their DMA.
     ``k_bias``: optional (B, T) additive key bias (padding mask).
     ``attn_bias``: optional (T, T) additive score bias (attention mask)."""
     BH, T, d = q.shape
+    use_lut = kmap is not None
     block_q, block_k = _auto_blocks(T, d, block_q, block_k)
     block_q = min(block_q, T)
     block_k = min(block_k, T)
@@ -192,84 +248,113 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=None,
     # would silently shift
     blk = np.lcm(block_q, block_k)
     Tp = int(np.ceil(T / blk) * blk)
+    assert not use_lut or Tp == T   # layout blocks always divide T
     q, k, v = _pad_t(q, Tp), _pad_t(k, Tp), _pad_t(v, Tp)
     nq = pl.cdiv(Tp, block_q)
     nk = pl.cdiv(Tp, block_k)
+    H = n_heads or 1
+
+    if use_lut:
+        # index maps receive the scalar-prefetch refs appended after the
+        # grid ids; the j-th visited block is kmap[h, i, j]
+        kv_idx = lambda b, i, j, km, kl: (b, km[jax.lax.rem(b, H), i, j], 0)
+        q_idx = lambda b, i, j, km, kl: (b, i, 0)
+        kb_idx = lambda b, i, j, km, kl: (
+            jax.lax.div(b, H), km[jax.lax.rem(b, H), i, j], 0, 0)
+        ab_idx = lambda b, i, j, km, kl: (i, km[jax.lax.rem(b, H), i, j], 0, 0)
+        n_inner = kmap.shape[2]
+    else:
+        kv_idx = lambda b, i, j: (b, j, 0)
+        q_idx = lambda b, i, j: (b, i, 0)
+        kb_idx = lambda b, i, j: (jax.lax.div(b, H), j, 0, 0)
+        ab_idx = lambda b, i, j: (i, j, 0, 0)
+        n_inner = nk
 
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), q_idx),
+        pl.BlockSpec((1, block_k, d), kv_idx),
+        pl.BlockSpec((1, block_k, d), kv_idx),
     ]
     args = (q, k, v)
-    if layout is not None:
-        # whole layout in SMEM (tiny int32 table); kernels index it with
-        # program ids — per-block blocking would violate Mosaic lane tiling
-        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
-        args = (layout,) + args
-    H = n_heads or 1
     if k_bias is not None:                    # (B, T) → (B, nk, 1, bk)
         k_bias = _tile_kbias(k_bias, T, Tp, block_k)
-        in_specs.append(pl.BlockSpec((1, 1, 1, block_k),
-                                     lambda b, i, j: (jax.lax.div(b, H), j, 0, 0)))
+        in_specs.append(pl.BlockSpec((1, 1, 1, block_k), kb_idx))
         args = args + (k_bias,)
     if attn_bias is not None:                 # (T, T) → (nq, nk, bq, bk)
         attn_bias = _tile_abias(attn_bias, T, Tp, block_q, block_k)
-        in_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
-                                     lambda b, i, j: (i, j, 0, 0)))
+        in_specs.append(pl.BlockSpec((1, 1, block_q, block_k), ab_idx))
         args = args + (attn_bias,)
-    out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          seq_len=T, use_layout=layout is not None,
-                          n_heads=H, use_kbias=k_bias is not None,
-                          use_abias=attn_bias is not None),
-        grid=(BH, nq, nk),
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, MIN_LANES), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, Tp, d), q.dtype),
-            jax.ShapeDtypeStruct((BH, Tp, MIN_LANES), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(*args)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=n_inner,
+        seq_len=T, n_heads=H, use_kbias=k_bias is not None,
+        use_abias=attn_bias is not None, use_lut=use_lut)
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), q_idx),
+        pl.BlockSpec((1, block_q, MIN_LANES), q_idx),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((BH, Tp, d), q.dtype),
+        jax.ShapeDtypeStruct((BH, Tp, MIN_LANES), jnp.float32),
+    ]
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+    ]
+    cp = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if use_lut:
+        out, lse = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2, grid=(BH, nq, n_inner),
+                in_specs=in_specs, out_specs=out_specs,
+                scratch_shapes=scratch),
+            out_shape=out_shape, compiler_params=cp,
+            interpret=_interpret(),
+        )(kmap, klen, *args)
+    else:
+        out, lse = pl.pallas_call(
+            kernel, grid=(BH, nq, n_inner), in_specs=in_specs,
+            out_specs=out_specs, out_shape=out_shape,
+            scratch_shapes=scratch, compiler_params=cp,
+            interpret=_interpret(),
+        )(*args)
     return out[:, :T], lse[:, :T, 0]
 
 
 # ============================================================== backward kernels
 def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
-                     seq_len, use_layout=False, n_heads=1, use_kbias=False,
-                     use_abias=False):
-    """Grid: (BH, nk, nq) with nq innermost; accumulates dK/dV for one k block."""
-    layout_ref, (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), \
+                     seq_len, n_heads=1, use_kbias=False,
+                     use_abias=False, use_lut=False):
+    """Grid: (BH, nk, nq) with nq innermost; accumulates dK/dV for one k block.
+    ``use_lut``: inner dim is the live q-block count; scalar-prefetch
+    ``(qmap, qlen)`` lead the args and pick the visited q block."""
+    if use_lut:
+        qmap_ref, qlen_ref = refs[:2]
+        refs = refs[2:]
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), \
         kb_ref, ab_ref, idx = \
-        _unpack_in_refs(refs, use_layout, 6, use_kbias, use_abias)
+        _unpack_in_refs(refs, 6, use_kbias, use_abias)
     dk_ref, dv_ref, dk_acc, dv_acc = refs[idx:idx + 4]
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    qj = pl.program_id(2)
 
-    @pl.when(qi == 0)
+    @pl.when(qj == 0)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    should_compute = True
-    if causal:
-        should_compute = qi * block_q + (block_q - 1) >= ki * block_k
-    if layout_ref is not None:
+    if use_lut:
         h_idx = pl.program_id(0) % n_heads
-        should_compute = jnp.logical_and(should_compute,
-                                         layout_ref[h_idx, qi, ki] > 0)
+        qi = qmap_ref[h_idx, ki, qj]
+        should_compute = qj < qlen_ref[h_idx, ki]
+    else:
+        qi = qj
+        should_compute = True
+        if causal:
+            should_compute = qi * block_q + (block_q - 1) >= ki * block_k
 
     @pl.when(should_compute)
     def _():
@@ -308,34 +393,40 @@ def _bwd_dkdv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q_blocks,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(qj == num_q_blocks - 1)
     def _():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
-                   seq_len, use_layout=False, n_heads=1, use_kbias=False,
-                   use_abias=False):
-    """Grid: (BH, nq, nk) with nk innermost; accumulates dQ for one q block."""
-    layout_ref, (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), \
+                   seq_len, n_heads=1, use_kbias=False,
+                   use_abias=False, use_lut=False):
+    """Grid: (BH, nq, nk) with nk innermost; accumulates dQ for one q block.
+    ``use_lut``: inner dim is the live k-block count (same LUT as forward)."""
+    if use_lut:
+        kmap_ref, klen_ref = refs[:2]
+        refs = refs[2:]
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), \
         kb_ref, ab_ref, idx = \
-        _unpack_in_refs(refs, use_layout, 6, use_kbias, use_abias)
+        _unpack_in_refs(refs, 6, use_kbias, use_abias)
     dq_ref, dq_acc = refs[idx:idx + 2]
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    kj = pl.program_id(2)
 
-    @pl.when(ki == 0)
+    @pl.when(kj == 0)
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    should_compute = True
-    if causal:
-        should_compute = ki * block_k <= qi * block_q + (block_q - 1)
-    if layout_ref is not None:
+    if use_lut:
         h_idx = pl.program_id(0) % n_heads
-        should_compute = jnp.logical_and(should_compute,
-                                         layout_ref[h_idx, qi, ki] > 0)
+        ki = kmap_ref[h_idx, qi, kj]
+        should_compute = kj < klen_ref[h_idx, qi]
+    else:
+        ki = kj
+        should_compute = True
+        if causal:
+            should_compute = ki * block_k <= qi * block_q + (block_q - 1)
 
     @pl.when(should_compute)
     def _():
@@ -368,15 +459,19 @@ def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(ki == num_k_blocks - 1)
+    @pl.when(kj == num_k_blocks - 1)
     def _():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
-         n_heads=None, dlse=None, k_bias=None, attn_bias=None):
+def _bwd(sm_scale, causal, block_q, block_k, residuals, dout,
+         n_heads=None, dlse=None, k_bias=None, attn_bias=None,
+         luts=None):
     q, k, v, out, lse = residuals
     BH, T, d = q.shape
+    use_lut = luts is not None
+    if use_lut:
+        kmap, klen, qmap, qlen = luts
     block_q, block_k = _auto_blocks(T, d, block_q, block_k)
     block_q = min(block_q, T)
     block_k = min(block_k, T)
@@ -403,17 +498,29 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
     bcast = lambda x: jnp.broadcast_to(x[:, :, None], (BH, Tp, MIN_LANES))
     lse, delta = bcast(lse), bcast(delta)
 
-    stat_spec_ji = pl.BlockSpec((1, block_q, MIN_LANES),
-                                lambda b, j, i: (b, i, 0))
-    dkdv_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # q
-        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # k
-        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),  # v
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),  # do
-        stat_spec_ji,                                              # lse
-        stat_spec_ji,                                              # delta
-    ]
     H = n_heads or 1
+    if use_lut:
+        # dK/dV grid: (BH, nk, live-q); the visited q block is qmap[h, j, i]
+        qrow_idx = lambda b, j, i, qm, ql: (b, qm[jax.lax.rem(b, H), j, i], 0)
+        kcol_idx = lambda b, j, i, qm, ql: (b, j, 0)
+        kb_ji = lambda b, j, i, qm, ql: (jax.lax.div(b, H), j, 0, 0)
+        ab_ji = lambda b, j, i, qm, ql: (qm[jax.lax.rem(b, H), j, i], j, 0, 0)
+        n_inner_q = qmap.shape[2]
+    else:
+        qrow_idx = lambda b, j, i: (b, i, 0)
+        kcol_idx = lambda b, j, i: (b, j, 0)
+        kb_ji = lambda b, j, i: (jax.lax.div(b, H), j, 0, 0)
+        ab_ji = lambda b, j, i: (i, j, 0, 0)
+        n_inner_q = nq
+    stat_spec_ji = pl.BlockSpec((1, block_q, MIN_LANES), qrow_idx)
+    dkdv_specs = [
+        pl.BlockSpec((1, block_q, d), qrow_idx),   # q
+        pl.BlockSpec((1, block_k, d), kcol_idx),   # k
+        pl.BlockSpec((1, block_k, d), kcol_idx),   # v
+        pl.BlockSpec((1, block_q, d), qrow_idx),   # do
+        stat_spec_ji,                              # lse
+        stat_spec_ji,                              # delta
+    ]
     if k_bias is not None:
         k_bias = _tile_kbias(k_bias, k_bias.shape[1], Tp, block_k)
     if attn_bias is not None:
@@ -421,78 +528,102 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout, layout=None,
                                 block_q, block_k)
     dkdv_args = (q, k, v, dout, lse, delta)
     if k_bias is not None:
-        dkdv_specs.append(pl.BlockSpec((1, 1, 1, block_k),
-                                       lambda b, j, i: (jax.lax.div(b, H), j, 0, 0)))
+        dkdv_specs.append(pl.BlockSpec((1, 1, 1, block_k), kb_ji))
         dkdv_args = dkdv_args + (k_bias,)
     if attn_bias is not None:
-        dkdv_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
-                                       lambda b, j, i: (i, j, 0, 0)))
+        dkdv_specs.append(pl.BlockSpec((1, 1, block_q, block_k), ab_ji))
         dkdv_args = dkdv_args + (attn_bias,)
-    if layout is not None:
-        dkdv_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dkdv_specs
-        dkdv_args = (layout,) + dkdv_args
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          seq_len=T, use_layout=layout is not None,
-                          n_heads=H, use_kbias=k_bias is not None,
-                          use_abias=attn_bias is not None),
-        grid=(BH, nk, nq),
-        in_specs=dkdv_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, Tp, d), k.dtype),
-            jax.ShapeDtypeStruct((BH, Tp, d), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(*dkdv_args)
+    dkdv_kernel = functools.partial(
+        _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_q_blocks=n_inner_q,
+        seq_len=T, n_heads=H, use_kbias=k_bias is not None,
+        use_abias=attn_bias is not None, use_lut=use_lut)
+    dkdv_out_specs = [
+        pl.BlockSpec((1, block_k, d), kcol_idx),
+        pl.BlockSpec((1, block_k, d), kcol_idx),
+    ]
+    dkdv_out_shape = [
+        jax.ShapeDtypeStruct((BH, Tp, d), k.dtype),
+        jax.ShapeDtypeStruct((BH, Tp, d), v.dtype),
+    ]
+    dkdv_scratch = [
+        pltpu.VMEM((block_k, d), jnp.float32),
+        pltpu.VMEM((block_k, d), jnp.float32),
+    ]
+    cp = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if use_lut:
+        dk, dv = pl.pallas_call(
+            dkdv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2, grid=(BH, nk, n_inner_q),
+                in_specs=dkdv_specs, out_specs=dkdv_out_specs,
+                scratch_shapes=dkdv_scratch),
+            out_shape=dkdv_out_shape, compiler_params=cp,
+            interpret=_interpret(),
+        )(qmap, qlen, *dkdv_args)
+    else:
+        dk, dv = pl.pallas_call(
+            dkdv_kernel, grid=(BH, nk, n_inner_q), in_specs=dkdv_specs,
+            out_specs=dkdv_out_specs, out_shape=dkdv_out_shape,
+            scratch_shapes=dkdv_scratch, compiler_params=cp,
+            interpret=_interpret(),
+        )(*dkdv_args)
 
-    stat_spec_ij = pl.BlockSpec((1, block_q, MIN_LANES),
-                                lambda b, i, j: (b, i, 0))
+    if use_lut:
+        q_ij = lambda b, i, j, km, kl: (b, i, 0)
+        kv_ij = lambda b, i, j, km, kl: (b, km[jax.lax.rem(b, H), i, j], 0)
+        kb_ij = lambda b, i, j, km, kl: (
+            jax.lax.div(b, H), km[jax.lax.rem(b, H), i, j], 0, 0)
+        ab_ij = lambda b, i, j, km, kl: (i, km[jax.lax.rem(b, H), i, j], 0, 0)
+        n_inner_k = kmap.shape[2]
+    else:
+        q_ij = lambda b, i, j: (b, i, 0)
+        kv_ij = lambda b, i, j: (b, j, 0)
+        kb_ij = lambda b, i, j: (jax.lax.div(b, H), j, 0, 0)
+        ab_ij = lambda b, i, j: (i, j, 0, 0)
+        n_inner_k = nk
+    stat_spec_ij = pl.BlockSpec((1, block_q, MIN_LANES), q_ij)
     dq_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, d), q_ij),
+        pl.BlockSpec((1, block_k, d), kv_ij),
+        pl.BlockSpec((1, block_k, d), kv_ij),
+        pl.BlockSpec((1, block_q, d), q_ij),
         stat_spec_ij,
         stat_spec_ij,
     ]
     dq_args = (q, k, v, dout, lse, delta)
     if k_bias is not None:
-        dq_specs.append(pl.BlockSpec((1, 1, 1, block_k),
-                                     lambda b, i, j: (jax.lax.div(b, H), j, 0, 0)))
+        dq_specs.append(pl.BlockSpec((1, 1, 1, block_k), kb_ij))
         dq_args = dq_args + (k_bias,)
     if attn_bias is not None:
-        dq_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
-                                     lambda b, i, j: (i, j, 0, 0)))
+        dq_specs.append(pl.BlockSpec((1, 1, block_q, block_k), ab_ij))
         dq_args = dq_args + (attn_bias,)
-    if layout is not None:
-        dq_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + dq_specs
-        dq_args = (layout,) + dq_args
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          seq_len=T, use_layout=layout is not None,
-                          n_heads=H, use_kbias=k_bias is not None,
-                          use_abias=attn_bias is not None),
-        grid=(BH, nq, nk),
-        in_specs=dq_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, Tp, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(*dq_args)
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=n_inner_k,
+        seq_len=T, n_heads=H, use_kbias=k_bias is not None,
+        use_abias=attn_bias is not None, use_lut=use_lut)
+    dq_out_spec = pl.BlockSpec((1, block_q, d), q_ij)
+    dq_out_shape = jax.ShapeDtypeStruct((BH, Tp, d), q.dtype)
+    dq_scratch = [pltpu.VMEM((block_q, d), jnp.float32)]
+    if use_lut:
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2, grid=(BH, nq, n_inner_k),
+                in_specs=dq_specs, out_specs=dq_out_spec,
+                scratch_shapes=dq_scratch),
+            out_shape=dq_out_shape, compiler_params=cp,
+            interpret=_interpret(),
+        )(kmap, klen, *dq_args)
+    else:
+        dq = pl.pallas_call(
+            dq_kernel, grid=(BH, nq, n_inner_k), in_specs=dq_specs,
+            out_specs=dq_out_spec, out_shape=dq_out_shape,
+            scratch_shapes=dq_scratch, compiler_params=cp,
+            interpret=_interpret(),
+        )(*dq_args)
 
     return dq[:, :T], dk[:, :T], dv[:, :T]
 
@@ -576,27 +707,46 @@ def flash_attention_with_lse(q, k, v, *, causal=True, sm_scale=None,
             lse.reshape(B, H, T))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _sparse_bhtd(q, k, v, layout, sm_scale, causal, block_q, block_k, n_heads):
-    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=layout,
-                  n_heads=n_heads)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _sparse_bhtd(q, k, v, kmap, klen, qmap, qlen, sm_scale, causal, block_q,
+                 block_k, n_heads):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                  n_heads=n_heads, kmap=kmap, klen=klen)
     return out
 
 
-def _sparse_fwd_rule(q, k, v, layout, sm_scale, causal, block_q, block_k, n_heads):
-    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, layout=layout,
-                    n_heads=n_heads)
-    return out, (q, k, v, out, lse, layout)
+def _sparse_fwd_rule(q, k, v, kmap, klen, qmap, qlen, sm_scale, causal,
+                     block_q, block_k, n_heads):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                    n_heads=n_heads, kmap=kmap, klen=klen)
+    return out, (q, k, v, out, lse, kmap, klen, qmap, qlen)
 
 
-def _sparse_bwd_rule(sm_scale, causal, block_q, block_k, n_heads, residuals, dout):
-    q, k, v, out, lse, layout = residuals
+def _sparse_bwd_rule(sm_scale, causal, block_q, block_k, n_heads, residuals,
+                     dout):
+    q, k, v, out, lse, kmap, klen, qmap, qlen = residuals
     dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k, (q, k, v, out, lse),
-                      dout, layout=layout, n_heads=n_heads)
-    return dq, dk, dv, None
+                      dout, n_heads=n_heads, luts=(kmap, klen, qmap, qlen))
+    return dq, dk, dv, None, None, None, None
 
 
 _sparse_bhtd.defvjp(_sparse_fwd_rule, _sparse_bwd_rule)
+
+
+def _layout_luts(layout, T, H, causal, block_q, block_k):
+    """Host-static layout → per-head jnp LUTs (cached by layout content)."""
+    layout = np.asarray(layout, np.int32)   # raises on traced layouts: the
+    # block pattern must be static — it sizes the Pallas grid
+    Lh, nq, nk = layout.shape
+    assert Lh in (1, H), \
+        f"layout has {Lh} head layouts; expected 1 (shared) or H={H}"
+    if Lh == 1 and H > 1:
+        layout = np.broadcast_to(layout, (H, nq, nk))
+    layout = np.ascontiguousarray(layout)
+    kmap, klen, qmap, qlen = _sparse_luts(
+        layout.tobytes(), layout.shape, bool(causal), block_q, block_k)
+    return (jnp.asarray(kmap), jnp.asarray(klen),
+            jnp.asarray(qmap), jnp.asarray(qlen))
 
 
 def sparse_flash_attention(q, k, v, layout, *, causal=True, sm_scale=None,
@@ -604,13 +754,17 @@ def sparse_flash_attention(q, k, v, layout, *, causal=True, sm_scale=None,
                            key_padding_bias=None, attn_bias=None):
     """Block-sparse flash attention over (B, T, H, d).
 
-    ``layout``: (n_heads_or_1, nq, nk) int block mask from a SparsityConfig
-    (reference ``ops/sparse_attention/sparsity_config.py`` hierarchy).  The
-    block size is implied: block_q = T // nq, block_k = T // nk.  Disallowed
-    blocks skip their compute in-kernel (``pl.when`` gating); their K/V
-    tiles are still DMA'd by the block pipeline, so the win is MXU time, not
-    HBM traffic (a LUT-compressed grid is future work; the reference's
-    Triton kernels compress the grid via LUTs, ``ops/sparse_attention/matmul.py:288``).
+    ``layout``: (n_heads_or_1, nq, nk) HOST-STATIC int block mask from a
+    SparsityConfig (reference ``ops/sparse_attention/sparsity_config.py``
+    hierarchy).  The block size is implied: block_q = T // nq, block_k =
+    T // nk.  The layout compiles into per-row LUTs that SIZE the Pallas
+    grid (reference: the Triton kernels' ``make_lut``,
+    ``ops/sparse_attention/matmul.py:288,429``): the inner grid dimension is
+    the max live-block count per q row, the BlockSpec index maps follow the
+    LUT, and skipped blocks skip their K/V DMA entirely — HBM traffic and
+    MXU time both scale with density.  TPU note: MXU efficiency needs
+    layout blocks >= 128 (ideally 256-512); GPU-oriented block=16 layouts
+    run correct but slow.
     """
     B, T, H, d = q.shape
     Lh, nq, nk = layout.shape
@@ -622,16 +776,12 @@ def sparse_flash_attention(q, k, v, layout, *, causal=True, sm_scale=None,
         f"layout {layout.shape} incompatible with T={T}"
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(d)
-    assert Lh in (1, H), \
-        f"layout has {Lh} head layouts; expected 1 (shared) or H={H}"
-    if Lh == 1 and H > 1:
-        layout = jnp.broadcast_to(layout, (H, nq, nk))
-    layout = jnp.asarray(layout, jnp.int32)
+    luts = _layout_luts(layout, T, H, causal, int(block_q), int(block_k))
     if key_padding_bias is not None or attn_bias is not None:
-        return _biased_call(q, k, v, layout, key_padding_bias, attn_bias,
+        return _biased_call(q, k, v, luts, key_padding_bias, attn_bias,
                             sm_scale, causal, block_q, block_k)
     to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
-    out = _sparse_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), layout,
+    out = _sparse_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), *luts,
                        float(sm_scale), bool(causal), int(block_q),
                        int(block_k), int(H))
     return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
@@ -639,57 +789,67 @@ def sparse_flash_attention(q, k, v, layout, *, causal=True, sm_scale=None,
 
 # ----------------------------------------------------- biased (masked) paths
 @functools.lru_cache(maxsize=None)
-def _make_biased_bhtd(has_layout, has_kb, has_ab):
+def _make_biased_bhtd(has_luts, has_kb, has_ab):
     """custom_vjp'd flash attention with optional in-kernel additive biases.
 
-    One cached instance per (layout?, key-bias?, attn-bias?) combination so
+    One cached instance per (luts?, key-bias?, attn-bias?) combination so
     unused operands never materialize.  Bias cotangents are zeros: masks are
     constants (the reference's mask tensors carry no grad either)."""
 
-    @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
-    def f(q, k, v, layout, kb, ab, sm_scale, causal, block_q, block_k, H):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13))
+    def f(q, k, v, kmap, klen, qmap, qlen, kb, ab, sm_scale, causal,
+          block_q, block_k, H):
         out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k,
-                      layout=layout if has_layout else None, n_heads=H,
+                      n_heads=H,
+                      kmap=kmap if has_luts else None,
+                      klen=klen if has_luts else None,
                       k_bias=kb if has_kb else None,
                       attn_bias=ab if has_ab else None)
         return out
 
-    def fwd_rule(q, k, v, layout, kb, ab, sm_scale, causal, block_q, block_k, H):
+    def fwd_rule(q, k, v, kmap, klen, qmap, qlen, kb, ab, sm_scale, causal,
+                 block_q, block_k, H):
         out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k,
-                        layout=layout if has_layout else None, n_heads=H,
+                        n_heads=H,
+                        kmap=kmap if has_luts else None,
+                        klen=klen if has_luts else None,
                         k_bias=kb if has_kb else None,
                         attn_bias=ab if has_ab else None)
-        return out, (q, k, v, out, lse, layout, kb, ab)
+        return out, (q, k, v, out, lse, kmap, klen, qmap, qlen, kb, ab)
 
     def bwd_rule(sm_scale, causal, block_q, block_k, H, res, dout):
-        q, k, v, out, lse, layout, kb, ab = res
+        q, k, v, out, lse, kmap, klen, qmap, qlen, kb, ab = res
         dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k,
-                          (q, k, v, out, lse), dout,
-                          layout=layout if has_layout else None, n_heads=H,
+                          (q, k, v, out, lse), dout, n_heads=H,
+                          luts=((kmap, klen, qmap, qlen) if has_luts
+                                else None),
                           k_bias=kb if has_kb else None,
                           attn_bias=ab if has_ab else None)
-        return (dq, dk, dv, None, jnp.zeros_like(kb), jnp.zeros_like(ab))
+        return (dq, dk, dv, None, None, None, None,
+                jnp.zeros_like(kb), jnp.zeros_like(ab))
 
     f.defvjp(fwd_rule, bwd_rule)
     return f
 
 
-def _biased_call(q, k, v, layout, key_padding_bias, attn_bias, sm_scale,
+def _biased_call(q, k, v, luts, key_padding_bias, attn_bias, sm_scale,
                  causal, block_q, block_k):
     """(B, T, H, d) entry shared by the dense and block-sparse biased paths."""
     B, T, H, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(d)
     block_q, block_k = _auto_blocks(T, d, block_q, block_k)
-    has_layout = layout is not None
+    has_luts = luts is not None
     has_kb = key_padding_bias is not None
     has_ab = attn_bias is not None
     dummy_i = jnp.zeros((1, 1, 1), jnp.int32)
+    dummy_l = jnp.zeros((1, 1), jnp.int32)
     dummy_f = jnp.zeros((1, 1), jnp.float32)
-    fn = _make_biased_bhtd(has_layout, has_kb, has_ab)
+    kmap, klen, qmap, qlen = luts if has_luts else (dummy_i, dummy_l,
+                                                    dummy_i, dummy_l)
+    fn = _make_biased_bhtd(has_luts, has_kb, has_ab)
     to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
-    out = fn(to_bhtd(q), to_bhtd(k), to_bhtd(v),
-             layout if has_layout else dummy_i,
+    out = fn(to_bhtd(q), to_bhtd(k), to_bhtd(v), kmap, klen, qmap, qlen,
              jnp.asarray(key_padding_bias, jnp.float32) if has_kb else dummy_f,
              jnp.asarray(attn_bias, jnp.float32) if has_ab else dummy_f,
              float(sm_scale), bool(causal), int(block_q), int(block_k), int(H))

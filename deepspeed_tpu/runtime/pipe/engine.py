@@ -147,9 +147,16 @@ class PipelineEngine(DeepSpeedEngine):
           arrive exactly when consumed.
 
         A micro-batch's boundary input is held for ``2(S - s) - 1`` ticks in a
-        ``2S``-slot circular buffer; the stage body is recomputed from it in
-        backward (activation checkpointing), so live activation memory is
-        O(S·micro) while the reference's GPipe profile is O(M·micro).
+        ``2S``-slot circular buffer.  With
+        ``activation_checkpoint_interval >= 1`` the stage body is recomputed
+        from it in backward (activation checkpointing) — live activation
+        memory is O(S·micro) where the reference's GPipe profile is
+        O(M·micro).  With ``interval == 0`` (reference semantics: no
+        checkpointing, ``runtime/pipe/engine.py:719`` runs backward on stored
+        activations) the forward tick runs under ``jax.vjp`` and the
+        *residuals* ride the same circular buffer — ``jax.vjp``'s pullback is
+        a pytree, so its leaves scan-carry like any activation — trading
+        O(S·micro·L) residual memory for a backward with no re-forward.
         """
         module = self.module
         S = self.num_stages
@@ -231,13 +238,39 @@ class PipelineEngine(DeepSpeedEngine):
                 lambda a: jnp.zeros(a.shape, jnp.float32), other_p))
             zero_f32 = varying(jnp.float32(0.0))
 
+            store_resid = interval == 0
+            if store_resid:
+                # One traced vjp OUTSIDE the scan gives the residual-leaf
+                # protos AND — by tracer identity — which leaves are just the
+                # tick-invariant parameters forwarded through (matmul saves W
+                # itself): those must NOT be buffered per slot, or every
+                # stage's weights would be materialized 2S times.  Only
+                # genuinely per-micro-batch residuals (activations, gathered
+                # inputs, rng-derived masks) ride the circular buffer; the
+                # unmatched-is-buffered default keeps unknown leaves correct.
+                _, _vf0 = jax.vjp(
+                    lambda lp, op, xr: stage_fwd(lp, op, xr, jnp.int32(0)),
+                    local, other_v, zero_x)
+                _leaves0 = jax.tree_util.tree_leaves(_vf0)
+                _inv_ids = {id(l) for l in
+                            jax.tree_util.tree_leaves((local, other_v))}
+                buffered_idx = tuple(i for i, l in enumerate(_leaves0)
+                                     if id(l) not in _inv_ids)
+                zero_res = tuple(
+                    varying(jnp.zeros((B,) + jnp.shape(_leaves0[i]),
+                                      jnp.result_type(_leaves0[i])))
+                    for i in buffered_idx)
+
             def tick(carry, t):
                 # UNIFORM execution: every device runs the identical op
                 # sequence every tick, with inactive work masked by `where`.
                 # No `lax.cond` on stage-dependent predicates: the auto-axis
                 # (data/tensor) collectives XLA inserts inside a branch would
                 # then be executed by only some pipe stages — deadlock.
-                buf, y_send, g_send, gl, go, lacc = carry
+                if store_resid:
+                    res_bufs, y_buf, y_send, g_send, gl, go, lacc = carry
+                else:
+                    buf, y_send, g_send, gl, go, lacc = carry
                 # receives: activation from s-1 (down ring), cotangent from
                 # s+1 (up ring) — both from the PREVIOUS tick's sends.
                 down = [(i, (i + 1) % S) for i in range(S)]
@@ -249,21 +282,44 @@ class PipelineEngine(DeepSpeedEngine):
                 f = t - s
                 f_act = (f >= 0) & (f < M)
                 fc = jnp.clip(f, 0, M - 1)
-                y = stage_fwd(local, other_v, x_recv, fc)
-                # save the boundary input; OOB index B drops the write on
-                # inactive ticks (no full-buffer select)
+                # OOB index B drops buffer writes on inactive ticks (no
+                # full-buffer select)
                 slot = jnp.where(f_act, fc % B, B)
-                buf = buf.at[slot].set(x_recv, mode="drop")
+                if store_resid:
+                    # no-recompute mode: forward runs under vjp NOW and the
+                    # pullback's per-micro-batch residual leaves ride the
+                    # circular buffer to this micro-batch's backward tick
+                    # (tick-invariant leaves — the weights — are reused from
+                    # this tick's own vjp at backward, see buffered_idx)
+                    y, vjp_f = jax.vjp(
+                        lambda lp, op, xr: stage_fwd(lp, op, xr, fc),
+                        local, other_v, x_recv)
+                    leaves_f, res_def = jax.tree_util.tree_flatten(vjp_f)
+                    res_bufs = tuple(
+                        rb.at[slot].set(_vary_one(leaves_f[i]), mode="drop")
+                        for rb, i in zip(res_bufs, buffered_idx))
+                    y_buf = y_buf.at[slot].set(y, mode="drop")
+                else:
+                    y = stage_fwd(local, other_v, x_recv, fc)
+                    # save the boundary input for the backward recompute
+                    buf = buf.at[slot].set(x_recv, mode="drop")
 
                 # ---------------- backward: micro-batch b = t-(2S-1)+s ------
                 b = t - (2 * S - 1) + s
                 b_act = (b >= 0) & (b < M)
                 bc = jnp.clip(b, 0, M - 1)
 
-                x_saved = buf[bc % B]
-                y_r, vjp_fn = jax.vjp(
-                    lambda lp, op, xr: stage_fwd(lp, op, xr, bc),
-                    local, other_v, x_saved)
+                if store_resid:
+                    leaves_b = list(leaves_f)   # invariant leaves: this tick's
+                    for rb, i in zip(res_bufs, buffered_idx):
+                        leaves_b[i] = rb[bc % B]
+                    vjp_fn = jax.tree_util.tree_unflatten(res_def, leaves_b)
+                    y_r = y_buf[bc % B]
+                else:
+                    x_saved = buf[bc % B]
+                    y_r, vjp_fn = jax.vjp(
+                        lambda lp, op, xr: stage_fwd(lp, op, xr, bc),
+                        local, other_v, x_saved)
                 # seed: last stage differentiates epilogue+loss; other stages
                 # use the received cotangent.  The head runs on every stage
                 # (masked) to keep the op sequence uniform.
@@ -284,15 +340,28 @@ class PipelineEngine(DeepSpeedEngine):
                 # mask sends so bubble-tick garbage never reaches active ticks
                 y_send_n = jnp.where(f_act, y, 0.0).astype(y.dtype)
                 g_send_n = jnp.where(b_act, d_x, 0.0).astype(d_x.dtype)
+                if store_resid:
+                    return (res_bufs, y_buf, y_send_n, g_send_n,
+                            gl, go, lacc), None
                 return (buf, y_send_n, g_send_n, gl, go, lacc), None
 
-            carry0 = (
-                varying(jnp.zeros((B,) + x_proto.shape, x_proto.dtype)),
-                zero_x,                              # y_send
-                zero_x,                              # g_send
-                zeros_local, zeros_other, zero_f32)
-            (_, _, _, gl, go, lacc), _ = lax.scan(
-                tick, carry0, jnp.arange(T))
+            if store_resid:
+                carry0 = (
+                    zero_res,
+                    varying(jnp.zeros((B,) + x_proto.shape, x_proto.dtype)),
+                    zero_x,                          # y_send
+                    zero_x,                          # g_send
+                    zeros_local, zeros_other, zero_f32)
+                (_, _, _, _, gl, go, lacc), _ = lax.scan(
+                    tick, carry0, jnp.arange(T))
+            else:
+                carry0 = (
+                    varying(jnp.zeros((B,) + x_proto.shape, x_proto.dtype)),
+                    zero_x,                          # y_send
+                    zero_x,                          # g_send
+                    zeros_local, zeros_other, zero_f32)
+                (_, _, _, gl, go, lacc), _ = lax.scan(
+                    tick, carry0, jnp.arange(T))
 
             # stage grads: re-add the stage axis; shard_map concatenates over
             # 'pipe'.  Prologue/epilogue grads: psum reduces the per-stage
@@ -412,6 +481,7 @@ class PipelineEngine(DeepSpeedEngine):
         """Pipelined forward-only loss on ONE micro-batch ``(inputs, labels)``
         (promoted internally to a stack of one; pass pre-stacked batches
         through ``_pipeline_loss`` directly if needed)."""
+        self._flush_offload()   # a pending DPU update must land first
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         if self._jit_eval is None:
             def eval_fn(params, b, r):

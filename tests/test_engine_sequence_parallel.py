@@ -31,6 +31,7 @@ def _run(impl, mesh_axes, steps=4):
 
 
 @pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
+@pytest.mark.slow
 def test_seq_parallel_training_matches_dense(devices, impl):
     ref = _run("jnp", {"data": 2, "seq": 4})
     sp = _run(impl, {"data": 2, "seq": 4})

@@ -45,6 +45,7 @@ def test_causality():
     assert not np.allclose(l1[0, 10:], l2[0, 10:], atol=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_matches_norematerialization():
     cfg = dict(n_embd=64, n_layer=2, n_head=2, vocab_size=128, max_seq=64)
     m1 = GPT2(GPT2Config(remat=True, **cfg), dtype=jnp.float32)
@@ -58,6 +59,7 @@ def test_remat_matches_norematerialization():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gpt2_trains_e2e(mesh8):
     cfg = {
         "train_micro_batch_size_per_gpu": 2,
@@ -75,6 +77,7 @@ def test_gpt2_trains_e2e(mesh8):
     assert losses[-1] < losses[0], f"GPT-2 loss did not decrease: {losses}"
 
 
+@pytest.mark.slow
 def test_gpt2_tp_sharding(devices):
     """Tensor-parallel mesh: qkv sharded on output dim, proj on input dim."""
     from deepspeed_tpu.parallel.mesh import make_mesh
@@ -94,6 +97,7 @@ def test_gpt2_tp_sharding(devices):
     assert np.isfinite(loss)
 
 
+@pytest.mark.slow
 def test_gpt2_tp_matches_dp(devices):
     """TP=4 must produce the same loss trajectory as pure DP (same math,
     different layout)."""

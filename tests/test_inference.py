@@ -33,6 +33,7 @@ def test_forward_matches_model_apply(devices):
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_cached_decode_matches_full_context(devices):
     """apply_with_cache over prefill+steps == full-context apply."""
     model = _tiny_model()
@@ -52,6 +53,7 @@ def test_cached_decode_matches_full_context(devices):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_generate_greedy_matches_naive_loop(devices):
     """KV-cache greedy generation == argmax loop over full-context forwards
     (the reference's CUDA-graph decode must match eager decode)."""
@@ -98,6 +100,7 @@ def test_generate_sampling_is_deterministic_given_rng(devices):
 
 
 # --------------------------------------------------------------- HF injection
+@pytest.mark.slow
 def test_hf_gpt2_injection_matches_transformers(devices):
     """Convert a tiny random HF GPT2LMHeadModel; logits must match the torch
     forward (reference: kernel-injected layer vs HF module numerics)."""

@@ -130,8 +130,9 @@ def test_multinode_runner_commands():
     the expected fan-out command lines with the jax.distributed env."""
     import argparse
     from deepspeed_tpu.launcher.multinode_runner import (SSHRunner, PDSHRunner,
-                                                         OpenMPIRunner, RUNNERS)
-    assert set(RUNNERS) == {"ssh", "pdsh", "openmpi"}
+                                                         OpenMPIRunner,
+                                                         MVAPICHRunner, RUNNERS)
+    assert set(RUNNERS) == {"ssh", "pdsh", "openmpi", "mvapich"}
     args = argparse.Namespace(user_script="train.py", user_args=["--x", "1"],
                               ssh_port=None)
     env = {"coordinator": "worker-0:29500"}
@@ -169,6 +170,15 @@ def test_multinode_runner_commands():
     assert mpi_cmds[0][-2] == "-c"
     assert "JAX_PROCESS_ID=${OMPI_COMM_WORLD_RANK:?}" in mpi_cmds[0][-1]
     assert "train.py" in mpi_cmds[0][-1]
+
+    mv_cmds = MVAPICHRunner(args, "w").get_cmd(env, active)
+    assert len(mv_cmds) == 1 and mv_cmds[0][0] == "mpirun_rsh"
+    assert "-hostfile" in mv_cmds[0]
+    # env rides as KEY=VALUE args (mpirun_rsh forwards no environment)
+    assert any(x.startswith("JAX_COORDINATOR_ADDRESS=") for x in mv_cmds[0])
+    assert "JAX_PROCESS_ID=${MV2_COMM_WORLD_RANK:?}" in mv_cmds[0][-1]
+    with open(MVAPICHRunner.HOSTFILE) as f:
+        assert f.read().splitlines() == ["worker-0", "worker-1"]
 
 
 def test_launcher_flag_selects_runner(monkeypatch, tmp_path):

@@ -151,6 +151,7 @@ def test_gpt2_moe_alternating_layers():
     assert kinds == ["ffn", "moe", "ffn", "moe"]
 
 
+@pytest.mark.slow
 def test_gpt2_moe_trains_and_uses_aux_loss(devices):
     model = GPT2MoE(preset="gpt2-moe-tiny", dtype=jnp.float32,
                     embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
@@ -193,6 +194,7 @@ def test_cifar_cnn_trains(devices):
     assert acc > 0.2  # well above chance after a few steps
 
 
+@pytest.mark.slow
 def test_gptj_flash_attention_matches_jnp():
     """Verdict #4: rotary models get the fast path — flash on pre-rotated
     q/k must reproduce the jnp attention logits, fwd AND grad."""
@@ -215,6 +217,7 @@ def test_gptj_flash_attention_matches_jnp():
                                    atol=5e-4, rtol=5e-3)
 
 
+@pytest.mark.slow
 def test_gptneox_flash_trains(devices):
     """NeoX (partial-rotary, dual-LN) trains through the flash path."""
     import deepspeed_tpu as ds

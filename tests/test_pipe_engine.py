@@ -122,6 +122,24 @@ def test_pipe_matches_unpipelined(devices):
     np.testing.assert_allclose(l1, l4, rtol=2e-2), (l1, l4)
 
 
+def test_pipe_no_recompute_matches_recompute(devices):
+    """activation_checkpoint_interval=0 stores the vjp residuals in the
+    circular buffer (no backward re-forward) and must produce the SAME
+    training trajectory as the recompute schedule (interval=1)."""
+    data = make_data(n_batches=2, mb=8, seed=5)
+    losses = {}
+    for interval in (1, 0):
+        config = dict(CONFIG(4), mesh={"axes": {"pipe": 4, "data": 2}})
+        specs = [LayerSpec(L.Linear, DIM, DIM, init_std=0.3)
+                 for _ in range(N_LAYERS)]
+        m = PipelineModule(layers=specs, num_stages=4, loss_fn=mse_loss,
+                           partition_method="uniform",
+                           activation_checkpoint_interval=interval)
+        e, _, _, _ = deepspeed.initialize(model=m, config=config)
+        losses[interval] = _train(e, data, steps=4)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
 def test_pipe_with_prologue_epilogue(devices):
     """Embedding prologue + projection epilogue outside the pipelined body."""
     V, D = 64, DIM
@@ -258,6 +276,44 @@ def test_pipe_1f1b_memory_bounded(devices):
     assert growth < gpipe_growth / 2, (
         f"temp memory grew {growth}B when M went 4→16; a bounded 1F1B "
         f"schedule must not stack O(M) activations (GPipe ≈ +{gpipe_growth}B)")
+
+
+def test_pipe_no_recompute_does_not_slot_weights(devices):
+    """interval=0 buffers only per-micro-batch residuals: the vjp also saves
+    the weight matrices, but those are tick-invariant and must be reused from
+    the live parameters, NOT stacked into the 2S-slot circular buffer
+    (which would multiply parameter memory by ~2S)."""
+    DIM_BIG, MB = 512, 4   # big weights, tiny activations → clear signal
+
+    def temp_bytes(interval):
+        specs = [LayerSpec(L.Linear, DIM_BIG, DIM_BIG, init_std=0.1)
+                 for _ in range(4)]
+        model = PipelineModule(layers=specs, num_stages=2, loss_fn=mse_loss,
+                               activation_checkpoint_interval=interval)
+        config = {
+            "train_micro_batch_size_per_gpu": MB // 4,
+            "gradient_accumulation_steps": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000,
+            "mesh": {"axes": {"pipe": 2, "data": 4}},
+        }
+        engine, _, _, _ = deepspeed.initialize(model=model, config=config)
+        rng = np.random.default_rng(0)
+        mb = (rng.standard_normal((MB, DIM_BIG)).astype(np.float32),
+              rng.standard_normal((MB, DIM_BIG)).astype(np.float32))
+        batch = engine._stack_microbatches([mb] * 8)
+        key = jax.random.PRNGKey(0)
+        lowered = engine._jit_train_step.lower(engine.state, batch, key)
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    t_rec, t_store = temp_bytes(1), temp_bytes(0)
+    # per-stage weights: 2 layers x DIM^2 fp32; slotting them would add
+    # ~B(=4) copies of that to temps
+    stage_weight_bytes = 2 * DIM_BIG * DIM_BIG * 4
+    assert t_store - t_rec < 2 * stage_weight_bytes, (
+        f"residual-store temps ({t_store}B) exceed recompute temps "
+        f"({t_rec}B) by more than ~2 stage-weight copies — weights are "
+        f"being slotted into the circular buffer")
 
 
 def test_pipe_tensor_parallel_composition(devices):

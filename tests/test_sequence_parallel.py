@@ -158,6 +158,7 @@ def test_ring_flash_matches_single_device(devices, causal):
                                atol=2e-3)
 
 
+@pytest.mark.slow
 def test_ring_flash_gradients(devices):
     import jax, numpy as np, jax.numpy as jnp
     from deepspeed_tpu.parallel.sequence_parallel import ring_flash_attention

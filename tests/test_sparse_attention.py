@@ -233,6 +233,71 @@ def test_kernel_masked_backward_matches_oracle():
                                    rtol=2e-4, err_msg=f"d{name}")
 
 
+def test_lut_compresses_grid():
+    """The sparse grid's inner dimension is the max LIVE block count, not
+    the full k-block count — skipped blocks are never visited (VERDICT r2:
+    grid/LUT compression; reference make_lut, matmul.py:288)."""
+    from deepspeed_tpu.ops.transformer.flash_attention import _layout_luts
+    T, nq = 512, 16
+    # pure sliding window (band of 3): every row has <= 3 live blocks
+    r = np.arange(nq)
+    layout = (np.abs(r[:, None] - r[None, :]) <= 1).astype(np.int32)[None]
+    kmap, klen, qmap, qlen = _layout_luts(layout, T, 1, False, 32, 32)
+    assert kmap.shape[2] <= 3       # window only
+    assert kmap.shape[2] < nq       # genuinely compressed vs dense grid
+    # causal pruning folds into the LUT too
+    kmap_c, klen_c, _, _ = _layout_luts(layout, T, 1, True, 32, 32)
+    assert int(np.asarray(klen_c).sum()) < int(np.asarray(klen).sum())
+    # row 0 under causal: only block 0 is live
+    assert int(np.asarray(klen_c)[0, 0]) == 1
+    # with a global row the padded width grows, but short rows pad by
+    # REPEATING their last live block (repeat == no new DMA in pallas)
+    cfg_g = BSLongformerSparsityConfig(num_heads=1, block=32,
+                                       num_sliding_window_blocks=3,
+                                       global_block_indices=[0])
+    kmap_g, klen_g, _, _ = _layout_luts(cfg_g.make_layout(T), T, 1,
+                                        False, 32, 32)
+    km, kl = np.asarray(kmap_g), np.asarray(klen_g)
+    row = km[0, 2]                  # a windowed (non-global) row
+    n = int(kl[0, 2])
+    assert n < km.shape[1]
+    assert (row[n:] == row[n - 1]).all()
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="wall-clock perf is only meaningful on TPU")
+def test_sparse_beats_dense_flash_on_tpu():
+    """With MXU-sized blocks and the LUT grid, a ~25%-dense layout must beat
+    dense flash at T>=2048 (BASELINE: reference claims 6.3x at high
+    sparsity; here the win scales with density)."""
+    import time
+    from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+    B, T, H, d = 1, 4096, 8, 64
+    q, k, v = make_qkv(B=B, T=T, H=H, d=d)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=512,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(T)     # 8x8 coarse blocks, ~50% live pre-causal
+
+    f_sparse = jax.jit(lambda q, k, v: sparse_flash_attention(
+        q, k, v, layout, causal=True))
+    f_dense = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=512, block_k=512))
+    np.asarray(f_sparse(q, k, v)); np.asarray(f_dense(q, k, v))  # compile
+
+    def timed(f, n=20):
+        t0 = time.time()
+        for _ in range(n):
+            out = f(q, k, v)
+        np.asarray(out[0, 0, 0, 0])
+        return (time.time() - t0) / n
+
+    t_s, t_d = timed(f_sparse), timed(f_dense)
+    assert t_s < t_d, (f"sparse {t_s*1e3:.2f}ms not faster than dense "
+                       f"{t_d*1e3:.2f}ms at T={T}")
+
+
 def test_flash_attention_with_padding_bias():
     """The dense flash kernel also accepts the additive biases."""
     from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
