@@ -157,7 +157,6 @@ class MVAPICHRunner(MultiNodeRunner):
     process."""
 
     name = "mvapich"
-    HOSTFILE = "/tmp/deepspeed_mvapich_hostfile"
 
     def backend_exists(self):
         # the reference additionally greps `mpiname` for MVAPICH2; the
@@ -165,13 +164,18 @@ class MVAPICHRunner(MultiNodeRunner):
         return shutil.which("mpirun_rsh") is not None
 
     def get_cmd(self, environment, active_resources):
+        import tempfile
         hosts = list(active_resources.keys())
         coordinator = environment["coordinator"]
         remote_env = self._coordinator_env(coordinator, len(hosts))
-        with open(self.HOSTFILE, "w") as f:
+        # per-launch private file: a fixed world-shared path would let
+        # concurrent launches clobber each other's host lists
+        fd, self.hostfile = tempfile.mkstemp(prefix="deepspeed_mvapich_",
+                                             suffix=".hosts", text=True)
+        with os.fdopen(fd, "w") as f:
             f.write("\n".join(hosts) + "\n")
         cmd = ["mpirun_rsh", "-np", str(len(hosts)),
-               "-hostfile", self.HOSTFILE]
+               "-hostfile", self.hostfile]
         for k, v in remote_env.items():
             cmd.append(f"{k}={v}")
         inner = ("export JAX_PROCESS_ID=${MV2_COMM_WORLD_RANK:?}; "

@@ -36,6 +36,7 @@ class GPT2MoEConfig(GPT2Config):
     aux_loss_coef: float = 0.01
     use_residual: bool = False  # PR-MoE (pyramid-residual)
     noisy_gate_policy: Optional[str] = None
+    dispatch_impl: str = "scatter"   # "scatter" (O(S·M)) | "einsum" (GShard)
 
 
 MOE_PRESETS = {
@@ -90,7 +91,8 @@ class GPT2MoE:
                                               else c.capacity_factor),
                         min_capacity=c.min_capacity,
                         use_residual=c.use_residual,
-                        noisy_gate_policy=c.noisy_gate_policy)
+                        noisy_gate_policy=c.noisy_gate_policy,
+                        dispatch_impl=c.dispatch_impl)
 
     def is_moe_layer(self, i):
         # last layer of every `moe_every` window hosts the experts

@@ -7,10 +7,12 @@ rides the ``expert`` mesh axis (see ``parallel/mesh.py``).
 
 from .layer import MoE, MOELayer
 from .experts import Experts
-from .sharded_moe import (TopKGate, top1gating, top2gating, compute_capacity,
-                          nodrop_capacity, tokens_overflowed)
+from .sharded_moe import (TopKGate, top1gating, top2gating, top1_routes,
+                          top2_routes, compute_capacity, nodrop_capacity,
+                          tokens_overflowed)
 from .utils import is_moe_param_path, split_moe_params
 
 __all__ = ["MoE", "MOELayer", "Experts", "TopKGate", "top1gating",
-           "top2gating", "compute_capacity", "nodrop_capacity",
-           "tokens_overflowed", "is_moe_param_path", "split_moe_params"]
+           "top2gating", "top1_routes", "top2_routes", "compute_capacity",
+           "nodrop_capacity", "tokens_overflowed", "is_moe_param_path",
+           "split_moe_params"]

@@ -35,11 +35,26 @@ from ..utils.logging import log_dist
 
 
 class MOELayer:
-    """GShard Algorithm 2 over the ``expert`` mesh axis."""
+    """GShard Algorithm 2 over the ``expert`` mesh axis.
 
-    def __init__(self, gate: TopKGate, experts: Experts):
+    ``dispatch_impl``:
+
+    - ``"scatter"`` (default): tokens scatter into the (E, C, M) buffer by
+      their (expert, slot) address and gather back weighted — O(S·M) data
+      movement.  TPU-native replacement for the reference's ``_AllToAll``
+      dispatch (``sharded_moe.py:85,525``): the sharding constraint on the
+      scattered buffer makes XLA emit the all-to-all.
+    - ``"einsum"``: the GShard one-hot formulation — an S×(E·C) matmul each
+      way, O(S²·M·cf) FLOPs.  MXU-friendly but quadratic in tokens; kept as
+      the numerics oracle and for comparison (examples/bench_moe.py).
+    """
+
+    def __init__(self, gate: TopKGate, experts: Experts,
+                 dispatch_impl: str = "scatter"):
+        assert dispatch_impl in ("scatter", "einsum"), dispatch_impl
         self.gate = gate
         self.experts = experts
+        self.dispatch_impl = dispatch_impl
 
     def init(self, rng):
         g, e = jax.random.split(rng)
@@ -53,27 +68,51 @@ class MOELayer:
             gate_rng, expert_rng = jax.random.split(rng)
         else:
             gate_rng = expert_rng = None
-        l_aux, combine_weights, dispatch_mask, exp_counts = self.gate.apply(
-            params["gate"], reshaped, rng=gate_rng, used_token=used_token,
-            train=train)
 
-        # dispatch: (S,E,C) × (S,M) → (E,C,M); constraining the expert axis
-        # makes XLA emit the forward all-to-all (reference :525)
-        dispatched = jnp.einsum("sec,sm->ecm",
-                                dispatch_mask.astype(x.dtype), reshaped)
+        if self.dispatch_impl == "scatter":
+            l_aux, routes, exp_counts, C = self.gate.apply_routes(
+                params["gate"], reshaped, rng=gate_rng,
+                used_token=used_token, train=train)
+            E = self.gate.num_experts
+            # dispatch: scatter each kept token to its (expert, slot) row;
+            # dropped routes (weight 0) address the OOB row and vanish
+            flat = jnp.zeros((E * C, d_model), x.dtype)
+            positions = []
+            for idx, loc, w in routes:
+                pos = jnp.where(w > 0, idx * C + loc, E * C)
+                flat = flat.at[pos].set(reshaped, mode="drop")
+                positions.append((pos, w))
+            dispatched = flat.reshape(E, C, d_model)
+        else:
+            l_aux, combine_weights, dispatch_mask, exp_counts = \
+                self.gate.apply(params["gate"], reshaped, rng=gate_rng,
+                                used_token=used_token, train=train)
+            C = dispatch_mask.shape[2]
+            # dispatch: (S,E,C) × (S,M) → (E,C,M)
+            dispatched = jnp.einsum("sec,sm->ecm",
+                                    dispatch_mask.astype(x.dtype), reshaped)
+
+        # constraining the expert axis makes XLA emit the forward
+        # all-to-all (reference :525)
         dispatched = maybe_constrain(dispatched, P("expert", None, None))
-
         expert_output = self.experts.apply(params["experts"], dispatched,
                                            rng=expert_rng)
         expert_output = maybe_constrain(expert_output, P("expert", None, None))
 
-        # combine: (S,E,C) × (E,C,M) → (S,M); the contraction back to
-        # token-sharded output is the reverse all-to-all (reference :542)
-        combined = jnp.einsum("sec,ecm->sm",
-                              combine_weights.astype(x.dtype), expert_output)
+        if self.dispatch_impl == "scatter":
+            flat_out = expert_output.reshape(-1, d_model)
+            combined = 0.0
+            for pos, w in positions:
+                row = flat_out[jnp.clip(pos, 0, flat_out.shape[0] - 1)]
+                combined = combined + row * w[:, None].astype(x.dtype)
+        else:
+            # combine: (S,E,C) × (E,C,M) → (S,M); the contraction back to
+            # token-sharded output is the reverse all-to-all (reference :542)
+            combined = jnp.einsum("sec,ecm->sm",
+                                  combine_weights.astype(x.dtype),
+                                  expert_output)
         # capacity drops are detectable: exp_counts is pre-thinning demand
-        overflow = tokens_overflowed(
-            exp_counts, self.gate.capacity_for(reshaped.shape[0], train))
+        overflow = tokens_overflowed(exp_counts, C)
         return combined.reshape(x.shape), l_aux, exp_counts, overflow
 
     def partition_specs(self, params):
@@ -94,7 +133,8 @@ class MoE:
                  use_residual: bool = False,
                  noisy_gate_policy: Optional[str] = None,
                  drop_tokens: bool = True, use_rts: bool = True,
-                 max_capacity: Optional[int] = None):
+                 max_capacity: Optional[int] = None,
+                 dispatch_impl: str = "scatter"):
         self.hidden_size = hidden_size
         self.num_experts = num_experts
         # ep_size is advisory here: actual expert parallelism is the mesh's
@@ -114,7 +154,7 @@ class MoE:
             TopKGate(hidden_size, num_experts, k, capacity_factor,
                      eval_capacity_factor, min_capacity, noisy_gate_policy,
                      drop_tokens, use_rts, max_capacity=max_capacity),
-            Experts(expert, num_experts))
+            Experts(expert, num_experts), dispatch_impl=dispatch_impl)
 
     def init(self, rng):
         r_moe, r_mlp, r_coef = jax.random.split(rng, 3)

@@ -104,6 +104,32 @@ def top1gating(logits, capacity_factor: float, min_capacity: int,
     ``max_capacity=num_tokens`` for the guaranteed-no-drop S×E×S worst
     case the reference gets from its runtime max-allreduce (:213-217).
     """
+    (l_aux, indices1_s, locations1_s, gates1_s, kept,
+     exp_counts, capacity) = top1_routes(
+        logits, capacity_factor, min_capacity, rng=rng,
+        used_token=used_token, noisy_gate_policy=noisy_gate_policy,
+        drop_tokens=drop_tokens, use_rts=use_rts, max_capacity=max_capacity)
+    num_experts = logits.shape[1]
+    se = jax.nn.one_hot(indices1_s, num_experts,
+                        dtype=jnp.float32) * gates1_s[:, None]
+    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=jnp.float32)
+    combine_weights = jnp.einsum("se,sc->sec", se, locations1_sc)
+    dispatch_mask = combine_weights.astype(bool)
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top1_routes(logits, capacity_factor: float, min_capacity: int,
+                *, rng=None, used_token=None,
+                noisy_gate_policy: Optional[str] = None,
+                drop_tokens: bool = True, use_rts: bool = True,
+                max_capacity: Optional[int] = None):
+    """The routing core of ``top1gating`` in COMPACT form — per token its
+    expert, capacity slot, and gate weight (0 if dropped) — for the
+    scatter/gather dispatch (``MOELayer(dispatch_impl="scatter")``) that
+    replaces the S×E×C one-hot einsum.
+
+    Returns ``(l_aux, indices (S,), locations (S,), gate_weights (S,),
+    kept (S,) bool, exp_counts (E,), capacity)``."""
     logits = logits.astype(jnp.float32)
     num_tokens, num_experts = logits.shape
 
@@ -153,16 +179,32 @@ def top1gating(logits, capacity_factor: float, min_capacity: int,
     mask1 = mask1 * (locations1 < capacity).astype(mask1.dtype)
     locations1_s = jnp.sum(locations1 * mask1, axis=1)
 
-    gates = gates * mask1.astype(jnp.float32)
-    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=jnp.float32)
-    combine_weights = jnp.einsum("se,sc->sec", gates, locations1_sc)
-    dispatch_mask = combine_weights.astype(bool)
-    return l_aux, combine_weights, dispatch_mask, exp_counts
+    kept = mask1.sum(axis=1) > 0
+    gates1_s = jnp.sum(gates * mask1.astype(jnp.float32), axis=1)
+    return (l_aux, indices1_s, locations1_s, gates1_s, kept,
+            exp_counts, capacity)
 
 
 def top2gating(logits, capacity_factor: float, min_capacity: int, *, rng=None):
     """Top-2 gating (reference ``sharded_moe.py:278-351``): second expert via
     the Gumbel-max trick, combine weights normalized over the two experts."""
+    (l_aux, routes, exp_counts, capacity) = top2_routes(
+        logits, capacity_factor, min_capacity, rng=rng)
+    num_experts = logits.shape[1]
+    combine_weights = 0.0
+    for idx, loc, w in routes:
+        se = jax.nn.one_hot(idx, num_experts, dtype=jnp.float32) * w[:, None]
+        sc = jax.nn.one_hot(loc, capacity, dtype=jnp.float32)
+        combine_weights = combine_weights + jnp.einsum("se,sc->sec", se, sc)
+    dispatch_mask = combine_weights.astype(bool)
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top2_routes(logits, capacity_factor: float, min_capacity: int, *,
+                rng=None):
+    """Routing core of ``top2gating`` in compact form: returns
+    ``(l_aux, [(idx, loc, weight)] x2, exp_counts, capacity)`` where dropped
+    routes carry weight 0."""
     logits = logits.astype(jnp.float32)
     num_tokens, num_experts = logits.shape
     gates = jax.nn.softmax(logits, axis=1)
@@ -202,15 +244,13 @@ def top2gating(logits, capacity_factor: float, min_capacity: int, *, rng=None):
     denom_s = jnp.clip(gates1_s + gates2_s, min=jnp.finfo(jnp.float32).eps)
     gates1_s = gates1_s / denom_s
     gates2_s = gates2_s / denom_s
-
-    gates1 = gates1_s[:, None] * mask1_f
-    gates2 = gates2_s[:, None] * mask2_f
-    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=jnp.float32)
-    locations2_sc = jax.nn.one_hot(locations2_s, capacity, dtype=jnp.float32)
-    combine_weights = (jnp.einsum("se,sc->sec", gates1, locations1_sc) +
-                       jnp.einsum("se,sc->sec", gates2, locations2_sc))
-    dispatch_mask = combine_weights.astype(bool)
-    return l_aux, combine_weights, dispatch_mask, exp_counts
+    # fold the drop mask back in: a capacity-dropped route must carry 0
+    gates1_s = gates1_s * mask1_f.sum(axis=1)
+    gates2_s = gates2_s * mask2_f.sum(axis=1)
+    return (l_aux,
+            [(indices1_s, locations1_s, gates1_s),
+             (indices2_s, locations2_s, gates2_s)],
+            exp_counts, capacity)
 
 
 class TopKGate:
@@ -276,7 +316,7 @@ class TopKGate:
         return nodrop_capacity(num_tokens, self.num_experts,
                                self.max_capacity, self.min_capacity)
 
-    def apply(self, params, x, rng=None, used_token=None, train: bool = True):
+    def _logits(self, params, x, rng, train):
         x32 = x.reshape(-1, self.model_dim).astype(jnp.float32)
         logits = x32 @ params["wg"]
 
@@ -287,7 +327,10 @@ class TopKGate:
             x32 = x32 * jax.random.uniform(sub, x32.shape, jnp.float32,
                                            1.0 - eps, 1.0 + eps)
             logits = x32 @ params["wg"]
+        return logits, rng, noisy
 
+    def apply(self, params, x, rng=None, used_token=None, train: bool = True):
+        logits, rng, noisy = self._logits(params, x, rng, train)
         cf = self.capacity_factor if train else self.eval_capacity_factor
         if self.k == 1:
             return top1gating(logits, cf, self.min_capacity, rng=rng,
@@ -296,3 +339,18 @@ class TopKGate:
                               drop_tokens=self.drop_tokens, use_rts=self.use_rts,
                               max_capacity=self.max_capacity)
         return top2gating(logits, cf, self.min_capacity, rng=rng)
+
+    def apply_routes(self, params, x, rng=None, used_token=None,
+                     train: bool = True):
+        """Compact routing for the scatter dispatch: returns
+        ``(l_aux, [(idx, loc, weight)] x k, exp_counts, capacity)``."""
+        logits, rng, noisy = self._logits(params, x, rng, train)
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            (l_aux, idx, loc, w, _kept, exp_counts, cap) = top1_routes(
+                logits, cf, self.min_capacity, rng=rng,
+                used_token=used_token, noisy_gate_policy=noisy,
+                drop_tokens=self.drop_tokens, use_rts=self.use_rts,
+                max_capacity=self.max_capacity)
+            return l_aux, [(idx, loc, w)], exp_counts, cap
+        return top2_routes(logits, cf, self.min_capacity, rng=rng)

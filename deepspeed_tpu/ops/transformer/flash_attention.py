@@ -58,6 +58,27 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
+def _pallas(kernel, *, grid, in_specs, out_specs, out_shape, scratch,
+            num_prefetch=0):
+    """One pallas_call builder for the dense (plain grid) and LUT
+    (scalar-prefetch grid) variants — the operand lists must never
+    diverge between the two paths."""
+    cp = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if num_prefetch:
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=num_prefetch, grid=grid,
+                in_specs=in_specs, out_specs=out_specs,
+                scratch_shapes=scratch),
+            out_shape=out_shape, compiler_params=cp, interpret=_interpret())
+    return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
+                          out_specs=out_specs, out_shape=out_shape,
+                          scratch_shapes=scratch, compiler_params=cp,
+                          interpret=_interpret())
+
+
 # ======================================================== sparse-layout LUTs
 @functools.lru_cache(maxsize=64)
 def _sparse_luts(layout_bytes, shape, causal, block_q, block_k):
@@ -302,25 +323,10 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k,
         pltpu.VMEM((block_q, 1), jnp.float32),
         pltpu.VMEM((block_q, 1), jnp.float32),
     ]
-    cp = pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
-    if use_lut:
-        out, lse = pl.pallas_call(
-            kernel,
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2, grid=(BH, nq, n_inner),
-                in_specs=in_specs, out_specs=out_specs,
-                scratch_shapes=scratch),
-            out_shape=out_shape, compiler_params=cp,
-            interpret=_interpret(),
-        )(kmap, klen, *args)
-    else:
-        out, lse = pl.pallas_call(
-            kernel, grid=(BH, nq, n_inner), in_specs=in_specs,
-            out_specs=out_specs, out_shape=out_shape,
-            scratch_shapes=scratch, compiler_params=cp,
-            interpret=_interpret(),
-        )(*args)
+    call = _pallas(kernel, grid=(BH, nq, n_inner), in_specs=in_specs,
+                   out_specs=out_specs, out_shape=out_shape, scratch=scratch,
+                   num_prefetch=2 if use_lut else 0)
+    out, lse = call(kmap, klen, *args) if use_lut else call(*args)
     return out[:, :T], lse[:, :T, 0]
 
 
@@ -550,25 +556,12 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout,
         pltpu.VMEM((block_k, d), jnp.float32),
         pltpu.VMEM((block_k, d), jnp.float32),
     ]
-    cp = pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
-    if use_lut:
-        dk, dv = pl.pallas_call(
-            dkdv_kernel,
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2, grid=(BH, nk, n_inner_q),
-                in_specs=dkdv_specs, out_specs=dkdv_out_specs,
-                scratch_shapes=dkdv_scratch),
-            out_shape=dkdv_out_shape, compiler_params=cp,
-            interpret=_interpret(),
-        )(qmap, qlen, *dkdv_args)
-    else:
-        dk, dv = pl.pallas_call(
-            dkdv_kernel, grid=(BH, nk, n_inner_q), in_specs=dkdv_specs,
-            out_specs=dkdv_out_specs, out_shape=dkdv_out_shape,
-            scratch_shapes=dkdv_scratch, compiler_params=cp,
-            interpret=_interpret(),
-        )(*dkdv_args)
+    call = _pallas(dkdv_kernel, grid=(BH, nk, n_inner_q),
+                   in_specs=dkdv_specs, out_specs=dkdv_out_specs,
+                   out_shape=dkdv_out_shape, scratch=dkdv_scratch,
+                   num_prefetch=2 if use_lut else 0)
+    dk, dv = (call(qmap, qlen, *dkdv_args) if use_lut
+              else call(*dkdv_args))
 
     if use_lut:
         q_ij = lambda b, i, j, km, kl: (b, i, 0)
@@ -607,23 +600,10 @@ def _bwd(sm_scale, causal, block_q, block_k, residuals, dout,
     dq_out_spec = pl.BlockSpec((1, block_q, d), q_ij)
     dq_out_shape = jax.ShapeDtypeStruct((BH, Tp, d), q.dtype)
     dq_scratch = [pltpu.VMEM((block_q, d), jnp.float32)]
-    if use_lut:
-        dq = pl.pallas_call(
-            dq_kernel,
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2, grid=(BH, nq, n_inner_k),
-                in_specs=dq_specs, out_specs=dq_out_spec,
-                scratch_shapes=dq_scratch),
-            out_shape=dq_out_shape, compiler_params=cp,
-            interpret=_interpret(),
-        )(kmap, klen, *dq_args)
-    else:
-        dq = pl.pallas_call(
-            dq_kernel, grid=(BH, nq, n_inner_k), in_specs=dq_specs,
-            out_specs=dq_out_spec, out_shape=dq_out_shape,
-            scratch_shapes=dq_scratch, compiler_params=cp,
-            interpret=_interpret(),
-        )(*dq_args)
+    call = _pallas(dq_kernel, grid=(BH, nq, n_inner_k), in_specs=dq_specs,
+                   out_specs=dq_out_spec, out_shape=dq_out_shape,
+                   scratch=dq_scratch, num_prefetch=2 if use_lut else 0)
+    dq = call(kmap, klen, *dq_args) if use_lut else call(*dq_args)
 
     return dq[:, :T], dk[:, :T], dv[:, :T]
 

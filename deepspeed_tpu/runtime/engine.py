@@ -64,7 +64,8 @@ class TrainState(NamedTuple):
     scale: Any                     # LossScaleState (None unless fp16)
 
 
-def _resolve_model(model, loss_fn, params, apply_fn, rng_seed):
+def _resolve_model(model, loss_fn, params, apply_fn, rng_seed,
+                   init_on_host=False):
     """Accept either a model object (``.init``/``.loss``[/``.apply``]) or an
     explicit (loss_fn, params) pair."""
     tp_specs = None
@@ -78,9 +79,17 @@ def _resolve_model(model, loss_fn, params, apply_fn, rng_seed):
             # jit the WHOLE init: eager per-leaf RNG ops are one device
             # dispatch each — on a remote-attached chip (~0.5-1 s round-trip
             # latency) a billion-param model's init takes tens of minutes
-            # eagerly vs one compile + one dispatch jitted
+            # eagerly vs one compile + one dispatch jitted.
+            # init_on_host (offload): create params on the HOST CPU backend —
+            # the fp32 master then builds from local memory (no multi-GB d2h)
+            # and only the 16-bit image crosses to the device.
             try:
-                params = jax.jit(model.init)(jax.random.PRNGKey(rng_seed))
+                if init_on_host:
+                    with jax.default_device(jax.devices("cpu")[0]):
+                        params = jax.jit(model.init)(
+                            jax.random.PRNGKey(rng_seed))
+                else:
+                    params = jax.jit(model.init)(jax.random.PRNGKey(rng_seed))
             except Exception:
                 # init closures that resist tracing (python-side state)
                 params = model.init(jax.random.PRNGKey(rng_seed))
@@ -131,11 +140,21 @@ class DeepSpeedEngine:
                 "scanned one (measured 1.8x temp bytes on the fsdp mesh). "
                 "Prefer the scanned layer loop (unroll_layers=False) at "
                 "stage 3.", ranks=[0])
+        offload_wanted = (self.config.zero_config.offload_optimizer_device()
+                          in ("cpu", "nvme"))
         self._loss_fn, params0, self._apply_fn, self._tp_specs = _resolve_model(
-            model, loss_fn, params, apply_fn, rng_seed)
+            model, loss_fn, params, apply_fn, rng_seed,
+            init_on_host=offload_wanted)
         # one jitted cast, not one dispatch per leaf (dispatch latency on a
-        # remote-attached chip makes eager tree_map casts minutes-slow)
-        params0 = jax.jit(lambda t: tree_cast(t, jnp.float32))(params0)
+        # remote-attached chip makes eager tree_map casts minutes-slow);
+        # under offload the cast runs ON THE HOST backend — the default-
+        # device jit would silently haul the tree to the accelerator
+        f32 = lambda t: tree_cast(t, jnp.float32)
+        if offload_wanted:
+            with jax.default_device(jax.devices("cpu")[0]):
+                params0 = jax.jit(f32)(params0)
+        else:
+            params0 = jax.jit(f32)(params0)
 
         # ---- optimizer -----------------------------------------------------
         self.optimizer = self._configure_optimizer(optimizer)
@@ -192,6 +211,7 @@ class DeepSpeedEngine:
         self._dpu_warmup = (off_cfg.delayed_param_update_warmup
                             if self._dpu else 0)
         self._pending_offload = None   # (grads, metrics) awaiting host apply
+        self._jit_scatter_params = None   # flat h2d → param tree (lazy)
 
         # ---- sparse embedding gradients (reference engine.py:2227
         # sparse_allreduce_no_retain) -----------------------------------------
@@ -341,10 +361,16 @@ class DeepSpeedEngine:
         dtype = self.compute_dtype
         needs_master = dtype != jnp.float32
 
-        # jit fuses the casts and materializes directly into the sharding
-        # (one dispatch; eager per-leaf casts pay per-leaf latency)
-        params = jax.jit(lambda t: tree_cast(t, dtype),
-                         out_shardings=self._param_sh)(params0)
+        # one jitted cast: in the offload path ON THE HOST backend (only the
+        # 16-bit image then crosses the wire, placed in a second step);
+        # otherwise fused straight into the target sharding
+        if self._offload is not None:
+            with jax.default_device(jax.devices("cpu")[0]):
+                p16 = jax.jit(lambda t: tree_cast(t, dtype))(params0)
+            params = jax.device_put(p16, self._param_sh)
+        else:
+            params = jax.jit(lambda t: tree_cast(t, dtype),
+                             out_shardings=self._param_sh)(params0)
 
         if self._offload is not None:
             # fp32 master + optimizer state live on the HOST (or NVMe); the
@@ -558,6 +584,13 @@ class DeepSpeedEngine:
             # ERROR (checked host-side in _host_offload_update), never a
             # silent truncation of embedding gradients
             metrics["sparse_rows_dropped"] = rows_dropped
+        else:
+            # ONE flat buffer for the wire: a per-leaf d2h pays one
+            # round-trip latency per leaf (~minutes per step for a
+            # billion-param tree on a remote-attached chip); the in-graph
+            # concatenate costs one HBM copy
+            grads = jnp.concatenate(
+                [g.reshape(-1) for g in jax.tree_util.tree_leaves(grads)])
         return grads, metrics, new_scale
 
     def _sparsify_grads(self, grads, batch):
@@ -630,13 +663,17 @@ class DeepSpeedEngine:
                     "sparse_grad_row_bound to use the safe default)")
         if not overflow:
             t0 = time.time()
-            flat = self._offload.flatten_grads(grads)
+            if isinstance(grads, jax.Array):
+                # flat wire format: ONE d2h transfer, host-side upcast
+                flat = np.asarray(grads).astype(np.float32)
+            else:
+                flat = self._offload.flatten_grads(grads)
             t1 = time.time()
             lr = float(metrics["lr"])
             self._offload.step(flat, int(state.optimizer_steps) + 1, lr)
             t2 = time.time()
             # h2d dispatch is async; its cost surfaces as next-step wait
-            params = jax.device_put(self._offload.payload_tree(), self._param_sh)
+            params = self._upload_offload_params()
             self._offload.last_host_times = {
                 "grad_d2h_flatten_s": t1 - t0, "host_adam_s": t2 - t1}
         else:
@@ -743,6 +780,25 @@ class DeepSpeedEngine:
                              sync_obj=metrics["loss"] if reporting else None)
         self._write_tensorboard(step_no, metrics)
         return metrics["loss"]
+
+    def _upload_offload_params(self):
+        """Host master → device params as ONE flat h2d + a jitted scatter
+        (per-leaf device_put pays one round-trip latency per leaf)."""
+        if self._sparse_grad_paths:
+            # sparse wire keeps the tree format end-to-end
+            return jax.device_put(self._offload.payload_tree(), self._param_sh)
+        if self._jit_scatter_params is None:
+            off = self._offload
+            shapes, offsets, treedef = off.shapes, off.offsets, off.treedef
+
+            def scatter(flat):
+                leaves = [flat[int(o):int(o) + int(np.prod(s or (1,)))]
+                          .reshape(s) for o, s in zip(offsets, shapes)]
+                return treedef.unflatten(leaves)
+            self._jit_scatter_params = jax.jit(
+                scatter, out_shardings=self._param_sh)
+        return self._jit_scatter_params(
+            jax.device_put(self._offload.payload_flat()))
 
     def _flush_offload(self):
         """Apply a pending delayed-param update so exported / evaluated

@@ -118,14 +118,17 @@ class HostOffloadOptimizer:
                 flat[off:off + n] = np.asarray(leaf, np.float32).ravel()
         return flat
 
-    def payload_tree(self):
-        """Master as a pytree of compute-dtype numpy arrays (h2d payload)."""
+    def payload_flat(self):
+        """Master as ONE flat compute-dtype numpy array (single h2d)."""
         import jax.numpy as jnp
         if self.out_dtype is None:
-            src = self.master
-        else:
-            src = self._out16.view(
-                jnp.bfloat16 if self.out_dtype == "bfloat16" else np.float16)
+            return self.master
+        return self._out16.view(
+            jnp.bfloat16 if self.out_dtype == "bfloat16" else np.float16)
+
+    def payload_tree(self):
+        """Master as a pytree of compute-dtype numpy arrays (h2d payload)."""
+        src = self.payload_flat()
         leaves = [src[off:off + int(np.prod(s or (1,)))].reshape(s)
                   for off, s in zip(self.offsets, self.shapes)]
         return self.treedef.unflatten(leaves)

@@ -59,6 +59,36 @@ def test_remat_matches_norematerialization():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+def test_remat_policies_and_chunked_loss_match():
+    """Selective remat policies and the chunked LM-head loss are pure
+    memory/scheduling changes — losses and gradients must match the
+    baseline exactly (they gate the headline 760M bench config)."""
+    base = dict(n_embd=64, n_layer=2, n_head=2, vocab_size=128, max_seq=64,
+                remat=True)
+    batch = (jnp.asarray(lm_data(n=4, seq=17, vocab=128)[0]),)
+    r = jax.random.PRNGKey(1)
+    ref_m = GPT2(GPT2Config(**base), dtype=jnp.float32)
+    params = ref_m.init(jax.random.PRNGKey(0))
+    ref_l, ref_g = jax.value_and_grad(ref_m.loss)(params, batch, r)
+    flat = lambda g: np.concatenate(
+        [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(g)])
+    for variant in (dict(remat_policy="dots"),
+                    dict(remat_policy="names:attn_out,mlp_fc"),
+                    dict(loss_chunk=16),
+                    dict(remat_policy="names:attn_out,mlp_fc",
+                         loss_chunk=16)):
+        m = GPT2(GPT2Config(**base, **variant), dtype=jnp.float32)
+        l, g = jax.value_and_grad(m.loss)(params, batch, r)
+        np.testing.assert_allclose(float(l), float(ref_l), rtol=1e-6,
+                                   err_msg=str(variant))
+        np.testing.assert_allclose(flat(g), flat(ref_g), rtol=2e-5,
+                                   atol=1e-6, err_msg=str(variant))
+    # unknown policy strings fail loudly
+    with pytest.raises(ValueError, match="remat_policy"):
+        GPT2(GPT2Config(**base, remat_policy="everything"),
+             dtype=jnp.float32).loss(params, batch, r)
+
+
 @pytest.mark.slow
 def test_gpt2_trains_e2e(mesh8):
     cfg = {

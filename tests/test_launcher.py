@@ -171,13 +171,14 @@ def test_multinode_runner_commands():
     assert "JAX_PROCESS_ID=${OMPI_COMM_WORLD_RANK:?}" in mpi_cmds[0][-1]
     assert "train.py" in mpi_cmds[0][-1]
 
-    mv_cmds = MVAPICHRunner(args, "w").get_cmd(env, active)
+    mv = MVAPICHRunner(args, "w")
+    mv_cmds = mv.get_cmd(env, active)
     assert len(mv_cmds) == 1 and mv_cmds[0][0] == "mpirun_rsh"
     assert "-hostfile" in mv_cmds[0]
     # env rides as KEY=VALUE args (mpirun_rsh forwards no environment)
     assert any(x.startswith("JAX_COORDINATOR_ADDRESS=") for x in mv_cmds[0])
     assert "JAX_PROCESS_ID=${MV2_COMM_WORLD_RANK:?}" in mv_cmds[0][-1]
-    with open(MVAPICHRunner.HOSTFILE) as f:
+    with open(mv.hostfile) as f:
         assert f.read().splitlines() == ["worker-0", "worker-1"]
 
 

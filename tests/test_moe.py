@@ -89,6 +89,38 @@ def test_top1_no_drop_tokens():
     assert int(dm.astype(jnp.int32).sum()) == S
 
 
+@pytest.mark.parametrize("k", [1, 2])
+def test_scatter_dispatch_matches_einsum(k):
+    """The O(S·M) scatter dispatch computes EXACTLY what the GShard one-hot
+    einsum computes — outputs and gradients — including capacity drops
+    (VERDICT r2 #4: quantify/replace the einsum dispatch)."""
+    dim, E, S = 8, 4, 32
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (S, dim), jnp.float32)
+    outs, grads = {}, {}
+    for impl in ("scatter", "einsum"):
+        moe = MoE(dim, ExpertMLP(dim), num_experts=E, k=k,
+                  capacity_factor=0.5, min_capacity=2, use_rts=False,
+                  dispatch_impl=impl)   # tight capacity → real drops
+        params = moe.init(jax.random.PRNGKey(2))
+
+        def loss(p):
+            out, l_aux, _, ovf = moe.apply(p, x, rng=rng,
+                                           return_overflow=True)
+            return jnp.sum(out ** 2) + l_aux, (out, ovf)
+
+        (l, (out, ovf)), g = jax.value_and_grad(loss, has_aux=True)(params)
+        outs[impl] = (np.asarray(out), float(l), int(ovf))
+        grads[impl] = np.concatenate(
+            [np.asarray(a).ravel() for a in jax.tree_util.tree_leaves(g)])
+    np.testing.assert_allclose(outs["scatter"][0], outs["einsum"][0],
+                               rtol=1e-5, atol=1e-6)
+    assert outs["scatter"][1] == pytest.approx(outs["einsum"][1], rel=1e-6)
+    assert outs["scatter"][2] == outs["einsum"][2]
+    np.testing.assert_allclose(grads["scatter"], grads["einsum"],
+                               rtol=1e-4, atol=1e-6)
+
+
 def test_capacity_for_matches_gating():
     """TopKGate.capacity_for reports the SAME capacity apply() uses, for all
     three sizing modes — pairing it with tokens_overflowed must not produce
