@@ -164,14 +164,18 @@ class MVAPICHRunner(MultiNodeRunner):
         return shutil.which("mpirun_rsh") is not None
 
     def get_cmd(self, environment, active_resources):
+        import atexit
         import tempfile
         hosts = list(active_resources.keys())
         coordinator = environment["coordinator"]
         remote_env = self._coordinator_env(coordinator, len(hosts))
         # per-launch private file: a fixed world-shared path would let
-        # concurrent launches clobber each other's host lists
+        # concurrent launches clobber each other's host lists; best-effort
+        # cleanup when the launcher exits (mpirun_rsh reads it at spawn)
         fd, self.hostfile = tempfile.mkstemp(prefix="deepspeed_mvapich_",
                                              suffix=".hosts", text=True)
+        atexit.register(lambda p=self.hostfile: (
+            os.path.exists(p) and os.unlink(p)))
         with os.fdopen(fd, "w") as f:
             f.write("\n".join(hosts) + "\n")
         cmd = ["mpirun_rsh", "-np", str(len(hosts)),

@@ -508,27 +508,33 @@ class GPT2:
     def _chunked_loss(self, params, tokens, labels, rng):
         """Tied-head + cross-entropy over token chunks, each under
         ``jax.checkpoint``: per-chunk logits live only inside the chunk
-        (fwd AND bwd) — the (B·T, V) fp32 array never exists."""
+        (fwd AND bwd) — the (B·T, V) fp32 array never exists.  The token
+        axis pads up to a chunk multiple with masked rows (a divisor
+        search could degenerate to per-token chunks on prime counts)."""
         x = self.apply(params, tokens, rng=rng, deterministic=False,
                        return_hidden=True)
         B, T, D = x.shape
         BT = B * T
-        n = max(1, -(-BT // int(self.config.loss_chunk)))
-        while BT % n:        # chunk count must divide the token count
-            n += 1
+        chunk = min(int(self.config.loss_chunk), BT)
+        n = -(-BT // chunk)
+        pad = n * chunk - BT
+        xf = jnp.pad(x.reshape(BT, D), ((0, pad), (0, 0)))
+        lf = jnp.pad(labels.reshape(BT).astype(jnp.int32), (0, pad))
+        valid = jnp.pad(jnp.ones((BT,), jnp.float32), (0, pad))
+        xf = xf.reshape(n, chunk, D)
+        lf = lf.reshape(n, chunk)
+        valid = valid.reshape(n, chunk)
         wte = params["wte"]
-        xf = x.reshape(n, BT // n, D)
-        lf = labels.reshape(n, BT // n).astype(jnp.int32)
 
         @jax.checkpoint
-        def chunk_nll(xc, lc):
+        def chunk_nll(xc, lc, vc):
             logits = jnp.einsum("td,vd->tv", xc, wte.astype(xc.dtype),
                                 preferred_element_type=jnp.float32)
             lse = jax.nn.logsumexp(logits, axis=-1)
             lab = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
-            return jnp.sum(lse - lab)
+            return jnp.sum((lse - lab) * vc)
 
-        total = jax.lax.map(lambda args: chunk_nll(*args), (xf, lf))
+        total = jax.lax.map(lambda args: chunk_nll(*args), (xf, lf, valid))
         return jnp.sum(total) / BT
 
     @staticmethod

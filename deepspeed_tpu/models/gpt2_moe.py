@@ -76,6 +76,11 @@ class GPT2MoE:
             base = dict(MOE_PRESETS[preset or "gpt2-moe-tiny"])
             base.update(overrides)
             config = GPT2MoEConfig(**base)
+        if config.loss_chunk:
+            raise NotImplementedError(
+                "loss_chunk is a GPT2 (dense) option; the MoE loss does not "
+                "chunk its head yet — unset it rather than silently "
+                "ignoring the memory tuning")
         self.config = config
         self.dtype = dtype
         c = config
@@ -189,7 +194,9 @@ class GPT2MoE:
                     l_aux, ovf)
 
         if c.remat:
-            block = jax.checkpoint(block, static_argnums=(3,))
+            from .gpt2 import resolve_remat_policy
+            block = jax.checkpoint(block, static_argnums=(3,),
+                                   policy=resolve_remat_policy(c.remat_policy))
 
         aux_total = jnp.float32(0.0)
         ovf_total = jnp.int32(0)

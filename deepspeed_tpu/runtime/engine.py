@@ -140,8 +140,13 @@ class DeepSpeedEngine:
                 "scanned one (measured 1.8x temp bytes on the fsdp mesh). "
                 "Prefer the scanned layer loop (unroll_layers=False) at "
                 "stage 3.", ranks=[0])
+        # mirrors the _offload construction condition below: an eval-only
+        # engine (DummyOptim) or a client-object optimizer never builds the
+        # host tier, so its params must NOT be committed to the CPU backend
         offload_wanted = (self.config.zero_config.offload_optimizer_device()
-                          in ("cpu", "nvme"))
+                          in ("cpu", "nvme")
+                          and optimizer is None
+                          and self.config.optimizer_name is not None)
         self._loss_fn, params0, self._apply_fn, self._tp_specs = _resolve_model(
             model, loss_fn, params, apply_fn, rng_seed,
             init_on_host=offload_wanted)
@@ -584,11 +589,12 @@ class DeepSpeedEngine:
             # ERROR (checked host-side in _host_offload_update), never a
             # silent truncation of embedding gradients
             metrics["sparse_rows_dropped"] = rows_dropped
-        else:
+        elif self.mesh.size == 1:
             # ONE flat buffer for the wire: a per-leaf d2h pays one
             # round-trip latency per leaf (~minutes per step for a
             # billion-param tree on a remote-attached chip); the in-graph
-            # concatenate costs one HBM copy
+            # concatenate costs one HBM copy.  Single-device only — on a
+            # mesh the concatenate would gather sharded grads whole.
             grads = jnp.concatenate(
                 [g.reshape(-1) for g in jax.tree_util.tree_leaves(grads)])
         return grads, metrics, new_scale
@@ -664,8 +670,8 @@ class DeepSpeedEngine:
         if not overflow:
             t0 = time.time()
             if isinstance(grads, jax.Array):
-                # flat wire format: ONE d2h transfer, host-side upcast
-                flat = np.asarray(grads).astype(np.float32)
+                # flat wire format: ONE d2h transfer, in-place host upcast
+                flat = self._offload.upcast_flat(grads)
             else:
                 flat = self._offload.flatten_grads(grads)
             t1 = time.time()
@@ -783,8 +789,13 @@ class DeepSpeedEngine:
 
     def _upload_offload_params(self):
         """Host master → device params as ONE flat h2d + a jitted scatter
-        (per-leaf device_put pays one round-trip latency per leaf)."""
-        if self._sparse_grad_paths:
+        (per-leaf device_put pays one round-trip latency per leaf).
+
+        Single-device fast path only: on a multi-chip mesh the flat image
+        would land whole on one device before resharding (OOM for models
+        that only fit sharded) — there the per-leaf placement puts each
+        leaf directly into its sharding."""
+        if self._sparse_grad_paths or self.mesh.size > 1:
             # sparse wire keeps the tree format end-to-end
             return jax.device_put(self._offload.payload_tree(), self._param_sh)
         if self._jit_scatter_params is None:

@@ -77,6 +77,17 @@ class HostOffloadOptimizer:
         else:
             self.swapper = None
             self.m, self.v = self.opt.init_buffers(self.numel)
+            # first-touch the moment pages NOW: lazily-faulted zeros add
+            # minutes to the FIRST optimizer step of a billion-param model
+            self.m.fill(0.0)
+            self.v.fill(0.0)
+        # reusable fp32 gradient landing buffer (the flat wire upcasts into
+        # it in place — no per-step multi-GB allocation/fault)
+        self._flat32 = np.empty(self.numel, np.float32)
+        self._flat32.fill(0.0)
+        if self.out_dtype is not None:
+            self._out16 = np.empty(self.numel, np.uint16)
+            self._out16.fill(0)
         log_dist(f"host offload optimizer: {self.numel} params, "
                  f"{len(self.sub_groups)} sub-group(s), "
                  f"moments on {'nvme' if self.nvme else 'cpu'}, "
@@ -98,6 +109,13 @@ class HostOffloadOptimizer:
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
 
+    def upcast_flat(self, flat_dev):
+        """Flat 16-bit device gradients → the reusable fp32 host buffer
+        (one d2h; the elementwise upcast converts INTO preallocated,
+        pre-faulted memory instead of allocating multi-GB per step)."""
+        self._flat32[...] = np.asarray(flat_dev)
+        return self._flat32
+
     def flatten_grads(self, grads_tree):
         """Device grads pytree → flat host fp32 (the d2h transfer).
 
@@ -106,7 +124,7 @@ class HostOffloadOptimizer:
         ``sparse_allreduce_no_retain`` engine.py:2227): only the touched rows
         cross the wire; the host scatters them into the flat buffer."""
         leaves = self.treedef.flatten_up_to(grads_tree)
-        flat = np.empty(self.numel, np.float32)
+        flat = self._flat32          # reuse: no multi-GB alloc/fault per step
         for leaf, off, shape in zip(leaves, self.offsets, self.shapes):
             n = int(np.prod(shape or (1,)))
             if isinstance(leaf, dict) and "sparse_indices" in leaf:
@@ -136,9 +154,7 @@ class HostOffloadOptimizer:
     # ------------------------------------------------------------------ step
     def step(self, flat_grads: np.ndarray, step_no: int, lr: float):
         """One fused host Adam step over all sub-groups (in place)."""
-        if self.out_dtype is not None and not hasattr(self, "_out16"):
-            self._out16 = np.empty(self.numel, np.uint16)
-        out16 = getattr(self, "_out16", None)
+        out16 = self._out16 if self.out_dtype is not None else None
         kind = self.out_dtype
 
         if not self.nvme:
@@ -232,8 +248,6 @@ class HostOffloadOptimizer:
                 np.copyto(self.v, v)
         # refresh the device payload for the next upload
         if self.out_dtype is not None:
-            if not hasattr(self, "_out16"):
-                self._out16 = np.empty(self.numel, np.uint16)
             import jax.numpy as jnp
             tgt = (jnp.bfloat16 if self.out_dtype == "bfloat16"
                    else np.float16)

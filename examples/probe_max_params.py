@@ -20,12 +20,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-# (name, n_embd, n_layer, n_head) — GPT-2/GPT-3 style ladders
+# (name, n_embd, n_layer, n_head) — GPT-2/GPT-3 style ladders, DESCENDING:
+# the first size whose full step completes is the capability number
+# (bigger sizes fail fast at allocation; a success costs a full
+# transfer-bound step, so don't retry smaller ones after a success)
 CANDIDATES = [
-    ("2.0b", 2560, 24, 32),
-    ("2.7b", 2560, 32, 32),
-    ("3.3b", 2816, 32, 32),
     ("4.1b", 3072, 36, 24),
+    ("3.3b", 2816, 32, 32),
+    ("2.7b", 2560, 32, 32),
+    ("2.0b", 2560, 24, 32),
 ]
 
 
@@ -79,9 +82,8 @@ def main():
         if line:
             results[name] = json.loads(line[0][6:])
             largest = results[name]["params_b"]
-        else:
-            results[name] = {"error": (r.stderr or r.stdout)[-200:]}
-            break                        # bigger ones will not fit either
+            break                        # descending: first success wins
+        results[name] = {"error": (r.stderr or r.stdout)[-200:]}
     out = {
         "largest_trainable_params_b": largest,
         "chip": "TPU v5e 16GB HBM",

@@ -265,37 +265,44 @@ def test_lut_compresses_grid():
 
 
 @pytest.mark.skipif(jax.default_backend() != "tpu",
-                    reason="wall-clock perf is only meaningful on TPU")
+                    reason="wall-clock perf is only meaningful on TPU "
+                           "(run directly: the suite conftest forces CPU)")
 def test_sparse_beats_dense_flash_on_tpu():
-    """With MXU-sized blocks and the LUT grid, a ~25%-dense layout must beat
-    dense flash at T>=2048 (BASELINE: reference claims 6.3x at high
-    sparsity; here the win scales with density)."""
+    """The LUT grid's time scales with the LIVE block count: at T=16384 a
+    window+global Longformer layout must clearly beat dense flash
+    (measured 2.4x — SPARSE_BENCH.json; the reference claims 6.3x at
+    higher sparsity, README.md:39).  Timed with in-graph iterations: the
+    remote-attach dispatch jitter otherwise swamps single calls."""
     import time
+    from jax import lax
     from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
-    B, T, H, d = 1, 4096, 8, 64
+    B, T, H, d = 1, 16384, 8, 64
     q, k, v = make_qkv(B=B, T=T, H=H, d=d)
     q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
     cfg = BSLongformerSparsityConfig(num_heads=1, block=512,
                                      num_sliding_window_blocks=3,
                                      global_block_indices=[0])
-    layout = cfg.make_layout(T)     # 8x8 coarse blocks, ~50% live pre-causal
+    layout = cfg.make_layout(T)
 
-    f_sparse = jax.jit(lambda q, k, v: sparse_flash_attention(
-        q, k, v, layout, causal=True))
-    f_dense = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, block_q=512, block_k=512))
-    np.asarray(f_sparse(q, k, v)); np.asarray(f_dense(q, k, v))  # compile
+    N = 20
 
-    def timed(f, n=20):
+    def timed(fn):
+        def body(i, acc):
+            return acc + fn(q * (1.0 + i * 1e-12), k,
+                            v).astype(jnp.float32).sum()
+        g = jax.jit(lambda: lax.fori_loop(0, N, body, jnp.float32(0.0)))
+        float(g())                       # compile + warm
         t0 = time.time()
-        for _ in range(n):
-            out = f(q, k, v)
-        np.asarray(out[0, 0, 0, 0])
-        return (time.time() - t0) / n
+        float(g())
+        return (time.time() - t0) / N
 
-    t_s, t_d = timed(f_sparse), timed(f_dense)
-    assert t_s < t_d, (f"sparse {t_s*1e3:.2f}ms not faster than dense "
-                       f"{t_d*1e3:.2f}ms at T={T}")
+    t_s = timed(lambda q, k, v: sparse_flash_attention(
+        q, k, v, layout, causal=True))
+    t_d = timed(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=512, block_k=512))
+    assert t_s < t_d * 0.75, (
+        f"sparse {t_s*1e3:.2f}ms not clearly faster than dense "
+        f"{t_d*1e3:.2f}ms at T={T}")
 
 
 def test_flash_attention_with_padding_bias():
