@@ -25,7 +25,9 @@ import numpy as np  # noqa: E402
 # (bigger sizes fail fast at allocation; a success costs a full
 # transfer-bound step, so don't retry smaller ones after a success)
 CANDIDATES = [
-    ("4.1b", 3072, 36, 24),
+    # 4.1b (3072x36) needs ~16.4GB for bf16 params+grads — over one v5e's
+    # HBM — and its single probe step moves ~16GB over the wire; start at
+    # the largest size that can both fit and finish.
     ("3.3b", 2816, 32, 32),
     ("2.7b", 2560, 32, 32),
     ("2.0b", 2560, 24, 32),

@@ -287,10 +287,15 @@ def test_sparse_beats_dense_flash_on_tpu():
     N = 20
 
     def timed(fn):
-        def body(i, acc):
-            return acc + fn(q * (1.0 + i * 1e-12), k,
-                            v).astype(jnp.float32).sum()
-        g = jax.jit(lambda: lax.fori_loop(0, N, body, jnp.float32(0.0)))
+        # optimization_barrier on the carried q: without it XLA proves the
+        # input loop-invariant and hoists the kernel out of the loop
+        # (timing one call as if it were N)
+        def body(i, carry):
+            acc, qq = carry
+            qq = jax.lax.optimization_barrier(qq)
+            return (acc + fn(qq, k, v).astype(jnp.float32).sum(), qq)
+        g = jax.jit(lambda: lax.fori_loop(
+            0, N, body, (jnp.float32(0.0), q))[0])
         float(g())                       # compile + warm
         t0 = time.time()
         float(g())
