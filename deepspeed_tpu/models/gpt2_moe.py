@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from jax.ad_checkpoint import checkpoint_name
+
 from .gpt2 import GPT2, GPT2Config, PRESETS as GPT2_PRESETS, _layer_norm, \
     _dropout, _attention_jnp
 
@@ -63,7 +65,6 @@ class _ExpertFFN:
 
     def apply(self, params, x, rng=None):
         h = x @ params["fc_w"].astype(x.dtype) + params["fc_b"].astype(x.dtype)
-        from jax.ad_checkpoint import checkpoint_name
         h = checkpoint_name(h, "mlp_fc")   # selective-remat save point
         h = jax.nn.gelu(h, approximate=True)
         return h @ params["proj_w"].astype(x.dtype) + params["proj_b"].astype(x.dtype)
@@ -180,7 +181,6 @@ class GPT2MoE:
             f = lambda t: t.reshape(B, T, H, hd)
             attn = self._attend(f(q), f(k_), f(v), causal, r1, deterministic)
             attn = attn.reshape(B, T, D)
-            from jax.ad_checkpoint import checkpoint_name
             attn = checkpoint_name(attn, "attn_out")
             attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
             x = x + _dropout(attn, c.resid_pdrop, r2, deterministic)

@@ -64,7 +64,10 @@ class SparseSelfAttention:
         causal = (self.sparsity_config.attention == "unidirectional"
                   if causal is None and
                   hasattr(self.sparsity_config, "attention") else bool(causal))
-        layout = jnp.asarray(self.get_layout(T))
+        # keep the layout a HOST numpy array: it compiles into static LUTs
+        # that size the kernel grid, and a jnp conversion here would become
+        # a tracer under remat/jit tracing (TracerArrayConversionError)
+        layout = self.get_layout(T)
         kb = self._to_additive(key_padding_mask, self.key_padding_mask_mode)
         ab = self._to_additive(attn_mask, self.attn_mask_mode)
         return sparse_flash_attention(query, key, value, layout,

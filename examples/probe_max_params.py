@@ -20,17 +20,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-# (name, n_embd, n_layer, n_head) — GPT-2/GPT-3 style ladders, DESCENDING:
-# the first size whose full step completes is the capability number
-# (bigger sizes fail fast at allocation; a success costs a full
-# transfer-bound step, so don't retry smaller ones after a success)
+# (name, n_embd, n_layer, n_head) — GPT-2/GPT-3 style ladders, ASCENDING:
+# each success raises the capability number; the first failure stops the
+# climb (bigger sizes would fail the same allocation)
 CANDIDATES = [
     # 4.1b (3072x36) needs ~16.4GB for bf16 params+grads — over one v5e's
-    # HBM — and its single probe step moves ~16GB over the wire; start at
-    # the largest size that can both fit and finish.
-    ("3.3b", 2816, 32, 32),
-    ("2.7b", 2560, 32, 32),
+    # HBM.  Ordered by what can FINISH a full offload step on the dev
+    # tunnel (~2-13 MB/s: a 3.3b step moves 13GB and timed out at 55 min
+    # in r3); run the biggest your wire budget allows.
     ("2.0b", 2560, 24, 32),
+    ("2.7b", 2560, 32, 32),
+    ("3.3b", 2816, 32, 32),
 ]
 
 
@@ -84,8 +84,9 @@ def main():
         if line:
             results[name] = json.loads(line[0][6:])
             largest = results[name]["params_b"]
-            break                        # descending: first success wins
-        results[name] = {"error": (r.stderr or r.stdout)[-200:]}
+        else:
+            results[name] = {"error": (r.stderr or r.stdout)[-200:]}
+            break                        # ascending: larger would fail too
     out = {
         "largest_trainable_params_b": largest,
         "chip": "TPU v5e 16GB HBM",

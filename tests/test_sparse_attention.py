@@ -270,7 +270,7 @@ def test_lut_compresses_grid():
 def test_sparse_beats_dense_flash_on_tpu():
     """The LUT grid's time scales with the LIVE block count: at T=16384 a
     window+global Longformer layout must clearly beat dense flash
-    (measured 2.4x — SPARSE_BENCH.json; the reference claims 6.3x at
+    (measured 2.92x — SPARSE_BENCH.json; the reference claims 6.3x at
     higher sparsity, README.md:39).  Timed with in-graph iterations: the
     remote-attach dispatch jitter otherwise swamps single calls."""
     import time
