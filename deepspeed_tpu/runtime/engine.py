@@ -217,6 +217,9 @@ class DeepSpeedEngine:
                             if self._dpu else 0)
         self._pending_offload = None   # (grads, metrics) awaiting host apply
         self._jit_scatter_params = None   # flat h2d → param tree (lazy)
+        self._scatter_nchunks = 0
+        from .zero.wire import H2DUploader
+        self._h2d = H2DUploader()
 
         # ---- sparse embedding gradients (reference engine.py:2227
         # sparse_allreduce_no_retain) -----------------------------------------
@@ -668,10 +671,11 @@ class DeepSpeedEngine:
                     "would be dropped; raise the bound (or remove "
                     "sparse_grad_row_bound to use the safe default)")
         if not overflow:
+            from .zero.offload_engine import FlatWireHandle
             t0 = time.time()
-            if isinstance(grads, jax.Array):
-                # flat wire format: ONE d2h transfer, in-place host upcast
-                flat = self._offload.upcast_flat(grads)
+            if isinstance(grads, FlatWireHandle):
+                # flat wire format: land the chunked d2h start_d2h began
+                flat = self._offload.land_flat(grads)
             else:
                 flat = self._offload.flatten_grads(grads)
             t1 = time.time()
@@ -683,6 +687,8 @@ class DeepSpeedEngine:
             self._offload.last_host_times = {
                 "grad_d2h_flatten_s": t1 - t0, "host_adam_s": t2 - t1}
         else:
+            # the skipped step's grads are never landed; dropping the wire
+            # handle (or tree) frees the device buffers
             params = state.params
         # scale already advanced in-graph by _grad_only_step (kept as-is:
         # under DPU `state` may carry newer scale than this pending step)
@@ -751,8 +757,12 @@ class DeepSpeedEngine:
                 # NEXT dispatch sees a post-overflow halving with no host sync
                 self.state = self.state._replace(scale=new_scale)
                 # queue grad d2h behind the device compute (async copy
-                # engine; overlaps the host work below)
-                self._offload.start_d2h(grads)
+                # engine; overlaps the host work below).  For the flat
+                # wire this swaps `grads` for a chunk handle — the
+                # original flat array's buffer is then freed as soon as
+                # the chunk slices are computed, instead of being pinned
+                # through the DPU delay window.
+                grads = self._offload.start_d2h(grads)
                 if self._dpu and self._global_steps_host >= self._dpu_warmup:
                     # DPU steady state: while the device computes THIS
                     # step's grads, the host applies the PREVIOUS step's —
@@ -788,28 +798,83 @@ class DeepSpeedEngine:
         return metrics["loss"]
 
     def _upload_offload_params(self):
-        """Host master → device params as ONE flat h2d + a jitted scatter
-        (per-leaf device_put pays one round-trip latency per leaf).
+        """Host master → device params as CHUNKED flat h2d transfers + a
+        jitted concat/scatter (per-leaf device_put pays one round-trip
+        latency per leaf; one monolithic transfer serializes the
+        transport — ``zero/wire.py``).  Chunks are staged through
+        reusable host buffers so the next host optimizer step can mutate
+        the 16-bit payload while the previous upload is still in flight
+        (the DPU overlap makes that race live otherwise).
 
         Single-device fast path only: on a multi-chip mesh the flat image
         would land whole on one device before resharding (OOM for models
         that only fit sharded) — there the per-leaf placement puts each
         leaf directly into its sharding."""
         if self._sparse_grad_paths or self.mesh.size > 1:
-            # sparse wire keeps the tree format end-to-end
-            return jax.device_put(self._offload.payload_tree(), self._param_sh)
-        if self._jit_scatter_params is None:
+            # sparse wire keeps the tree format end-to-end.  Under DPU the
+            # payload leaves are live views of the host 16-bit image, which
+            # the NEXT host step mutates while this device_put may still be
+            # reading — stage copies first (same race the flat branch
+            # stages against).
+            tree = self._offload.payload_tree()
+            if self._dpu:
+                # copy into ALTERNATING pre-faulted staging trees (a fresh
+                # tree_map(np.array) would allocate + first-touch the full
+                # payload every step; a single reused tree could itself be
+                # overwritten while its upload is in flight — two buffers
+                # give a full upload cycle of slack, and the grad landing
+                # between reuses proves the older transfer completed)
+                stages = getattr(self, "_tree_stages", None)
+                if stages is None:
+                    stages = self._tree_stages = [
+                        jax.tree_util.tree_map(np.array, tree), None]
+                    self._tree_stage_idx = 0
+                idx = self._tree_stage_idx
+                if stages[idx] is None:
+                    stages[idx] = jax.tree_util.tree_map(np.array, tree)
+                else:
+                    jax.tree_util.tree_map(np.copyto, stages[idx], tree)
+                self._tree_stage_idx = 1 - idx
+                tree = stages[idx]
+            return jax.device_put(tree, self._param_sh)
+        payload = self._offload.payload_flat()
+        chunks = self._h2d.upload_flat(payload, stage=self._dpu)
+        if self._jit_scatter_params is None or \
+                self._scatter_nchunks != len(chunks):
             off = self._offload
             shapes, offsets, treedef = off.shapes, off.offsets, off.treedef
+            per = int(chunks[0].shape[0])     # all chunks `per` but the last
 
-            def scatter(flat):
-                leaves = [flat[int(o):int(o) + int(np.prod(s or (1,)))]
-                          .reshape(s) for o, s in zip(offsets, shapes)]
+            def scatter(*parts):
+                # slice each leaf straight out of the chunk(s) covering it —
+                # NO full-size concatenate (that would double peak HBM) and
+                # the per-chunk donation stays usable (XLA reuses chunk
+                # memory for the leaf outputs)
+                leaves = []
+                for o, s in zip(offsets, shapes):
+                    o = int(o)
+                    n = int(np.prod(s or (1,)))
+                    pieces = []
+                    start = o
+                    while start < o + n:
+                        c = start // per
+                        base = c * per
+                        end = min(o + n, base + int(parts[c].shape[0]))
+                        pieces.append(parts[c][start - base:end - base])
+                        start = end
+                    flat = (pieces[0] if len(pieces) == 1
+                            else jnp.concatenate(pieces))
+                    leaves.append(flat.reshape(s))
                 return treedef.unflatten(leaves)
+            self._scatter_nchunks = len(chunks)
             self._jit_scatter_params = jax.jit(
-                scatter, out_shardings=self._param_sh)
-        return self._jit_scatter_params(
-            jax.device_put(self._offload.payload_flat()))
+                scatter, out_shardings=self._param_sh,
+                donate_argnums=tuple(range(len(chunks))))
+        params = self._jit_scatter_params(*chunks)
+        # staging buffers recycle once the scatter OUTPUT is ready (the
+        # donated chunks' is_deleted cannot prove the h2d DMA finished)
+        self._h2d.settle_on(jax.tree_util.tree_leaves(params)[0])
+        return params
 
     def _flush_offload(self):
         """Apply a pending delayed-param update so exported / evaluated

@@ -21,9 +21,19 @@ import jax
 
 from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
 from ...utils.logging import logger, log_dist
+from . import wire
 
 OUT_DTYPE = {"bfloat16": "bfloat16", "float16": "float16",
              "float32": None}
+
+
+class FlatWireHandle:
+    """In-flight chunked d2h of one flat grad array (see
+    :meth:`HostOffloadOptimizer.start_d2h`); holds only the chunk slices,
+    so dropping it frees the device memory."""
+
+    def __init__(self, handle):
+        self.handle = handle
 
 
 class HostOffloadOptimizer:
@@ -94,27 +104,31 @@ class HostOffloadOptimizer:
                  f"native={self.opt.is_native}", ranks=[0])
 
     # ------------------------------------------------------------ flattening
-    @staticmethod
-    def start_d2h(grads_tree):
+    def start_d2h(self, grads_tree):
         """Kick off the device→host DMA for every gradient leaf WITHOUT
-        blocking.  Called right after the grad step is dispatched, so the
-        transfers queue behind the device compute and run while the host
-        does other work (the reference overlaps per-bucket pinned d2h
-        copies with backward, ``stage_1_and_2.py:1008-1160``; here the
-        async copy engine provides the same pipelining).  The later
-        ``flatten_grads``'s ``np.asarray`` calls then find the bytes
-        already home (or in flight) instead of serializing one blocking
-        transfer per leaf."""
-        for leaf in jax.tree_util.tree_leaves(grads_tree):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
+        blocking, and return the wire object the caller should hold IN
+        PLACE OF the grads.  Called right after the grad step is
+        dispatched, so the transfers queue behind the device compute and
+        run while the host does other work (the reference overlaps
+        per-bucket pinned d2h copies with backward,
+        ``stage_1_and_2.py:1008-1160``).
 
-    def upcast_flat(self, flat_dev):
-        """Flat 16-bit device gradients → the reusable fp32 host buffer
-        (one d2h; the elementwise upcast converts INTO preallocated,
-        pre-faulted memory instead of allocating multi-GB per step)."""
-        self._flat32[...] = np.asarray(flat_dev)
-        return self._flat32
+        A flat grad array is CHUNKED first (``zero/wire.py``; one
+        monolithic transfer serializes the transport, ~8x measured) and a
+        :class:`FlatWireHandle` over the chunk slices is returned — the
+        caller drops its reference to the original flat array so only the
+        chunks stay live (dropping the handle, e.g. on an fp16 overflow
+        skip, frees everything).  Pytree grads start per-leaf transfers
+        and pass through unchanged."""
+        if isinstance(grads_tree, jax.Array):
+            return FlatWireHandle(wire.d2h_flat_start(grads_tree))
+        wire.d2h_tree_start(grads_tree)
+        return grads_tree
+
+    def land_flat(self, handle):
+        """Land a :class:`FlatWireHandle`'s chunks into the reusable fp32
+        host buffer (upcasts into preallocated, pre-faulted memory)."""
+        return wire.d2h_flat_land(handle.handle, self._flat32)
 
     def flatten_grads(self, grads_tree):
         """Device grads pytree → flat host fp32 (the d2h transfer).

@@ -67,8 +67,12 @@ def main():
         print(f"device grad step: {time.time()-t0:.2f}s", flush=True)
 
     t0 = time.time()
-    engine._offload.start_d2h(grads)
-    flat = engine._offload.flatten_grads(grads)
+    wire_obj = engine._offload.start_d2h(grads)
+    del grads
+    from deepspeed_tpu.runtime.zero.offload_engine import FlatWireHandle
+    flat = (engine._offload.land_flat(wire_obj)
+            if isinstance(wire_obj, FlatWireHandle)
+            else engine._offload.flatten_grads(wire_obj))
     d2h = time.time() - t0
     gb = flat.nbytes / 2 / 1e9  # bf16 on the wire
     print(f"grad d2h+flatten: {d2h:.1f}s ({gb:.2f} GB bf16 -> "
