@@ -209,6 +209,38 @@ def gpt2_block_forward(c, p, x, rng, deterministic, causal_mask, attend,
         return x + _dropout(h, c.resid_pdrop, r3, deterministic)
 
 
+def _chunked_head_nll(c, wte, x, labels):
+    """Tied-head + cross-entropy over token chunks, each under
+    ``jax.checkpoint``: per-chunk logits live only inside the chunk
+    (fwd AND bwd) — the (B·T, V) fp32 array never exists.  The token
+    axis pads up to a chunk multiple with masked rows (a divisor
+    search could degenerate to per-token chunks on prime counts).
+
+    ``x``: post-final-LN hidden states (B, T, D)."""
+    B, T, D = x.shape
+    BT = B * T
+    chunk = min(int(c.loss_chunk), BT)
+    n = -(-BT // chunk)
+    pad = n * chunk - BT
+    xf = jnp.pad(x.reshape(BT, D), ((0, pad), (0, 0)))
+    lf = jnp.pad(labels.reshape(BT).astype(jnp.int32), (0, pad))
+    valid = jnp.pad(jnp.ones((BT,), jnp.float32), (0, pad))
+    xf = xf.reshape(n, chunk, D)
+    lf = lf.reshape(n, chunk)
+    valid = valid.reshape(n, chunk)
+
+    @jax.checkpoint
+    def chunk_nll(xc, lc, vc):
+        logits = jnp.einsum("td,vd->tv", xc, wte.astype(xc.dtype),
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        lab = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
+        return jnp.sum((lse - lab) * vc)
+
+    total = jax.lax.map(lambda args: chunk_nll(*args), (xf, lf, valid))
+    return jnp.sum(total) / BT
+
+
 class GPT2:
     """Decoder-only LM. Params are a dict pytree with scanned block stacks."""
 
@@ -506,36 +538,69 @@ class GPT2:
         return jnp.mean(lse - label_logit)
 
     def _chunked_loss(self, params, tokens, labels, rng):
-        """Tied-head + cross-entropy over token chunks, each under
-        ``jax.checkpoint``: per-chunk logits live only inside the chunk
-        (fwd AND bwd) — the (B·T, V) fp32 array never exists.  The token
-        axis pads up to a chunk multiple with masked rows (a divisor
-        search could degenerate to per-token chunks on prime counts)."""
+        """Tied-head + cross-entropy over token chunks (see
+        :func:`_chunked_head_nll`)."""
         x = self.apply(params, tokens, rng=rng, deterministic=False,
                        return_hidden=True)
-        B, T, D = x.shape
-        BT = B * T
-        chunk = min(int(self.config.loss_chunk), BT)
-        n = -(-BT // chunk)
-        pad = n * chunk - BT
-        xf = jnp.pad(x.reshape(BT, D), ((0, pad), (0, 0)))
-        lf = jnp.pad(labels.reshape(BT).astype(jnp.int32), (0, pad))
-        valid = jnp.pad(jnp.ones((BT,), jnp.float32), (0, pad))
-        xf = xf.reshape(n, chunk, D)
-        lf = lf.reshape(n, chunk)
-        valid = valid.reshape(n, chunk)
-        wte = params["wte"]
+        return _chunked_head_nll(self.config, params["wte"], x, labels)
 
-        @jax.checkpoint
-        def chunk_nll(xc, lc, vc):
-            logits = jnp.einsum("td,vd->tv", xc, wte.astype(xc.dtype),
+    # ------------------------------------------------- param-offload streaming
+    def stream_fns(self):
+        """Decomposed forward for the ZeRO-3 parameter-offload runner
+        (``runtime/zero/param_stream.py``): params live on the HOST and
+        layer blocks stream through the device one at a time, so the
+        forward must be callable in per-layer pieces.  RNG derivation
+        matches :meth:`apply` exactly (embed dropout ``fold_in(rng, 17)``,
+        layer rngs ``split(fold_in(rng, 31), L)``) so a streamed run
+        loss-matches the monolithic one bit-for-bit.
+
+        Parity: reference ``zero/stage3.py:656 _configure_offloading`` +
+        ``partitioned_param_coordinator`` fetch/release per submodule.
+        """
+        c = self.config
+        dtype = self.dtype
+
+        def embed(nonblock, tokens, rng, deterministic):
+            T = tokens.shape[1]
+            pos = jnp.arange(T)
+            x = (nonblock["wte"].astype(dtype)[tokens]
+                 + nonblock["wpe"].astype(dtype)[pos])
+            return _dropout(x, c.embd_pdrop, jax.random.fold_in(rng, 17),
+                            deterministic)
+
+        def layer_rngs(rng):
+            return jax.random.split(jax.random.fold_in(rng, 31), c.n_layer)
+
+        def block(layer_p, x, rng, is_local, deterministic):
+            T = x.shape[1]
+            causal_mask = jnp.tril(jnp.ones((T, T), bool))[None, None, :, :]
+            return gpt2_block_forward(c, layer_p, x, rng, deterministic,
+                                      causal_mask, self._attend,
+                                      is_local=is_local)
+
+        def head_loss(nonblock, x, labels):
+            x = _layer_norm(x, nonblock["lnf_scale"], nonblock["lnf_bias"],
+                            c.layer_norm_eps)
+            if c.loss_chunk > 0:
+                return _chunked_head_nll(c, nonblock["wte"], x, labels)
+            logits = jnp.einsum("btd,vd->btv", x,
+                                nonblock["wte"].astype(x.dtype),
                                 preferred_element_type=jnp.float32)
             lse = jax.nn.logsumexp(logits, axis=-1)
-            lab = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
-            return jnp.sum((lse - lab) * vc)
+            label_logit = jnp.take_along_axis(
+                logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            return jnp.mean(lse - label_logit)
 
-        total = jax.lax.map(lambda args: chunk_nll(*args), (xf, lf, valid))
-        return jnp.sum(total) / BT
+        return {
+            "stacked_key": "blocks",
+            "n_layer": c.n_layer,
+            "local_flags": np.arange(c.n_layer) % 2 == 1,
+            "embed": embed,
+            "layer_rngs": layer_rngs,
+            "block": block,
+            "head_loss": head_loss,
+            "split_batch": self._split_batch,
+        }
 
     @staticmethod
     def _split_batch(batch):

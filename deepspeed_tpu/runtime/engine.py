@@ -147,6 +147,39 @@ class DeepSpeedEngine:
                           in ("cpu", "nvme")
                           and optimizer is None
                           and self.config.optimizer_name is not None)
+        # ---- ZeRO-3 parameter offload (streamed layer blocks) ------------
+        # reference: stage3.py:656 _configure_offloading + the param tier of
+        # swap_tensor/ — params live on host/NVMe, so offload_param REQUIRES
+        # the host optimizer tier (there is nowhere on-device to keep a
+        # master) and the decomposed-forward contract from the model.
+        param_stream_wanted = (
+            self.config.zero_config.offload_param_device() in ("cpu", "nvme"))
+        if param_stream_wanted:
+            if self.zero_stage != 3:
+                raise ValueError(
+                    "zero_optimization.offload_param requires stage 3 "
+                    f"(got stage {self.zero_stage})")
+            if not offload_wanted:
+                raise ValueError(
+                    "offload_param requires offload_optimizer (cpu or nvme) "
+                    "with a config-specified Adam/AdamW: streamed parameters "
+                    "have no device-resident master for an in-device "
+                    "optimizer to update")
+            if self.fp16_enabled:
+                raise ValueError(
+                    "offload_param does not support fp16 dynamic loss "
+                    "scaling; use bf16 (TPU-native) or fp32")
+            if not callable(getattr(model, "stream_fns", None)):
+                raise ValueError(
+                    "offload_param needs a model exposing stream_fns() "
+                    "(decomposed embed/block/head forward) — GPT2 and "
+                    "compatible families provide it")
+            if self.mesh.size > 1:
+                raise ValueError(
+                    "offload_param streaming is single-chip scale-up "
+                    "machinery; on a multi-chip mesh use ZeRO-3 sharding "
+                    "(params shard over the fsdp axis) without offload_param")
+        self._param_stream = None
         self._loss_fn, params0, self._apply_fn, self._tp_specs = _resolve_model(
             model, loss_fn, params, apply_fn, rng_seed,
             init_on_host=offload_wanted)
@@ -155,7 +188,11 @@ class DeepSpeedEngine:
         # under offload the cast runs ON THE HOST backend — the default-
         # device jit would silently haul the tree to the accelerator
         f32 = lambda t: tree_cast(t, jnp.float32)
-        if offload_wanted:
+        if all(np.dtype(l.dtype) == np.float32
+               for l in jax.tree_util.tree_leaves(params0)):
+            pass      # already fp32: skip the cast (a copy of the whole
+            # tree — prohibitive transient RAM at beyond-HBM param counts)
+        elif offload_wanted:
             with jax.default_device(jax.devices("cpu")[0]):
                 params0 = jax.jit(f32)(params0)
         else:
@@ -203,11 +240,43 @@ class DeepSpeedEngine:
                 f"offload_optimizer requires Adam/AdamW (got {name!r}; " \
                 "reference parity: DeepSpeedCPUAdam)"
             from .zero.offload_engine import HostOffloadOptimizer
-            self._offload = HostOffloadOptimizer(
-                params0, self.config.zero_config, self.config.aio_config,
-                optimizer_name=name,
-                optimizer_params=self.config.optimizer_params,
-                compute_dtype_name=self.config.precision_dtype)
+            if param_stream_wanted:
+                # layer-major flat layout: each streamed layer is one
+                # contiguous host segment (zero-copy h2d views, contiguous
+                # grad landing).  consume_params frees the init tree leaf
+                # by leaf — at beyond-HBM scale the init tree, master and
+                # moments cannot coexist in host RAM.
+                from .zero import param_stream as ps
+                stacked_key = model.stream_fns()["stacked_key"]
+                stream_tree = ps.to_stream_tree(params0, stacked_key)
+                # the per-layer slices copied the stacked leaves — free the
+                # stacks now (nonblock leaves are SHARED with the stream
+                # tree and get consumed by the host optimizer build)
+                for leaf in jax.tree_util.tree_leaves(params0[stacked_key]):
+                    if hasattr(leaf, "delete"):
+                        leaf.delete()
+                params0 = None
+                self._offload = HostOffloadOptimizer(
+                    stream_tree, self.config.zero_config,
+                    self.config.aio_config, optimizer_name=name,
+                    optimizer_params=self.config.optimizer_params,
+                    compute_dtype_name=self.config.precision_dtype,
+                    consume_params=True,
+                    payload_in_ram=(self.config.zero_config
+                                    .offload_param_device() == "cpu"))
+                del stream_tree
+                self._param_stream = ps.ParamStreamRunner(
+                    model, self._offload, self.mesh, self.compute_dtype,
+                    gas=self.config.gradient_accumulation_steps,
+                    grad_clip=self.config.gradient_clipping,
+                    zero_config=self.config.zero_config,
+                    aio_config=self.config.aio_config)
+            else:
+                self._offload = HostOffloadOptimizer(
+                    params0, self.config.zero_config, self.config.aio_config,
+                    optimizer_name=name,
+                    optimizer_params=self.config.optimizer_params,
+                    compute_dtype_name=self.config.precision_dtype)
         # one-step delayed parameter update (ZeRO-Offload DPU): device step
         # k+1 overlaps the host optimizer+transfers for step k
         off_cfg = self.config.zero_config.offload_optimizer
@@ -368,6 +437,16 @@ class DeepSpeedEngine:
     def _init_state(self, params0):
         dtype = self.compute_dtype
         needs_master = dtype != jnp.float32
+
+        if self._param_stream is not None:
+            # streamed params: nothing model-sized lives on the device;
+            # the runner owns the nonblock tree and the host owns the rest
+            self._scaler = None       # fp16 rejected for streamed mode
+            z = lambda: jax.device_put(jnp.asarray(0, jnp.int32),
+                                       self._repl_sh)
+            return TrainState(global_steps=z(), optimizer_steps=z(),
+                              skipped_steps=z(), params=None, master=None,
+                              opt_state=None, scale=None)
 
         # one jitted cast: in the offload path ON THE HOST backend (only the
         # 16-bit image then crosses the wire, placed in a second step);
@@ -711,6 +790,8 @@ class DeepSpeedEngine:
         micro_batches = [next(it) for _ in range(gas)]
         if self.curriculum_scheduler is not None:
             micro_batches = [self._apply_curriculum(mb) for mb in micro_batches]
+        if self._param_stream is not None:
+            return self._run_stream_step(micro_batches)
         batch = self._stack_microbatches(micro_batches)
         return self._run_fused_step(batch)
 
@@ -776,6 +857,27 @@ class DeepSpeedEngine:
                     self._host_offload_update(grads, metrics)
             else:
                 self.state, metrics = self._jit_train_step(self.state, batch, rng)
+        return self._finish_step(metrics)
+
+    def _run_stream_step(self, micro_batches):
+        """ZeRO-3 param-offload step: the runner streams layer blocks
+        through the device (``zero/param_stream.py``); the engine keeps
+        counters/schedules/reporting identical to the fused path."""
+        self.tput_timer.start()
+        rng = jax.random.fold_in(self._base_rng, self.micro_steps)
+        lr = float(self._lr_at(self.state.global_steps))
+        with jax.set_mesh(self.mesh):
+            metrics = self._param_stream.train_step(
+                micro_batches, rng, lr=lr,
+                step_no=int(self.state.optimizer_steps) + 1)
+        one = jnp.asarray(1, jnp.int32)
+        self.state = self.state._replace(
+            global_steps=self.state.global_steps + one,
+            optimizer_steps=self.state.optimizer_steps + one)
+        return self._finish_step(metrics)
+
+    def _finish_step(self, metrics):
+        """Post-step bookkeeping shared by the fused and streamed paths."""
         self._last_metrics = metrics
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
@@ -841,35 +943,12 @@ class DeepSpeedEngine:
         chunks = self._h2d.upload_flat(payload, stage=self._dpu)
         if self._jit_scatter_params is None or \
                 self._scatter_nchunks != len(chunks):
-            off = self._offload
-            shapes, offsets, treedef = off.shapes, off.offsets, off.treedef
-            per = int(chunks[0].shape[0])     # all chunks `per` but the last
-
-            def scatter(*parts):
-                # slice each leaf straight out of the chunk(s) covering it —
-                # NO full-size concatenate (that would double peak HBM) and
-                # the per-chunk donation stays usable (XLA reuses chunk
-                # memory for the leaf outputs)
-                leaves = []
-                for o, s in zip(offsets, shapes):
-                    o = int(o)
-                    n = int(np.prod(s or (1,)))
-                    pieces = []
-                    start = o
-                    while start < o + n:
-                        c = start // per
-                        base = c * per
-                        end = min(o + n, base + int(parts[c].shape[0]))
-                        pieces.append(parts[c][start - base:end - base])
-                        start = end
-                    flat = (pieces[0] if len(pieces) == 1
-                            else jnp.concatenate(pieces))
-                    leaves.append(flat.reshape(s))
-                return treedef.unflatten(leaves)
+            from .zero.wire import make_chunk_scatter
             self._scatter_nchunks = len(chunks)
-            self._jit_scatter_params = jax.jit(
-                scatter, out_shardings=self._param_sh,
-                donate_argnums=tuple(range(len(chunks))))
+            self._jit_scatter_params = make_chunk_scatter(
+                self._offload.shapes, self._offload.treedef,
+                int(chunks[0].shape[0]), len(chunks),
+                out_shardings=self._param_sh)
         params = self._jit_scatter_params(*chunks)
         # staging buffers recycle once the scatter OUTPUT is ready (the
         # donated chunks' is_deleted cannot prove the h2d DMA finished)
@@ -886,6 +965,10 @@ class DeepSpeedEngine:
     def eval_batch(self, batch, rng=None):
         """Loss without gradient/update (jitted separately)."""
         self._flush_offload()
+        if self._param_stream is not None:
+            rng = rng if rng is not None else jax.random.PRNGKey(0)
+            with jax.set_mesh(self.mesh):
+                return self._param_stream.eval_loss(batch, rng)
         if self._jit_eval is None:
             def eval_fn(params, mb, r):
                 return self._loss_fn(params, mb, r)
@@ -927,9 +1010,11 @@ class DeepSpeedEngine:
         over the queued microbatches."""
         if not self.is_gradient_accumulation_boundary():
             return None
-        batch = self._stack_microbatches(self._pending_microbatches)
-        self._pending_microbatches = []
-        return self._run_fused_step(batch)
+        micro_batches, self._pending_microbatches = \
+            self._pending_microbatches, []
+        if self._param_stream is not None:
+            return self._run_stream_step(micro_batches)
+        return self._run_fused_step(self._stack_microbatches(micro_batches))
 
     # ------------------------------------------------------------ data/loader
     def deepspeed_io(self, dataset, batch_size=None, route=None, data_sampler=None,
@@ -1049,6 +1134,8 @@ class DeepSpeedEngine:
     def module_state_dict(self):
         """Full (gathered) params as a host pytree of numpy arrays."""
         self._flush_offload()
+        if self._param_stream is not None:
+            return self._param_stream.full_params_host()
         return jax.tree_util.tree_map(np.asarray, self.state.params)
 
     # ----------------------------------------------------------- checkpoints
@@ -1082,15 +1169,26 @@ class DeepSpeedEngine:
                              if self.lr_scheduler is not None and
                              hasattr(self.lr_scheduler, "state_dict") else None),
         }
+        params_out = (self._param_stream.full_params_host()
+                      if self._param_stream is not None
+                      else self.state.params)
         save_tree(os.path.join(path, MODEL_FILE),
-                  {"params": self.state.params}, meta=engine_meta)
+                  {"params": params_out}, meta=engine_meta)
         if self._offload is not None:
             # host-resident state saved in the SAME layout as the in-device
             # AdamState (param-shaped moment pytrees + full master pytree),
             # so offload/non-offload runs can load each other's checkpoints
-            # and zero_to_fp32 consolidation works unchanged
-            optim_tree = {"opt_state": self._offload.moments_tree(),
-                          "master": self._offload.master_tree()}
+            # and zero_to_fp32 consolidation works unchanged.  Streamed mode
+            # converts its layer-major trees back to the stacked model tree.
+            moments = self._offload.moments_tree()
+            master = self._offload.master_tree()
+            if self._param_stream is not None:
+                from .zero.param_stream import from_stream_tree
+                key = self._param_stream.sf["stacked_key"]
+                moments = {k: from_stream_tree(v, key)
+                           for k, v in moments.items()}
+                master = from_stream_tree(master, key)
+            optim_tree = {"opt_state": moments, "master": master}
         else:
             optim_tree = {"opt_state": self.state.opt_state}
             if self.state.master is not None:
@@ -1131,7 +1229,10 @@ class DeepSpeedEngine:
         self._flush_offload()
         os.makedirs(save_dir, exist_ok=True)
         path = os.path.join(save_dir, save_filename)
-        save_tree(path, {"params": self.state.params},
+        params_out = (self._param_stream.full_params_host()
+                      if self._param_stream is not None
+                      else self.state.params)
+        save_tree(path, {"params": params_out},
                   meta={"dtype": self.config.precision_dtype})
         log_dist(f"saved 16-bit model to {path}", ranks=[0])
         return True
@@ -1173,21 +1274,33 @@ class DeepSpeedEngine:
 
         if self._offload is not None:
             # host tier: master/moments restored into the offload buffers;
-            # the device payload is refreshed from the loaded master
-            self._offload.load_state(master_tree=model_tree["params"])
+            # the device payload is refreshed from the loaded master.
+            # Streamed mode converts checkpoint (stacked) trees into its
+            # layer-major layout first.
+            if self._param_stream is not None:
+                from .zero.param_stream import to_stream_tree
+                skey = self._param_stream.sf["stacked_key"]
+                conv = lambda t: (to_stream_tree(t, skey)
+                                  if t is not None else None)
+            else:
+                conv = lambda t: t
+            self._offload.load_state(master_tree=conv(model_tree["params"]))
             if load_optimizer_states and not load_module_only:
                 optim_tree, _ = load_tree(os.path.join(path, OPTIM_FILE),
                                           with_meta=True)
                 opt = optim_tree.get("opt_state", {})
                 self._offload.load_state(
-                    master_tree=optim_tree.get("master"),
-                    m=opt.get("exp_avg"), v=opt.get("exp_avg_sq"))
+                    master_tree=conv(optim_tree.get("master")),
+                    m=conv(opt.get("exp_avg")), v=conv(opt.get("exp_avg_sq")))
                 if "scale" in optim_tree and state.scale is not None:
                     state = state._replace(scale=jax.device_put(
                         restore_like(state.scale, optim_tree["scale"]),
                         self._repl_sh))
-            state = state._replace(params=jax.device_put(
-                self._offload.payload_tree(), self._param_sh))
+            if self._param_stream is not None:
+                self._param_stream.reload_from_host()
+            else:
+                state = state._replace(params=jax.device_put(
+                    self._offload.payload_tree(), self._param_sh))
         elif load_optimizer_states and not load_module_only:
             optim_tree, _ = load_tree(os.path.join(path, OPTIM_FILE), with_meta=True)
             opt_state = jax.device_put(

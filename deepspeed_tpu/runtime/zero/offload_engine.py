@@ -38,7 +38,8 @@ class FlatWireHandle:
 
 class HostOffloadOptimizer:
     def __init__(self, params0, zero_config, aio_config, *, optimizer_name,
-                 optimizer_params, compute_dtype_name, rank=0):
+                 optimizer_params, compute_dtype_name, rank=0,
+                 consume_params=False, payload_in_ram=True):
         p = dict(optimizer_params or {})
         p.pop("torch_adam", None)
         # same default as FusedAdam (adam_w_mode=True): identical update rule
@@ -59,8 +60,13 @@ class HostOffloadOptimizer:
         # np.asarray pays one transfer LATENCY per leaf (~minutes for a
         # billion-param tree on a remote-attached chip)
         self.start_d2h(leaves)
-        for leaf, off, n in zip(leaves, self.offsets, sizes):
+        for i, (leaf, off, n) in enumerate(zip(leaves, self.offsets, sizes)):
             self.master[off:off + n] = np.asarray(leaf, np.float32).ravel()
+            if consume_params and hasattr(leaf, "delete"):
+                # free each source leaf as it is absorbed — at billions of
+                # params the init tree + master together would not fit RAM
+                leaf.delete()
+                leaves[i] = None
 
         # ---- sub-groups (reference sub_group_size elements) ----------------
         sg = int(zero_config.sub_group_size)
@@ -95,13 +101,42 @@ class HostOffloadOptimizer:
         # it in place — no per-step multi-GB allocation/fault)
         self._flat32 = np.empty(self.numel, np.float32)
         self._flat32.fill(0.0)
-        if self.out_dtype is not None:
+        self._out16 = None
+        if self.out_dtype is not None and payload_in_ram:
             self._out16 = np.empty(self.numel, np.uint16)
             self._out16.fill(0)
+            self.refresh_payload()
         log_dist(f"host offload optimizer: {self.numel} params, "
                  f"{len(self.sub_groups)} sub-group(s), "
                  f"moments on {'nvme' if self.nvme else 'cpu'}, "
                  f"native={self.opt.is_native}", ranks=[0])
+
+    # ------------------------------------------------------- payload encode
+    def encode_range(self, lo, hi, out_buf):
+        """master[lo:hi] → compute-dtype payload bytes in ``out_buf``
+        (uint16 view for 16-bit dtypes, fp32 otherwise).  The param-stream
+        NVMe tier uses this to materialize per-layer payloads without a
+        whole-model RAM image."""
+        n = hi - lo
+        if self.out_dtype is None:
+            np.copyto(out_buf[:n], self.master[lo:hi])
+        elif self.out_dtype == "bfloat16":
+            import ml_dtypes
+            out_buf[:n] = self.master[lo:hi].astype(
+                ml_dtypes.bfloat16).view(np.uint16)
+        else:
+            out_buf[:n] = self.master[lo:hi].astype(np.float16).view(np.uint16)
+
+    def refresh_payload(self):
+        """Re-encode the full 16-bit RAM image from the fp32 master (init
+        and checkpoint-load; steady-state steps update it incrementally
+        through the fused op's 16-bit copy-back)."""
+        if self._out16 is not None:
+            self.encode_range(0, self.numel, self._out16)
+
+    def drop_payload(self):
+        """Release the RAM image (NVMe param tier keeps payloads on disk)."""
+        self._out16 = None
 
     # ------------------------------------------------------------ flattening
     def start_d2h(self, grads_tree):
@@ -155,6 +190,8 @@ class HostOffloadOptimizer:
         import jax.numpy as jnp
         if self.out_dtype is None:
             return self.master
+        assert self._out16 is not None, \
+            "payload image dropped (NVMe param tier); use encode_range"
         return self._out16.view(
             jnp.bfloat16 if self.out_dtype == "bfloat16" else np.float16)
 
@@ -168,8 +205,10 @@ class HostOffloadOptimizer:
     # ------------------------------------------------------------------ step
     def step(self, flat_grads: np.ndarray, step_no: int, lr: float):
         """One fused host Adam step over all sub-groups (in place)."""
-        out16 = self._out16 if self.out_dtype is not None else None
-        kind = self.out_dtype
+        out16 = self._out16          # None for fp32 or external payload
+        # no RAM image -> skip the fused op's 16-bit copy-back entirely
+        # (the NVMe tier re-encodes per layer from the master instead)
+        kind = self.out_dtype if out16 is not None else None
 
         if not self.nvme:
             self._step_range(0, self.numel, flat_grads, self.m, self.v,
@@ -261,11 +300,4 @@ class HostOffloadOptimizer:
                 np.copyto(self.m, m)
                 np.copyto(self.v, v)
         # refresh the device payload for the next upload
-        if self.out_dtype is not None:
-            import jax.numpy as jnp
-            tgt = (jnp.bfloat16 if self.out_dtype == "bfloat16"
-                   else np.float16)
-            self._out16[...] = np.asarray(
-                jnp.asarray(self.master).astype(tgt)).view(np.uint16) \
-                if self.out_dtype == "bfloat16" \
-                else self.master.astype(np.float16).view(np.uint16)
+        self.refresh_payload()

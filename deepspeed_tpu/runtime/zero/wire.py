@@ -69,6 +69,39 @@ def d2h_tree_start(tree):
             leaf.copy_to_host_async()
 
 
+def make_chunk_scatter(shapes, treedef, per, nchunks, *, out_shardings=None):
+    """Build the jitted chunks→pytree scatter shared by every h2d upload
+    path: each leaf is sliced straight out of the chunk(s) covering it —
+    no full-size concatenate (that would double peak HBM) and per-chunk
+    donation stays usable (XLA reuses chunk memory for the leaf outputs).
+
+    ``shapes``: leaf shapes in treedef order (leaves tile the flat buffer
+    contiguously); ``per``: elements per chunk (all chunks but the last).
+    """
+    import jax.numpy as jnp
+
+    def scatter(*parts):
+        leaves = []
+        o = 0
+        for s in shapes:
+            n = int(np.prod(s or (1,)))
+            pieces = []
+            start = o
+            while start < o + n:
+                c = start // per
+                base = c * per
+                end = min(o + n, base + int(parts[c].shape[0]))
+                pieces.append(parts[c][start - base:end - base])
+                start = end
+            flat = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+            leaves.append(flat.reshape(s))
+            o += n
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    kw = {"out_shardings": out_shardings} if out_shardings is not None else {}
+    return jax.jit(scatter, donate_argnums=tuple(range(nchunks)), **kw)
+
+
 class H2DUploader:
     """Chunked host->device upload with an optional staging copy.
 

@@ -1,0 +1,150 @@
+"""ZeRO-3 parameter offload (streamed layer blocks) — `zero/param_stream.py`.
+
+Oracle strategy (reference ``tests/unit/test_zero.py`` cpu_offload
+parametrizations): the streamed run must loss-match a non-streamed run of
+the same config on the same data — here the baseline is ZeRO-3 + host
+optimizer offload WITHOUT offload_param, which isolates exactly the
+parameter-streaming machinery (same host fused Adam on both sides).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+from deepspeed_tpu.parallel.mesh import make_mesh
+
+
+def _model(dropout=0.0):
+    return GPT2(GPT2Config(n_embd=64, n_layer=3, n_head=4, vocab_size=256,
+                           max_seq=32, embd_pdrop=dropout, attn_pdrop=0.0,
+                           resid_pdrop=dropout, remat=False,
+                           attention_impl="jnp"),
+                dtype=jnp.bfloat16)
+
+
+def _config(micro, gas=1, offload_param=None, clip=1.0):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 10 ** 9,
+        "gradient_clipping": clip,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3,
+                              "offload_optimizer": {"device": "cpu"}},
+    }
+    if offload_param is not None:
+        cfg["zero_optimization"]["offload_param"] = offload_param
+    return cfg
+
+
+def _mesh1():
+    return make_mesh({"data": 1}, devices=jax.devices()[:1])
+
+
+def _tokens(n=16, seq=24, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (n, seq + 1)).astype(np.int32)
+
+
+def _train(config, dropout=0.0, steps=3, model=None):
+    engine, _, _, _ = ds.initialize(
+        config=config, model=model or _model(dropout),
+        training_data=(_tokens(),), mesh=_mesh1())
+    losses = [float(engine.train_batch()) for _ in range(steps)]
+    return engine, losses
+
+
+def test_stream_loss_matches_nonstream(devices):
+    _, ref = _train(_config(4))
+    eng, got = _train(_config(4, offload_param={"device": "cpu"}))
+    assert eng._param_stream is not None
+    np.testing.assert_allclose(ref, got, rtol=3e-4)
+
+
+def test_stream_gas_accumulation_matches(devices):
+    _, ref = _train(_config(2, gas=2))
+    eng, got = _train(_config(2, gas=2, offload_param={"device": "cpu"}))
+    assert eng._param_stream is not None
+    np.testing.assert_allclose(ref, got, rtol=5e-4)
+
+
+def test_stream_with_dropout_rng_parity(devices):
+    # dropout active: RNG folding must match the monolithic path exactly
+    _, ref = _train(_config(4), dropout=0.1)
+    _, got = _train(_config(4, offload_param={"device": "cpu"}), dropout=0.1)
+    np.testing.assert_allclose(ref, got, rtol=3e-4)
+
+
+def test_stream_nvme_param_tier_matches_cpu(tmp_path, devices):
+    cpu_cfg = _config(4, offload_param={"device": "cpu"})
+    _, ref = _train(cpu_cfg)
+    nvme_cfg = _config(4, offload_param={"device": "nvme",
+                                         "nvme_path": str(tmp_path)})
+    eng, got = _train(nvme_cfg)
+    assert eng._param_stream.nvme
+    assert eng._offload._out16 is None     # no RAM image in the NVMe tier
+    np.testing.assert_allclose(ref, got, rtol=1e-4)
+
+
+def test_stream_checkpoint_cross_compatible(tmp_path, devices):
+    # streamed save -> non-streamed load continues identically (and the
+    # reverse), proving the layer-major layout never leaks into ckpts
+    eng_s, _ = _train(_config(4, offload_param={"device": "cpu"}), steps=2)
+    eng_s.save_checkpoint(str(tmp_path), tag="t")
+
+    eng_a, _, _, _ = ds.initialize(config=_config(4), model=_model(),
+                                   training_data=(_tokens(),), mesh=_mesh1())
+    eng_a.load_checkpoint(str(tmp_path), tag="t")
+    eng_b, _, _, _ = ds.initialize(
+        config=_config(4, offload_param={"device": "cpu"}), model=_model(),
+        training_data=(_tokens(),), mesh=_mesh1())
+    eng_b.load_checkpoint(str(tmp_path), tag="t")
+
+    # master state restored identically (before any further training)
+    np.testing.assert_allclose(
+        np.asarray(eng_b._offload.master[:64]),
+        np.asarray(eng_s._offload.master[:64]), rtol=1e-6)
+    la = [float(eng_a.train_batch()) for _ in range(2)]
+    lb = [float(eng_b.train_batch()) for _ in range(2)]
+    np.testing.assert_allclose(la, lb, rtol=3e-4)
+
+
+def test_stream_eval_and_state_dict(devices):
+    eng, _ = _train(_config(4, offload_param={"device": "cpu"}), steps=1)
+    loss = float(eng.eval_batch(_tokens(4, 24, seed=3)))
+    assert np.isfinite(loss)
+    sd = eng.module_state_dict()
+    assert "blocks" in sd and sd["blocks"]["qkv_w"].shape[0] == 3
+
+
+def test_stream_config_validation(devices):
+    bad = _config(4, offload_param={"device": "cpu"})
+    del bad["zero_optimization"]["offload_optimizer"]
+    with pytest.raises(ValueError, match="offload_optimizer"):
+        ds.initialize(config=bad, model=_model(), mesh=_mesh1())
+
+    bad = _config(4, offload_param={"device": "cpu"})
+    bad["zero_optimization"]["stage"] = 2
+    with pytest.raises(ValueError, match="stage 3"):
+        ds.initialize(config=bad, model=_model(), mesh=_mesh1())
+
+    bad = _config(4, offload_param={"device": "cpu"})
+    bad["bf16"] = {"enabled": False}
+    bad["fp16"] = {"enabled": True}
+    with pytest.raises(ValueError, match="fp16"):
+        ds.initialize(config=bad, model=_model(), mesh=_mesh1())
+
+    class NoStream:
+        def init(self, rng):
+            return {"w": jnp.zeros((4,))}
+
+        def loss(self, params, batch, rng):
+            return jnp.sum(params["w"])
+
+    with pytest.raises(ValueError, match="stream_fns"):
+        ds.initialize(config=_config(4, offload_param={"device": "cpu"}),
+                      model=NoStream(), mesh=_mesh1())
