@@ -200,9 +200,24 @@ class InferenceEngine:
         assert max_len >= total, "max_len must cover prompt + new tokens"
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+        # int8: dequantize ONCE per jitted call, outside the token scan —
+        # QuantizedModel.apply_with_cache would otherwise re-materialize
+        # the full bf16 weight tree every decoded token (measured 1.6x
+        # SLOWER than bf16 decode; hoisted, int8 matches bf16 speed and
+        # halves resident weight memory)
+        from ..module_inject.module_quantize import (QuantizedModel,
+                                                     dequantize_tree)
+        if isinstance(self.module, QuantizedModel):
+            inner = self.module._model
+            deq = lambda p: dequantize_tree(p, self.module._dtype)
+        else:
+            inner = self.module
+            deq = lambda p: p
+
         if self._jit_prefill is None:
             def prefill(params, toks, cache):
-                logits, cache = self.module.apply_with_cache(params, toks, cache)
+                logits, cache = inner.apply_with_cache(deq(params), toks,
+                                                       cache)
                 return logits[:, -1], cache
             self._jit_prefill = jax.jit(prefill)
 
@@ -212,12 +227,13 @@ class InferenceEngine:
         loop = self._decode_loops.get(key)
         if loop is None:
             def decode_loop(params, last_logits, cache, r, temp):
+                params = deq(params)      # once, OUTSIDE the token scan
                 first = _select_token(last_logits, temp, do_sample,
                                       top_k, jax.random.fold_in(r, 0))
 
                 def body(carry, i):
                     tok, cache = carry
-                    logits, cache = self.module.apply_with_cache(
+                    logits, cache = inner.apply_with_cache(
                         params, tok[:, None], cache)
                     nxt = _select_token(logits[:, -1], temp, do_sample,
                                         top_k, jax.random.fold_in(r, i))

@@ -414,32 +414,25 @@ class GPT2:
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
-    def _cached_attention(self, p, h, cache_k, cache_v, index, is_local=None):
-        """Shared cached-attention core (qkv, cache update, masked softmax,
-        output proj) — used by this model AND GPT2MoE's decode path so the
-        scale_attn / local-window semantics cannot drift between them.
-
-        ``h``: normalized block input (B, T, D).  Returns
-        (attn_out (B, T, D), new_cache_k, new_cache_v)."""
+    def _qkv(self, p, h):
         c = self.config
         B, T, D = h.shape
         H, hd = c.n_head, c.head_dim
-        S = cache_k.shape[1]
-
         qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, H, hd)
-        k = k.reshape(B, T, H, hd)
-        v = v.reshape(B, T, H, hd)
+        return (q.reshape(B, T, H, hd), k.reshape(B, T, H, hd),
+                v.reshape(B, T, H, hd))
 
-        cache_k = jax.lax.dynamic_update_slice(
-            cache_k, k.astype(cache_k.dtype), (0, index, 0, 0))
-        cache_v = jax.lax.dynamic_update_slice(
-            cache_v, v.astype(cache_v.dtype), (0, index, 0, 0))
-
+    def _attend_cached(self, q, cache_k, cache_v, index, is_local=None):
+        """Masked softmax attention of ``q`` over a cache view — the
+        shared scoring core for both cache layouts, so scale_attn /
+        local-window semantics cannot drift between decode paths."""
+        c = self.config
+        B, T = q.shape[0], q.shape[1]
+        S = cache_k.shape[1]
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k).astype(jnp.float32)
         if c.scale_attn:
-            scores = scores / np.sqrt(hd)
+            scores = scores / np.sqrt(c.head_dim)
         q_pos = index + jnp.arange(T)[:, None]          # (T, 1)
         k_pos = jnp.arange(S)[None, :]                  # (1, S)
         valid = k_pos <= q_pos                          # causal within cache
@@ -449,9 +442,53 @@ class GPT2:
             valid = jnp.where(is_local, local, valid)
         scores = jnp.where(valid[None, None], scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v).reshape(B, T, D)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v).reshape(
+            B, T, q.shape[2] * q.shape[3])
+
+    def _cached_attention(self, p, h, cache_k, cache_v, index, is_local=None):
+        """Per-layer-cache variant (scan decode path; also GPT2MoE).
+
+        ``h``: normalized block input (B, T, D).  Returns
+        (attn_out (B, T, D), new_cache_k, new_cache_v)."""
+        q, k, v = self._qkv(p, h)
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, k.astype(cache_k.dtype), (0, index, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, v.astype(cache_v.dtype), (0, index, 0, 0))
+        attn = self._attend_cached(q, cache_k, cache_v, index, is_local)
         attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
         return attn, cache_k, cache_v
+
+    def _block_with_cache_stacked(self, x, layer_params, ck_all, cv_all,
+                                  layer, index, is_local=None):
+        """One decode block updating the FULL stacked (L, B, S, H, hd)
+        cache IN PLACE via dynamic_update_slice at (layer, 0, index, 0, 0).
+
+        The unrolled decode loop threads the whole cache through every
+        layer so XLA aliases one buffer end-to-end (donated at the jit
+        boundary).  The per-layer variant below instead gathers
+        ``cache[i]`` copies and re-stacks them after the loop — a full
+        cache copy per decoded token, which is what broke batched decode
+        throughput (B-proportional copy traffic on top of the
+        B-independent weight streaming)."""
+        c = self.config
+        p = layer_params
+        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
+        q, k, v = self._qkv(p, h)
+        ck_all = jax.lax.dynamic_update_slice(
+            ck_all, k[None].astype(ck_all.dtype), (layer, 0, index, 0, 0))
+        cv_all = jax.lax.dynamic_update_slice(
+            cv_all, v[None].astype(cv_all.dtype), (layer, 0, index, 0, 0))
+        attn = self._attend_cached(q, ck_all[layer], cv_all[layer], index,
+                                   is_local)
+        attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+        x = x + attn
+
+        h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
+        h = h @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        h = h @ p["fc_proj_w"].astype(h.dtype) + p["fc_proj_b"].astype(h.dtype)
+        return x + h, ck_all, cv_all
 
     def _block_with_cache(self, x, layer_params, cache_k, cache_v, index,
                           is_local=None):
@@ -491,18 +528,16 @@ class GPT2:
         local_flags = jnp.arange(c.n_layer) % 2 == 1
 
         if c.unroll_layers:
-            # static layer indices: no per-layer dynamic-slice of the stacked
-            # weights/cache — the same single-chip win as the training path
-            ks, vs = [], []
+            # static layer indices AND an in-place threaded cache: the
+            # stacked (L,B,S,H,hd) arrays flow through every layer's
+            # dynamic_update_slice, so a donated cache updates in place —
+            # no per-token full-cache re-stack (see
+            # _block_with_cache_stacked)
+            new_k, new_v = cache["k"], cache["v"]
             for i in range(c.n_layer):
                 lp = layer_slice(params["blocks"], i)
-                x, ck, cv = self._block_with_cache(
-                    x, lp, cache["k"][i], cache["v"][i], index,
-                    local_flags[i])
-                ks.append(ck)
-                vs.append(cv)
-            new_k = jnp.stack(ks)
-            new_v = jnp.stack(vs)
+                x, new_k, new_v = self._block_with_cache_stacked(
+                    x, lp, new_k, new_v, i, index, local_flags[i])
         else:
             def scan_body(carry, xs):
                 h = carry
@@ -516,8 +551,12 @@ class GPT2:
                                local_flags))
 
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], c.layer_norm_eps)
-        logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
-                            params["wte"].astype(jnp.float32))
+        # bf16 operands + fp32 accumulation: a pure-fp32 head matmul runs
+        # at a fraction of MXU rate and is the only B-proportional flop
+        # term in decode — it was the b=8 throughput ceiling
+        logits = jnp.einsum("btd,vd->btv", x,
+                            params["wte"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
         new_cache = {"k": new_k, "v": new_v, "index": index + T}
         return logits, new_cache
 

@@ -248,7 +248,10 @@ class GPT2MoE:
                 "index": jnp.zeros((), jnp.int32)}
 
     # cached-attention core shared with the dense model (scale_attn /
-    # local-window semantics live in ONE place)
+    # local-window semantics live in ONE place) — including the helpers
+    # _cached_attention delegates to
+    _qkv = GPT2._qkv
+    _attend_cached = GPT2._attend_cached
     _cached_attention = GPT2._cached_attention
 
     def apply_with_cache(self, params, tokens, cache):

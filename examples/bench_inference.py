@@ -50,22 +50,35 @@ def main():
     np.asarray(eng.generate(ids, max_new_tokens=1, max_len=args.prompt + args.new))
     np.asarray(eng.generate(ids, max_new_tokens=args.new))
 
-    def timed(new_tokens, trials=3):
-        """min over trials: remote-attached dispatch jitter (~100ms) would
-        otherwise swamp the prefill/decode difference."""
-        best = float("inf")
-        for _ in range(trials):
-            t0 = time.time()
-            out = eng.generate(ids, max_new_tokens=new_tokens,
-                               max_len=args.prompt + args.new)
-            np.asarray(out)                          # value read = sync
-            best = min(best, time.time() - t0)
-        return best
-
-    t_prefill = timed(1)
-    dt = timed(args.new)
+    # alternate prefill-only and full-decode trials inside one window: the
+    # shared dev chip's speed drifts minute-to-minute and dispatch jitter
+    # is ~100ms, so the two timed shapes sample the same window and the
+    # min of each is compared
+    t_prefill, dt = float("inf"), float("inf")
+    for _ in range(5):
+        t0 = time.time()
+        np.asarray(eng.generate(ids, max_new_tokens=1,
+                                max_len=args.prompt + args.new))
+        t_prefill = min(t_prefill, time.time() - t0)
+        t0 = time.time()
+        np.asarray(eng.generate(ids, max_new_tokens=args.new,
+                                max_len=args.prompt + args.new))
+        dt = min(dt, time.time() - t0)
     decode_s = max(dt - t_prefill, 1e-9)             # steady-state portion
     toks = args.batch * (args.new - 1)
+    # weight-streaming roofline for the artifact: weight bytes + KV bytes
+    # actually read per decode step, over v5e HBM.  int8 still streams
+    # bf16 weights per token (dequant is hoisted out of the token scan but
+    # the scan reads the dequantized tree) — int8 halves RESIDENT weight
+    # memory; true int8-gemm traffic would need activation quantization.
+    HBM_GBS = 819.0
+    n_params = model.num_params()
+    w_bytes = n_params * 2
+    c = model.config
+    mid_S = args.prompt + args.new // 2
+    kv_bytes = 2 * c.n_layer * args.batch * mid_S * c.n_embd * 2
+    bound_ms = (w_bytes + kv_bytes) / HBM_GBS / 1e6
+    bound_tps = args.batch / bound_ms * 1000
     print(json.dumps({
         "preset": args.preset, "int8": bool(args.int8),
         "batch": args.batch, "prompt_len": args.prompt,
@@ -73,6 +86,13 @@ def main():
         "prefill_ms": round(t_prefill * 1e3, 2),
         "decode_tokens_per_sec": round(toks / decode_s, 1),
         "ms_per_token_per_seq": round(decode_s / max(args.new - 1, 1) * 1e3, 2),
+        "roofline": {
+            "hbm_gb_s": HBM_GBS,
+            "weight_bytes_mb": round(w_bytes / 1e6, 1),
+            "kv_bytes_per_step_mb": round(kv_bytes / 1e6, 1),
+            "bound_tokens_per_sec": round(bound_tps),
+            "fraction_of_bound": round(toks / decode_s / bound_tps, 3),
+        },
     }))
 
 
