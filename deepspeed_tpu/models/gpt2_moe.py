@@ -289,14 +289,26 @@ class GPT2MoE:
                         "index": index + tokens.shape[1]}
 
     # ------------------------------------------------------------------ loss
-    def loss(self, params, batch, rng):
+    def loss_with_metrics(self, params, batch, rng):
+        """(total_loss, {"moe_aux_loss", "moe_tokens_dropped"}).
+
+        The engine detects this method and carries the aux dict into its
+        per-step ``metrics`` (reference: the engine surfaces MoE state —
+        expert grads, gate timing — ``runtime/engine.py:1639``; a user
+        training MoE through DeepSpeedEngine sees aux loss and token
+        overflow without bypassing the engine)."""
         from .gpt2 import GPT2
         tokens, labels = GPT2._split_batch(batch)
-        logits, aux, _ = self._apply_with_aux(params, tokens, rng,
-                                              deterministic=False)
+        logits, aux, ovf = self._apply_with_aux(params, tokens, rng,
+                                                deterministic=False)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll) + self.config.aux_loss_coef * aux
+        total = -jnp.mean(ll) + self.config.aux_loss_coef * aux
+        return total, {"moe_aux_loss": aux,
+                       "moe_tokens_dropped": ovf.astype(jnp.float32)}
+
+    def loss(self, params, batch, rng):
+        return self.loss_with_metrics(params, batch, rng)[0]
 
     def num_params(self):
         shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))

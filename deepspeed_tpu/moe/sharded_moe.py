@@ -13,11 +13,12 @@ TPU re-design notes (NOT a port):
   ``capacity = max(ceil(tokens/experts × capacity_factor), min_capacity)``
   (reference ``_capacity``, :149-160).  The reference's ``drop_tokens=False``
   mode discovers the needed capacity at runtime with an allreduce-MAX
-  (:213-217); here no-drop defaults to ``nodrop_capacity`` —
-  ``NO_DROP_CAPACITY_MULT``× the balanced load — so extreme routing skew CAN
-  drop tokens, detectably via ``tokens_overflowed(exp_counts, capacity)``
-  (``MoE.apply(..., return_overflow=True)`` surfaces the count).  Pass
-  ``max_capacity=num_tokens`` for the guaranteed-no-drop worst case.
+  (:213-217); here no-drop defaults to the GUARANTEED worst case
+  (capacity = token count) so nothing is ever dropped, honoring the
+  reference contract at the cost of an S×E×S dispatch.  Pass
+  ``max_capacity=<bound>`` to opt into bounded memory instead — overflow
+  is then detectable via ``tokens_overflowed(exp_counts, capacity)``
+  (``MoE.apply(..., return_overflow=True)`` surfaces the count).
 - **Dispatch/combine are einsums** on a one-hot routing tensor, and expert
   parallelism is a *sharding* of the expert dimension over the ``expert`` mesh
   axis — the SPMD partitioner inserts the all-to-alls the reference wrote by
@@ -58,23 +59,20 @@ def _keep_topc_per_expert(priority, mask, capacity: int):
     return mask * keep
 
 
-# drop_tokens=False default capacity: this multiple of the balanced load
-# (tokens/experts).  The reference sizes no-drop capacity with a runtime
-# max-allreduce over actual expert load (sharded_moe.py:213-217); XLA's
-# static shapes forbid that, so we cap at 4x the balanced load — enough for
-# heavy imbalance — and make any overflow *detectable* via
-# ``tokens_overflowed`` instead of silently allocating an S×E×S dispatch.
-NO_DROP_CAPACITY_MULT = 4
-
-
 def nodrop_capacity(num_tokens: int, num_experts: int,
                     max_capacity: Optional[int], min_capacity: int) -> int:
-    """Static capacity for ``drop_tokens=False`` gating."""
+    """Static capacity for ``drop_tokens=False`` gating.
+
+    DEFAULT = ``num_tokens``: the guaranteed worst case, honoring the
+    reference's no-drop contract (``sharded_moe.py:213-217`` sizes it at
+    runtime with an allreduce-MAX over actual load — impossible under
+    XLA's static shapes, so the static worst case is the only
+    drop-free choice).  The cost is an S×E×S dispatch mask; a model
+    that wants bounded memory instead opts IN to a cap with
+    ``max_capacity`` and monitors ``tokens_overflowed``."""
     if max_capacity is not None:
-        return min(num_tokens, int(max_capacity))
-    cap = max(int(min_capacity),
-              -(-num_tokens * NO_DROP_CAPACITY_MULT // num_experts))
-    return min(num_tokens, cap)
+        return max(int(min_capacity), min(num_tokens, int(max_capacity)))
+    return max(int(min_capacity), num_tokens)
 
 
 def tokens_overflowed(exp_counts, capacity: int):
@@ -95,14 +93,13 @@ def top1gating(logits, capacity_factor: float, min_capacity: int,
     logits: (S, E) fp32.  Returns ``(l_aux, combine_weights (S,E,C),
     dispatch_mask (S,E,C) bool, exp_counts (E,))``.
 
-    ``drop_tokens=False``: capacity defaults to ``nodrop_capacity`` —
-    ``NO_DROP_CAPACITY_MULT``× the balanced load (or the explicit
-    ``max_capacity`` bound).  Demand beyond the cap IS dropped
-    (lowest-priority first); detect it with
-    ``tokens_overflowed(exp_counts, capacity)`` — ``exp_counts`` is the
-    pre-thinning demand, so the overflow count is exact.  Pass
-    ``max_capacity=num_tokens`` for the guaranteed-no-drop S×E×S worst
-    case the reference gets from its runtime max-allreduce (:213-217).
+    ``drop_tokens=False``: capacity defaults to the GUARANTEED no-drop
+    worst case (= token count, the static equivalent of the reference's
+    runtime max-allreduce, :213-217).  An explicit ``max_capacity``
+    opts into a bounded S×E×C dispatch; demand beyond that cap IS
+    dropped (lowest-priority first) — detect it with
+    ``tokens_overflowed(exp_counts, capacity)``, where ``exp_counts``
+    is the pre-thinning demand, so the overflow count is exact.
     """
     (l_aux, indices1_s, locations1_s, gates1_s, kept,
      exp_counts, capacity) = top1_routes(
@@ -284,16 +281,14 @@ class TopKGate:
                 f"(got k={k})")
         self.max_capacity = max_capacity
         if not drop_tokens and k == 1 and max_capacity is None:
-            # loud note: no-drop is CAPPED by default (the reference sizes it
-            # at runtime via allreduce-MAX, impossible under static shapes)
             from ..utils.logging import logger
             logger.warning(
-                "drop_tokens=False defaults to a capacity of "
-                f"{NO_DROP_CAPACITY_MULT}x the balanced load; routing skew "
-                "past that bound drops tokens. Monitor it via "
-                "MoE.apply(..., return_overflow=True) / tokens_overflowed(), "
-                "or pass max_capacity=<token count> for the guaranteed "
-                "no-drop worst case.")
+                "drop_tokens=False defaults to the guaranteed no-drop "
+                "capacity (= token count): nothing is ever dropped, at the "
+                "cost of an S x E x S dispatch. Pass max_capacity=<bound> "
+                "to cap the memory instead, monitoring drops via "
+                "MoE.apply(..., return_overflow=True) / tokens_overflowed() "
+                "or the engine's moe_tokens_dropped metric.")
 
     def init(self, rng):
         scale = 1.0 / math.sqrt(self.model_dim)

