@@ -20,6 +20,7 @@ the transfer terms to 16 GB/s — compute and host-Adam terms stay measured.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -202,7 +203,7 @@ def measure_offload(preset, seq, micro, *, gas=1, steps=1, warmup=1,
         "projected_mfu_pcie16": round(proj_mfu, 4) if proj_mfu else None,
         "projected_mfu_pcie16_8core_host": (round(proj_mfu8, 4)
                                             if proj_mfu8 else None),
-        "host_cores": 1,
+        "host_cores": os.cpu_count(),
     }
     del engine, model
     return out

@@ -71,8 +71,11 @@ def nodrop_capacity(num_tokens: int, num_experts: int,
     that wants bounded memory instead opts IN to a cap with
     ``max_capacity`` and monitors ``tokens_overflowed``."""
     if max_capacity is not None:
-        return max(int(min_capacity), min(num_tokens, int(max_capacity)))
-    return max(int(min_capacity), num_tokens)
+        # the user's explicit memory bound WINS (min_capacity must not
+        # silently exceed it); clamp to num_tokens — capacity beyond the
+        # token count buys nothing
+        return min(num_tokens, max(1, int(max_capacity)))
+    return num_tokens
 
 
 def tokens_overflowed(exp_counts, capacity: int):

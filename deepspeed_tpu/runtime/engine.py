@@ -799,8 +799,12 @@ class DeepSpeedEngine:
         if not overflow and "sparse_rows_dropped" in metrics:
             self._pending_row_drop_checks.append(
                 metrics["sparse_rows_dropped"])
+            # flush on reporting steps OR every 50 steps — steps_per_print
+            # is often set huge to silence logs, which must not disable
+            # the guard (or grow the pending list without bound)
             if (self._global_steps_host + 1) % \
-                    self.config.steps_per_print == 0:
+                    self.config.steps_per_print == 0 or \
+                    len(self._pending_row_drop_checks) >= 50:
                 n_dropped = sum(int(x) for x in
                                 self._pending_row_drop_checks)
                 self._pending_row_drop_checks = []

@@ -327,3 +327,56 @@ def test_flash_attention_with_padding_bias():
     ref = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=3e-5, rtol=3e-5)
+
+
+def test_block_q_merge_exact():
+    """block_q_merge=2 (two layout rows share one kernel row with
+    per-half-row gating) must be bit-exact vs the unmerged LUT path —
+    forward AND gradients."""
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        sparse_flash_attention)
+    cfg = BSLongformerSparsityConfig(num_heads=2, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    T = 128
+    layout = jnp.asarray(cfg.make_layout(T), jnp.int32)
+    q, k, v = make_qkv(B=1, T=T, H=2, d=16, seed=3)
+
+    ref = sparse_flash_attention(q, k, v, layout, causal=True)
+    got = sparse_flash_attention(q, k, v, layout, causal=True,
+                                 block_q_merge=2)
+    np.testing.assert_array_equal(np.asarray(ref, np.float32),
+                                  np.asarray(got, np.float32))
+
+    def loss(fn):
+        return jax.grad(lambda a: jnp.sum(
+            fn(a, k, v).astype(jnp.float32) ** 2))
+    g_ref = loss(lambda a, b, c: sparse_flash_attention(
+        a, b, c, layout, causal=True))(q)
+    g_got = loss(lambda a, b, c: sparse_flash_attention(
+        a, b, c, layout, causal=True, block_q_merge=2))(q)
+    np.testing.assert_array_equal(np.asarray(g_ref, np.float32),
+                                  np.asarray(g_got, np.float32))
+
+
+def test_block_q_merge_empty_row_outputs_zero():
+    """A layout q-row with ZERO live blocks merged with a live sibling must
+    output exact zeros (the unmerged path's compute-gated behavior), not
+    the mean of the sibling's visited V rows."""
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        sparse_flash_attention)
+    T, blk = 64, 16
+    n = T // blk
+    layout = np.zeros((1, n, n), np.int32)
+    # row 0: EMPTY; rows 1..: diagonal only
+    for i in range(1, n):
+        layout[0, i, i] = 1
+    layout = jnp.asarray(layout)
+    q, k, v = make_qkv(B=1, T=T, H=2, d=16, seed=5)
+    ref = sparse_flash_attention(q, k, v, layout, causal=True)
+    got = sparse_flash_attention(q, k, v, layout, causal=True,
+                                 block_q_merge=2)
+    np.testing.assert_array_equal(np.asarray(ref, np.float32),
+                                  np.asarray(got, np.float32))
+    # row 0's tokens (first blk rows) must be exactly zero
+    assert float(jnp.max(jnp.abs(got[:, :blk].astype(jnp.float32)))) == 0.0
