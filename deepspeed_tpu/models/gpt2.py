@@ -285,6 +285,39 @@ class GPT2:
         }
         return params
 
+    def init_numpy(self, seed=0):
+        """Host-RAM numpy twin of :meth:`init` (same structure, shapes and
+        init distribution; different RNG stream).  Used by the streamed
+        param-offload tier's ``fast_init``: at multi-billion params the
+        jitted XLA-CPU init costs minutes and ~3x the tree in transient
+        RAM, while numpy fills the buffers in place."""
+        c = self.config
+        D, L, V, T = c.n_embd, c.n_layer, c.vocab_size, c.max_seq
+        rng = np.random.default_rng(seed)
+        std = 0.02
+        proj_std = std / np.sqrt(2.0 * L)
+        n = lambda shape, s=std: rng.normal(0.0, s, shape).astype(np.float32)
+        return {
+            "wte": n((V, D)),
+            "wpe": n((T, D), 0.01),
+            "blocks": {
+                "ln1_scale": np.ones((L, D), np.float32),
+                "ln1_bias": np.zeros((L, D), np.float32),
+                "qkv_w": n((L, D, 3 * D)),
+                "qkv_b": np.zeros((L, 3 * D), np.float32),
+                "proj_w": n((L, D, D), proj_std),
+                "proj_b": np.zeros((L, D), np.float32),
+                "ln2_scale": np.ones((L, D), np.float32),
+                "ln2_bias": np.zeros((L, D), np.float32),
+                "fc_w": n((L, D, 4 * D)),
+                "fc_b": np.zeros((L, 4 * D), np.float32),
+                "fc_proj_w": n((L, 4 * D, D), proj_std),
+                "fc_proj_b": np.zeros((L, D), np.float32),
+            },
+            "lnf_scale": np.ones((D,), np.float32),
+            "lnf_bias": np.zeros((D,), np.float32),
+        }
+
     # ------------------------------------------------- tensor-parallel specs
     def partition_specs(self, params=None):
         """Megatron-style TP sharding (reference delegates this to mpu;

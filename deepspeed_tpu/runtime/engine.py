@@ -195,6 +195,15 @@ class DeepSpeedEngine:
                     "machinery; on a multi-chip mesh use ZeRO-3 sharding "
                     "(params shard over the fsdp axis) without offload_param")
         self._param_stream = None
+        if param_stream_wanted and params is None and \
+                self.config.zero_config.offload_param.fast_init:
+            # host numpy init: the jitted XLA-CPU init costs minutes and
+            # ~3x the tree in transient RAM at multi-billion params
+            if not callable(getattr(model, "init_numpy", None)):
+                raise ValueError(
+                    "offload_param.fast_init requires the model to expose "
+                    "init_numpy(seed) (a host-RAM init twin)")
+            params = model.init_numpy(rng_seed)
         self._loss_fn, params0, self._apply_fn, self._tp_specs = _resolve_model(
             model, loss_fn, params, apply_fn, rng_seed,
             init_on_host=offload_wanted)
@@ -280,6 +289,8 @@ class DeepSpeedEngine:
                     payload_in_ram=(self.config.zero_config
                                     .offload_param_device() == "cpu"))
                 del stream_tree
+                # init tree freed — NOW allocate grad buffer + RAM image
+                self._offload.alloc_buffers()
                 self._param_stream = ps.ParamStreamRunner(
                     model, self._offload, self.mesh, self.compute_dtype,
                     gas=self.config.gradient_accumulation_steps,

@@ -51,11 +51,18 @@ class DeepSpeedZeroOffloadParamConfig:
         self.buffer_size = int(get_scalar_param(param_dict, "buffer_size", 1e8))
         self.max_in_cpu = int(get_scalar_param(param_dict, "max_in_cpu", 1e9))
         self.pin_memory = get_scalar_param(param_dict, "pin_memory", False)
+        # host-side numpy init for the streamed tier (reference:
+        # offload fast_init): skips the jitted XLA-CPU init, which at
+        # multi-billion params costs minutes and ~3x the tree in RAM.
+        # Values come from the model's numpy init twin, so runs are NOT
+        # bit-identical to the jitted init — off by default.
+        self.fast_init = get_scalar_param(param_dict, "fast_init", False)
 
     def repr_dict(self):
         return dict(device=self.device, nvme_path=self.nvme_path,
                     buffer_count=self.buffer_count, buffer_size=self.buffer_size,
-                    max_in_cpu=self.max_in_cpu, pin_memory=self.pin_memory)
+                    max_in_cpu=self.max_in_cpu, pin_memory=self.pin_memory,
+                    fast_init=self.fast_init)
 
 
 class DeepSpeedZeroOffloadOptimizerConfig:

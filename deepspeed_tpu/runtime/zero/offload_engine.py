@@ -99,10 +99,25 @@ class HostOffloadOptimizer:
             self.v.fill(0.0)
         # reusable fp32 gradient landing buffer (the flat wire upcasts into
         # it in place — no per-step multi-GB allocation/fault)
-        self._flat32 = np.empty(self.numel, np.float32)
-        self._flat32.fill(0.0)
+        self._flat32 = None
         self._out16 = None
-        if self.out_dtype is not None and payload_in_ram:
+        self._payload_in_ram = payload_in_ram
+        if not consume_params:
+            self.alloc_buffers()
+        # consume_params callers (the streamed tier) free the init tree
+        # FIRST and then call alloc_buffers() — at multi-billion params the
+        # init tree, master, grad buffer and image cannot coexist in RAM
+
+    def alloc_buffers(self):
+        """Allocate + pre-fault the flat gradient buffer and (if configured)
+        the 16-bit RAM image.  Separated from __init__ so the streamed tier
+        can free the init tree between the master build and these
+        allocations (peak-RAM control)."""
+        if self._flat32 is None:
+            self._flat32 = np.empty(self.numel, np.float32)
+            self._flat32.fill(0.0)
+        if self.out_dtype is not None and self._payload_in_ram \
+                and self._out16 is None:
             self._out16 = np.empty(self.numel, np.uint16)
             self._out16.fill(0)
             self.refresh_payload()

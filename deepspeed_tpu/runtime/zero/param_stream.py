@@ -333,6 +333,7 @@ class ParamStreamRunner:
                 # prefetch next layer's params while this block computes
                 p_next = (self.fetch_layer(l + 1) if l + 1 < self.L
                           else None)
+                self._throttle(l, x)
             del p, p_next
 
             # ---------- head: loss + gradients ----------
@@ -403,6 +404,20 @@ class ParamStreamRunner:
                 "overflow": jnp.asarray(False), "lr": jnp.asarray(lr),
                 "loss_scale": jnp.asarray(1.0)}
 
+    THROTTLE_EVERY = 4    # forward-loop sync cadence (layers)
+
+    def _throttle(self, l, x):
+        """Backpressure for the forward stream: without it the Python loop
+        dispatches EVERY layer's upload before any compute finishes, and
+        the runtime buffers up to the whole model's bytes in host RAM
+        (observed: the 2.7B probe OOM'd a 125 GB host).  A tiny VALUE READ
+        of the current activation every few layers bounds the in-flight
+        window to ~THROTTLE_EVERY layers (``jax.block_until_ready`` does
+        not actually wait on this remote-attached runtime — only a value
+        read synchronizes)."""
+        if (l + 1) % self.THROTTLE_EVERY == 0:
+            np.asarray(jax.device_get(x[0, 0, 0]))
+
     @staticmethod
     def _land_add(handle, lo, hi, flat):
         """Land a started chunked d2h and ACCUMULATE (+=) into the flat
@@ -438,6 +453,7 @@ class ParamStreamRunner:
             x = J["block_fwd"](p, x, rngs[l],
                                jnp.asarray(self.local_flags[l]))
             p_next = self.fetch_layer(l + 1) if l + 1 < self.L else None
+            self._throttle(l, x)
         return J["head_eval"](self._nonblock_dev, x, labels)
 
     # --------------------------------------------------------- checkpoints

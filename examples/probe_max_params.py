@@ -69,7 +69,7 @@ def try_size(n_embd, n_layer, n_head, seq=SEQ, micro=1):
             "offload_optimizer": {"device": "nvme", "nvme_path": nvme,
                                   "pipeline_read": True,
                                   "pipeline_write": True},
-            "offload_param": {"device": "cpu"}},
+            "offload_param": {"device": "cpu", "fast_init": True}},
     }
     toks = np.random.default_rng(0).integers(
         0, model.config.vocab_size, (2 * micro, seq + 1)).astype(np.int32)

@@ -148,3 +148,13 @@ def test_stream_config_validation(devices):
     with pytest.raises(ValueError, match="stream_fns"):
         ds.initialize(config=_config(4, offload_param={"device": "cpu"}),
                       model=NoStream(), mesh=_mesh1())
+
+
+def test_stream_fast_init_trains(devices):
+    """offload_param.fast_init uses the model's numpy init twin (no jitted
+    XLA-CPU init); training must run and converge from it."""
+    cfg = _config(4, offload_param={"device": "cpu", "fast_init": True})
+    eng, losses = _train(cfg, steps=4)
+    assert eng._param_stream is not None
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
