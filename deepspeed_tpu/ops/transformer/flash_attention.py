@@ -782,15 +782,12 @@ def _merged_luts_cached(layout_bytes, shape, causal, block_q, block_k):
     kmap, klen, _, _ = _sparse_luts(
         np.ascontiguousarray(merged).tobytes(), merged.shape, causal,
         2 * block_q, block_k)
-    # per-half-row liveness at the visited block: sub0 = upper (even) row
-    sub0 = np.zeros_like(kmap)
-    sub1 = np.zeros_like(kmap)
-    for h in range(H):
-        for i in range(merged.shape[1]):
-            for j in range(kmap.shape[2]):
-                b = kmap[h, i, j]
-                sub0[h, i, j] = layout[h, 2 * i, b]
-                sub1[h, i, j] = layout[h, 2 * i + 1, b]
+    # per-half-row liveness at the visited block: sub0 = upper (even) row.
+    # Vectorized gather (kmap is (H, nq/2, slots) of k-block ids): a Python
+    # triple loop here costs millions of interpreter iterations at
+    # production shapes — a multi-second trace-time stall per layout.
+    sub0 = np.take_along_axis(layout[:, 0::2, :], kmap, axis=2)
+    sub1 = np.take_along_axis(layout[:, 1::2, :], kmap, axis=2)
     return kmap, klen, sub0, sub1
 
 

@@ -812,20 +812,14 @@ class DeepSpeedEngine:
                 metrics["sparse_rows_dropped"])
             # flush on reporting steps OR every 50 steps — steps_per_print
             # is often set huge to silence logs, which must not disable
-            # the guard (or grow the pending list without bound)
+            # the guard (or grow the pending list without bound).  Checkpoint
+            # save, eval and state-dict export flush unconditionally
+            # (_flush_row_drop_checks) so a short run or a mid-interval save
+            # can never skip the check.
             if (self._global_steps_host + 1) % \
                     self.config.steps_per_print == 0 or \
                     len(self._pending_row_drop_checks) >= 50:
-                n_dropped = sum(int(x) for x in
-                                self._pending_row_drop_checks)
-                self._pending_row_drop_checks = []
-                if n_dropped > 0:
-                    raise RuntimeError(
-                        f"sparse_grad_row_bound under-declared: {n_dropped} "
-                        "nonzero gradient row(s) exceeded the declared bound "
-                        "within the last reporting interval and were "
-                        "dropped; raise the bound (or remove "
-                        "sparse_grad_row_bound to use the safe default)")
+                self._flush_row_drop_checks()
         if not overflow:
             from .zero.offload_engine import FlatWireHandle
             t0 = time.time()
@@ -1032,12 +1026,34 @@ class DeepSpeedEngine:
         self._h2d.settle_on(jax.tree_util.tree_leaves(params)[0])
         return params
 
+    def _flush_row_drop_checks(self):
+        """Read the accumulated device drop counters (syncs) and raise if any
+        sparse-gradient row was silently dropped since the last flush."""
+        pending, self._pending_row_drop_checks = \
+            self._pending_row_drop_checks, []
+        n_dropped = sum(int(x) for x in pending)
+        if n_dropped > 0:
+            raise RuntimeError(
+                f"sparse_grad_row_bound under-declared: {n_dropped} "
+                "nonzero gradient row(s) exceeded the declared bound "
+                "within the last reporting interval and were "
+                "dropped; raise the bound (or remove "
+                "sparse_grad_row_bound to use the safe default)")
+
     def _flush_offload(self):
         """Apply a pending delayed-param update so exported / evaluated
-        parameters reflect every batch seen (DPU holds one step in flight)."""
+        parameters reflect every batch seen (DPU holds one step in flight).
+        Also the unconditional flush point for the sparse row-drop guard:
+        every state-export boundary (checkpoint save, eval, state_dict)
+        routes through here, so corrupted-gradient errors cannot be skipped
+        by run length or checkpoint timing."""
+        self._flush_row_drop_checks()
         if self._pending_offload is not None:
             pending, self._pending_offload = self._pending_offload, None
             self._host_offload_update(*pending)
+            # the just-applied in-flight step appended its own drop counter
+            # (DPU holds one step back) — check it too before any export
+            self._flush_row_drop_checks()
 
     def eval_batch(self, batch, rng=None):
         """Loss without gradient/update (jitted separately)."""
@@ -1330,8 +1346,10 @@ class DeepSpeedEngine:
                         load_optimizer_states=True, load_lr_scheduler_states=True):
         """Parity: reference ``engine.py:2467``. Returns (path, client_state)."""
         from ..checkpoint.serialization import load_tree
-        # a pending delayed update is superseded by the loaded state
+        # a pending delayed update is superseded by the loaded state —
+        # and so are its drop counters (they describe discarded steps)
         self._pending_offload = None
+        self._pending_row_drop_checks = []
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILE)
             assert os.path.isfile(latest), f"missing {latest}; pass tag="

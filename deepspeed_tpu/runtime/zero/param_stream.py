@@ -213,12 +213,15 @@ class ParamStreamRunner:
 
     def prefetch_layer_nvme(self, l):
         """Begin the NVMe read for layer l (overlaps the current layer's
-        compute; no-op on the cpu tier where fetch is a RAM view)."""
+        compute; no-op on the cpu tier where fetch is a RAM view).  Skips
+        (rather than fails) only on the one benign condition — no free pool
+        buffer, in which case the blocking fetch_layer picks the read up —
+        so genuine AIO errors surface HERE with their real context instead
+        of resurfacing later mislabeled."""
         if self.nvme and 0 <= l < self.L:
-            try:
-                self.swapper.swap_in([l], async_op=True)
-            except RuntimeError:      # buffer pool exhausted; fetch will block
-                pass
+            if self.swapper.available_swap_in_buffers() < 1:
+                return                # pool busy; fetch_layer will block
+            self.swapper.swap_in([l], async_op=True)
 
     def _upload_nonblock(self):
         nb_shapes = self._nb_shapes

@@ -132,8 +132,12 @@ class H2DUploader:
             # is_deleted (e.g. the chunk was donated downstream) does NOT
             # mean the h2d DMA finished reading the staging buffer —
             # donation marks deletion at dispatch.  Only an observed
-            # is_ready() proves the transfer landed; a deleted-but-never-
-            # observed-ready buffer is dropped from the pool, not recycled.
+            # is_ready() proves the transfer landed.  A deleted-but-never-
+            # observed-ready pair stays PARKED in the list (keeping the
+            # staging buffer referenced until a later settle_on re-keys it
+            # onto a provable completion point) — dropping it would release
+            # the last Python reference to host memory a DMA may still be
+            # reading, and permanently shrink the staging pool.
             deleted = arr.is_deleted()
             done = (not deleted) and arr.is_ready()
             if block and not done and not deleted:
@@ -142,7 +146,7 @@ class H2DUploader:
             if done:
                 if buf is not None:
                     self._staging.append(buf)
-            elif not deleted:
+            else:
                 still.append((arr, buf))
         self._inflight = still
 

@@ -10,19 +10,29 @@ moves from the chip's 16 GB HBM to host memory:
 
     RAM bytes/param = 4 (fp32 master) + 4 (fp32 grad accum)
                     + 2 (16-bit image) [+ 8 moments unless NVMe]
-    => ~6.9B params with CPU moments, ~8.5B with NVMe moments, on this
-       125 GB host.  The device holds ~2 layer blocks + activations.
+    => 18 B/param with CPU moments (~6.9B params on this 125 GB host) or
+       10 B/param with NVMe moments (~12.5B).  The device holds ~2
+       streamed layer blocks + activations.
 
-This probe trains TWO full optimizer steps (streamed fwd/bwd → host fused
-Adam with NVMe moments) at growing model sizes and records the largest
-that completes, writing MAXPARAMS.json with the component breakdown and
-the PCIe-16GB/s projection (the dev tunnel moves ~0.02-0.1 GB/s, so wire
-seconds here are NOT what real hardware would see).
+This probe trains TWO full optimizer steps at each rung of an ASCENDING
+ladder (1.3B → 2.0B → 2.7B → 6.7B → 8.3B) and records the largest that
+completes.  Rungs whose 18 B/param fit comfortably in RAM keep Adam
+moments on the host (fast); larger rungs put moments on NVMe (the
+ZeRO-Infinity tier) so RAM holds only 10 B/param.
+
+Failure capture (a probe is only evidence if its failures are visible):
+the parent polls the worker's VmHWM (peak RSS) via /proc while it runs,
+records the exit code (negative = killed by signal; -9 usually the OOM
+killer), keeps a long stderr tail, and greps the kernel ring buffer for
+oom-kill lines.  The worker itself emits one PROGRESS line per completed
+step so a mid-rung death still leaves per-step data.
 
 Run solo on the TPU:  python examples/probe_max_params.py [size ...]
 """
 import json
 import os
+import shutil
+import signal
 import subprocess
 import sys
 import time
@@ -33,6 +43,8 @@ import numpy as np  # noqa: E402
 
 # (name, n_embd, n_layer, n_head) — GPT-3-style ladder, ASCENDING.
 CANDIDATES = [
+    ("1.3b", 2048, 24, 16),
+    ("2.0b", 2560, 24, 32),
     ("2.7b", 2560, 32, 32),
     ("6.7b", 4096, 32, 32),
     ("8.3b", 4096, 40, 32),
@@ -40,6 +52,25 @@ CANDIDATES = [
 
 SEQ = 512
 PEAK_FLOPS = 197e12          # v5e bf16
+HOST_RAM_GB = 125
+# moments stay in host RAM while 18 B/param + slack fits; beyond that the
+# NVMe optimizer tier (10 B/param in RAM) carries the rung.
+CPU_MOMENT_RAM_CAP_GB = 90
+
+
+def _vm_hwm_gb(pid="self"):
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmHWM"):
+                    return round(int(line.split()[1]) / 1e6, 2)   # kB → GB
+    except OSError:
+        pass
+    return None
+
+
+def _approx_params(n_embd, n_layer, vocab=50257, max_seq=SEQ):
+    return 12 * n_layer * n_embd ** 2 + (vocab + max_seq) * n_embd
 
 
 def try_size(n_embd, n_layer, n_head, seq=SEQ, micro=1):
@@ -53,9 +84,16 @@ def try_size(n_embd, n_layer, n_head, seq=SEQ, micro=1):
                             resid_pdrop=0.0, remat=False,
                             attention_impl="flash"),
                  dtype=jnp.bfloat16)
+    n_approx = _approx_params(n_embd, n_layer)
+    moments = ("cpu" if n_approx * 18 / 1e9 < CPU_MOMENT_RAM_CAP_GB
+               else "nvme")
     nvme = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".nvme_probe")
     os.makedirs(nvme, exist_ok=True)
+    off_opt = {"device": moments}
+    if moments == "nvme":
+        off_opt.update(nvme_path=nvme, pipeline_read=True,
+                       pipeline_write=True)
     config = {
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": 1,
@@ -66,9 +104,7 @@ def try_size(n_embd, n_layer, n_head, seq=SEQ, micro=1):
         "zero_optimization": {
             "stage": 3,
             "sub_group_size": int(5e8),
-            "offload_optimizer": {"device": "nvme", "nvme_path": nvme,
-                                  "pipeline_read": True,
-                                  "pipeline_write": True},
+            "offload_optimizer": off_opt,
             "offload_param": {"device": "cpu", "fast_init": True}},
     }
     toks = np.random.default_rng(0).integers(
@@ -77,12 +113,19 @@ def try_size(n_embd, n_layer, n_head, seq=SEQ, micro=1):
     engine, _, _, _ = ds.initialize(config=config, model=model,
                                     training_data=(toks,))
     t_init = time.time() - t0
+    print("PROGRESS" + json.dumps(
+        {"event": "init_done", "init_s": round(t_init, 1),
+         "moments": moments, "rss_hwm_gb": _vm_hwm_gb()}), flush=True)
     losses, walls, comps = [], [], []
-    for _ in range(2):
+    for i in range(2):
         t0 = time.time()
         losses.append(float(engine.train_batch()))
         walls.append(time.time() - t0)
         comps.append(dict(engine._param_stream.last_times))
+        print("PROGRESS" + json.dumps(
+            {"event": "step_done", "step": i, "loss": round(losses[-1], 3),
+             "wall_s": round(walls[-1], 1), "rss_hwm_gb": _vm_hwm_gb(),
+             "components": comps[-1]}), flush=True)
     assert all(np.isfinite(l) for l in losses)
     n = model.num_params()
     wire_gb = {
@@ -98,6 +141,8 @@ def try_size(n_embd, n_layer, n_head, seq=SEQ, micro=1):
     proj_wall = max(dev_s, pcie_s) + adam_s   # streaming overlaps compute
     return {"params_b": round(n / 1e9, 2),
             "init_s": round(t_init, 1),
+            "moments_tier": moments,
+            "rss_hwm_gb": _vm_hwm_gb(),
             "losses": [round(l, 2) for l in losses],
             "step_wall_s": [round(w, 1) for w in walls],
             "components": comps,
@@ -105,6 +150,89 @@ def try_size(n_embd, n_layer, n_head, seq=SEQ, micro=1):
             "projected_step_s_pcie16": round(proj_wall, 2),
             "projected_mfu_pcie16": round(
                 flops_step / (proj_wall * PEAK_FLOPS), 4)}
+
+
+def _signal_name(num):
+    try:
+        return signal.Signals(num).name
+    except ValueError:
+        return f"signal {num}"
+
+
+def _dmesg_oom_tail():
+    """Kernel ring-buffer lines mentioning the OOM killer (best effort)."""
+    try:
+        r = subprocess.run(["dmesg"], capture_output=True, text=True,
+                           timeout=10)
+        lines = [l for l in r.stdout.splitlines()
+                 if "oom" in l.lower() or "out of memory" in l.lower()]
+        return lines[-5:] if lines else None
+    except Exception:
+        return None
+
+
+def _run_rung(name, root):
+    """Launch one worker, polling its peak RSS; capture ALL failure modes."""
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__), "--worker", name],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=root)
+    peak_gb = 0.0
+    import threading
+
+    def _poll():
+        nonlocal peak_gb
+        while proc.poll() is None:
+            hwm = _vm_hwm_gb(proc.pid)
+            if hwm:
+                peak_gb = max(peak_gb, hwm)
+            time.sleep(2.0)
+
+    out_lines, err_chunks = [], []
+
+    def _pump(stream, sink, echo):
+        for line in stream:
+            sink.append(line)
+            if echo:                  # live progress in the parent's log
+                print("  | " + line.rstrip(), flush=True)
+
+    threads = [threading.Thread(target=_poll, daemon=True),
+               threading.Thread(target=_pump,
+                                args=(proc.stdout, out_lines, True),
+                                daemon=True),
+               threading.Thread(target=_pump,
+                                args=(proc.stderr, err_chunks, False),
+                                daemon=True)]
+    for t in threads:
+        t.start()
+    proc.wait()
+    for t in threads:
+        t.join(timeout=5)
+    out, err = "".join(out_lines), "".join(err_chunks)
+    rc = proc.returncode
+    progress = [json.loads(l[8:]) for l in out.splitlines()
+                if l.startswith("PROGRESS")]
+    done = [l for l in out.splitlines() if l.startswith("WORKER")]
+    if done and rc == 0:
+        res = json.loads(done[0][6:])
+        res["parent_observed_rss_hwm_gb"] = round(peak_gb, 2)
+        return res, True
+    failure = {
+        "error": "worker failed",
+        "exit_code": rc,
+        "killed_by_signal": (_signal_name(-rc) if rc and rc < 0 else None),
+        "parent_observed_rss_hwm_gb": round(peak_gb, 2),
+        "progress_before_failure": progress,
+        "stderr_tail": (err or "")[-3000:],
+        "stdout_tail": "\n".join(
+            l for l in out.splitlines()[-20:]
+            if not l.startswith(("PROGRESS", "WORKER"))),
+        "dmesg_oom": _dmesg_oom_tail(),
+    }
+    if rc == -9 or (failure["dmesg_oom"] and peak_gb > 0.8 * HOST_RAM_GB):
+        failure["diagnosis"] = (
+            f"host OOM kill (SIGKILL, peak RSS {peak_gb:.1f} GB of "
+            f"{HOST_RAM_GB} GB)")
+    return failure, False
 
 
 def main():
@@ -119,42 +247,46 @@ def main():
     ladder = [c for c in CANDIDATES if not args or c[0] in args]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(root, "MAXPARAMS.json")
+    nvme = os.path.join(root, ".nvme_probe")
     results = {}
     largest = None
     for name, *_ in ladder:
         print(f"=== probing {name} ===", flush=True)
-        r = subprocess.run([sys.executable, "-u", os.path.abspath(__file__),
-                            "--worker", name], capture_output=True, text=True,
-                           cwd=root)
-        line = [l for l in r.stdout.splitlines() if l.startswith("WORKER")]
-        if line:
-            results[name] = json.loads(line[0][6:])
-            largest = results[name]["params_b"]
-        else:
-            results[name] = {"error": (r.stderr or r.stdout)[-500:]}
+        # fresh NVMe scratch per rung so earlier moment files can't fill
+        # the disk out from under a later rung
+        shutil.rmtree(nvme, ignore_errors=True)
+        free_gb = shutil.disk_usage(root).free / 1e9
+        r, ok = _run_rung(name, root)
+        r["disk_free_before_gb"] = round(free_gb, 1)
+        results[name] = r
+        if ok:
+            largest = r["params_b"]
         out = {
             "largest_trainable_params_b": largest,
             "chip": "TPU v5e 16GB HBM (device holds ~2 streamed layer "
                     "blocks + activations; params NEVER whole in HBM)",
-            "host_ram_gb": 125,
+            "host_ram_gb": HOST_RAM_GB,
             "criterion": "2 full optimizer steps (streamed fwd/bwd, host "
-                         "fused Adam, NVMe moments), finite losses",
+                         "fused Adam; moments cpu<=2.7B / nvme above), "
+                         "finite losses",
             "per_size": results,
             "ram_arithmetic_bytes_per_param": {
                 "fp32_master": 4, "fp32_grad_accum": 4, "16bit_image": 2,
                 "adam_moments": "0 (NVMe) / 8 (cpu)"},
             "note": ("offload_param streaming: 16-bit layer blocks stream "
                      "host->device in fwd AND bwd (zero/param_stream.py); "
-                     "wire seconds are tunnel-bound here (~0.02-0.1 GB/s) — "
-                     "projected_* fields rescale wire to PCIe 16 GB/s. "
-                     "Reference claim shape: 13B on one 32GB V100 "
-                     "(0.41 B/GB device); here 6.7B+ on a 16GB chip "
-                     "(>0.4 B/GB device, host-RAM bound)."),
+                     "wire seconds are tunnel-bound here — projected_* "
+                     "fields rescale wire to PCIe 16 GB/s. Reference claim "
+                     "shape: 13B on one 32GB V100 (0.41 B/GB device) "
+                     "(docs/_posts/2020-09-09-ZeRO-Offload.md:9)."),
         }
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
-        if "error" in results[name]:
+        print(json.dumps({name: ("ok" if ok else "FAILED"),
+                          "largest": largest}), flush=True)
+        if not ok:
             break                     # ascending: larger would fail too
+    shutil.rmtree(nvme, ignore_errors=True)
     print(json.dumps(out))
 
 
