@@ -447,11 +447,27 @@ class GPT2:
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "index": jnp.zeros((), jnp.int32)}
 
+    # decode-path matmuls route through q_matmul/q_gather: params may be
+    # int8 payloads from init_inference(dtype=int8) — the Pallas kernel
+    # streams int8 bytes from HBM (the whole point of int8 decode; the
+    # reference's qkv_gemm_int8/mlp_gemm_int8,
+    # ``csrc/transformer/inference/csrc/pt_binding.cpp:1148``).  Plain
+    # arrays pass through unchanged, so the float path is untouched.
+    supports_quantized_decode = True
+
+    @staticmethod
+    def _mm(h, w, b=None, transpose=False):
+        from ..module_inject.module_quantize import q_matmul
+        out = q_matmul(h, w, w_transposed=transpose)
+        if b is not None:
+            out = out + b.astype(out.dtype)
+        return out
+
     def _qkv(self, p, h):
         c = self.config
         B, T, D = h.shape
         H, hd = c.n_head, c.head_dim
-        qkv = h @ p["qkv_w"].astype(h.dtype) + p["qkv_b"].astype(h.dtype)
+        qkv = self._mm(h, p["qkv_w"], p["qkv_b"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
         return (q.reshape(B, T, H, hd), k.reshape(B, T, H, hd),
                 v.reshape(B, T, H, hd))
@@ -489,7 +505,7 @@ class GPT2:
         cache_v = jax.lax.dynamic_update_slice(
             cache_v, v.astype(cache_v.dtype), (0, index, 0, 0))
         attn = self._attend_cached(q, cache_k, cache_v, index, is_local)
-        attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+        attn = self._mm(attn, p["proj_w"], p["proj_b"])
         return attn, cache_k, cache_v
 
     def _block_with_cache_stacked(self, x, layer_params, ck_all, cv_all,
@@ -514,13 +530,13 @@ class GPT2:
             cv_all, v[None].astype(cv_all.dtype), (layer, 0, index, 0, 0))
         attn = self._attend_cached(q, ck_all[layer], cv_all[layer], index,
                                    is_local)
-        attn = attn @ p["proj_w"].astype(h.dtype) + p["proj_b"].astype(h.dtype)
+        attn = self._mm(attn, p["proj_w"], p["proj_b"])
         x = x + attn
 
         h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
-        h = h @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
+        h = self._mm(h, p["fc_w"], p["fc_b"])
         h = jax.nn.gelu(h, approximate=True)
-        h = h @ p["fc_proj_w"].astype(h.dtype) + p["fc_proj_b"].astype(h.dtype)
+        h = self._mm(h, p["fc_proj_w"], p["fc_proj_b"])
         return x + h, ck_all, cv_all
 
     def _block_with_cache(self, x, layer_params, cache_k, cache_v, index,
@@ -538,9 +554,9 @@ class GPT2:
         x = x + attn
 
         h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
-        h = h @ p["fc_w"].astype(h.dtype) + p["fc_b"].astype(h.dtype)
+        h = self._mm(h, p["fc_w"], p["fc_b"])
         h = jax.nn.gelu(h, approximate=True)
-        h = h @ p["fc_proj_w"].astype(h.dtype) + p["fc_proj_b"].astype(h.dtype)
+        h = self._mm(h, p["fc_proj_w"], p["fc_proj_b"])
         return x + h, cache_k, cache_v
 
     def apply_with_cache(self, params, tokens, cache):
@@ -556,7 +572,9 @@ class GPT2:
         index = cache["index"]
 
         pos = index + jnp.arange(T)
-        x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[pos]
+        from ..module_inject.module_quantize import q_gather
+        x = q_gather(params["wte"], tokens, dtype) + \
+            q_gather(params["wpe"], pos, dtype)
 
         local_flags = jnp.arange(c.n_layer) % 2 == 1
 
@@ -586,10 +604,12 @@ class GPT2:
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"], c.layer_norm_eps)
         # bf16 operands + fp32 accumulation: a pure-fp32 head matmul runs
         # at a fraction of MXU rate and is the only B-proportional flop
-        # term in decode — it was the b=8 throughput ceiling
-        logits = jnp.einsum("btd,vd->btv", x,
-                            params["wte"].astype(x.dtype),
-                            preferred_element_type=jnp.float32)
+        # term in decode — it was the b=8 throughput ceiling.  Tied head:
+        # wte used transposed (and possibly int8 — the vocab matmul is
+        # ~31% of 125M weight bytes, the single biggest decode stream).
+        from ..module_inject.module_quantize import q_matmul
+        logits = q_matmul(x, params["wte"], w_transposed=True,
+                          out_dtype=jnp.float32)
         new_cache = {"k": new_k, "v": new_v, "index": index + T}
         return logits, new_cache
 

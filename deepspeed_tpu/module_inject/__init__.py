@@ -2,6 +2,9 @@
 
 from .replace_module import replace_transformer_layer
 from .replace_policy import DSPolicy, HFGPT2LayerPolicy, replace_policies
+from .inject import (inject_training, load_back_into_hf,
+                     extract_trained_weights)
 
 __all__ = ["replace_transformer_layer", "DSPolicy", "HFGPT2LayerPolicy",
-           "replace_policies"]
+           "replace_policies", "inject_training", "load_back_into_hf",
+           "extract_trained_weights"]

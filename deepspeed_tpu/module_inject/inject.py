@@ -1,0 +1,80 @@
+"""Training-time injection: train a HuggingFace model on this engine.
+
+Parity: reference ``module_inject/inject.py`` (``replace_transformer_layer``
+for TRAINING — swaps HF layers for the fused ``DeepSpeedTransformerLayer``
+so an unmodified HF model trains on the fast kernels).
+
+TPU re-design: instead of surgically swapping layers inside a live torch
+module, the whole HF model converts ONCE into the native JAX family
+(``replace_policy`` registry — same weight-location knowledge), trains
+through ``deepspeed_tpu.initialize`` as usual, and converts BACK into the
+HF module in place when done, so the user's torch model object receives
+the trained weights (save_pretrained etc. keep working).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from .replace_module import replace_transformer_layer
+from ..utils.logging import logger
+
+
+def inject_training(hf_model, config, *, training_data=None, policy=None,
+                    dtype=None, mesh=None, **initialize_kw):
+    """HF torch model → training-ready engine.
+
+    Returns ``(engine, optimizer, dataloader, lr_scheduler)`` exactly like
+    ``deepspeed_tpu.initialize``; the engine trains the NATIVE conversion
+    of ``hf_model``.  Call :func:`extract_trained_weights` (or
+    ``engine.module_state_dict()`` + :func:`load_back_into_hf`) afterwards
+    to put the trained weights back into the torch model.
+    """
+    import deepspeed_tpu as ds
+    model, params = replace_transformer_layer(None, hf_model, policy=policy,
+                                              dtype=dtype)
+    return ds.initialize(config=config, model=model, params=params,
+                         training_data=training_data, mesh=mesh,
+                         **initialize_kw)
+
+
+def load_back_into_hf(hf_model, params) -> None:
+    """Write a native GPT-2-family param tree back into the HF module
+    IN PLACE (inverse of ``HFGPT2LayerPolicy.convert``'s mapping)."""
+    import torch
+
+    tr = hf_model.transformer if hasattr(hf_model, "transformer") else hf_model
+    blocks = params["blocks"]
+
+    def put(torch_param, arr):
+        arr = np.asarray(arr, np.float32)
+        assert tuple(torch_param.shape) == arr.shape, \
+            (tuple(torch_param.shape), arr.shape)
+        with torch.no_grad():
+            torch_param.copy_(torch.from_numpy(arr))
+
+    put(tr.wte.weight, params["wte"])
+    put(tr.wpe.weight, params["wpe"])
+    put(tr.ln_f.weight, params["lnf_scale"])
+    put(tr.ln_f.bias, params["lnf_bias"])
+    for i, b in enumerate(tr.h):
+        put(b.ln_1.weight, blocks["ln1_scale"][i])
+        put(b.ln_1.bias, blocks["ln1_bias"][i])
+        put(b.attn.c_attn.weight, blocks["qkv_w"][i])
+        put(b.attn.c_attn.bias, blocks["qkv_b"][i])
+        put(b.attn.c_proj.weight, blocks["proj_w"][i])
+        put(b.attn.c_proj.bias, blocks["proj_b"][i])
+        put(b.ln_2.weight, blocks["ln2_scale"][i])
+        put(b.ln_2.bias, blocks["ln2_bias"][i])
+        put(b.mlp.c_fc.weight, blocks["fc_w"][i])
+        put(b.mlp.c_fc.bias, blocks["fc_b"][i])
+        put(b.mlp.c_proj.weight, blocks["fc_proj_w"][i])
+        put(b.mlp.c_proj.bias, blocks["fc_proj_b"][i])
+    logger.info("module_inject: trained weights written back into "
+                f"{type(hf_model).__name__}")
+
+
+def extract_trained_weights(engine, hf_model) -> None:
+    """Convenience: gather the engine's (possibly sharded/offloaded) params
+    and write them back into ``hf_model`` in place."""
+    load_back_into_hf(hf_model, engine.module_state_dict())

@@ -53,8 +53,19 @@ def quantize_param_tree(params, *, bits: int = 8, groups: int = 1,
         before += nbytes
         if predicate(key, leaf):
             x = jnp.asarray(leaf)
-            q, scale, _ = _quantize(x.astype(jnp.float32), groups=groups,
-                                    bits=bits, symmetric=True)
+            if x.ndim >= 3:
+                # stacked per-layer weights (L, ...): quantize each layer
+                # slice independently and keep the layer axis leading on
+                # the scale, so lax.scan / layer_slice carve both payload
+                # and scale per layer (scale[l] is that layer's groups)
+                L = x.shape[0]
+                q, scale, _ = _quantize(x.astype(jnp.float32),
+                                        groups=L * groups, bits=bits,
+                                        symmetric=True)
+                scale = scale.reshape(L, groups)
+            else:
+                q, scale, _ = _quantize(x.astype(jnp.float32), groups=groups,
+                                        bits=bits, symmetric=True)
             out.append({"q": q.astype(jnp.int8), "scale": scale})
             after += q.size + scale.size * 4
         else:
@@ -66,14 +77,65 @@ def quantize_param_tree(params, *, bits: int = 8, groups: int = 1,
     return tree, {"bytes_before": before, "bytes_after": after}
 
 
+def is_quantized_leaf(x):
+    """Public alias: True for an ``{"q", "scale"}`` int8 payload leaf."""
+    return _is_quantized_leaf(x)
+
+
+def q_matmul(h, w, *, w_transposed=False, out_dtype=None):
+    """``h @ w`` (or ``h @ w.T``) where ``w`` may be a quantized leaf.
+
+    Quantized leaves route through the Pallas weight-int8 kernel
+    (``ops/transformer/int8_matmul.py``) so decode's HBM traffic stays
+    int8-sized; plain arrays take the ordinary matmul.  Scales that map
+    neither per-tensor nor per-output-channel fall back to an explicit
+    dequant (correct, full-width)."""
+    out_dtype = out_dtype or h.dtype
+
+    def _plain(w):
+        # bf16 operands, fp32 accumulation (MXU full rate), cast at the end
+        acc = jax.lax.dot_general(
+            h, w.astype(h.dtype),
+            (((h.ndim - 1,), (1 if w_transposed else 0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc.astype(out_dtype)
+
+    if not _is_quantized_leaf(w):
+        return _plain(w)
+    from ..ops.transformer.int8_matmul import int8_matmul
+    q, scale = w["q"], w["scale"]
+    N = q.shape[0] if w_transposed else q.shape[1]
+    if scale.size == 1 or (w_transposed and scale.size == N):
+        return int8_matmul(h, q, scale, w_transposed=w_transposed,
+                           out_dtype=out_dtype)
+    return _plain(dequantize_tree(w, h.dtype))
+
+
+def q_gather(w, idx, dtype=jnp.bfloat16):
+    """Row gather (embedding lookup) from a possibly-quantized table:
+    gathers int8 rows then rescales — touched rows only, never the full
+    dequantized table."""
+    if not _is_quantized_leaf(w):
+        return w.astype(dtype)[idx]
+    q, scale = w["q"], w["scale"]
+    scale = jnp.asarray(scale, jnp.float32).reshape(-1)
+    if scale.size == 1:
+        return (q[idx].astype(jnp.float32) * scale[0]).astype(dtype)
+    if scale.size == q.shape[0]:      # per-row groups
+        return (q[idx].astype(jnp.float32)
+                * scale[idx][..., None]).astype(dtype)
+    return dequantize_tree(w, dtype)[idx]
+
+
 def dequantize_tree(params, dtype=jnp.bfloat16):
     """Inverse transform — call INSIDE jit so dequant fuses into consumers."""
     def deq(x):
         if _is_quantized_leaf(x):
-            groups = x["scale"].shape[0] if np.ndim(x["scale"]) else 1
             from ..ops.quantizer.quantizer import dequantize as _deq
-            return _deq(x["q"].astype(jnp.float32), x["scale"],
-                        groups=groups).astype(dtype)
+            scale = jnp.asarray(x["scale"]).reshape(-1)   # (L, g) → (L·g,),
+            # row-major — exactly the quantizer's flattened group order
+            return _deq(x["q"].astype(jnp.float32), scale,
+                        groups=max(1, scale.size)).astype(dtype)
         return x
     return jax.tree_util.tree_map(deq, params,
                                   is_leaf=lambda x: _is_quantized_leaf(x))

@@ -248,6 +248,120 @@ def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
             (block_q, MIN_LANES))
 
 
+_N_KV_BUF = 3    # triple buffer: slot (j+2)%3 held block j-1 (consumed one
+#                  grid step ago), so the j+2 fetch can start BEFORE block
+#                  j's compute with no read/write hazard
+
+
+def _fwd_kernel_dma(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
+                    seq_len, n_heads=1, use_merge=False):
+    """LUT forward with MANUAL double-buffered K/V DMA (splash-attention
+    style).  The BlockSpec LUT path pays ~1.5×/slot vs static index maps
+    (SPARSE_BENCH limits analysis: all-ones LUT 0.457 ms vs dense 0.307 ms
+    at identical visited slots) because scalar-prefetch-dependent index
+    maps serialize the pipeline's DMA issue with the index computation.
+    Here K/V stay in HBM (``pltpu.ANY``); the kernel fetches block
+    ``kmap[h, qi, j]`` into a 3-deep VMEM ring with explicit
+    ``make_async_copy`` — block j+2's fetch is issued before block j's
+    compute, so the DMA engine runs a full block ahead of the MXU."""
+    if use_merge:
+        kmap_ref, klen_ref, sub0_ref, sub1_ref = refs[:4]
+        refs = refs[4:]
+    else:
+        kmap_ref, klen_ref = refs[:2]
+        refs = refs[2:]
+    q_ref, k_hbm, v_hbm = refs[:3]
+    o_ref, lse_ref = refs[3:5]
+    acc_ref, m_ref, l_ref, k_buf, v_buf, k_sem, v_sem = refs[5:]
+
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    h_idx = jax.lax.rem(b, n_heads)
+    klen = klen_ref[h_idx, qi]
+
+    def copies(j, slot):
+        ki = kmap_ref[h_idx, qi, j]
+        kc = pltpu.make_async_copy(
+            k_hbm.at[b, pl.ds(ki * block_k, block_k), :], k_buf.at[slot],
+            k_sem.at[slot])
+        vc = pltpu.make_async_copy(
+            v_hbm.at[b, pl.ds(ki * block_k, block_k), :], v_buf.at[slot],
+            v_sem.at[slot])
+        return kc, vc
+
+    def start(j):
+        @pl.when(j < klen)
+        def _():
+            kc, vc = copies(j, jax.lax.rem(j, _N_KV_BUF))
+            kc.start()
+            vc.start()
+
+    @pl.when(kj == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        start(0)
+        if num_k_blocks > 1:
+            start(1)
+
+    if num_k_blocks > 2:
+        start(kj + 2)          # gated on kj+2 < klen inside
+
+    @pl.when(kj < klen)
+    def _():
+        slot = jax.lax.rem(kj, _N_KV_BUF)
+        kc, vc = copies(kj, slot)
+        kc.wait()
+        vc.wait()
+        ki = kmap_ref[h_idx, qi, kj]
+        q = q_ref[0]                  # (block_q, d)
+        k = k_buf[slot]               # (block_k, d)
+        v = v_buf[slot]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < seq_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        if use_merge:
+            row_iota = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            sel = jnp.where(row_iota < block_q // 2,
+                            sub0_ref[h_idx, qi, kj],
+                            sub1_ref[h_idx, qi, kj])
+            valid = jnp.logical_and(valid, sel > 0)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(kj == num_k_blocks - 1)
+    def _():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        row_live = m_ref[:] > NEG_INF * 0.5
+        o_ref[0] = jnp.where(row_live, acc_ref[:] / l_safe,
+                             0.0).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            jnp.where(row_live, m_ref[:] + jnp.log(l_safe), NEG_INF),
+            (block_q, MIN_LANES))
+
+
 def _tile_kbias(kb, T, Tp, block_k):
     """(B, T) additive key bias → (B, nk, 1, block_k) tile-major view whose
     trailing block dims EQUAL the array dims (always Mosaic-legal, any
@@ -300,6 +414,11 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k,
     H = n_heads or 1
 
     use_merge = sub01 is not None
+    # manual-DMA LUT variant: K/V stay in HBM, the kernel runs its own
+    # triple-buffered fetch ring (compiled TPU only — the interpreter
+    # executes the BlockSpec variant, same numerics)
+    use_dma = (use_lut and not _interpret()
+               and k_bias is None and attn_bias is None)
     if use_merge:
         assert k_bias is None and attn_bias is None, \
             "merged-row path composes with the unbiased kernel only"
@@ -324,11 +443,18 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k,
         ab_idx = lambda b, i, j: (i, j, 0, 0)
         n_inner = nk
 
-    in_specs = [
-        pl.BlockSpec((1, block_q, d), q_idx),
-        pl.BlockSpec((1, block_k, d), kv_idx),
-        pl.BlockSpec((1, block_k, d), kv_idx),
-    ]
+    if use_dma:
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), q_idx),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ]
+    else:
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), q_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+        ]
     args = (q, k, v)
     if k_bias is not None:                    # (B, T) → (B, nk, 1, bk)
         k_bias = _tile_kbias(k_bias, T, Tp, block_k)
@@ -338,12 +464,18 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k,
         attn_bias = _tile_abias(attn_bias, T, Tp, block_q, block_k)
         in_specs.append(pl.BlockSpec((1, 1, block_q, block_k), ab_idx))
         args = args + (attn_bias,)
-    kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k_blocks=n_inner,
-        seq_len=T, n_heads=H, use_kbias=k_bias is not None,
-        use_abias=attn_bias is not None,
-        use_lut=use_lut and not use_merge, use_merge=use_merge)
+    if use_dma:
+        kernel = functools.partial(
+            _fwd_kernel_dma, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k_blocks=n_inner,
+            seq_len=T, n_heads=H, use_merge=use_merge)
+    else:
+        kernel = functools.partial(
+            _fwd_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k_blocks=n_inner,
+            seq_len=T, n_heads=H, use_kbias=k_bias is not None,
+            use_abias=attn_bias is not None,
+            use_lut=use_lut and not use_merge, use_merge=use_merge)
     out_specs = [
         pl.BlockSpec((1, block_q, d), q_idx),
         pl.BlockSpec((1, block_q, MIN_LANES), q_idx),
@@ -357,6 +489,13 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k,
         pltpu.VMEM((block_q, 1), jnp.float32),
         pltpu.VMEM((block_q, 1), jnp.float32),
     ]
+    if use_dma:
+        scratch += [
+            pltpu.VMEM((_N_KV_BUF, block_k, d), k.dtype),
+            pltpu.VMEM((_N_KV_BUF, block_k, d), v.dtype),
+            pltpu.SemaphoreType.DMA((_N_KV_BUF,)),
+            pltpu.SemaphoreType.DMA((_N_KV_BUF,)),
+        ]
     call = _pallas(kernel, grid=(BH, nq, n_inner), in_specs=in_specs,
                    out_specs=out_specs, out_shape=out_shape, scratch=scratch,
                    num_prefetch=(4 if use_merge else 2) if use_lut else 0)

@@ -1,0 +1,115 @@
+"""Weight-int8 matmul: dequantize INSIDE the kernel so HBM streams int8.
+
+Parity: the reference's int8 inference gemms
+(``csrc/transformer/inference/csrc/pt_binding.cpp:1148`` ``qkv_gemm_int8`` /
+``mlp_gemm_int8`` + ``dequantize.cu``) exist so int8-stored weights reach
+the tensor cores without a full-width round trip through device memory.
+
+TPU shape of the problem: batched decode is weight-streaming bound — each
+token must read every weight byte out of HBM, so tok/s ≈ HBM_BW /
+weight_bytes.  ``jnp.dot(x, q.astype(bf16))`` does NOT deliver int8's
+2× byte saving: XLA materializes the bf16 convert as a separate HBM
+tensor and the matmul then streams full-width.  This Pallas kernel loads
+int8 blocks into VMEM, converts there (VPU), and feeds the MXU bf16 —
+HBM traffic stays int8-sized.  Scale is applied by the CALLER on the
+(M, N) output (per-tensor or per-output-channel), where XLA fuses it
+into the kernel's consumer.
+
+Decode-only by design: M (batch rows) is small and the weight block is
+the whole VMEM working set.  Prefill / training use the XLA path where
+the dequant materialization amortizes over T.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:                 # pragma: no cover - no backend
+        return False
+
+
+def _kernel_nt(x_ref, q_ref, o_ref):
+    # q block: (K, bn) int8 → bf16 in VMEM; x: (M, K) bf16
+    w = q_ref[...].astype(jnp.bfloat16)
+    o_ref[...] = jax.lax.dot_general(
+        x_ref[...], w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _kernel_t(x_ref, q_ref, o_ref):
+    # q block: (bn, K) int8 (weight stored (N, K), used as x @ w.T)
+    w = q_ref[...].astype(jnp.bfloat16)
+    o_ref[...] = jax.lax.dot_general(
+        x_ref[...], w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("w_transposed", "block_n"))
+def _int8_mm_tpu(x, q, *, w_transposed, block_n):
+    from jax.experimental import pallas as pl
+
+    M, K = x.shape
+    N = q.shape[0] if w_transposed else q.shape[1]
+    grid = (pl.cdiv(N, block_n),)
+    if w_transposed:
+        q_spec = pl.BlockSpec((block_n, K), lambda i: (i, 0))
+        kernel = _kernel_t
+    else:
+        q_spec = pl.BlockSpec((K, block_n), lambda i: (0, i))
+        kernel = _kernel_nt
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((M, K), lambda i: (0, 0)), q_spec],
+        out_specs=pl.BlockSpec((M, block_n), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+    )(x, q)
+
+
+def int8_matmul(x, q, scale, *, w_transposed=False, block_n=512,
+                out_dtype=None):
+    """``x @ dequant(q)`` (or ``x @ dequant(q).T``) streaming int8 weights.
+
+    ``x``: (..., K) floating; ``q``: int8 (K, N), or (N, K) when
+    ``w_transposed``; ``scale``: per-tensor (size 1) or per-output-channel
+    (size N, only with ``w_transposed`` — the quantizer's row groups).
+    Returns (..., N) in ``out_dtype`` (default ``x.dtype``).
+    """
+    out_dtype = out_dtype or x.dtype
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    N = q.shape[0] if w_transposed else q.shape[1]
+    M = int(np.prod(lead)) if lead else 1
+    x2 = x.reshape(M, K).astype(jnp.bfloat16)
+
+    use_pallas = (_on_tpu() and M <= 64 and K % 128 == 0)
+    if use_pallas:
+        # pad rows to the bf16 sublane tile so tiny decode batches map
+        # cleanly; cost is VMEM-only
+        Mp = max(16, -(-M // 16) * 16)
+        if Mp != M:
+            x2 = jnp.pad(x2, ((0, Mp - M), (0, 0)))
+        acc = _int8_mm_tpu(x2, q, w_transposed=w_transposed,
+                           block_n=min(block_n, N))[:M]
+    else:
+        w = q.astype(jnp.bfloat16)
+        acc = jax.lax.dot_general(
+            x2, w, (((1,), (1 if w_transposed else 0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    scale = jnp.asarray(scale, jnp.float32).reshape(-1)
+    if scale.size == 1:
+        acc = acc * scale[0]
+    elif w_transposed and scale.size == N:
+        acc = acc * scale[None, :]
+    else:
+        raise ValueError(
+            f"scale size {scale.size} does not map to per-tensor or "
+            f"per-output-channel (N={N}, w_transposed={w_transposed})")
+    return acc.astype(out_dtype).reshape(*lead, N)

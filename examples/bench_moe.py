@@ -78,9 +78,111 @@ def measure(dispatch_impl, micro, steps, warmup=2, seq=1024):
             "loss": round(final, 3)}
 
 
+def measure_16e_offload(micro=8, steps=2, warmup=1, seq=1024):
+    """The FULL 16-expert model on one chip through the tier built for it
+    (VERDICT r4 next #2): ~1.9B total params — bf16 images + grads fit the
+    16 GB HBM, the fp32 Adam states do NOT, so ``offload_optimizer`` holds
+    master+moments on the host (reference: ZeRO-Offload for MoE models,
+    ``deepspeed/moe/sharded_moe.py:443`` + ``stage_1_and_2.py:1008``).
+    Reports MFU + the wire/host component breakdown."""
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt2_moe import GPT2MoE
+
+    model = GPT2MoE(preset="gpt2-moe-350m-16e", dtype=jnp.bfloat16,
+                    max_seq=seq, embd_pdrop=0.0, attn_pdrop=0.0,
+                    resid_pdrop=0.0, remat=True, unroll_layers=False,
+                    attention_impl="flash", dispatch_impl="scatter")
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10 ** 9,
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4,
+                                                  "weight_decay": 0.1}},
+        "zero_optimization": {
+            "stage": 1,
+            "offload_optimizer": {"device": "cpu",
+                                  "delayed_param_update": True,
+                                  "delayed_param_update_warmup": 0}},
+    }
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, model.config.vocab_size,
+                          size=(micro * 2, seq + 1)).astype(np.int32)
+    t0 = time.time()
+    engine, _, _, _ = ds.initialize(config=config, model=model,
+                                    training_data=(tokens,))
+    init_s = time.time() - t0
+    n_params = model.num_params() if hasattr(model, "num_params") else \
+        engine._offload.numel
+    losses = []
+    for _ in range(warmup):
+        losses.append(float(engine.train_batch()))
+    walls = []
+    for _ in range(steps):
+        t0 = time.time()
+        losses.append(float(engine.train_batch()))
+        walls.append(time.time() - t0)
+    engine._flush_offload()
+    host = dict(getattr(engine._offload, "last_host_times", {}))
+    assert all(np.isfinite(l) for l in losses)
+
+    c = model.config
+    per_layer_attn = 4 * c.n_embd ** 2
+    ffn = 8 * c.n_embd ** 2
+    n_moe = sum(model.is_moe_layer(i) for i in range(c.n_layer))
+    act_params = (c.vocab_size * c.n_embd + c.max_seq * c.n_embd
+                  + c.n_layer * (per_layer_attn + ffn)
+                  + n_moe * c.n_embd * c.num_experts)
+    flops_tok = 6 * act_params + 12 * c.n_layer * c.n_embd * seq
+    dt = float(np.mean(walls))
+    tps = micro * seq / dt
+    return {
+        "total_params_b": round(n_params / 1e9, 2),
+        "experts": c.num_experts,
+        "init_s": round(init_s, 1),
+        "losses": [round(l, 3) for l in losses],
+        "step_wall_s": [round(w, 1) for w in walls],
+        "host_component_times": host,
+        "wire_gb_each_way": round(n_params * 2 / 1e9, 2),
+        "mfu_activated": round(flops_tok * tps / 197e12, 4),
+        "tokens_per_sec": round(tps),
+        "dpu": True,
+        "note": ("steady-state wall includes the tunnel-bound grad d2h "
+                 "(~0.01-0.03 GB/s here vs >=16 GB/s PCIe); losses must be "
+                 "finite and decreasing for the datapoint to count"),
+    }
+
+
+def run_16e_only():
+    """Run ONLY the 16e on-chip offload point and merge it into the
+    committed MOE_BENCH.json (subprocess for clean device memory)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-u", os.path.abspath(__file__),
+                        "8", "2", "offload16e"], capture_output=True,
+                       text=True, cwd=root)
+    line = [l for l in r.stdout.splitlines() if l.startswith("WORKER")]
+    res = (json.loads(line[0][6:]) if line
+           else {"error": (r.stderr or r.stdout)[-2000:]})
+    path = os.path.join(root, "MOE_BENCH.json")
+    with open(path) as f:
+        out = json.load(f)
+    out["gpt_moe_16e_onchip_offload"] = res
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(res))
+
+
 def main():
+    if "--16e" in sys.argv:
+        run_16e_only()
+        return
     micro = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    if len(sys.argv) > 3 and sys.argv[3] == "offload16e":
+        print("WORKER" + json.dumps(measure_16e_offload(micro, steps)))
+        return
     if len(sys.argv) > 3:                       # subprocess worker
         print("WORKER" + json.dumps(measure(sys.argv[3], micro, steps)))
         return

@@ -71,14 +71,18 @@ def bench_one(T, block, iters=500, rounds=4, floor_s=None):
     qk = jax.random.normal(rng, (1, T, H, d), jnp.bfloat16)
 
     def many(fn):
+        # the attention INPUT threads through the carry (q ← q + 1e-30·o,
+        # a bf16 no-op with a real data dependence) so XLA cannot hoist
+        # the loop-invariant kernel out of the scan; the elementwise add
+        # (~0.02 ms at HBM rate) applies equally to sparse and dense
         def run(q):
             def body(x, _):
-                o = fn(q, q, q)
-                x = jax.lax.optimization_barrier(x + o[0, 0, 0, 0]
-                                                 .astype(jnp.float32))
+                o = fn(x, x, x)
+                x = jax.lax.optimization_barrier(
+                    x + (o * 1e-30).astype(x.dtype))
                 return x, None
-            x, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=iters)
-            return x
+            x, _ = jax.lax.scan(body, q, None, length=iters)
+            return x[0, 0, 0, 0].astype(jnp.float32)
         return jax.jit(run)
 
     if floor_s is None:

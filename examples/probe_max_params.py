@@ -250,6 +250,11 @@ def main():
     nvme = os.path.join(root, ".nvme_probe")
     results = {}
     largest = None
+    if os.path.exists(path):          # merge: partial re-runs keep old rungs
+        with open(path) as f:
+            prev = json.load(f)
+        results = prev.get("per_size", {})
+        largest = prev.get("largest_trainable_params_b")
     for name, *_ in ladder:
         print(f"=== probing {name} ===", flush=True)
         # fresh NVMe scratch per rung so earlier moment files can't fill
@@ -260,7 +265,7 @@ def main():
         r["disk_free_before_gb"] = round(free_gb, 1)
         results[name] = r
         if ok:
-            largest = r["params_b"]
+            largest = max(largest or 0, r["params_b"])
         out = {
             "largest_trainable_params_b": largest,
             "chip": "TPU v5e 16GB HBM (device holds ~2 streamed layer "

@@ -1,0 +1,172 @@
+"""Attribute the MoE dispatch overhead (VERDICT r4 next #5).
+
+MOE_BENCH shows 0.343 activated MFU for the 4e scatter model vs 0.516 for
+the equivalent dense model — ~33% of the activated-flops throughput goes
+somewhere.  This profiles the pieces AT THE BENCH SHAPES (S=8192 tokens,
+M=1024, E=4, top-1 cf=1.25) as separately-jitted fwd+bwd programs:
+
+  - gate        — fp32 logits + top-1 routing math (sharded_moe.top1_routes)
+  - dispatch    — scatter S rows into (E*C, M) + combine gather, no FFN
+  - expert_ffn  — the (E, C, M) batched FFN alone (the useful work, on
+                  E*C = cf*S padded rows — capacity padding is VISIBLE
+                  here as extra rows vs the dense S-row FFN)
+  - dense_ffn   — S-row dense FFN (what the activated-flops model divides
+                  by)
+  - moe_block   — everything together (one MoE sublayer fwd+bwd)
+
+The sum of parts vs the whole exposes fusion wins/losses; expert_ffn /
+dense_ffn exposes the capacity-factor padding tax; dispatch is the pure
+routing-data-movement floor (the reference's ``_AllToAll``,
+``deepspeed/moe/sharded_moe.py:85`` — on one chip this is the scatter
+itself, no ICI term).
+
+Run solo on the TPU:  python examples/profile_moe_dispatch.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+S, M, E, CF = 8192, 1024, 4, 1.25
+FF = 4 * M          # FFN hidden
+ITERS = 100
+
+
+def _timeit(grad_f, x0):
+    """min wall of 4 rounds of ITERS in-graph iterations.
+
+    The iterated value THREADS THROUGH THE CARRY (x ← x + 1e-30·dx, a
+    bf16 no-op numerically but a real data dependence), so XLA cannot
+    hoist the loop-invariant computation out of the scan — without this
+    the whole fwd+bwd would run once and the per-iteration time would
+    read ~ITERS× too small."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(x):
+        def body(c, _):
+            dx = grad_f(c)
+            c = jax.lax.optimization_barrier(
+                c + (dx * 1e-30).astype(c.dtype))
+            return c, None
+        c, _ = jax.lax.scan(body, x, None, length=ITERS)
+        return c.reshape(-1)[0].astype(jnp.float32)
+    jf = jax.jit(run)
+    float(jf(x0))
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.time()
+        float(jf(x0))
+        best = min(best, time.time() - t0)
+    return best / ITERS
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.moe.sharded_moe import top1_routes, compute_capacity
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (S, M), jnp.bfloat16)
+    logits_w = jax.random.normal(rng, (M, E), jnp.float32) * 0.02
+    w1 = jax.random.normal(rng, (E, M, FF), jnp.bfloat16) * 0.02
+    b1 = jnp.zeros((E, 1, FF), jnp.bfloat16)
+    w2 = jax.random.normal(rng, (E, FF, M), jnp.bfloat16) * 0.02
+    b2 = jnp.zeros((E, 1, M), jnp.bfloat16)
+    dw1 = jax.random.normal(rng, (M, FF), jnp.bfloat16) * 0.02
+    dw2 = jax.random.normal(rng, (FF, M), jnp.bfloat16) * 0.02
+    C = compute_capacity(S, E, CF, 4)
+
+    def gate_fn(x):
+        logits = x.astype(jnp.float32) @ logits_w
+        l_aux, idx, loc, w, kept, counts, cap = top1_routes(
+            logits, CF, 4, rng=None, use_rts=False)
+        return l_aux + w.sum()
+
+    def routes_of(x):
+        logits = x.astype(jnp.float32) @ logits_w
+        _, idx, loc, w, _, _, _ = top1_routes(logits, CF, 4, rng=None,
+                                              use_rts=False)
+        return idx, loc, w
+
+    def dispatch_fn(x):
+        idx, loc, w = routes_of(x)
+        pos = jnp.where(w > 0, idx * C + loc, E * C)
+        flat = jnp.zeros((E * C, M), x.dtype)
+        flat = flat.at[pos].set(x, mode="drop")
+        out = flat[jnp.clip(pos, 0, E * C - 1)]
+        return (out * w[:, None].astype(x.dtype)).sum()
+
+    def expert_ffn_fn(x):
+        d = jnp.broadcast_to(x[:E * C].reshape(E, C, M), (E, C, M))
+        h = jax.nn.gelu(d @ w1 + b1, approximate=True)
+        return (h @ w2 + b2).sum()
+
+    def dense_ffn_fn(x):
+        h = jax.nn.gelu(x @ dw1, approximate=True)
+        return (h @ dw2).sum()
+
+    def moe_block_fn(x):
+        idx, loc, w = routes_of(x)
+        pos = jnp.where(w > 0, idx * C + loc, E * C)
+        flat = jnp.zeros((E * C, M), x.dtype)
+        flat = flat.at[pos].set(x, mode="drop")
+        d = flat.reshape(E, C, M)
+        h = jax.nn.gelu(d @ w1 + b1, approximate=True)
+        o = (h @ w2 + b2).reshape(-1, M)
+        out = o[jnp.clip(pos, 0, E * C - 1)] * w[:, None].astype(x.dtype)
+        return out.sum()
+
+    parts = {}
+    for name, fn in [("gate", gate_fn), ("dispatch", dispatch_fn),
+                     ("expert_ffn", expert_ffn_fn),
+                     ("dense_ffn", dense_ffn_fn),
+                     ("moe_block", moe_block_fn)]:
+        g = jax.grad(lambda x, fn=fn: fn(x).astype(jnp.float32))
+        parts[name + "_fwdbwd_ms"] = round(_timeit(g, x) * 1e3, 3)
+        print(name, parts[name + "_fwdbwd_ms"], "ms", flush=True)
+    # the carry add costs one (S, M) elementwise pass (~0.04 ms at HBM
+    # rate) — identical across parts, so ratios are clean; absolute gate
+    # time carries it as a small constant
+
+    ffn_flops = 2 * 2 * S * M * FF * 3        # fwd + 2x bwd, both matmuls
+    out = {
+        "shapes": {"tokens": S, "model_dim": M, "experts": E,
+                   "capacity_factor": CF, "capacity": int(C),
+                   "padded_rows": int(E * C), "iters": ITERS},
+        "parts": parts,
+        "derived": {
+            "capacity_padding_tax": round(
+                parts["expert_ffn_fwdbwd_ms"]
+                / max(parts["dense_ffn_fwdbwd_ms"], 1e-9), 3),
+            "dispatch_overhead_vs_dense_ffn": round(
+                parts["dispatch_fwdbwd_ms"]
+                / max(parts["dense_ffn_fwdbwd_ms"], 1e-9), 3),
+            "sum_parts_ms": round(
+                parts["gate_fwdbwd_ms"] + parts["dispatch_fwdbwd_ms"]
+                + parts["expert_ffn_fwdbwd_ms"], 3),
+            "whole_block_ms": parts["moe_block_fwdbwd_ms"],
+            "dense_ffn_tflops": round(
+                ffn_flops / parts["dense_ffn_fwdbwd_ms"] / 1e9, 1),
+        },
+        "note": ("per-sublayer fwd+bwd true times (in-graph scan, "
+                 "floor-free by construction at 100 iters); the MoE "
+                 "activated-MFU gap decomposes into capacity padding "
+                 "(expert_ffn/dense_ffn), routing data movement "
+                 "(dispatch), and gate math"),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MOE_DISPATCH.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
+
+
