@@ -250,6 +250,11 @@ class GPT2MoE:
     # cached-attention core shared with the dense model (scale_attn /
     # local-window semantics live in ONE place) — including the helpers
     # _cached_attention delegates to
+    _mm = staticmethod(GPT2._mm)
+    # NOT quantized-decode-capable: the expert FFN decode path multiplies
+    # expert weights directly (no q_matmul routing yet), so int8 MoE
+    # decode takes the hoisted-dequant route in the inference engine
+    supports_quantized_decode = False
     _qkv = GPT2._qkv
     _attend_cached = GPT2._attend_cached
     _cached_attention = GPT2._cached_attention

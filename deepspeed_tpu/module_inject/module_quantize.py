@@ -31,9 +31,18 @@ def _is_quantized_leaf(x):
 
 
 def default_predicate(path: str, leaf) -> bool:
-    """Quantize matmul weights only: ≥2-D and large (embeddings included —
-    the reference quantizes those too via MoQ ckpt quantization)."""
-    return hasattr(leaf, "ndim") and leaf.ndim >= 2 and leaf.size >= 4096
+    """Quantize matmul weights only: large, MATRIX-shaped leaves
+    (embeddings included — the reference quantizes those too via MoQ ckpt
+    quantization).  Vector-per-layer leaves stacked to 2-D (layernorm
+    scales/biases: (L, D)) must NOT quantize — they feed elementwise
+    ops, their dynamic range matters, and the reference's quantizer
+    never touches them either."""
+    if not (hasattr(leaf, "ndim") and leaf.ndim >= 2 and leaf.size >= 4096):
+        return False
+    if min(leaf.shape[-2:]) < 64:      # stacked vectors, tiny matrices
+        return False
+    name = path.lower()
+    return not any(t in name for t in ("ln", "bias", "scale", "norm"))
 
 
 def quantize_param_tree(params, *, bits: int = 8, groups: int = 1,

@@ -67,13 +67,16 @@ def main():
     decode_s = max(dt - t_prefill, 1e-9)             # steady-state portion
     toks = args.batch * (args.new - 1)
     # weight-streaming roofline for the artifact: weight bytes + KV bytes
-    # actually read per decode step, over v5e HBM.  int8 still streams
-    # bf16 weights per token (dequant is hoisted out of the token scan but
-    # the scan reads the dequantized tree) — int8 halves RESIDENT weight
-    # memory; true int8-gemm traffic would need activation quantization.
+    # actually read per decode step, over v5e HBM.  int8 streams int8
+    # bytes per token (XLA convert-in-dot fusion: the int8 leaf feeds
+    # dot_general directly via q_matmul — see
+    # ops/transformer/int8_matmul.py for the measured comparison vs the
+    # opt-in Pallas block kernel), so the roofline counts ~1 byte per
+    # quantized param: the int8 bound is ~2x the bf16 bound and the model
+    # must BEAT bf16 decode to hold its fraction.
     HBM_GBS = 819.0
     n_params = model.num_params()
-    w_bytes = n_params * 2
+    w_bytes = n_params * (1 if args.int8 else 2)
     c = model.config
     mid_S = args.prompt + args.new // 2
     kv_bytes = 2 * c.n_layer * args.batch * mid_S * c.n_embd * 2

@@ -89,6 +89,9 @@ def measure_16e_offload(micro=8, steps=2, warmup=1, seq=1024):
     import deepspeed_tpu as ds
     from deepspeed_tpu.models.gpt2_moe import GPT2MoE
 
+    # no loss_chunk: GPT2MoE doesn't support it; micro=2 keeps the fp32
+    # logits (2x1024xV ~ 0.4 GB) plus 3.8 GB params + 3.8 GB grads inside
+    # the 16 GB HBM (micro=8 RESOURCE_EXHAUSTED'd)
     model = GPT2MoE(preset="gpt2-moe-350m-16e", dtype=jnp.bfloat16,
                     max_seq=seq, embd_pdrop=0.0, attn_pdrop=0.0,
                     resid_pdrop=0.0, remat=True, unroll_layers=False,
@@ -160,7 +163,7 @@ def run_16e_only():
     committed MOE_BENCH.json (subprocess for clean device memory)."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     r = subprocess.run([sys.executable, "-u", os.path.abspath(__file__),
-                        "8", "2", "offload16e"], capture_output=True,
+                        "2", "2", "offload16e"], capture_output=True,
                        text=True, cwd=root)
     line = [l for l in r.stdout.splitlines() if l.startswith("WORKER")]
     res = (json.loads(line[0][6:]) if line

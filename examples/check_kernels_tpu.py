@@ -1,0 +1,120 @@
+"""On-chip numerics parity for the compiled-only kernel paths.
+
+Two kernels run ONLY when compiled on TPU (the CPU test suite exercises
+their fallback/interpret twins): the weight-int8 Pallas matmul
+(``ops/transformer/int8_matmul.py``) and the manual-DMA block-sparse
+flash attention (``_fwd_kernel_dma``).  This script checks both against
+their portable references on the real chip and exits nonzero on
+mismatch — run it before trusting any bench numbers from those paths.
+
+Run solo on the TPU:  python examples/check_kernels_tpu.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def check_int8_matmul():
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer.int8_matmul import int8_matmul
+    from deepspeed_tpu.ops.quantizer.quantizer import quantize, dequantize
+
+    rng = np.random.RandomState(0)
+    ok = True
+    for (mk, kk, nn, transposed, groups) in [
+            (8, 768, 2304, False, 1),        # qkv
+            (8, 3072, 768, False, 1),        # fc_proj
+            (8, 768, 50257, True, 1),        # tied head, ragged N
+            (16, 768, 50257, True, 50257),   # per-row scales
+    ]:
+        x = jnp.asarray(rng.randn(mk, kk).astype(np.float32) * 0.5,
+                        jnp.bfloat16)
+        w = rng.randn(*((nn, kk) if transposed else (kk, nn))).astype(
+            np.float32) * 0.1
+        q, scale, _ = quantize(jnp.asarray(w), groups=groups)
+        deq = np.asarray(dequantize(q.astype(jnp.float32), scale,
+                                    groups=groups))
+        ref = np.asarray(x, np.float32) @ (deq.T if transposed else deq)
+        out = np.asarray(int8_matmul(x, q.astype(jnp.int8), scale, use_pallas=True,
+                                     w_transposed=transposed,
+                                     out_dtype=jnp.float32))
+        err = np.max(np.abs(out - ref)) / (np.max(np.abs(ref)) + 1e-9)
+        tag = f"int8_mm M={mk} K={kk} N={nn} t={transposed} g={groups}"
+        print(f"{tag}: rel_err={err:.4f}")
+        if err > 0.05:
+            ok = False
+            print(f"  FAIL: {tag}")
+    return ok
+
+
+def check_sparse_dma():
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        sparse_flash_attention)
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+        BSLongformerSparsityConfig, FixedSparsityConfig)
+
+    ok = True
+    for name, T, H, d, cfg in [
+        ("bslongformer", 4096, 8, 64, BSLongformerSparsityConfig(
+            num_heads=8, block=512, num_sliding_window_blocks=3,
+            global_block_indices=[0])),
+        ("fixed", 2048, 4, 128, FixedSparsityConfig(
+            num_heads=4, block=256, num_local_blocks=2,
+            num_global_blocks=1)),
+    ]:
+        layout = np.asarray(cfg.make_layout(T))
+        key = jax.random.PRNGKey(7)
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                     (1, T, H, d), jnp.bfloat16)
+                   for i in range(3))
+        # compiled manual-DMA LUT kernel
+        out = np.asarray(sparse_flash_attention(q, k, v, layout,
+                                                causal=True),
+                         np.float32)
+        # portable per-head reference: full masked softmax in fp32
+        blk = T // layout.shape[1]
+        Lh = layout.shape[0]
+        causal = np.tril(np.ones((T, T), bool))
+        qf = np.asarray(q, np.float32)[0]      # (T, H, d)
+        kf = np.asarray(k, np.float32)[0]
+        vf = np.asarray(v, np.float32)[0]
+        sm = 1.0 / np.sqrt(d)
+        err = 0.0
+        for h in range(H):
+            lay = layout[h if Lh > 1 else 0]
+            mask = np.kron(lay > 0, np.ones((blk, blk), bool)) & causal
+            s = (qf[:, h] @ kf[:, h].T) * sm
+            s = np.where(mask, s, -np.inf)
+            live = mask.any(1)
+            s = s - s.max(1, keepdims=True, initial=-1e30)
+            p = np.exp(s, where=np.isfinite(s), out=np.zeros_like(s))
+            denom = p.sum(1, keepdims=True)
+            ref_h = np.divide(p, np.where(denom == 0, 1, denom)) @ vf[:, h]
+            err = max(err, float(np.max(
+                np.abs(out[0, live, h] - ref_h[live]))))
+        print(f"sparse_dma {name} T={T}: max_abs_err={err:.5f}")
+        if err > 3e-2:
+            ok = False
+            print(f"  FAIL: sparse_dma {name}")
+    return ok
+
+
+def main():
+    import jax
+    assert jax.devices()[0].platform == "tpu", (
+        "this parity check must run on the TPU (compiled kernels); "
+        f"got {jax.devices()}")
+    ok = check_int8_matmul()
+    ok = check_sparse_dma() and ok
+    print("ALL OK" if ok else "FAILURES", flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -30,27 +30,59 @@ import numpy as np
 B, S, L, H, HD, V = 8, 256, 12, 12, 64, 50257
 M = H * HD
 FF = 4 * M
-STEPS = 128
+STEPS = 512
 
 
-def _time_scan(step_fn, carry0):
+def _time_scan(step_fn, carry0, params=()):
+    """``params`` are jit ARGUMENTS (closed-over device arrays would ship
+    as constants inside the remote-compile payload — 124M of weights
+    overflows the compile request)."""
     import jax
     import jax.numpy as jnp
 
-    def run(c0):
+    def run(c0, ps):
         def body(c, _):
-            c = step_fn(c)
+            c = step_fn(c, ps)
             return c, None
         c, _ = jax.lax.scan(body, c0, None, length=STEPS)
         return jax.tree_util.tree_leaves(c)[0].reshape(-1)[0]
     f = jax.jit(run)
-    float(f(carry0))
+    float(f(carry0, params))
     best = float("inf")
     for _ in range(4):
         t0 = time.time()
-        float(f(carry0))
+        float(f(carry0, params))
         best = min(best, time.time() - t0)
-    return best / STEPS
+    return (best - _call_floor()) / STEPS
+
+
+_FLOOR = [None]
+
+
+def _call_floor():
+    """Empty-scan dispatch floor (~100 ms on this remote runtime) — the
+    same subtraction the sparse bench applies; at 512 steps it is still
+    ~15%% of a raw reading."""
+    if _FLOOR[0] is not None:
+        return _FLOOR[0]
+    import jax
+    import jax.numpy as jnp
+
+    def run(x):
+        def body(c, _):
+            return jax.lax.optimization_barrier(c + x[0, 0]), None
+        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=STEPS)
+        return c
+    f = jax.jit(run)
+    x = jnp.ones((2, 2), jnp.float32)
+    float(f(x))
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.time()
+        float(f(x))
+        best = min(best, time.time() - t0)
+    _FLOOR[0] = best
+    return best
 
 
 def main():
@@ -68,7 +100,8 @@ def main():
     cv = jax.random.normal(ks[6], (L, B, S, H, HD), jnp.bfloat16)
     x0 = jax.random.normal(ks[7], (B, M), jnp.bfloat16)
 
-    def mm_stack(x):
+    def mm_stack(x, ps):
+        Wqkv, Wproj, W1, W2, Wte, ck, cv = ps
         for l in range(L):
             qkv = x @ Wqkv[l]
             q = qkv[:, :M]
@@ -80,7 +113,7 @@ def main():
             preferred_element_type=jnp.float32)
         return x, logits
 
-    def attn_read(q, l):
+    def attn_read(q, l, ck, cv):
         qh = q.reshape(B, 1, H, HD)
         s = jnp.einsum("bqhd,bkhd->bhqk", qh, ck[l]).astype(jnp.float32)
         p = jax.nn.softmax(s, axis=-1).astype(qh.dtype)
@@ -94,16 +127,17 @@ def main():
     def _fold(x, logits):
         return x + (logits.sum() * 1e-30).astype(x.dtype)
 
-    def weights_only(c):
+    def weights_only(c, ps):
         x, i = c
-        x, logits = mm_stack(x)
+        x, logits = mm_stack(x, ps)
         return (_fold(x, logits), i + 1)
 
-    def plus_attn_read(c):
+    def plus_attn_read(c, ps):
+        Wqkv, Wproj, W1, W2, Wte, ck, cv = ps
         x, i = c
         for l in range(L):
             qkv = x @ Wqkv[l]
-            a = attn_read(qkv[:, :M], l)
+            a = attn_read(qkv[:, :M], l, ck, cv)
             x = x + a @ Wproj[l]
             h = jax.nn.gelu(x @ W1[l], approximate=True)
             x = x + h @ W2[l]
@@ -112,7 +146,8 @@ def main():
             preferred_element_type=jnp.float32)
         return (_fold(x, logits), i + 1)
 
-    def _cache_write_core(c):
+    def _cache_write_core(c, ps):
+        Wqkv, Wproj, W1, W2, Wte, _, _ = ps
         x, i, k_all, v_all = c
         for l in range(L):
             qkv = x @ Wqkv[l]
@@ -133,25 +168,26 @@ def main():
             preferred_element_type=jnp.float32)
         return x, logits, (i + 1) % S, k_all, v_all
 
-    def plus_cache_write(c):
-        x, logits, i, k_all, v_all = _cache_write_core(c)
+    def plus_cache_write(c, ps):
+        x, logits, i, k_all, v_all = _cache_write_core(c, ps)
         return (_fold(x, logits), i, k_all, v_all)
 
-    def plus_sampling(c):
-        x, logits, i, k_all, v_all = _cache_write_core(c)
+    def plus_sampling(c, ps):
+        x, logits, i, k_all, v_all = _cache_write_core(c, ps)
         tok = jnp.argmax(logits, axis=-1)           # the _select_token path
         x = x + tok[:, None].astype(x.dtype) * 1e-30
         return (x, i, k_all, v_all)
 
+    ps = (Wqkv, Wproj, W1, W2, Wte, ck, cv)
     times = {}
     times["weights_only_ms"] = round(
-        _time_scan(weights_only, (x0, jnp.int32(0))) * 1e3, 3)
+        _time_scan(weights_only, (x0, jnp.int32(0)), ps) * 1e3, 3)
     times["plus_attn_read_ms"] = round(
-        _time_scan(plus_attn_read, (x0, jnp.int32(0))) * 1e3, 3)
+        _time_scan(plus_attn_read, (x0, jnp.int32(0)), ps) * 1e3, 3)
     times["plus_cache_write_ms"] = round(
-        _time_scan(plus_cache_write, (x0, jnp.int32(0), ck, cv)) * 1e3, 3)
+        _time_scan(plus_cache_write, (x0, jnp.int32(0), ck, cv), ps) * 1e3, 3)
     times["plus_sampling_ms"] = round(
-        _time_scan(plus_sampling, (x0, jnp.int32(0), ck, cv)) * 1e3, 3)
+        _time_scan(plus_sampling, (x0, jnp.int32(0), ck, cv), ps) * 1e3, 3)
     for k, v in times.items():
         print(k, v, flush=True)
 

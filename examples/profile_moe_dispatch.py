@@ -85,7 +85,7 @@ def main():
         logits = x.astype(jnp.float32) @ logits_w
         l_aux, idx, loc, w, kept, counts, cap = top1_routes(
             logits, CF, 4, rng=None, use_rts=False)
-        return l_aux + w.sum()
+        return l_aux + w.sum()          # scalar; loss wrapper seeds with x
 
     def routes_of(x):
         logits = x.astype(jnp.float32) @ logits_w
@@ -99,16 +99,17 @@ def main():
         flat = jnp.zeros((E * C, M), x.dtype)
         flat = flat.at[pos].set(x, mode="drop")
         out = flat[jnp.clip(pos, 0, E * C - 1)]
-        return (out * w[:, None].astype(x.dtype)).sum()
+        return out * w[:, None].astype(x.dtype)
 
     def expert_ffn_fn(x):
-        d = jnp.broadcast_to(x[:E * C].reshape(E, C, M), (E, C, M))
+        # (E, C, M) rows from x (tiled to cover capacity padding E*C > S)
+        d = jnp.concatenate([x, x[:E * C - S]]).reshape(E, C, M)
         h = jax.nn.gelu(d @ w1 + b1, approximate=True)
-        return (h @ w2 + b2).sum()
+        return (h @ w2 + b2)
 
     def dense_ffn_fn(x):
         h = jax.nn.gelu(x @ dw1, approximate=True)
-        return (h @ dw2).sum()
+        return h @ dw2
 
     def moe_block_fn(x):
         idx, loc, w = routes_of(x)
@@ -118,15 +119,26 @@ def main():
         d = flat.reshape(E, C, M)
         h = jax.nn.gelu(d @ w1 + b1, approximate=True)
         o = (h @ w2 + b2).reshape(-1, M)
-        out = o[jnp.clip(pos, 0, E * C - 1)] * w[:, None].astype(x.dtype)
-        return out.sum()
+        return o[jnp.clip(pos, 0, E * C - 1)] * w[:, None].astype(x.dtype)
+
+    def make_loss(fn):
+        # x-dependent cotangent: a plain .sum() loss gives an all-ones
+        # cotangent whose backward matmuls XLA collapses algebraically
+        # (column sums - measured "228 TF/s", over hardware peak)
+        def loss(x):
+            out = fn(x)
+            if out.ndim == 0:
+                return out * jnp.sum(x.astype(jnp.float32) ** 2) * 1e-6
+            out2 = out.reshape(-1, M)[:S].astype(jnp.float32)
+            return jnp.sum(out2 * x.astype(jnp.float32)) * 1e-6
+        return loss
 
     parts = {}
     for name, fn in [("gate", gate_fn), ("dispatch", dispatch_fn),
                      ("expert_ffn", expert_ffn_fn),
                      ("dense_ffn", dense_ffn_fn),
                      ("moe_block", moe_block_fn)]:
-        g = jax.grad(lambda x, fn=fn: fn(x).astype(jnp.float32))
+        g = jax.grad(make_loss(fn))
         parts[name + "_fwdbwd_ms"] = round(_timeit(g, x) * 1e3, 3)
         print(name, parts[name + "_fwdbwd_ms"], "ms", flush=True)
     # the carry add costs one (S, M) elementwise pass (~0.04 ms at HBM
