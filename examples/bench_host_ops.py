@@ -10,7 +10,10 @@ Run:  python examples/bench_host_ops.py [--mb 256] [--path /tmp/ds_aio_bench]
 import argparse
 import json
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
