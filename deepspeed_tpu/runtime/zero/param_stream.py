@@ -359,6 +359,9 @@ class ParamStreamRunner:
                     t1 = time.time()
                     self._land_add(*pending, flat)
                     t_d2h += time.time() - t1
+                    # landing reads the bwd outputs — a barrier proving
+                    # the consumed param uploads completed
+                    self._h2d.release_parked()
                 lo, hi = self.layer_bounds[l]
                 pending = (handle, lo, hi)
                 xs[l] = None          # free the saved activation
@@ -420,6 +423,11 @@ class ParamStreamRunner:
         read synchronizes)."""
         if (l + 1) % self.THROTTLE_EVERY == 0:
             np.asarray(jax.device_get(x[0, 0, 0]))
+            # the value read above transitively proves every upload
+            # consumed by layers <= l completed — recycle their staging
+            # buffers (parked pairs never self-observe ready on this
+            # runtime once their settle target is donated downstream)
+            self._h2d.release_parked()
 
     @staticmethod
     def _land_add(handle, lo, hi, flat):

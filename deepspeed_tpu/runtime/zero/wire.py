@@ -118,7 +118,14 @@ class H2DUploader:
     def __init__(self, chunk_bytes=DEFAULT_CHUNK_BYTES):
         self.chunk_bytes = chunk_bytes
         self._staging = []        # reusable host buffers
-        self._inflight = []       # (device_array, staging_buf) pairs
+        # fresh: chunk pairs from upload_flat awaiting their settle_on
+        # (their arrays are donated into the consuming scatter, so they
+        # MUST re-key to its output).  settled: pairs keyed to a settle
+        # target; once THAT is deleted downstream they are parked until
+        # release_parked() — a later settle_on must NOT re-key them (it
+        # would hide their deletion and defeat the recycling barrier).
+        self._fresh = []          # (device_array, staging_buf)
+        self._settled = []        # (settle_target, staging_buf)
 
     def _get_staging(self, nbytes):
         for i, buf in enumerate(self._staging):
@@ -127,28 +134,28 @@ class H2DUploader:
         return np.empty(nbytes, np.uint8)
 
     def _reclaim(self, block=False):
-        still = []
-        for arr, buf in self._inflight:
-            # is_deleted (e.g. the chunk was donated downstream) does NOT
-            # mean the h2d DMA finished reading the staging buffer —
-            # donation marks deletion at dispatch.  Only an observed
-            # is_ready() proves the transfer landed.  A deleted-but-never-
-            # observed-ready pair stays PARKED in the list (keeping the
-            # staging buffer referenced until a later settle_on re-keys it
-            # onto a provable completion point) — dropping it would release
-            # the last Python reference to host memory a DMA may still be
-            # reading, and permanently shrink the staging pool.
-            deleted = arr.is_deleted()
-            done = (not deleted) and arr.is_ready()
-            if block and not done and not deleted:
-                arr.block_until_ready()
-                done = True
-            if done:
-                if buf is not None:
-                    self._staging.append(buf)
-            else:
-                still.append((arr, buf))
-        self._inflight = still
+        def sweep(pairs):
+            still = []
+            for arr, buf in pairs:
+                # is_deleted (e.g. donated downstream) does NOT mean the
+                # h2d DMA finished reading the staging buffer — donation
+                # marks deletion at dispatch.  Only an observed is_ready()
+                # proves the transfer landed.  A deleted-but-never-
+                # observed-ready pair stays PARKED (buffer referenced)
+                # until release_parked() at a caller-proven barrier.
+                deleted = arr.is_deleted()
+                done = (not deleted) and arr.is_ready()
+                if block and not done and not deleted:
+                    arr.block_until_ready()
+                    done = True
+                if done:
+                    if buf is not None:
+                        self._staging.append(buf)
+                else:
+                    still.append((arr, buf))
+            return still
+        self._settled = sweep(self._settled)
+        self._fresh = sweep(self._fresh)
 
     def upload_flat(self, host_flat, *, device=None, stage=False):
         """host flat array -> list of device chunk arrays (async)."""
@@ -168,17 +175,34 @@ class H2DUploader:
             arr = (jax.device_put(src, device) if device is not None
                    else jax.device_put(src))
             out.append(arr)
-            self._inflight.append((arr, buf))
+            self._fresh.append((arr, buf))
         return out
 
     def settle_on(self, arr):
-        """Re-key every in-flight staging buffer onto ``arr`` — a
-        downstream array whose readiness implies the uploads' DMAs have
-        completed (e.g. the output of a jit that consumed the donated
-        chunks: the compute that overwrites a donated chunk cannot run
-        before its h2d transfer lands, so output-ready ⇒ transfers done).
-        Lets chunk donation and staging-buffer recycling coexist."""
-        self._inflight = [(arr, buf) for _, buf in self._inflight]
+        """Re-key the FRESH (just-uploaded, donated-into-the-scatter)
+        chunk pairs onto ``arr`` — a downstream array whose readiness
+        implies their DMAs completed (the compute that overwrites a
+        donated chunk cannot run before its h2d transfer lands).
+        Already-settled pairs are NOT re-keyed: once their own target is
+        deleted downstream they are parked, and re-keying them onto ever-
+        newer targets would hide the deletion and defeat
+        :meth:`release_parked` (the r5 6.7B probe leaked a staging buffer
+        per layer fetch exactly this way)."""
+        self._settled += [(arr, buf) for _, buf in self._fresh]
+        self._fresh = []
+
+    def release_parked(self):
+        """Recycle parked pairs after the CALLER has executed a true
+        completion barrier (a VALUE READ of a downstream result — on
+        remote-attached runtimes ``is_ready``/``block_until_ready`` may
+        never observe donated-then-deleted settle targets).  Only call at
+        a point that PROVES every previously dispatched consumer ran
+        (e.g. after reading a value that transitively depends on them)."""
+        for arr, buf in self._settled:
+            if arr.is_deleted() and buf is not None:
+                self._staging.append(buf)
+        self._settled = [(a, b) for a, b in self._settled
+                         if not a.is_deleted()]
 
     def wait(self):
         self._reclaim(block=True)

@@ -78,7 +78,7 @@ def measure(dispatch_impl, micro, steps, warmup=2, seq=1024):
             "loss": round(final, 3)}
 
 
-def measure_16e_offload(micro=8, steps=2, warmup=1, seq=1024):
+def measure_16e_offload(micro=1, steps=2, warmup=1, seq=1024):
     """The FULL 16-expert model on one chip through the tier built for it
     (VERDICT r4 next #2): ~1.9B total params — bf16 images + grads fit the
     16 GB HBM, the fp32 Adam states do NOT, so ``offload_optimizer`` holds

@@ -91,9 +91,18 @@ def try_size(n_embd, n_layer, n_head, seq=SEQ, micro=1):
         os.path.abspath(__file__))), ".nvme_probe")
     os.makedirs(nvme, exist_ok=True)
     off_opt = {"device": moments}
+    off_param = {"device": "cpu", "fast_init": True}
+    sub_group = int(5e8)
     if moments == "nvme":
         off_opt.update(nvme_path=nvme, pipeline_read=True,
                        pipeline_write=True)
+        # big rungs: the 16-bit param payload ALSO moves to NVMe
+        # (drop_payload frees the RAM image — 13.4 GB at 6.7B; the r5
+        # first 6.7B attempt host-OOM'd at 130.7/125 GB with the image
+        # resident), and smaller sub-groups halve the moment-swap pools
+        off_param = {"device": "nvme", "nvme_path": nvme,
+                     "fast_init": True}
+        sub_group = int(2.5e8)
     config = {
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": 1,
@@ -103,9 +112,9 @@ def try_size(n_embd, n_layer, n_head, seq=SEQ, micro=1):
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "zero_optimization": {
             "stage": 3,
-            "sub_group_size": int(5e8),
+            "sub_group_size": sub_group,
             "offload_optimizer": off_opt,
-            "offload_param": {"device": "cpu", "fast_init": True}},
+            "offload_param": off_param},
     }
     toks = np.random.default_rng(0).integers(
         0, model.config.vocab_size, (2 * micro, seq + 1)).astype(np.int32)
@@ -276,7 +285,8 @@ def main():
                          "finite losses",
             "per_size": results,
             "ram_arithmetic_bytes_per_param": {
-                "fp32_master": 4, "fp32_grad_accum": 4, "16bit_image": 2,
+                "fp32_master": 4, "fp32_grad_accum": 4,
+                "16bit_image": "2 (cpu param tier) / 0 (nvme tier)",
                 "adam_moments": "0 (NVMe) / 8 (cpu)"},
             "note": ("offload_param streaming: 16-bit layer blocks stream "
                      "host->device in fwd AND bwd (zero/param_stream.py); "
