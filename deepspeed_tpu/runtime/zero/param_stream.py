@@ -40,6 +40,7 @@ in per-layer files serviced by the kernel-AIO op (no host-RAM image);
 reads prefetch ahead of the layer loop.
 """
 
+import os
 import time
 
 import numpy as np
@@ -410,7 +411,22 @@ class ParamStreamRunner:
                 "overflow": jnp.asarray(False), "lr": jnp.asarray(lr),
                 "loss_scale": jnp.asarray(1.0)}
 
-    THROTTLE_EVERY = 4    # forward-loop sync cadence (layers)
+    @property
+    def THROTTLE_EVERY(self):
+        """Forward-loop sync cadence (layers); tighter = smaller in-flight
+        upload window (host RAM) at the cost of more syncs — the
+        max-params probe sets 2 via env to squeeze under the 125 GB
+        host.  Read per-use so setting the env after import still works;
+        clamped to >= 1 (0 would divide by zero in the layer loop)."""
+        try:
+            return max(1, int(os.environ.get("DS_TPU_STREAM_THROTTLE", "4")))
+        except ValueError:
+            logger.warning("DS_TPU_STREAM_THROTTLE is not an int; using 4")
+            return 4
+
+    @property
+    def GC_AT_THROTTLE(self):
+        return os.environ.get("DS_TPU_STREAM_GC", "0") == "1"
 
     def _throttle(self, l, x):
         """Backpressure for the forward stream: without it the Python loop
@@ -428,6 +444,9 @@ class ParamStreamRunner:
             # buffers (parked pairs never self-observe ready on this
             # runtime once their settle target is donated downstream)
             self._h2d.release_parked()
+            if self.GC_AT_THROTTLE:
+                import gc
+                gc.collect()      # drop cyclic refs pinning transfer state
 
     @staticmethod
     def _land_add(handle, lo, hi, flat):
