@@ -7,6 +7,7 @@ compute path is jitted SPMD over a named device mesh, not a port of the
 reference's torch/CUDA machinery.
 """
 
+from .utils import jax_compat as _jax_compat  # must precede runtime imports
 from .version import __version__
 from .runtime.activation_checkpointing import checkpointing
 from .runtime.engine import DeepSpeedEngine
@@ -20,13 +21,20 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                training_data=None, lr_scheduler=None, mpu=None,
                dist_init_required=None, collate_fn=None, config=None,
                config_params=None, mesh=None, loss_fn=None, params=None,
-               apply_fn=None, rng_seed=0):
+               apply_fn=None, rng_seed=0, auto_resume=None):
     """Initialize the engine. Returns ``(engine, optimizer, dataloader, lr_scheduler)``.
 
     Parity: reference ``deepspeed/__init__.py:51-151``.  ``args.deepspeed_config``
     is honored when ``config`` is not given.  If the model is a
     ``PipelineModule``, a ``PipelineEngine`` is built instead
     (reference ``__init__.py:119-143``).
+
+    ``auto_resume=True`` (or config ``checkpoint.auto_resume``, or env
+    ``DSTPU_AUTO_RESUME=1`` as set by ``deepspeed --auto-resume``) restarts
+    the job from the newest *valid* checkpoint under ``checkpoint.dir`` when
+    one exists — the restart path of a preempted TPU job
+    (docs/fault-tolerance.md).  A missing or empty checkpoint dir is a
+    normal cold start, not an error.
     """
     if config is None and config_params is not None:
         config = config_params
@@ -55,7 +63,40 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                                  params=params, apply_fn=apply_fn,
                                  rng_seed=rng_seed, mpu=mpu,
                                  dist_init_required=dist_init_required)
+    _maybe_auto_resume(engine, auto_resume)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def _maybe_auto_resume(engine, auto_resume):
+    """Resolve the auto-resume request (kwarg > env > config) and restart
+    from the newest valid checkpoint in ``checkpoint.dir`` if any."""
+    import os
+    ckpt_cfg = engine.config.checkpoint_config
+    if auto_resume is None:
+        # precedence: kwarg > env (when set, can also DISABLE) > config
+        env = os.environ.get("DSTPU_AUTO_RESUME")
+        if env:
+            auto_resume = env.lower() in ("1", "true", "yes")
+        else:
+            auto_resume = ckpt_cfg.auto_resume
+    if not auto_resume:
+        return
+    load_dir = ckpt_cfg.dir
+    if not load_dir:
+        from .runtime.config import DeepSpeedConfigError
+        raise DeepSpeedConfigError(
+            "auto_resume needs checkpoint.dir in the config (where to look)")
+    from .checkpoint import atomic
+    atomic.clean_stale_staging(load_dir,
+                               min_age_s=atomic.LOAD_STAGING_MIN_AGE_S)
+    # cheap cold-start detection only; tag resolution + manifest
+    # verification (and torn-tag fallback) happen inside load_checkpoint
+    if not atomic.has_checkpoint(load_dir):
+        log_dist(f"auto_resume: no checkpoint in {load_dir}; cold start",
+                 ranks=[0])
+        return
+    path, _ = engine.load_checkpoint(load_dir)
+    log_dist(f"auto_resume: restarted from {path}", ranks=[0])
 
 
 def init_distributed(dist_backend=None, auto_mpi_discovery=True,

@@ -2,6 +2,7 @@
 ``deepspeed/checkpoint/``."""
 
 from . import constants
+from . import atomic
 from .serialization import save_tree, load_tree, restore_like
 
-__all__ = ["constants", "save_tree", "load_tree", "restore_like"]
+__all__ = ["constants", "atomic", "save_tree", "load_tree", "restore_like"]

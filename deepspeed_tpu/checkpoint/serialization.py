@@ -12,10 +12,14 @@ what makes checkpoints elastically reshardable across mesh changes
 
 import io
 import json
+import os
 import zipfile
 
 import numpy as np
 import jax
+
+from .. import fault
+from ..utils.retry import RetryPolicy, retry_call
 
 
 def _flatten_with_paths(tree):
@@ -37,22 +41,39 @@ def _resolve_dtype(name):
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def save_tree(path, tree, meta=None):
+def save_tree(path, tree, meta=None, fsync=True, retry=None):
     """Write a pytree of (possibly sharded, device) arrays to one file.
 
     Leaves are stored as raw bytes + a dtype-name/shape record so exotic
-    accelerator dtypes (bfloat16, float8) survive the round trip.
+    accelerator dtypes (bfloat16, float8) survive the round trip.  The file
+    is flushed + fsynced before close (crash mid-save must never leave a
+    page-cache-only "file" that a later commit would hash); transient IO
+    errors are retried with bounded backoff.
     """
     flat, treedef = _flatten_with_paths(tree)
-    index = {}
-    with zipfile.ZipFile(path, "w", compression=zipfile.ZIP_STORED) as zf:
-        if meta is not None:
-            zf.writestr("meta.json", json.dumps(meta))
-        for key, leaf in flat.items():
-            arr = np.asarray(leaf)  # gathers sharded arrays to host
-            index[key] = {"dtype": arr.dtype.name, "shape": list(arr.shape)}
-            zf.writestr(f"arrays/{key}.bin", arr.tobytes())
-        zf.writestr("treedef.json", json.dumps({"index": index}))
+
+    def _write():
+        # gather leaf-by-leaf INSIDE the write loop: peak host RAM holds one
+        # leaf, not a full checkpoint copy (a retry re-gathers — rare and
+        # cheap relative to OOM-killing a beyond-HBM save)
+        fault.site("io.write", path=path)
+        index = {}
+        with open(path, "wb") as f:
+            with zipfile.ZipFile(f, "w", compression=zipfile.ZIP_STORED) as zf:
+                if meta is not None:
+                    zf.writestr("meta.json", json.dumps(meta))
+                for key, leaf in flat.items():
+                    arr = np.asarray(leaf)  # gathers sharded arrays to host
+                    index[key] = {"dtype": arr.dtype.name,
+                                  "shape": list(arr.shape)}
+                    zf.writestr(f"arrays/{key}.bin", arr.tobytes())
+                zf.writestr("treedef.json", json.dumps({"index": index}))
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+
+    retry_call(_write, policy=retry or RetryPolicy(),
+               describe=f"save_tree({path})")
 
 
 def restore_like(target_tree, loaded):
@@ -69,27 +90,34 @@ def restore_like(target_tree, loaded):
         jax.tree_util.tree_structure(target_tree), leaves)
 
 
-def load_tree(path, with_meta=False):
+def load_tree(path, with_meta=False, retry=None):
     """Read back as a nested dict (dict-of-dicts mirror of the saved pytree).
 
     The caller device_puts leaves with its own shardings; structure is
-    reconstructed from the path keys.
+    reconstructed from the path keys.  Transient IO errors are retried with
+    bounded backoff.
     """
-    with zipfile.ZipFile(path, "r") as zf:
-        meta = None
-        if "meta.json" in zf.namelist():
-            meta = json.loads(zf.read("meta.json"))
-        index = json.loads(zf.read("treedef.json"))["index"]
-        tree = {}
-        for key, rec in index.items():
-            raw = zf.read(f"arrays/{key}.bin")
-            arr = np.frombuffer(raw, dtype=_resolve_dtype(rec["dtype"]))
-            arr = arr.reshape(rec["shape"])
-            parts = key.split("/")
-            node = tree
-            for p in parts[:-1]:
-                node = node.setdefault(p, {})
-            node[parts[-1]] = arr
+    def _read():
+        fault.site("io.read", path=path)
+        with zipfile.ZipFile(path, "r") as zf:
+            meta = None
+            if "meta.json" in zf.namelist():
+                meta = json.loads(zf.read("meta.json"))
+            index = json.loads(zf.read("treedef.json"))["index"]
+            tree = {}
+            for key, rec in index.items():
+                raw = zf.read(f"arrays/{key}.bin")
+                arr = np.frombuffer(raw, dtype=_resolve_dtype(rec["dtype"]))
+                arr = arr.reshape(rec["shape"])
+                parts = key.split("/")
+                node = tree
+                for p in parts[:-1]:
+                    node = node.setdefault(p, {})
+                node[parts[-1]] = arr
+        return tree, meta
+
+    tree, meta = retry_call(_read, policy=retry or RetryPolicy(),
+                            describe=f"load_tree({path})")
     if with_meta:
         return tree, meta
     return tree

@@ -1,0 +1,167 @@
+"""Deterministic fault-injection harness for host-side IO paths.
+
+Every recovery path in the fault-tolerance layer (atomic checkpoint commit,
+manifest-validated load, retry/backoff IO) is *provable* in tests because
+the failures themselves are injectable: serialization, the NVMe swappers,
+and the engine's host-side step wrapper call ``fault.site(name)`` at named
+points, and an armed plan turns those calls into crashes, IO errors, or
+delays.
+
+Zero overhead when disabled: ``site()`` is one module-global load and an
+``is None`` test.  Hooks live ONLY in host-side Python IO code — never
+inside jitted functions — so the compiled step is byte-identical with the
+harness armed or not (asserted by a tier-1 test via jaxpr equality).
+
+Configuration (env ``DSTPU_FAULT`` or ``configure(spec)``) is a
+comma-separated spec, e.g.::
+
+    DSTPU_FAULT=ckpt_crash_after_model_file,io_error_p=0.2,io_delay_ms=50
+
+tokens:
+- ``crash_at=<site>``              raise ``InjectedCrash`` at the named site
+                                   (one-shot: disarms after firing so the
+                                   recovery path can run in-process)
+- ``<area>_crash_<point>``         sugar for ``crash_at=<area>.<point>``
+                                   (``ckpt_crash_after_model_file`` ->
+                                   ``ckpt.after_model_file``)
+- ``io_error_p=<float>``           each ``io.*``/``aio.*`` site raises
+                                   ``InjectedIOError`` with probability p
+- ``io_delay_ms=<float>``          sleep this long at each io site
+- ``max_faults=<int>``             cap on injected io errors (determinism)
+- ``seed=<int>``                   seed for the probability draws
+
+Known sites (kept in ``SITES`` so tests and docs can't drift): checkpoint
+commit protocol (``ckpt.*``), tree serialization (``io.read``/``io.write``),
+AIO submits (``aio.submit``), and the engine's host-side step boundary
+(``engine.step``).
+"""
+
+import os
+import random
+import time
+
+from ..utils.logging import logger
+
+SITES = (
+    "ckpt.after_model_file",   # model_states written to staging, optim not yet
+    "ckpt.after_optim_file",   # both state files staged, manifest not yet
+    "ckpt.before_commit",      # manifest staged, final rename not yet done
+    "ckpt.after_commit",       # committed, `latest` pointer not yet updated
+    "ckpt.before_latest",      # inside the latest-pointer update, pre-rename
+    "io.write",                # serialization writes (save_tree)
+    "io.read",                 # serialization reads (load_tree)
+    "aio.submit",              # NVMe swap read/write submission
+    "engine.step",             # host-side train_batch boundary
+)
+
+_IO_PREFIXES = ("io.", "aio.")
+
+
+class InjectedCrash(BaseException):
+    """Simulated preemption/kill at a named site.  Derives from
+    BaseException so ordinary ``except Exception``/``except OSError``
+    recovery code cannot accidentally swallow a "kill" — exactly like a
+    real SIGKILL, only the test harness catches it."""
+
+
+class InjectedIOError(OSError):
+    """Simulated transient IO failure (retriable by classification)."""
+
+
+class FaultPlan:
+    def __init__(self, crash_sites=(), io_error_p=0.0, io_delay_ms=0.0,
+                 max_faults=None, seed=0):
+        unknown = set(crash_sites) - set(SITES)
+        assert not unknown, f"unknown fault sites {sorted(unknown)}; " \
+                            f"valid: {SITES}"
+        self.crash_sites = set(crash_sites)
+        self.io_error_p = float(io_error_p)
+        self.io_delay_ms = float(io_delay_ms)
+        self.max_faults = max_faults
+        self.rng = random.Random(seed)
+        self.injected_io_errors = 0
+        self.hits = {}            # site -> visit count (test observability)
+
+    @classmethod
+    def from_spec(cls, spec):
+        crash, kw = [], {}
+        for token in str(spec).split(","):
+            token = token.strip()
+            if not token:
+                continue
+            if "=" in token:
+                key, val = token.split("=", 1)
+                key = key.strip()
+                if key == "crash_at":
+                    crash.append(val.strip())
+                elif key in ("io_error_p", "io_delay_ms"):
+                    kw[key] = float(val)
+                elif key in ("max_faults", "seed"):
+                    kw[key] = int(val)
+                else:
+                    raise ValueError(f"unknown fault spec key {key!r}")
+            elif "_crash_" in token:
+                area, point = token.split("_crash_", 1)
+                crash.append(f"{area}.{point}")
+            else:
+                raise ValueError(f"cannot parse fault spec token {token!r}")
+        return cls(crash_sites=crash, **kw)
+
+
+_PLAN = None  # None = disabled; site() is a load + `is None` test
+
+
+def configure(spec=None, **kwargs):
+    """Arm the harness from a spec string, a FaultPlan, or kwargs."""
+    global _PLAN
+    if isinstance(spec, FaultPlan):
+        _PLAN = spec
+    elif spec is not None:
+        _PLAN = FaultPlan.from_spec(spec)
+    else:
+        _PLAN = FaultPlan(**kwargs)
+    logger.warning(f"fault injection ARMED: crash_sites="
+                   f"{sorted(_PLAN.crash_sites)} io_error_p={_PLAN.io_error_p} "
+                   f"io_delay_ms={_PLAN.io_delay_ms}")
+    return _PLAN
+
+
+def reset():
+    global _PLAN
+    _PLAN = None
+
+
+def is_enabled():
+    return _PLAN is not None
+
+
+def plan():
+    return _PLAN
+
+
+def site(name, path=None):
+    """Fault hook.  Host-side IO code only — never call under jit."""
+    if _PLAN is None:
+        return
+    p = _PLAN
+    p.hits[name] = p.hits.get(name, 0) + 1
+    if name in p.crash_sites:
+        p.crash_sites.discard(name)   # one-shot: recovery can proceed
+        raise InjectedCrash(f"injected crash at {name}"
+                            + (f" ({path})" if path else ""))
+    if name.startswith(_IO_PREFIXES):
+        if p.io_delay_ms > 0:
+            time.sleep(p.io_delay_ms / 1e3)
+        if p.io_error_p > 0 and (p.max_faults is None
+                                 or p.injected_io_errors < p.max_faults):
+            if p.rng.random() < p.io_error_p:
+                p.injected_io_errors += 1
+                raise InjectedIOError(
+                    f"injected IO error at {name}"
+                    + (f" ({path})" if path else ""))
+
+
+# env wiring: a preemption-test job (or `deepspeed --fault=...` launch) arms
+# the harness before any engine code runs
+if os.environ.get("DSTPU_FAULT"):
+    configure(os.environ["DSTPU_FAULT"])

@@ -52,6 +52,15 @@ def parse_args(args=None):
     parser.add_argument("--autotuning", type=str, default="",
                         choices=["", "tune", "run"],
                         help="Run the autotuner instead of the job")
+    parser.add_argument("--auto-resume", action="store_true",
+                        dest="auto_resume",
+                        help="Restart from the newest valid checkpoint under "
+                             "the config's checkpoint.dir (sets "
+                             "DSTPU_AUTO_RESUME=1 for the job; see "
+                             "docs/fault-tolerance.md)")
+    parser.add_argument("--fault", type=str, default="",
+                        help="Arm the fault-injection harness for the job "
+                             "(sets DSTPU_FAULT=<spec>; test/chaos runs only)")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -167,6 +176,10 @@ def main(args=None):
         active = None
 
     env = os.environ.copy()
+    if args.auto_resume:
+        env["DSTPU_AUTO_RESUME"] = "1"
+    if args.fault:
+        env["DSTPU_FAULT"] = args.fault
     cmd_tail = [args.user_script] + list(args.user_args)
 
     if not active or (len(active) == 1 and not args.force_multi):

@@ -214,6 +214,56 @@ class DeepSpeedCheckpointConfig:
                 f"checkpoint.tag_validation must be one of {C.CHECKPOINT_TAG_VALIDATION_MODES}")
         self.load_universal = get_scalar_param(ckpt_dict, C.LOAD_UNIVERSAL_CHECKPOINT,
                                                C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT)
+        # fault-tolerance layer (docs/fault-tolerance.md)
+        self.keep_n = get_scalar_param(ckpt_dict, C.CHECKPOINT_KEEP_N,
+                                       C.CHECKPOINT_KEEP_N_DEFAULT)
+        if self.keep_n is None:
+            self.keep_n = 0
+        if int(self.keep_n) < 0:
+            raise DeepSpeedConfigError("checkpoint.keep_n must be >= 0")
+        self.keep_n = int(self.keep_n)
+        self.verify = get_scalar_param(ckpt_dict, C.CHECKPOINT_VERIFY,
+                                       C.CHECKPOINT_VERIFY_DEFAULT)
+        if self.verify not in C.CHECKPOINT_VERIFY_MODES:
+            raise DeepSpeedConfigError(
+                f"checkpoint.verify must be one of {C.CHECKPOINT_VERIFY_MODES}")
+        self.auto_resume = get_scalar_param(ckpt_dict, C.CHECKPOINT_AUTO_RESUME,
+                                            C.CHECKPOINT_AUTO_RESUME_DEFAULT)
+        self.dir = get_scalar_param(ckpt_dict, C.CHECKPOINT_DIR,
+                                    C.CHECKPOINT_DIR_DEFAULT)
+        self.fsync = get_scalar_param(ckpt_dict, C.CHECKPOINT_FSYNC,
+                                      C.CHECKPOINT_FSYNC_DEFAULT)
+
+
+class DeepSpeedIORetryConfig:
+    """Bounded-backoff policy for checkpoint + NVMe-swap IO
+    (``utils/retry.py``; docs/fault-tolerance.md)."""
+
+    def __init__(self, param_dict):
+        r = get_dict_param(param_dict, C.IO_RETRY, {}) or {}
+        self.max_attempts = int(get_scalar_param(
+            r, C.IO_RETRY_MAX_ATTEMPTS, C.IO_RETRY_MAX_ATTEMPTS_DEFAULT))
+        self.base_delay_s = float(get_scalar_param(
+            r, C.IO_RETRY_BASE_DELAY_S, C.IO_RETRY_BASE_DELAY_S_DEFAULT))
+        self.max_delay_s = float(get_scalar_param(
+            r, C.IO_RETRY_MAX_DELAY_S, C.IO_RETRY_MAX_DELAY_S_DEFAULT))
+        self.jitter = float(get_scalar_param(
+            r, C.IO_RETRY_JITTER, C.IO_RETRY_JITTER_DEFAULT))
+        if self.max_attempts < 1:
+            raise DeepSpeedConfigError("io_retry.max_attempts must be >= 1")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise DeepSpeedConfigError(
+                "io_retry.base_delay_s/max_delay_s must be >= 0")
+        if not (0.0 <= self.jitter < 1.0):
+            raise DeepSpeedConfigError("io_retry.jitter must be in [0, 1)")
+
+    def policy(self, **overrides):
+        from ..utils.retry import RetryPolicy
+        kw = dict(max_attempts=self.max_attempts,
+                  base_delay_s=self.base_delay_s,
+                  max_delay_s=self.max_delay_s, jitter=self.jitter)
+        kw.update(overrides)
+        return RetryPolicy(**kw)
 
 
 class DeepSpeedMeshConfig:
@@ -436,6 +486,7 @@ class DeepSpeedConfig:
         self.eigenvalue = DeepSpeedEigenvalueConfig(pd)
         self.quantize_training = DeepSpeedQuantizeTrainingConfig(pd)
         self.checkpoint_config = DeepSpeedCheckpointConfig(pd)
+        self.io_retry_config = DeepSpeedIORetryConfig(pd)
         self.mesh_config = DeepSpeedMeshConfig(pd)
         self.sequence_parallel = DeepSpeedSequenceParallelConfig(pd)
         self.wall_clock_breakdown = get_scalar_param(pd, C.WALL_CLOCK_BREAKDOWN,

@@ -258,6 +258,32 @@ CHECKPOINT_TAG_VALIDATION_MODES = ["Warn", "Ignore", "Fail"]
 LOAD_UNIVERSAL_CHECKPOINT = "load_universal"
 LOAD_UNIVERSAL_CHECKPOINT_DEFAULT = False
 
+# fault-tolerance layer: atomic-commit retention / validation / auto-resume
+CHECKPOINT_KEEP_N = "keep_n"
+CHECKPOINT_KEEP_N_DEFAULT = 0          # 0 = keep everything
+CHECKPOINT_VERIFY = "verify"
+CHECKPOINT_VERIFY_DEFAULT = "full"     # full | size | off
+CHECKPOINT_VERIFY_MODES = ["full", "size", "off"]
+CHECKPOINT_AUTO_RESUME = "auto_resume"
+CHECKPOINT_AUTO_RESUME_DEFAULT = False
+CHECKPOINT_DIR = "dir"
+CHECKPOINT_DIR_DEFAULT = None
+CHECKPOINT_FSYNC = "fsync"
+CHECKPOINT_FSYNC_DEFAULT = True
+
+#############################################
+# IO retry (checkpoint + NVMe swap backoff)
+#############################################
+IO_RETRY = "io_retry"
+IO_RETRY_MAX_ATTEMPTS = "max_attempts"
+IO_RETRY_MAX_ATTEMPTS_DEFAULT = 5
+IO_RETRY_BASE_DELAY_S = "base_delay_s"
+IO_RETRY_BASE_DELAY_S_DEFAULT = 0.05
+IO_RETRY_MAX_DELAY_S = "max_delay_s"
+IO_RETRY_MAX_DELAY_S_DEFAULT = 2.0
+IO_RETRY_JITTER = "jitter"
+IO_RETRY_JITTER_DEFAULT = 0.25
+
 #############################################
 # Dataloader
 #############################################

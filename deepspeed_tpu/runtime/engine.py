@@ -48,9 +48,7 @@ from ..parallel import mesh as M
 from ..utils.logging import logger, log_dist
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
-MODEL_FILE = "model_states.msgpack"
-OPTIM_FILE = "optim_states.msgpack"
-LATEST_FILE = "latest"
+from ..checkpoint.constants import MODEL_FILE, OPTIM_FILE
 
 
 class TrainState(NamedTuple):
@@ -287,7 +285,8 @@ class DeepSpeedEngine:
                     compute_dtype_name=self.config.precision_dtype,
                     consume_params=True,
                     payload_in_ram=(self.config.zero_config
-                                    .offload_param_device() == "cpu"))
+                                    .offload_param_device() == "cpu"),
+                    retry=self.config.io_retry_config.policy())
                 del stream_tree
                 # init tree freed — NOW allocate grad buffer + RAM image
                 self._offload.alloc_buffers()
@@ -296,13 +295,15 @@ class DeepSpeedEngine:
                     gas=self.config.gradient_accumulation_steps,
                     grad_clip=self.config.gradient_clipping,
                     zero_config=self.config.zero_config,
-                    aio_config=self.config.aio_config)
+                    aio_config=self.config.aio_config,
+                    retry=self.config.io_retry_config.policy())
             else:
                 self._offload = HostOffloadOptimizer(
                     params0, self.config.zero_config, self.config.aio_config,
                     optimizer_name=name,
                     optimizer_params=self.config.optimizer_params,
-                    compute_dtype_name=self.config.precision_dtype)
+                    compute_dtype_name=self.config.precision_dtype,
+                    retry=self.config.io_retry_config.policy())
         # one-step delayed parameter update (ZeRO-Offload DPU): device step
         # k+1 overlaps the host optimizer+transfers for step k
         off_cfg = self.config.zero_config.offload_optimizer
@@ -855,6 +856,8 @@ class DeepSpeedEngine:
         Parity: ``PipelineEngine.train_batch`` naming; for the non-pipeline
         engine this replaces the forward/backward/step trio with one call.
         """
+        from .. import fault
+        fault.site("engine.step")    # host-side only; never traced
         it = data_iter if data_iter is not None else self._data_iterator
         assert it is not None, "train_batch needs training_data or a data_iter"
         gas = self.gradient_accumulation_steps()
@@ -1254,12 +1257,27 @@ class DeepSpeedEngine:
         Arrays are gathered to host; ZeRO-sharded state is saved in full so
         checkpoints reshard freely across mesh-size changes (the reference
         needs ``elastic_checkpoint`` machinery for this; here resharding is a
-        device_put)."""
+        device_put).
+
+        Crash-consistent (docs/fault-tolerance.md): every file goes into a
+        ``<tag>.tmp`` staging dir, a SHA-256 manifest is recorded, and the
+        checkpoint is published by one ``os.rename``; the ``latest`` pointer
+        is updated write-temp-then-rename only after commit.  A kill at any
+        instant leaves either the previous checkpoint set intact or the new
+        tag fully committed — never a torn tag that ``latest`` points at."""
         from ..checkpoint.serialization import save_tree
+        from ..checkpoint import atomic
+        from .. import fault
         self._flush_offload()
         tag = tag or f"global_step{self.global_steps}"
-        path = self._get_ckpt_name(save_dir, tag)
-        os.makedirs(path, exist_ok=True)
+        retry = self.config.io_retry_config.policy()
+        fsync = self.config.checkpoint_config.fsync
+        os.makedirs(save_dir, exist_ok=True)
+        # drop staging leftovers of killed saves (any tag) and restore an
+        # orphaned `.replaced` before staging anew
+        atomic.clean_stale_staging(save_dir)
+        path = atomic.stage_path(save_dir, tag)
+        os.makedirs(path)
 
         engine_meta = {
             "global_steps": self.global_steps,
@@ -1277,8 +1295,12 @@ class DeepSpeedEngine:
         params_out = (self._param_stream.full_params_host()
                       if self._param_stream is not None
                       else self.state.params)
+        # fsync deferred to commit_staged: one durability pass per file,
+        # not two (the manifest hash reads the page cache either way)
         save_tree(os.path.join(path, MODEL_FILE),
-                  {"params": params_out}, meta=engine_meta)
+                  {"params": params_out}, meta=engine_meta,
+                  fsync=False, retry=retry)
+        fault.site("ckpt.after_model_file")
         if self._offload is not None:
             # host-resident state saved in the SAME layout as the in-device
             # AdamState (param-shaped moment pytrees + full master pytree),
@@ -1300,15 +1322,33 @@ class DeepSpeedEngine:
                 optim_tree["master"] = self.state.master
         if self.state.scale is not None:
             optim_tree["scale"] = self.state.scale
-        save_tree(os.path.join(path, OPTIM_FILE), optim_tree)
+        save_tree(os.path.join(path, OPTIM_FILE), optim_tree,
+                  fsync=False, retry=retry)
+        fault.site("ckpt.after_optim_file")
 
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(tag)
+        # everything that belongs to the tag — recovery script and gathered
+        # 16-bit weights included — is staged and manifested BEFORE commit
         self._copy_recovery_script(path)
         if self.config.zero_config.gather_16bit_weights_on_model_save:
-            self.save_16bit_model(path)
-        log_dist(f"saved checkpoint {path}", ranks=[0])
+            self.save_16bit_model(path, fsync=False, retry=retry)
+        atomic.write_manifest(path, meta={
+            "tag": tag,
+            "global_steps": self.global_steps,
+            "format_version": 1,
+        })
+        fault.site("ckpt.before_commit")
+        final = atomic.commit_staged(save_dir, tag, fsync=fsync)
+        fault.site("ckpt.after_commit")
+        if save_latest:
+            atomic.write_latest(save_dir, tag)
+        keep_n = self.config.checkpoint_config.keep_n
+        if keep_n:
+            # rotation's newest-valid probe uses the cheap size level: the
+            # retained tags were hash-verified at commit, and re-hashing
+            # them all on every save would put O(keep_n · ckpt_bytes) of
+            # SHA-256 on the training hot path
+            atomic.rotate_checkpoints(save_dir, keep_n)
+        log_dist(f"saved checkpoint {final}", ranks=[0])
         return True
 
     def _copy_recovery_script(self, save_path):
@@ -1325,7 +1365,8 @@ class DeepSpeedEngine:
         except OSError as e:
             logger.warning(f"could not copy recovery script: {e}")
 
-    def save_16bit_model(self, save_dir, save_filename="model_16bit.msgpack"):
+    def save_16bit_model(self, save_dir, save_filename="model_16bit.msgpack",
+                         fsync=True, retry=None):
         """Save the full (gathered) params in the 16-bit compute dtype
         (parity: reference ``engine.py:3194 save_16bit_model`` /
         ``_zero3_consolidated_16bit_state_dict`` :3118 — with sharded state
@@ -1338,27 +1379,96 @@ class DeepSpeedEngine:
                       if self._param_stream is not None
                       else self.state.params)
         save_tree(path, {"params": params_out},
-                  meta={"dtype": self.config.precision_dtype})
+                  meta={"dtype": self.config.precision_dtype},
+                  fsync=fsync, retry=retry)
         log_dist(f"saved 16-bit model to {path}", ranks=[0])
         return True
 
+    def _resolve_checkpoint_tag(self, load_dir, tag):
+        """Validating, self-healing tag resolution (docs/fault-tolerance.md):
+
+        - explicit ``tag``: manifest must verify, else raise
+          ``CheckpointValidationError`` (the caller asked for *that* state);
+        - ``latest`` pointer: verify; on mismatch, a missing pointer, or a
+          pointer at a torn/uncommitted tag, fall back to the newest valid
+          tag with one structured warning;
+        - a tag without a manifest (pre-fault-tolerance layout) loads with a
+          warning instead of failing — old checkpoints stay readable.
+        """
+        from ..checkpoint import atomic
+        # restore an orphaned `.replaced` (killed same-tag re-commit) on
+        # EVERY load path, not just auto_resume.  `.tmp` cleanup is age-
+        # guarded here: a reader sharing a live trainer's dir must not
+        # delete an in-flight save's staging dir (loads never need the
+        # cleanup for correctness; the next save sweeps the garbage)
+        atomic.clean_stale_staging(load_dir,
+                                   min_age_s=atomic.LOAD_STAGING_MIN_AGE_S)
+        verify = self.config.checkpoint_config.verify
+        explicit = tag is not None
+        problems = []
+        if tag is None:
+            tag = atomic.read_latest(load_dir)
+            if tag is None:
+                problems.append(f"no `latest` pointer in {load_dir}")
+        if tag is not None:
+            path = self._get_ckpt_name(load_dir, tag)
+            # legacy = the manifest FILE is absent but state files are
+            # there; an unparseable manifest is a torn checkpoint, not a
+            # pre-fault-tolerance one
+            if atomic.is_legacy_checkpoint(path):
+                logger.warning(
+                    f"checkpoint {path} has no manifest (pre-fault-tolerance "
+                    f"layout); loading without integrity verification")
+                return tag
+            ok, tag_problems = atomic.verify_checkpoint(path, level=verify)
+            if ok:
+                return tag
+            if explicit:
+                raise atomic.CheckpointValidationError(
+                    f"checkpoint {path} failed validation: {tag_problems}")
+            problems.extend(tag_problems)
+        fallback = atomic.find_latest_valid(
+            load_dir, exclude=(tag,) if tag else (), level=verify)
+        if fallback is None:
+            # last resort: a pre-fault-tolerance tag the validity scan
+            # cannot vouch for is still better than refusing restorable
+            # state (manifested-but-invalid tags never land here — a
+            # manifest file, even a corrupt one, means post-upgrade)
+            legacy = [t for t in atomic.find_legacy_tags(load_dir)
+                      if t != tag]
+            if legacy:
+                logger.warning("checkpoint fallback engaged: " + json.dumps({
+                    "event": "checkpoint_fallback", "load_dir": load_dir,
+                    "unusable_tag": tag, "problems": problems,
+                    "fallback_tag": legacy[0], "legacy": True}))
+                return legacy[0]
+            raise FileNotFoundError(
+                f"no loadable checkpoint in {load_dir}: {problems}")
+        logger.warning("checkpoint fallback engaged: " + json.dumps({
+            "event": "checkpoint_fallback", "load_dir": load_dir,
+            "unusable_tag": tag, "problems": problems,
+            "fallback_tag": fallback}))
+        return fallback
+
     def load_checkpoint(self, load_dir, tag=None, load_module_only=False,
                         load_optimizer_states=True, load_lr_scheduler_states=True):
-        """Parity: reference ``engine.py:2467``. Returns (path, client_state)."""
+        """Parity: reference ``engine.py:2467``. Returns (path, client_state).
+
+        Loads only manifest-verified checkpoints; see
+        ``_resolve_checkpoint_tag`` for the fallback policy."""
         from ..checkpoint.serialization import load_tree
         # a pending delayed update is superseded by the loaded state —
         # and so are its drop counters (they describe discarded steps)
         self._pending_offload = None
         self._pending_row_drop_checks = []
-        if tag is None:
-            latest = os.path.join(load_dir, LATEST_FILE)
-            assert os.path.isfile(latest), f"missing {latest}; pass tag="
-            with open(latest) as f:
-                tag = f.read().strip()
+        tag = self._resolve_checkpoint_tag(load_dir, tag)
         path = self._get_ckpt_name(load_dir, tag)
+        self.loaded_checkpoint_tag = tag
+        retry = self.config.io_retry_config.policy()
 
         from ..checkpoint.serialization import restore_like
-        model_tree, meta = load_tree(os.path.join(path, MODEL_FILE), with_meta=True)
+        model_tree, meta = load_tree(os.path.join(path, MODEL_FILE),
+                                     with_meta=True, retry=retry)
         state = self.state
         if self._offload is None:
             # (offload path uploads once from the restored host master below)
@@ -1394,7 +1504,7 @@ class DeepSpeedEngine:
             self._offload.load_state(master_tree=conv(model_tree["params"]))
             if load_optimizer_states and not load_module_only:
                 optim_tree, _ = load_tree(os.path.join(path, OPTIM_FILE),
-                                          with_meta=True)
+                                          with_meta=True, retry=retry)
                 opt = optim_tree.get("opt_state", {})
                 self._offload.load_state(
                     master_tree=conv(optim_tree.get("master")),
@@ -1409,7 +1519,8 @@ class DeepSpeedEngine:
                 state = state._replace(params=jax.device_put(
                     self._offload.payload_tree(), self._param_sh))
         elif load_optimizer_states and not load_module_only:
-            optim_tree, _ = load_tree(os.path.join(path, OPTIM_FILE), with_meta=True)
+            optim_tree, _ = load_tree(os.path.join(path, OPTIM_FILE),
+                                      with_meta=True, retry=retry)
             opt_state = jax.device_put(
                 restore_like(self.state.opt_state, optim_tree["opt_state"]),
                 self._opt_shardings(self.state.opt_state))

@@ -8,15 +8,18 @@ computing; ``add_buffers``/``flush`` bracket a swap-out burst.
 
 import numpy as np
 
-from .utils import SwapBufferPool, swap_out_tensors, aligned_numel
+from .utils import (SwapBufferPool, acquire_swap_buffer, aligned_numel,
+                    swap_out_tensors)
 from ...utils.logging import logger
 
 
 class AsyncTensorSwapper:
     def __init__(self, aio_handle, numel_alignment=None, timers=None,
-                 buffer_count=2, buffer_numel=None):
+                 buffer_count=2, buffer_numel=None, retry=None):
         self.aio_handle = aio_handle
         self.timers = timers
+        from ...utils.retry import RetryPolicy
+        self.retry = retry or RetryPolicy()
         self.buffer_count = max(2, buffer_count)
         self._pool = None
         self._buffer_numel = buffer_numel
@@ -40,14 +43,18 @@ class AsyncTensorSwapper:
         (the write itself completes at flush())."""
         flat = np.ascontiguousarray(array).ravel()
         self._ensure_pool(flat.size, flat.dtype)
+        # pool exhaustion drains in-flight writes between bounded backoff
+        # attempts (shared idiom: utils.acquire_swap_buffer)
+        buf = acquire_swap_buffer(self._pool, drain=self._flush_pending,
+                                  retry=self.retry)
         try:
-            buf = self._pool.get()
-        except RuntimeError:
-            self._flush_pending()
-            buf = self._pool.get()
-        view = buf.view(flat.size)
-        np.copyto(view, flat)
-        swap_out_tensors(self.aio_handle, [view], [path])
+            view = buf.view(flat.size)
+            np.copyto(view, flat)
+            swap_out_tensors(self.aio_handle, [view], [path],
+                             retry=self.retry)
+        except Exception:
+            self._pool.release(buf)
+            raise
         self._pending.append(buf)
         self.swapped_bytes += flat.nbytes
 

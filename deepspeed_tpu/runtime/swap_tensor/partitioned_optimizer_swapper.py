@@ -12,16 +12,19 @@ import os
 
 import numpy as np
 
-from .utils import make_swap_path
+from .utils import aio_submit_read, aio_submit_write, make_swap_path
 from ...utils.logging import logger
+from ...utils.retry import RetryPolicy
 
 
 class OptimizerSwapper:
-    """Base: per-(group, tensor-name) files, sync swap in/out."""
+    """Base: per-(group, tensor-name) files, sync swap in/out.  Submits go
+    through the shared bounded-backoff retry helpers (``utils/retry.py``)."""
 
-    def __init__(self, swap_config, aio_config, nvme_path, rank=0):
+    def __init__(self, swap_config, aio_config, nvme_path, rank=0, retry=None):
         from .utils import make_aio_handle
         self.aio_handle = make_aio_handle(aio_config)
+        self.retry = retry or RetryPolicy()
         self.swap_folder = os.path.join(nvme_path, "zero_stage_optimizer",
                                         f"rank{rank}")
         os.makedirs(self.swap_folder, exist_ok=True)
@@ -35,7 +38,8 @@ class OptimizerSwapper:
         for name, arr in tensors.items():
             flat = np.ascontiguousarray(arr, np.float32).ravel()
             self._numel[(group, name)] = flat.size
-            self.aio_handle.async_pwrite(flat, self._path(group, name))
+            aio_submit_write(self.aio_handle, flat, self._path(group, name),
+                             retry=self.retry)
         if not async_op:
             self.aio_handle.wait()
 
@@ -47,7 +51,8 @@ class OptimizerSwapper:
             numel = self._numel[(group, name)]
             if name not in out or out[name].size != numel:
                 out[name] = np.zeros(numel, np.float32)
-            self.aio_handle.async_pread(out[name], self._path(group, name))
+            aio_submit_read(self.aio_handle, out[name],
+                            self._path(group, name), retry=self.retry)
         if not async_op:
             self.aio_handle.wait()
         return out
@@ -65,8 +70,8 @@ class PipelinedOptimizerSwapper(OptimizerSwapper):
     separate read/write queues so group g+1's read and group g-1's write
     proceed while group g computes."""
 
-    def __init__(self, swap_config, aio_config, nvme_path, rank=0):
-        super().__init__(swap_config, aio_config, nvme_path, rank)
+    def __init__(self, swap_config, aio_config, nvme_path, rank=0, retry=None):
+        super().__init__(swap_config, aio_config, nvme_path, rank, retry=retry)
         from .utils import make_aio_handle
         self.aio_read_handle = make_aio_handle(aio_config)
         self._read_bufs = {}   # group -> {name: array} prefetch in flight
@@ -79,7 +84,8 @@ class PipelinedOptimizerSwapper(OptimizerSwapper):
         for name in names:
             numel = self._numel[(group, name)]
             bufs[name] = np.zeros(numel, np.float32)
-            self.aio_read_handle.async_pread(bufs[name], self._path(group, name))
+            aio_submit_read(self.aio_read_handle, bufs[name],
+                            self._path(group, name), retry=self.retry)
         self._read_bufs[group] = bufs
         self._reads_pending.add(group)
 
@@ -97,6 +103,7 @@ class PipelinedOptimizerSwapper(OptimizerSwapper):
         staged = {n: np.array(a, np.float32).ravel() for n, a in tensors.items()}
         for name, flat in staged.items():
             self._numel[(group, name)] = flat.size
-            self.aio_handle.async_pwrite(flat, self._path(group, name))
+            aio_submit_write(self.aio_handle, flat, self._path(group, name),
+                             retry=self.retry)
         if not async_op:
             self.aio_handle.wait()

@@ -13,17 +13,19 @@ import os
 
 import numpy as np
 
-from .utils import (SwapBufferPool, aligned_numel, make_swap_path,
-                    swap_in_tensors, swap_out_tensors)
+from .utils import (SwapBufferPool, acquire_swap_buffer, aligned_numel,
+                    make_swap_path, swap_in_tensors, swap_out_tensors)
 from ...utils.logging import logger
+from ...utils.retry import RetryPolicy
 
 
 class AsyncPartitionedParameterSwapper:
     def __init__(self, ds_config_aio, nvme_path, dtype=np.float32,
-                 buffer_count=5, buffer_numel=int(1e8), rank=0):
+                 buffer_count=5, buffer_numel=int(1e8), rank=0, retry=None):
         from .utils import make_aio_handle
         self.aio_read_handle = make_aio_handle(ds_config_aio)
         self.aio_write_handle = make_aio_handle(ds_config_aio)
+        self.retry = retry or RetryPolicy()
         self.dtype = np.dtype(dtype)
         self.swap_folder = os.path.join(
             nvme_path, "zero_stage_3", f"{self.dtype.name}params", f"rank{rank}")
@@ -49,15 +51,19 @@ class AsyncPartitionedParameterSwapper:
         assert flat.size <= self.buffer_numel, \
             f"param {param_id} ({flat.size}) exceeds buffer_size {self.buffer_numel}"
         self._id_to_numel[param_id] = flat.size
+        # all buffers may be in flight: drain pending writes between bounded
+        # backoff attempts (utils.acquire_swap_buffer)
+        buf = acquire_swap_buffer(self._pool, drain=self.synchronize_writes,
+                                  retry=self.retry)
         try:
-            buf = self._pool.get()
-        except RuntimeError:
-            # all buffers in flight: drain pending writes and retry
-            self.synchronize_writes()
-            buf = self._pool.get()
-        np.copyto(buf.view(flat.size), flat)
-        swap_out_tensors(self.aio_write_handle, [buf.view(flat.size)],
-                         [self._path(param_id)])
+            np.copyto(buf.view(flat.size), flat)
+            swap_out_tensors(self.aio_write_handle, [buf.view(flat.size)],
+                             [self._path(param_id)], retry=self.retry)
+        except Exception:
+            # a submit that exhausted its retries must not leak the buffer:
+            # it is not in _inflight_writes yet, so nothing else can free it
+            self._pool.release(buf)
+            raise
         self._inflight_writes.append(buf)
         # drop any stale swapped-in copy
         old = self._id_to_buffer.pop(param_id, None)
@@ -80,9 +86,13 @@ class AsyncPartitionedParameterSwapper:
             if pid in self._id_to_buffer or pid in self._inflight_reads:
                 continue
             numel = self._id_to_numel[pid]
-            buf = self._pool.get()
-            swap_in_tensors(self.aio_read_handle, [buf.view(numel)],
-                            [self._path(pid)])
+            buf = acquire_swap_buffer(self._pool, retry=self.retry)
+            try:
+                swap_in_tensors(self.aio_read_handle, [buf.view(numel)],
+                                [self._path(pid)], retry=self.retry)
+            except Exception:
+                self._pool.release(buf)
+                raise
             self._id_to_buffer[pid] = buf
             self._inflight_reads.append(pid)
         if not async_op:

@@ -11,6 +11,9 @@ import os
 
 import numpy as np
 
+from ... import fault
+from ...utils.retry import RetryPolicy, retry_call
+
 MIN_AIO_BYTES = 1024 ** 2
 AIO_ALIGNED_BYTES = 1024
 
@@ -27,16 +30,35 @@ def aligned_numel(numel, itemsize=4):
     return ((numel + align - 1) // align) * align
 
 
-def swap_in_tensors(aio_handle, buffers, paths):
+def aio_submit_read(aio_handle, buf, path, retry=None):
+    """Submit one async read with bounded-backoff retry on transient submit
+    failures (queue momentarily full, EAGAIN, injected faults)."""
+    def _submit():
+        fault.site("aio.submit", path=path)
+        return aio_handle.async_pread(buf, path)
+    return retry_call(_submit, policy=retry or RetryPolicy(),
+                      describe=f"aio read submit {path}")
+
+
+def aio_submit_write(aio_handle, buf, path, retry=None):
+    """Submit one async write with bounded-backoff retry."""
+    def _submit():
+        fault.site("aio.submit", path=path)
+        return aio_handle.async_pwrite(buf, path)
+    return retry_call(_submit, policy=retry or RetryPolicy(),
+                      describe=f"aio write submit {path}")
+
+
+def swap_in_tensors(aio_handle, buffers, paths, retry=None):
     """Submit one async read per (buffer, path); caller waits on the handle."""
     for buf, path in zip(buffers, paths):
-        aio_handle.async_pread(buf, path)
+        aio_submit_read(aio_handle, buf, path, retry=retry)
 
 
-def swap_out_tensors(aio_handle, buffers, paths):
+def swap_out_tensors(aio_handle, buffers, paths, retry=None):
     """Submit one async write per (buffer, path)."""
     for buf, path in zip(buffers, paths):
-        aio_handle.async_pwrite(buf, path)
+        aio_submit_write(aio_handle, buf, path, retry=retry)
 
 
 class SwapBuffer:
@@ -71,6 +93,41 @@ class SwapBufferPool:
     def release_all(self):
         for b in self.buffers:
             b.in_use = False
+
+
+def acquire_swap_buffer(pool, drain=None, retry=None):
+    """Bounded-backoff acquisition of a free swap buffer.
+
+    Replaces the single drain-and-retry on pool exhaustion: each attempt
+    first drains pending async writes (``drain``) so their buffers return to
+    the pool, then retries with exponential backoff — an in-flight write
+    completing a moment later is a transient condition, not a crash.  Shared
+    by the param and optimizer swappers.
+
+    Without a ``drain`` nothing can free a buffer between attempts, so
+    exhaustion is a logic error (buffer leak / undersized pool) and fails
+    fast instead of sleeping through a hopeless backoff schedule.
+    """
+    def _get():
+        try:
+            return pool.get()
+        except RuntimeError:
+            if drain is None:
+                raise
+            drain()
+            return pool.get()
+    if drain is None:
+        return _get()
+    base = retry or RetryPolicy()
+    # the RuntimeError-augmented clone is invariant per policy; cache it so
+    # the per-parameter swap hot path doesn't rebuild a policy (and copy
+    # RNG state) on every acquisition
+    policy = getattr(base, "_buffer_acquire_policy", None)
+    if policy is None:
+        policy = base.clone(
+            retriable_types=(RuntimeError,) + base.retriable_types)
+        base._buffer_acquire_policy = policy
+    return retry_call(_get, policy=policy, describe="acquire_swap_buffer")
 
 
 def make_swap_path(folder, name):

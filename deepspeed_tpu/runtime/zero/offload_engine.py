@@ -39,7 +39,7 @@ class FlatWireHandle:
 class HostOffloadOptimizer:
     def __init__(self, params0, zero_config, aio_config, *, optimizer_name,
                  optimizer_params, compute_dtype_name, rank=0,
-                 consume_params=False, payload_in_ram=True):
+                 consume_params=False, payload_in_ram=True, retry=None):
         p = dict(optimizer_params or {})
         p.pop("torch_adam", None)
         # same default as FusedAdam (adam_w_mode=True): identical update rule
@@ -84,7 +84,8 @@ class HostOffloadOptimizer:
                    else PartitionedOptimizerSwapper)
             assert off_cfg.nvme_path, \
                 "offload_optimizer.device=nvme requires nvme_path"
-            self.swapper = cls(off_cfg, aio_config, off_cfg.nvme_path, rank)
+            self.swapper = cls(off_cfg, aio_config, off_cfg.nvme_path, rank,
+                               retry=retry)
             for g, (s, e) in enumerate(self.sub_groups):
                 z = np.zeros(e - s, np.float32)
                 self.swapper.swap_out_group(

@@ -83,7 +83,7 @@ class ParamStreamRunner:
     """
 
     def __init__(self, model, host_opt, mesh, compute_dtype, *,
-                 gas, grad_clip, zero_config, aio_config):
+                 gas, grad_clip, zero_config, aio_config, retry=None):
         assert mesh.size == 1, (
             "offload_param streaming is single-chip (scale-up) machinery; "
             "on a multi-chip mesh use ZeRO-3 sharding (stage 3 without "
@@ -142,7 +142,7 @@ class ParamStreamRunner:
                 aio_config, off_p.nvme_path,
                 dtype=np.uint16 if itemsize == 2 else np.float32,
                 buffer_count=max(4, int(off_p.buffer_count)),
-                buffer_numel=per_layer)
+                buffer_numel=per_layer, retry=retry)
             self._flush_layers_to_nvme(range(self.L))
             host_opt.drop_payload()
         else:

@@ -1,0 +1,98 @@
+"""Version-compat shims for the jax API surface this repo targets.
+
+The codebase is written against the current jax spellings —
+``jax.shard_map`` (with ``check_vma``) and ``jax.set_mesh`` — but a
+deployment container may carry an older jax where those live at
+``jax.experimental.shard_map.shard_map`` (with ``check_rep``) and where
+entering a ``Mesh`` as a context manager is the way to set the ambient
+mesh.  ``install()`` backfills the new names onto the ``jax`` module when
+missing so the rest of the codebase (and user scripts written against it)
+run unchanged on both.  Idempotent and a no-op on current jax.
+"""
+
+import jax
+
+# True when this jax lacks native jax.shard_map and the backport's
+# axis_names handling degrades partial-manual regions to FULL manual
+# (dropped axes replicate instead of auto-partitioning).  Tests whose
+# per-device memory/layout expectations assume auto-partitioned axes
+# key off this.
+SHARD_MAP_FULL_MANUAL_FALLBACK = False
+
+
+def _physical_mesh():
+    from jax._src.mesh import thread_resources
+    return thread_resources.env.physical_mesh
+
+
+def _shard_map_backport():
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f=None, **kw):
+        # new-jax spelling `check_vma` maps onto old `check_rep`
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        # new-jax `axis_names` names the MANUAL axes.  Old jax spells the
+        # complement as `auto=` — but partial-manual mode is broken in this
+        # jaxlib's SPMD partitioner (axis_index lowers to a PartitionId HLO
+        # it rejects; ppermute trips a hard CHECK).  Fall back to FULL manual
+        # instead: in/out specs are unchanged, so the dropped axes become
+        # replicated rather than auto-partitioned — numerically identical
+        # (the body never differentiates across the boundary), at the cost
+        # of redundant compute along those axes.  Old-jax-only tradeoff.
+        if "axis_names" in kw:
+            kw.pop("axis_names")
+            kw.setdefault("check_rep", False)
+        if f is None:
+            return lambda g: _sm(g, **kw)
+        return _sm(f, **kw)
+
+    return shard_map
+
+
+def _set_mesh_backport():
+    def set_mesh(mesh):
+        # jax.sharding.Mesh is itself a context manager that sets the
+        # ambient physical mesh — exactly what `with jax.set_mesh(m):`
+        # needs on old jax.
+        return mesh
+
+    return set_mesh
+
+
+def install():
+    global SHARD_MAP_FULL_MANUAL_FALLBACK
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_backport()
+        SHARD_MAP_FULL_MANUAL_FALLBACK = True
+    if not hasattr(jax, "set_mesh"):
+        jax.set_mesh = _set_mesh_backport()
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        # callers probe .empty/.axis_names/.axis_sizes/.shape — the ambient
+        # physical mesh satisfies all of them on old jax
+        jax.sharding.get_abstract_mesh = _physical_mesh
+    if not hasattr(jax.sharding.Mesh, "axis_sizes"):
+        jax.sharding.Mesh.axis_sizes = property(
+            lambda self: tuple(self.shape.values()))
+    if not hasattr(jax.lax, "axis_size"):
+        # psum of a Python constant is evaluated statically -> the axis size
+        jax.lax.axis_size = lambda name: jax.lax.psum(1, name)
+    if not hasattr(jax.lax, "pcast"):
+        # vma (varying-manual-axes) typing does not exist on old jax and the
+        # shard_map backport always runs with check_rep=False when partial-
+        # manual — pcast is computationally the identity there
+        jax.lax.pcast = lambda x, axes, to=None: x
+    if not hasattr(jax, "typeof"):
+        # callers only probe attrs with getattr(..., default) — an aval
+        # (which lacks new-style .vma) degrades correctly
+        jax.typeof = lambda x: jax.core.get_aval(x)
+    try:
+        import jax.experimental.pallas.tpu as _pltpu
+        if not hasattr(_pltpu, "CompilerParams") and \
+                hasattr(_pltpu, "TPUCompilerParams"):
+            _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+    except ImportError:
+        pass
+
+
+install()

@@ -22,9 +22,19 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Backfill jax.shard_map / jax.set_mesh on older jax before any test module
+# (or deepspeed_tpu itself) references them.
+from deepspeed_tpu.utils import jax_compat  # noqa: E402,F401
+
 # The env var alone is not enough under the axon site hook; force via config.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+# NOTE: do NOT enable jax_compilation_cache_dir on this jax (0.4.37/CPU):
+# the persistent cache returns executables whose donated-buffer aliasing
+# does not match the new trace, silently corrupting training numerics
+# (reproduced via test_mid_save_crash_then_auto_fallback_resume: loaded
+# params drift ~1e-2 with a warm cache, exact with a cold one).
 
 
 def pytest_addoption(parser):
@@ -37,6 +47,10 @@ def pytest_configure(config):
         "markers",
         "slow: compile-heavy test excluded from the default fast tier "
         "(run with --runslow or RUN_SLOW=1)")
+    config.addinivalue_line(
+        "markers",
+        "fault: fault-injection / fault-tolerance test (crash-consistent "
+        "checkpointing, retry/backoff IO, recovery paths)")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -83,3 +97,13 @@ def mesh_2x4(devices):
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture
+def fault_harness():
+    """Yields the fault-injection module, guaranteed disarmed before AND
+    after the test (a leaked plan would poison unrelated tests)."""
+    from deepspeed_tpu import fault
+    fault.reset()
+    yield fault
+    fault.reset()

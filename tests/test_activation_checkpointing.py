@@ -45,8 +45,11 @@ def test_checkpoint_matches_plain_forward_and_grad():
     lp, gp = jax.value_and_grad(loss_plain, argnums=(0, 1))(w1, w2, x)
     lc, gc = jax.value_and_grad(loss_ckpt, argnums=(0, 1))(w1, w2, x)
     np.testing.assert_allclose(float(lp), float(lc), rtol=1e-6)
+    # atol absorbs fp32 op-reordering noise on near-zero entries: XLA may
+    # schedule the remat recompute differently from the plain forward
     for a, b in zip(gp, gc):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                                   atol=1e-4)
 
 
 def test_checkpoint_reduces_saved_residuals():

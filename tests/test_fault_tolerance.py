@@ -1,0 +1,796 @@
+"""Fault-tolerance layer: atomic checkpoint commit, validating load with
+fallback, retry/backoff IO, and the fault-injection harness that proves the
+recovery paths (docs/fault-tolerance.md).
+
+All CPU-only and fast: the engine tests reuse the tiny SimpleModel fixture;
+the unit tests drive the protocol pieces directly on tmp_path.
+"""
+
+import ast
+import json
+import logging
+import os
+import re
+
+import numpy as np
+import pytest
+import jax
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.checkpoint import atomic
+from deepspeed_tpu.utils.retry import NON_RETRIABLE, RetryPolicy, retry_call
+
+from simple_model import SimpleModel, random_dataset, base_config
+
+pytestmark = pytest.mark.fault
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff unit tests
+# ---------------------------------------------------------------------------
+
+def _fast_policy(**kw):
+    """Policy whose sleeps record instead of sleeping (tests run in µs)."""
+    slept = []
+    kw.setdefault("base_delay_s", 0.05)
+    policy = RetryPolicy(sleep=slept.append, seed=kw.pop("seed", 0), **kw)
+    return policy, slept
+
+
+def test_retry_success_after_n():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy, slept = _fast_policy(max_attempts=5)
+    assert retry_call(flaky, policy=policy) == "ok"
+    assert len(calls) == 3
+    assert len(slept) == 2  # one backoff per failed attempt
+
+
+def test_retry_exhaustion_reraises_last():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError(f"fail #{len(calls)}")
+
+    policy, slept = _fast_policy(max_attempts=4)
+    with pytest.raises(OSError, match="fail #4"):
+        retry_call(always, policy=policy)
+    assert len(calls) == 4
+    assert len(slept) == 3  # no backoff after the final failure
+
+
+@pytest.mark.parametrize("exc_type", NON_RETRIABLE)
+def test_retry_structural_errors_raise_immediately(exc_type):
+    calls = []
+
+    def structural():
+        calls.append(1)
+        raise exc_type("not transient")
+
+    policy, slept = _fast_policy(max_attempts=5)
+    with pytest.raises(exc_type):
+        retry_call(structural, policy=policy)
+    assert len(calls) == 1 and not slept
+
+
+def test_retry_jitter_bounds_and_cap():
+    policy = RetryPolicy(max_attempts=8, base_delay_s=0.1, max_delay_s=1.0,
+                         jitter=0.25, seed=7)
+    for attempt in range(8):
+        lo, hi = policy.delay_bounds(attempt)
+        nominal = min(0.1 * 2 ** attempt, 1.0)
+        assert lo == pytest.approx(nominal * 0.75)
+        assert hi == pytest.approx(nominal * 1.25)
+        for _ in range(50):
+            assert lo <= policy.delay(attempt) <= hi
+    # deep attempts saturate at the cap, never unbounded
+    assert policy.delay_bounds(100)[1] == pytest.approx(1.25)
+
+
+def test_retry_jitter_deterministic_under_seed():
+    a = RetryPolicy(seed=42)
+    b = RetryPolicy(seed=42)
+    assert [a.delay(k) for k in range(5)] == [b.delay(k) for k in range(5)]
+    # determinism survives clone() (used by acquire_swap_buffer)
+    c = RetryPolicy(seed=42).clone(max_attempts=9)
+    d = RetryPolicy(seed=42)
+    assert [d.delay(k) for k in range(5)] == [c.delay(k) for k in range(5)]
+
+
+def test_retry_on_retry_hook_runs_before_backoff():
+    events = []
+
+    def flaky():
+        events.append("call")
+        if events.count("call") < 2:
+            raise OSError("x")
+        return 1
+
+    policy, slept = _fast_policy()
+    retry_call(flaky, policy=policy,
+               on_retry=lambda attempt, exc: events.append("drain"))
+    assert events == ["call", "drain", "call"]
+
+
+def test_io_retry_config_validation():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
+                                              DeepSpeedIORetryConfig)
+    cfg = DeepSpeedIORetryConfig({"io_retry": {"max_attempts": 3,
+                                               "base_delay_s": 0.01}})
+    policy = cfg.policy()
+    assert policy.max_attempts == 3
+    assert policy.base_delay_s == 0.01
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedIORetryConfig({"io_retry": {"max_attempts": 0}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedIORetryConfig({"io_retry": {"jitter": 1.5}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedIORetryConfig({"io_retry": {"base_delay_s": -1}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedIORetryConfig({"io_retry": {"max_delay_s": -0.5}})
+
+
+def test_checkpoint_config_validation():
+    from deepspeed_tpu.runtime.config import (DeepSpeedCheckpointConfig,
+                                              DeepSpeedConfigError)
+    cfg = DeepSpeedCheckpointConfig({"checkpoint": {"keep_n": 3,
+                                                    "verify": "size"}})
+    assert cfg.keep_n == 3 and cfg.verify == "size"
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedCheckpointConfig({"checkpoint": {"keep_n": -1}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedCheckpointConfig({"checkpoint": {"verify": "paranoid"}})
+
+
+# ---------------------------------------------------------------------------
+# atomic commit protocol unit tests
+# ---------------------------------------------------------------------------
+
+def _stage_fake_ckpt(save_dir, tag, step, payload=b"x" * 256):
+    """Stage + manifest a fake checkpoint; returns the staging path."""
+    staged = atomic.stage_path(str(save_dir), tag)
+    os.makedirs(staged, exist_ok=True)
+    for name in ("model_states.msgpack", "optim_states.msgpack"):
+        with open(os.path.join(staged, name), "wb") as f:
+            f.write(payload + tag.encode() + name.encode())
+    atomic.write_manifest(staged, meta={"tag": tag, "global_steps": step})
+    return staged
+
+
+def _commit_fake_ckpt(save_dir, tag, step, **kw):
+    _stage_fake_ckpt(save_dir, tag, step, **kw)
+    final = atomic.commit_staged(str(save_dir), tag)
+    atomic.write_latest(str(save_dir), tag)
+    return final
+
+
+def test_atomic_latest_pointer_roundtrip(tmp_path):
+    assert atomic.read_latest(str(tmp_path)) is None
+    atomic.write_latest(str(tmp_path), "step5")
+    assert atomic.read_latest(str(tmp_path)) == "step5"
+    # rewrite goes through temp+rename: no .tmp residue
+    atomic.write_latest(str(tmp_path), "step9")
+    assert atomic.read_latest(str(tmp_path)) == "step9"
+    assert not os.path.exists(os.path.join(str(tmp_path), "latest.tmp"))
+
+
+def test_commit_staged_publishes_and_clears_staging(tmp_path):
+    final = _commit_fake_ckpt(tmp_path, "A", 1)
+    assert os.path.isdir(final)
+    assert not os.path.isdir(atomic.stage_path(str(tmp_path), "A"))
+    ok, problems = atomic.verify_checkpoint(final)
+    assert ok, problems
+
+
+def test_commit_replaces_existing_tag_without_zero_copy_window(tmp_path):
+    _commit_fake_ckpt(tmp_path, "A", 1, payload=b"old" * 100)
+    _commit_fake_ckpt(tmp_path, "A", 2, payload=b"new" * 100)
+    final = os.path.join(str(tmp_path), "A")
+    ok, problems = atomic.verify_checkpoint(final)
+    assert ok, problems
+    assert atomic.read_manifest(final)["meta"]["global_steps"] == 2
+    assert not os.path.isdir(final + ".replaced")
+
+
+def test_verify_detects_truncation_corruption_and_missing(tmp_path):
+    final = _commit_fake_ckpt(tmp_path, "A", 1)
+    model = os.path.join(final, "model_states.msgpack")
+
+    # truncation → size mismatch, caught even at the cheap level
+    orig = open(model, "rb").read()
+    with open(model, "wb") as f:
+        f.write(orig[:-7])
+    ok, problems = atomic.verify_checkpoint(final, level="size")
+    assert not ok and any("size" in p for p in problems)
+
+    # same-size bit flip → only the full (sha256) level catches it
+    with open(model, "wb") as f:
+        f.write(bytes([orig[0] ^ 0xFF]) + orig[1:])
+    assert atomic.verify_checkpoint(final, level="size")[0]
+    ok, problems = atomic.verify_checkpoint(final, level="full")
+    assert not ok and any("sha256" in p for p in problems)
+
+    # missing file
+    os.remove(model)
+    ok, problems = atomic.verify_checkpoint(final, level="size")
+    assert not ok and any("missing" in p for p in problems)
+
+    # corrupt (unparseable) manifest → invalid at any level
+    with open(os.path.join(final, atomic.MANIFEST_FILE), "w") as f:
+        f.write('{"version": 1, "files": {tru')
+    assert not atomic.verify_checkpoint(final, level="off")[0]
+
+    # missing manifest → invalid at any level
+    os.remove(os.path.join(final, atomic.MANIFEST_FILE))
+    assert not atomic.verify_checkpoint(final, level="off")[0]
+
+    # an uncommitted staging dir is never a valid checkpoint
+    staged = _stage_fake_ckpt(tmp_path, "B", 2)
+    assert not atomic.verify_checkpoint(staged)[0]
+
+
+def test_find_latest_valid_orders_by_step_and_skips_torn(tmp_path):
+    _commit_fake_ckpt(tmp_path, "A", 1)
+    _commit_fake_ckpt(tmp_path, "B", 2)
+    final_c = _commit_fake_ckpt(tmp_path, "C", 3)
+    assert atomic.find_latest_valid(str(tmp_path)) == "C"
+    # tear C → B is the newest valid
+    os.remove(os.path.join(final_c, "optim_states.msgpack"))
+    assert atomic.find_latest_valid(str(tmp_path)) == "B"
+    assert atomic.find_latest_valid(str(tmp_path), exclude=("B",)) == "A"
+
+
+def test_clean_stale_staging(tmp_path):
+    _commit_fake_ckpt(tmp_path, "A", 1)
+    _stage_fake_ckpt(tmp_path, "B", 2)
+    removed = atomic.clean_stale_staging(str(tmp_path))
+    assert removed == ["B.tmp"]
+    assert atomic.list_tags(str(tmp_path)) == ["A"]
+
+
+def test_clean_stale_staging_restores_orphaned_replaced_dir(tmp_path):
+    """A same-tag re-commit killed between its two renames leaves only
+    `<tag>.replaced` — the sole valid copy must be restored, not deleted."""
+    _commit_fake_ckpt(tmp_path, "A", 1)
+    os.rename(os.path.join(str(tmp_path), "A"),
+              os.path.join(str(tmp_path), "A.replaced"))
+    atomic.clean_stale_staging(str(tmp_path))
+    assert atomic.list_tags(str(tmp_path)) == ["A"]
+    assert atomic.verify_checkpoint(os.path.join(str(tmp_path), "A"))[0]
+    # ...but with a committed final present, `.replaced` is garbage
+    _commit_fake_ckpt(tmp_path, "B", 2)
+    os.makedirs(os.path.join(str(tmp_path), "B.replaced"))
+    atomic.clean_stale_staging(str(tmp_path))
+    assert not os.path.isdir(os.path.join(str(tmp_path), "B.replaced"))
+    assert "B" in atomic.list_tags(str(tmp_path))
+
+
+def test_clean_stale_staging_min_age_spares_young_tmp(tmp_path):
+    """A reader sharing a live trainer's dir must not delete an in-flight
+    save's staging dir; an old leftover still goes."""
+    _commit_fake_ckpt(tmp_path, "A", 1)
+    fresh = _stage_fake_ckpt(tmp_path, "B", 2)
+    old = _stage_fake_ckpt(tmp_path, "C", 3)
+    past = os.path.getmtime(old) - 3600
+    os.utime(old, (past, past))
+    removed = atomic.clean_stale_staging(str(tmp_path), min_age_s=900)
+    assert removed == ["C.tmp"]
+    assert os.path.isdir(fresh)
+    # the saver (age 0) sweeps everything
+    assert atomic.clean_stale_staging(str(tmp_path)) == ["B.tmp"]
+
+
+def test_verify_unreadable_file_is_a_problem_not_a_crash(tmp_path,
+                                                         monkeypatch):
+    """One unreadable file marks THAT tag invalid; it must not abort the
+    caller's newest-valid fallback scan over the other tags."""
+    _commit_fake_ckpt(tmp_path, "A", 1)
+    final_b = _commit_fake_ckpt(tmp_path, "B", 2)
+    bad = os.path.join(final_b, "model_states.msgpack")
+    real = atomic.sha256_file
+
+    def flaky_sha(path):
+        if path == bad:
+            raise PermissionError(13, "injected unreadable file", path)
+        return real(path)
+
+    monkeypatch.setattr(atomic, "sha256_file", flaky_sha)
+    ok, problems = atomic.verify_checkpoint(final_b, level="full")
+    assert not ok and any("unreadable" in p for p in problems)
+    assert atomic.find_latest_valid(str(tmp_path)) == "A"
+
+
+def test_legacy_checkpoints_visible_to_auto_resume_and_fallback(tmp_path):
+    """Pre-fault-tolerance tags (state files, no manifest) must be found by
+    has_checkpoint and serve as the fallback of last resort — but a tag
+    carrying a manifest file, even a corrupt one, is torn, never legacy."""
+    legacy = os.path.join(str(tmp_path), "global_step5")
+    os.makedirs(legacy)
+    with open(os.path.join(legacy, "model_states.msgpack"), "wb") as f:
+        f.write(b"old layout")
+    assert atomic.is_legacy_checkpoint(legacy)
+    assert atomic.has_checkpoint(str(tmp_path))  # no `latest` needed
+    assert atomic.find_legacy_tags(str(tmp_path)) == ["global_step5"]
+    # a stray dir without state files is neither legacy nor a checkpoint
+    os.makedirs(os.path.join(str(tmp_path), "tensorboard"))
+    assert not atomic.is_legacy_checkpoint(
+        os.path.join(str(tmp_path), "tensorboard"))
+    # a corrupt manifest disqualifies: that dir is torn, not legacy
+    with open(os.path.join(legacy, atomic.MANIFEST_FILE), "w") as f:
+        f.write("{not json")
+    assert not atomic.is_legacy_checkpoint(legacy)
+
+
+def test_rotate_never_touches_non_checkpoint_dirs(tmp_path):
+    """Retention only considers manifested checkpoint dirs: tensorboard
+    logs or legacy un-manifested checkpoints in save_dir must survive."""
+    os.makedirs(os.path.join(str(tmp_path), "tensorboard"))
+    legacy = os.path.join(str(tmp_path), "legacy_ckpt")
+    os.makedirs(legacy)
+    with open(os.path.join(legacy, "model_states.msgpack"), "wb") as f:
+        f.write(b"old layout, no manifest")
+    for step, tag in enumerate(["A", "B", "C"], start=1):
+        _commit_fake_ckpt(tmp_path, tag, step)
+    removed = atomic.rotate_checkpoints(str(tmp_path), keep_n=1)
+    assert sorted(removed) == ["A", "B"]
+    assert os.path.isdir(os.path.join(str(tmp_path), "tensorboard"))
+    assert os.path.isdir(legacy)
+
+
+def test_rotate_keep_n_never_deletes_newest_valid(tmp_path):
+    for step, tag in enumerate(["A", "B", "C", "D"], start=1):
+        _commit_fake_ckpt(tmp_path, tag, step)
+    removed = atomic.rotate_checkpoints(str(tmp_path), keep_n=2)
+    assert sorted(removed) == ["A", "B"]
+    assert sorted(atomic.list_tags(str(tmp_path))) == ["C", "D"]
+
+    # tear BOTH tags inside the retention window; the newest valid one
+    # (now outside the window) must survive rotation
+    _commit_fake_ckpt(tmp_path, "E", 5)
+    for tag in ("D", "E"):
+        os.remove(os.path.join(str(tmp_path), tag, "model_states.msgpack"))
+    atomic.rotate_checkpoints(str(tmp_path), keep_n=2, level="size")
+    assert "C" in atomic.list_tags(str(tmp_path))
+    assert atomic.find_latest_valid(str(tmp_path), level="size") == "C"
+
+
+# ---------------------------------------------------------------------------
+# fault harness unit tests
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing(fault_harness):
+    plan = fault_harness.FaultPlan.from_spec(
+        "ckpt_crash_after_model_file,io_error_p=0.2,io_delay_ms=50,"
+        "max_faults=3,seed=11")
+    assert plan.crash_sites == {"ckpt.after_model_file"}
+    assert plan.io_error_p == 0.2
+    assert plan.io_delay_ms == 50.0
+    assert plan.max_faults == 3
+    with pytest.raises(AssertionError):
+        fault_harness.FaultPlan.from_spec("crash_at=no.such.site")
+    with pytest.raises(ValueError):
+        fault_harness.FaultPlan.from_spec("warp_speed=9")
+
+
+def test_fault_site_disarmed_is_noop(fault_harness):
+    assert not fault_harness.is_enabled()
+    fault_harness.site("io.write")  # no exception, no state
+    assert fault_harness.plan() is None
+
+
+def test_fault_crash_is_one_shot(fault_harness):
+    fault_harness.configure("crash_at=io.write")
+    with pytest.raises(fault_harness.InjectedCrash):
+        fault_harness.site("io.write")
+    fault_harness.site("io.write")  # disarmed after firing: recovery can run
+    assert fault_harness.plan().hits["io.write"] == 2
+
+
+def test_fault_io_errors_deterministic_and_capped(fault_harness):
+    def run():
+        fault_harness.configure(io_error_p=0.5, max_faults=4, seed=3)
+        outcomes = []
+        for _ in range(64):
+            try:
+                fault_harness.site("aio.submit")
+                outcomes.append(0)
+            except fault_harness.InjectedIOError:
+                outcomes.append(1)
+        return outcomes
+
+    first, second = run(), run()
+    assert first == second            # seeded → reproducible
+    assert sum(first) == 4            # max_faults caps the chaos
+    assert isinstance(fault_harness.InjectedIOError("x"), OSError)
+
+
+def test_injected_crash_not_swallowed_by_except_exception(fault_harness):
+    """InjectedCrash models a SIGKILL: generic error recovery must not eat it."""
+    fault_harness.configure("crash_at=io.write")
+    with pytest.raises(fault_harness.InjectedCrash):
+        try:
+            fault_harness.site("io.write")
+        except Exception:  # the broadest *ordinary* handler
+            pytest.fail("InjectedCrash must escape `except Exception`")
+
+
+# ---------------------------------------------------------------------------
+# swap buffer acquisition backoff
+# ---------------------------------------------------------------------------
+
+def test_acquire_swap_buffer_drains_and_retries():
+    from deepspeed_tpu.runtime.swap_tensor.utils import (SwapBufferPool,
+                                                         acquire_swap_buffer)
+    pool = SwapBufferPool(count=1, numel=16)
+    held = pool.get()
+    drained = []
+
+    def drain():
+        drained.append(1)
+        pool.release(held)
+
+    policy, _ = _fast_policy(max_attempts=3)
+    buf = acquire_swap_buffer(pool, drain=drain, retry=policy)
+    assert buf is not None and drained
+
+
+def test_acquire_swap_buffer_without_drain_fails_fast():
+    """No drain → nothing can free a buffer between attempts → exhaustion
+    is a logic error (leak / undersized pool), surfaced immediately."""
+    from deepspeed_tpu.runtime.swap_tensor.utils import (SwapBufferPool,
+                                                         acquire_swap_buffer)
+    pool = SwapBufferPool(count=1, numel=16)
+    pool.get()  # pool now empty
+    policy, slept = _fast_policy(max_attempts=3)
+    with pytest.raises(RuntimeError):
+        acquire_swap_buffer(pool, retry=policy)
+    assert not slept  # no hopeless backoff schedule
+
+
+def test_param_swapper_releases_buffer_when_submit_exhausts_retries(
+        tmp_path, fault_harness):
+    """A submit that exhausts its retries must hand the acquired buffer
+    back to the pool: leaking one per failure would shrink the pool until
+    acquisition fails even after the IO condition clears."""
+    from deepspeed_tpu.runtime.swap_tensor.partitioned_param_swapper import (
+        AsyncPartitionedParameterSwapper)
+    sw = AsyncPartitionedParameterSwapper(
+        {}, str(tmp_path), buffer_count=2, buffer_numel=256,
+        retry=_fast_policy(max_attempts=2)[0])
+    fault_harness.configure(io_error_p=1.0, seed=0)  # every aio.submit fails
+    arr = np.arange(64, dtype=np.float32)
+    for _ in range(4):  # more failures than buffers: a leak exhausts the pool
+        with pytest.raises(OSError):
+            sw.swap_out(0, arr)
+    fault_harness.reset()
+    sw.swap_out(0, arr)  # pool intact once the condition clears
+    sw.synchronize_writes()
+    np.testing.assert_array_equal(
+        np.fromfile(sw._path(0), dtype=np.float32)[:64], arr)
+
+
+def test_acquire_swap_buffer_exhaustion_with_drain_is_bounded():
+    from deepspeed_tpu.runtime.swap_tensor.utils import (SwapBufferPool,
+                                                         acquire_swap_buffer)
+    pool = SwapBufferPool(count=1, numel=16)
+    pool.get()
+    policy, slept = _fast_policy(max_attempts=3)
+    with pytest.raises(RuntimeError):
+        acquire_swap_buffer(pool, drain=lambda: None, retry=policy)
+    assert len(slept) == 2  # bounded: it gave up, it didn't spin
+
+
+# ---------------------------------------------------------------------------
+# engine-level recovery (the acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+def _make_engine(mesh, tmp_path=None, seed=0, **cfg_kw):
+    cfg = base_config(**cfg_kw)
+    model = SimpleModel()
+    data = random_dataset(n=64)
+    engine, _, _, _ = ds.initialize(config=cfg, model=model,
+                                    training_data=data, mesh=mesh,
+                                    rng_seed=seed)
+    return engine
+
+
+def test_mid_save_crash_then_auto_fallback_resume(mesh8, tmp_path,
+                                                  fault_harness):
+    """THE preemption scenario: kill lands after model_states is staged but
+    before commit → `latest` and the newest committed tag are untouched →
+    a restarting job resumes from the last valid checkpoint, checksums
+    verified."""
+    save_dir = str(tmp_path)
+    engine = _make_engine(mesh8, seed=0)
+    for _ in range(3):
+        engine.train_batch()
+    engine.save_checkpoint(save_dir, tag="good")
+    ref_params = jax.tree_util.tree_map(np.asarray, engine.state.params)
+
+    engine.train_batch()
+    fault_harness.configure("ckpt_crash_after_model_file")
+    with pytest.raises(fault_harness.InjectedCrash):
+        engine.save_checkpoint(save_dir, tag="torn")
+
+    # post-crash disk state: staging dir left behind, nothing committed,
+    # `latest` still points at the good tag
+    assert os.path.isdir(os.path.join(save_dir, "torn.tmp"))
+    assert not os.path.isdir(os.path.join(save_dir, "torn"))
+    assert atomic.read_latest(save_dir) == "good"
+    ok, problems = atomic.verify_checkpoint(
+        os.path.join(save_dir, "good"), level="full")
+    assert ok, problems
+
+    # restart path: auto_resume lands on the last valid checkpoint with all
+    # manifest checksums verified.  The fresh `.tmp` is left alone by the
+    # LOAD path (it could be another process's in-flight save) — staging
+    # dirs are invisible to tag resolution either way.
+    cfg = base_config(
+        checkpoint={"dir": save_dir, "auto_resume": True, "verify": "full"})
+    engine2, _, _, _ = ds.initialize(config=cfg, model=SimpleModel(),
+                                     training_data=random_dataset(n=64),
+                                     mesh=mesh8, rng_seed=99)
+    assert os.path.isdir(os.path.join(save_dir, "torn.tmp"))
+    assert engine2.global_steps == 3
+    assert engine2.loaded_checkpoint_tag == "good"
+    for a, b in zip(jax.tree_util.tree_leaves(ref_params),
+                    jax.tree_util.tree_leaves(
+                        jax.tree_util.tree_map(np.asarray,
+                                               engine2.state.params))):
+        np.testing.assert_array_equal(a, b)
+    # and training continues; the resumed job's next save — which OWNS the
+    # directory — sweeps the staging garbage
+    assert np.isfinite(float(engine2.train_batch()))
+    engine2.save_checkpoint(save_dir, tag="resumed")
+    assert not os.path.isdir(os.path.join(save_dir, "torn.tmp"))
+
+
+def test_crash_windows_around_commit(mesh8, tmp_path, fault_harness):
+    """One engine, two save dirs, two crash points:
+
+    - before the commit rename: B is fully staged + manifested but never
+      committed → invisible to load, previous tag stays live;
+    - after commit but before the `latest` update: stale pointer at a
+      still-valid tag — load follows it; auto-resume's newest-valid scan
+      finds the newer committed tag.  Either way: no torn state."""
+    dir_pre = os.path.join(str(tmp_path), "pre_commit")
+    dir_post = os.path.join(str(tmp_path), "post_commit")
+    engine = _make_engine(mesh8)
+    engine.train_batch()
+    engine.save_checkpoint(dir_pre, tag="A")
+    engine.save_checkpoint(dir_post, tag="A")
+    engine.train_batch()
+
+    fault_harness.configure("crash_at=ckpt.before_commit")
+    with pytest.raises(fault_harness.InjectedCrash):
+        engine.save_checkpoint(dir_pre, tag="B")
+    assert os.path.isdir(os.path.join(dir_pre, "B.tmp"))
+    assert not os.path.isdir(os.path.join(dir_pre, "B"))
+
+    fault_harness.configure("crash_at=ckpt.after_commit")
+    with pytest.raises(fault_harness.InjectedCrash):
+        engine.save_checkpoint(dir_post, tag="B")
+    assert atomic.read_latest(dir_post) == "A"          # stale but valid
+    assert atomic.verify_checkpoint(os.path.join(dir_post, "B"))[0]
+    assert atomic.find_latest_valid(dir_post) == "B"
+
+    engine2 = _make_engine(mesh8, seed=7)
+    for save_dir in (dir_pre, dir_post):
+        path, _ = engine2.load_checkpoint(save_dir)
+        assert path.endswith("A")
+        assert engine2.global_steps == 1
+
+
+class _RecordingHandler(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.WARNING)
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+def test_corrupted_checkpoint_falls_back_with_structured_warning(
+        mesh8, tmp_path, fault_harness):
+    save_dir = str(tmp_path)
+    engine = _make_engine(mesh8)
+    engine.train_batch()
+    engine.save_checkpoint(save_dir, tag="A")
+    engine.train_batch()
+    engine.save_checkpoint(save_dir, tag="B")
+
+    # flip one byte of B's model file (size unchanged: only sha256 sees it)
+    model = os.path.join(save_dir, "B", "model_states.msgpack")
+    raw = bytearray(open(model, "rb").read())
+    raw[100] ^= 0xFF
+    with open(model, "wb") as f:
+        f.write(bytes(raw))
+
+    engine2 = _make_engine(mesh8, seed=7)
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    handler = _RecordingHandler()
+    ds_logger.addHandler(handler)  # ds logger does not propagate to root
+    try:
+        path, _ = engine2.load_checkpoint(save_dir)
+    finally:
+        ds_logger.removeHandler(handler)
+    assert path.endswith("A")
+    assert engine2.global_steps == 1
+    fallback_logs = [m for m in handler.messages
+                     if "checkpoint_fallback" in m]
+    assert fallback_logs, "fallback must emit a structured warning"
+    payload = json.loads(fallback_logs[0].split("engaged: ", 1)[1])
+    assert payload["unusable_tag"] == "B"
+    assert payload["fallback_tag"] == "A"
+
+    # an EXPLICITLY requested corrupt tag is an error, not a silent swap
+    with pytest.raises(atomic.CheckpointValidationError):
+        engine2.load_checkpoint(save_dir, tag="B")
+
+    # pre-fault-tolerance layout (no manifest, as the old direct-to-final-
+    # path code wrote) must stay readable — with a warning, not a failure
+    import shutil
+    shutil.rmtree(os.path.join(save_dir, "B"))
+    os.remove(os.path.join(save_dir, "A", atomic.MANIFEST_FILE))
+    atomic.write_latest(save_dir, "A")
+    path, _ = engine2.load_checkpoint(save_dir)
+    assert path.endswith("A")
+    assert engine2.global_steps == 1
+
+    # ...and even with no usable `latest`, the legacy tag is the fallback
+    # of last resort: restore it rather than refuse (or cold-start over)
+    # restorable state
+    os.remove(os.path.join(save_dir, atomic.LATEST_FILE))
+    path, _ = engine2.load_checkpoint(save_dir)
+    assert path.endswith("A")
+    assert engine2.global_steps == 1
+
+    # ...but a CORRUPT manifest is a torn checkpoint, not a legacy one:
+    # with no other valid tag the load must refuse, never load unverified
+    with open(os.path.join(save_dir, "A", atomic.MANIFEST_FILE), "w") as f:
+        f.write('{"version": 1, "files"')
+    with pytest.raises(FileNotFoundError):
+        engine2.load_checkpoint(save_dir)
+
+
+def test_engine_keep_n_rotation_and_io_error_retry(mesh8, tmp_path,
+                                                   fault_harness):
+    """One engine, two save dirs: keep_n retention rotates old tags, and
+    injected transient IO errors at the write sites are absorbed by the
+    bounded-backoff retry — the checkpoint still commits and verifies."""
+    rot_dir = os.path.join(str(tmp_path), "rotation")
+    io_dir = os.path.join(str(tmp_path), "io_errors")
+    engine = _make_engine(mesh8, checkpoint={"keep_n": 2})
+    for tag in ("s1", "s2", "s3"):
+        engine.train_batch()
+        engine.save_checkpoint(rot_dir, tag=tag)
+    assert sorted(atomic.list_tags(rot_dir)) == ["s2", "s3"]
+    assert atomic.read_latest(rot_dir) == "s3"
+
+    fault_harness.configure(io_error_p=1.0, max_faults=2, seed=0)
+    engine.save_checkpoint(io_dir, tag="A")
+    assert fault_harness.plan().injected_io_errors == 2
+    ok, problems = atomic.verify_checkpoint(
+        os.path.join(io_dir, "A"), level="full")
+    assert ok, problems
+
+
+def test_env_can_disable_config_auto_resume(mesh8, tmp_path, monkeypatch):
+    """Precedence is kwarg > env > config: DSTPU_AUTO_RESUME=0 overrides a
+    config that enables auto-resume (the operator's one-shot cold start)."""
+    save_dir = str(tmp_path)
+    engine = _make_engine(mesh8)
+    engine.train_batch()
+    engine.save_checkpoint(save_dir)
+    monkeypatch.setenv("DSTPU_AUTO_RESUME", "0")
+    cfg = base_config(checkpoint={"dir": save_dir, "auto_resume": True})
+    engine2, _, _, _ = ds.initialize(config=cfg, model=SimpleModel(),
+                                     training_data=random_dataset(n=64),
+                                     mesh=mesh8)
+    assert engine2.global_steps == 0  # cold start despite config
+
+
+def test_auto_resume_cold_start_is_not_an_error(mesh8, tmp_path):
+    # a stray non-checkpoint dir must not defeat cold-start detection
+    os.makedirs(os.path.join(str(tmp_path), "tensorboard"))
+    cfg = base_config(checkpoint={"dir": str(tmp_path), "auto_resume": True})
+    engine, _, _, _ = ds.initialize(config=cfg, model=SimpleModel(),
+                                    training_data=random_dataset(n=64),
+                                    mesh=mesh8)
+    assert engine.global_steps == 0
+
+
+def test_launcher_auto_resume_and_fault_flags():
+    from deepspeed_tpu.launcher.runner import parse_args
+    args = parse_args(["--auto-resume", "--fault", "io_error_p=0.1",
+                       "train.py"])
+    assert args.auto_resume is True
+    assert args.fault == "io_error_p=0.1"
+    args = parse_args(["train.py"])
+    assert args.auto_resume is False and args.fault == ""
+
+
+# ---------------------------------------------------------------------------
+# acceptance companion: zero overhead in the compiled step
+# ---------------------------------------------------------------------------
+
+def test_jitted_step_identical_with_harness_armed(mesh8, fault_harness):
+    """Fault hooks live ONLY in host-side IO paths: the traced step program
+    must be identical with the harness armed vs disarmed."""
+    engine = _make_engine(mesh8)
+    batch = engine._stack_microbatches(
+        [next(engine._data_iterator)
+         for _ in range(engine.gradient_accumulation_steps())])
+    rng = jax.random.fold_in(engine._base_rng, 0)
+
+    def step_jaxpr():
+        # object reprs inside the jaxpr embed memory addresses that differ
+        # between otherwise-identical traces; mask them before comparing
+        with jax.set_mesh(engine.mesh):
+            text = str(jax.make_jaxpr(engine._train_step)(
+                engine.state, batch, rng))
+        return re.sub(r"0x[0-9a-f]+", "0x_", text)
+
+    jaxpr_off = step_jaxpr()
+    fault_harness.configure(
+        "engine_crash_step,io_error_p=1.0,io_delay_ms=100")
+    jaxpr_on = step_jaxpr()
+    assert jaxpr_on == jaxpr_off
+    # and none of the host-side sites fired during tracing
+    assert fault_harness.plan().hits == {}
+
+
+# ---------------------------------------------------------------------------
+# lint: no bare except / silently-swallowed OSError in deepspeed_tpu/
+# ---------------------------------------------------------------------------
+
+# files where an `except OSError: pass` is a reviewed, commented decision
+_SWALLOW_ALLOWLIST = {
+    "checkpoint/atomic.py",   # fsync on directories is optional per-filesystem
+}
+
+
+def _exception_names(node):
+    """Names mentioned in an except clause (handles tuples)."""
+    if node is None:
+        return []
+    elts = node.elts if isinstance(node, ast.Tuple) else [node]
+    return [e.id for e in elts if isinstance(e, ast.Name)]
+
+
+def test_no_bare_except_or_swallowed_oserror():
+    pkg_root = os.path.dirname(os.path.abspath(ds.__file__))
+    offenders = []
+    for root, _, names in os.walk(pkg_root):
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, pkg_root)
+            with open(full) as f:
+                tree = ast.parse(f.read(), filename=rel)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is None:
+                    offenders.append(f"{rel}:{node.lineno} bare `except:`")
+                    continue
+                swallows = (len(node.body) == 1
+                            and isinstance(node.body[0], ast.Pass))
+                mentions_oserror = any(
+                    n in ("OSError", "IOError", "EnvironmentError")
+                    for n in _exception_names(node.type))
+                if (swallows and mentions_oserror
+                        and rel not in _SWALLOW_ALLOWLIST):
+                    offenders.append(
+                        f"{rel}:{node.lineno} silently swallowed OSError")
+    assert not offenders, (
+        "IO errors must be retried, logged, or re-raised — never silently "
+        "dropped (docs/fault-tolerance.md):\n" + "\n".join(offenders))
