@@ -211,9 +211,57 @@ def measure_offload(preset, seq, micro, *, gas=1, steps=1, warmup=1,
 
 TIME_BUDGET_S = 27 * 60   # never run past this: the driver must see output
 
+# the driver tails stdout and json-parses the LAST line; everything about
+# the headline's framing lives in these three helpers so a unit test can
+# round-trip the exact path (tests/test_bench_headline.py)
+TAIL_CAPTURE_CHARS = 2000
+HEADLINE_MAX_CHARS = 1600   # stays well inside the tail window
+
+
+def format_headline(headline: dict) -> str:
+    """One compact JSON line; oversize extras are dropped, never split —
+    the headline must ALWAYS parse from a truncated tail capture."""
+    line = json.dumps(headline)
+    if len(line) > HEADLINE_MAX_CHARS:
+        headline = dict(headline)
+        headline["extra"] = {
+            "details_file": (headline.get("extra") or {}).get("details_file"),
+            "truncated": True}
+        line = json.dumps(headline)
+    assert "\n" not in line
+    return line
+
+
+def emit_headline(headline: dict, stream=None):
+    """Print the headline as the STRICT FINAL stdout line: logging is
+    rerouted to stderr (r4/r5 lost the flagship number to interleaved
+    output — ``parsed: null``), both streams are flushed, and the line
+    goes out last with its own flush."""
+    from deepspeed_tpu.utils.logging import route_logs_to_stderr
+    route_logs_to_stderr()
+    stream = stream if stream is not None else sys.stdout
+    line = format_headline(headline)
+    sys.stderr.flush()
+    stream.flush()
+    print(line, file=stream, flush=True)
+    return line
+
+
+def parse_headline_tail(tail: str) -> dict:
+    """The driver's parse path: tail capture → last non-empty line →
+    ``json.loads``.  Kept here so the emit side and the parse side are
+    tested against each other."""
+    lines = [ln for ln in tail[-TAIL_CAPTURE_CHARS:].splitlines()
+             if ln.strip()]
+    return json.loads(lines[-1])
+
 
 def main():
     import os
+    from deepspeed_tpu.utils.logging import route_logs_to_stderr
+    # stdout is the headline protocol; engine INFO chatter goes to stderr
+    # from the start so nothing can trail the final line
+    route_logs_to_stderr()
     t_start = time.time()
     left = lambda: TIME_BUDGET_S - (time.time() - t_start)
     extra = {"environment": {
@@ -342,11 +390,7 @@ def main():
     }
     if details_error:
         headline["extra"]["details_error"] = details_error
-    line = json.dumps(headline)
-    if len(line) > 1600:   # belt-and-braces: the headline must always parse
-        headline["extra"] = {"details_file": details_ref, "truncated": True}
-        line = json.dumps(headline)
-    print(line)
+    emit_headline(headline)
 
 
 if __name__ == "__main__":

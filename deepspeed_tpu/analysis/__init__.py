@@ -1,0 +1,29 @@
+"""Static analysis for compiled-step performance invariants.
+
+Two passes (docs/static-analysis.md):
+
+  - :mod:`jaxpr_audit` — given a jitted callable + example args (or a
+    ``DeepSpeedEngine`` via :func:`audit_engine`), statically verifies
+    the properties the perf story depends on: no host callbacks in the
+    step, no dtype promotion above the configured compute dtype,
+    donation actually honored by the compiled executable, the per-step
+    collective census within a declared comms budget, and no
+    weak-typed-scalar recompile hazards.
+  - :mod:`lint` — an AST rule engine (bare except, swallowed OSError,
+    tracing-safety rules) with per-site suppression comments.
+
+CLI: ``python -m deepspeed_tpu.analysis [paths] [--rules ...] [--json]``.
+"""
+
+from .comms import COLLECTIVE_KINDS, CommsBudget, check_budget, summarize
+from .findings import Finding, counts_by_severity, worst_severity
+from .jaxpr_audit import AuditReport, audit_engine, audit_fn, iter_eqns
+from .lint import REGISTRY, lint_file, lint_paths, select_rules
+from .lint import rules as _rules  # noqa: F401  (populate REGISTRY)
+
+__all__ = [
+    "AuditReport", "CommsBudget", "COLLECTIVE_KINDS", "Finding",
+    "REGISTRY", "audit_engine", "audit_fn", "check_budget",
+    "counts_by_severity", "iter_eqns", "lint_file", "lint_paths",
+    "select_rules", "summarize", "worst_severity",
+]

@@ -1,0 +1,121 @@
+"""Per-step collective census + declarative comms budget.
+
+The census is taken at two levels:
+
+  - **jaxpr level**: explicit named-axis collectives (``psum``,
+    ``all_gather``, ...) from ``shard_map``/``pmap`` regions — the
+    explicitly scheduled paths (pipeline, ring attention, MoE dispatch);
+  - **compiled-HLO level**: the collectives XLA's SPMD partitioner
+    inserted for sharding constraints (``all-reduce``, ``reduce-scatter``,
+    ...) — the implicit ZeRO traffic.
+
+A :class:`CommsBudget` declares per-kind ceilings (op count and payload
+bytes per step); :func:`check_budget` turns census overruns into
+findings.  ZeRO's comms-volume math (1x / 1x / 1.5x parameter bytes for
+stages 1/2/3, ZeRO arXiv:1910.02054 §7) makes these budgets writable in
+advance of a bench run.
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .findings import Finding
+
+# canonical kind names; both jaxpr primitives and HLO opcodes map here
+KIND_ALIASES = {
+    "psum": "all_reduce", "psum2": "all_reduce", "pmax": "all_reduce",
+    "pmin": "all_reduce", "all-reduce": "all_reduce",
+    "all_gather": "all_gather", "all-gather": "all_gather",
+    "psum_scatter": "reduce_scatter", "reduce_scatter": "reduce_scatter",
+    "reduce-scatter": "reduce_scatter",
+    "all_to_all": "all_to_all", "all-to-all": "all_to_all",
+    "ppermute": "collective_permute", "pshuffle": "collective_permute",
+    "collective-permute": "collective_permute",
+    "pbroadcast": "broadcast", "collective-broadcast": "broadcast",
+}
+
+COLLECTIVE_KINDS = tuple(sorted(set(KIND_ALIASES.values())))
+
+
+def canonical_kind(name: str) -> Optional[str]:
+    return KIND_ALIASES.get(name)
+
+
+@dataclass
+class CensusEntry:
+    kind: str                 # canonical kind
+    op: str                   # raw primitive / HLO opcode name
+    axes: tuple = ()          # named axes (jaxpr level; empty for HLO)
+    bytes: int = 0            # payload bytes (sum of output aval bytes)
+    eqn_path: Optional[str] = None
+    level: str = "jaxpr"      # "jaxpr" | "hlo"
+
+    def to_dict(self):
+        return {"kind": self.kind, "op": self.op, "axes": list(self.axes),
+                "bytes": self.bytes, "eqn_path": self.eqn_path,
+                "level": self.level}
+
+
+def summarize(census) -> dict:
+    """{kind: {"count": n, "bytes": total}} over both census levels."""
+    out = {}
+    for e in census:
+        rec = out.setdefault(e.kind, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += e.bytes
+    return out
+
+
+@dataclass
+class CommsBudget:
+    """Declarative per-step ceilings, checked against the census.
+
+    ``per_kind`` maps a canonical kind (see :data:`COLLECTIVE_KINDS`) to
+    ``{"max_count": int|None, "max_bytes": int|None}``; ``None`` (or a
+    missing kind) means unlimited.  ``total_max_bytes`` bounds the sum
+    over every kind.
+    """
+    per_kind: dict = field(default_factory=dict)
+    total_max_bytes: Optional[int] = None
+
+    def __post_init__(self):
+        for kind in self.per_kind:
+            assert kind in COLLECTIVE_KINDS, \
+                f"unknown collective kind {kind!r}; known: {COLLECTIVE_KINDS}"
+
+
+def check_budget(census, budget: CommsBudget):
+    """Census overruns → findings (rule DSTPU203)."""
+    findings = []
+    summary = summarize(census)
+    for kind, limits in budget.per_kind.items():
+        got = summary.get(kind, {"count": 0, "bytes": 0})
+        max_count = limits.get("max_count")
+        if max_count is not None and got["count"] > max_count:
+            findings.append(Finding(
+                "DSTPU203", "error",
+                f"comms budget exceeded: {got['count']} {kind} ops per step "
+                f"(budget {max_count})",
+                eqn_path=f"census/{kind}",
+                extra={"kind": kind, "count": got["count"],
+                       "max_count": max_count}))
+        max_bytes = limits.get("max_bytes")
+        if max_bytes is not None and got["bytes"] > max_bytes:
+            findings.append(Finding(
+                "DSTPU203", "error",
+                f"comms budget exceeded: {got['bytes']} {kind} payload "
+                f"bytes per step (budget {max_bytes})",
+                eqn_path=f"census/{kind}",
+                extra={"kind": kind, "bytes": got["bytes"],
+                       "max_bytes": max_bytes}))
+    if budget.total_max_bytes is not None:
+        total = sum(rec["bytes"] for rec in summary.values())
+        if total > budget.total_max_bytes:
+            findings.append(Finding(
+                "DSTPU203", "error",
+                f"comms budget exceeded: {total} total collective payload "
+                f"bytes per step (budget {budget.total_max_bytes})",
+                eqn_path="census/total",
+                extra={"bytes": total,
+                       "max_bytes": budget.total_max_bytes}))
+    return findings

@@ -1,0 +1,63 @@
+"""Machine-readable findings shared by the jaxpr auditor and the lint pass.
+
+One schema for both: a lint finding anchors to ``file:line``, an auditor
+finding anchors to an equation path inside the audited jaxpr
+(``eqn_path`` like ``pjit/scan/dot_general[3]``).  Severity gates the CLI
+exit code: ``error`` findings fail the run, ``warning``/``info`` report.
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+SEVERITIES = ("info", "warning", "error")
+
+
+@dataclass
+class Finding:
+    rule: str                      # stable rule id, e.g. "DSTPU102"
+    severity: str                  # "info" | "warning" | "error"
+    message: str
+    file: Optional[str] = None     # repo-relative path (lint findings)
+    line: Optional[int] = None
+    eqn_path: Optional[str] = None  # jaxpr equation path (audit findings)
+    extra: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        assert self.severity in SEVERITIES, self.severity
+
+    @property
+    def location(self) -> str:
+        if self.file is not None:
+            return f"{self.file}:{self.line}" if self.line else self.file
+        return self.eqn_path or "<program>"
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "severity": self.severity,
+             "message": self.message, "location": self.location}
+        if self.file is not None:
+            d["file"] = self.file
+        if self.line is not None:
+            d["line"] = self.line
+        if self.eqn_path is not None:
+            d["eqn_path"] = self.eqn_path
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    def __str__(self):
+        return f"{self.location}: {self.severity}: {self.rule}: {self.message}"
+
+
+def counts_by_severity(findings) -> dict:
+    out = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        out[f.severity] += 1
+    return out
+
+
+def worst_severity(findings) -> Optional[str]:
+    worst = None
+    for f in findings:
+        if worst is None or SEVERITIES.index(f.severity) > SEVERITIES.index(worst):
+            worst = f.severity
+    return worst

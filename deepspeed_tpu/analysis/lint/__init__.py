@@ -1,0 +1,152 @@
+"""AST lint rule engine with per-site suppressions.
+
+Grown out of the swallowed-OSError check that used to live inline in
+``tests/test_fault_tolerance.py``: rules are now first-class objects
+with stable ids, findings are machine-readable (``findings.Finding``),
+and deliberate violations are suppressed AT THE SITE with a comment —
+so the reviewed decision travels with the code, not with an allowlist
+in a far-away test file.
+
+Suppression syntax (``docs/static-analysis.md``):
+
+  x = risky()          # dstpu: disable=DSTPU102
+  # dstpu: disable=DSTPU101,DSTPU103      <- line above also works
+  # dstpu: disable-file=DSTPU102          <- whole file, any line
+
+Rules register themselves in :data:`REGISTRY` (see ``rules.py``); add a
+rule by subclassing :class:`Rule` and decorating with
+:func:`register`.
+"""
+
+import ast
+import io
+import os
+import re
+import tokenize
+
+from ..findings import Finding
+
+_SUPPRESS_LINE_RE = re.compile(r"#\s*dstpu:\s*disable=([\w,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*dstpu:\s*disable-file=([\w,\s]+)")
+
+REGISTRY = {}
+
+
+def register(cls):
+    """Class decorator: add a Rule subclass to the registry by id."""
+    rule = cls()
+    assert rule.id not in REGISTRY, f"duplicate rule id {rule.id}"
+    REGISTRY[rule.id] = rule
+    return cls
+
+
+class Rule:
+    """One lint rule.  Subclasses set the class attrs and implement
+    ``check(tree, src, relpath) -> iterable[Finding]``."""
+    id = ""
+    name = ""
+    severity = "error"
+    description = ""
+
+    def check(self, tree: ast.Module, src: str, relpath: str):
+        raise NotImplementedError
+
+    def finding(self, relpath, lineno, message):
+        return Finding(self.id, self.severity, message,
+                       file=relpath, line=lineno)
+
+
+class Suppressions:
+    """Parsed suppression comments for one file.
+
+    Only REAL comment tokens count (via ``tokenize``) — suppression text
+    quoted inside a string or docstring (e.g. a module documenting the
+    syntax) must not silently disable rules."""
+
+    def __init__(self, src: str):
+        self.by_line = {}      # lineno -> set of rule ids
+        self.file_level = set()
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(src).readline))
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            return    # unparseable source surfaces as DSTPU000 instead
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_FILE_RE.search(tok.string)
+            if m:
+                self.file_level |= _ids(m.group(1))
+                continue
+            m = _SUPPRESS_LINE_RE.search(tok.string)
+            if m:
+                self.by_line.setdefault(tok.start[0], set()).update(
+                    _ids(m.group(1)))
+
+    def active(self, rule_id: str, lineno) -> bool:
+        if rule_id in self.file_level:
+            return True
+        if lineno is None:
+            return False
+        # the flagged line itself, or a standalone comment just above it
+        return (rule_id in self.by_line.get(lineno, ()) or
+                rule_id in self.by_line.get(lineno - 1, ()))
+
+
+def _ids(text):
+    return {t.strip() for t in text.split(",") if t.strip()}
+
+
+def iter_py_files(paths):
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, names in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def select_rules(rule_ids=None):
+    from . import rules as _rules  # noqa: F401  (populates REGISTRY)
+    if rule_ids is None:
+        return list(REGISTRY.values())
+    unknown = set(rule_ids) - set(REGISTRY)
+    assert not unknown, f"unknown rule ids: {sorted(unknown)}; " \
+                        f"known: {sorted(REGISTRY)}"
+    return [REGISTRY[r] for r in rule_ids]
+
+
+def lint_file(path, rules=None, root=None, src=None):
+    """Run rules over one file; returns unsuppressed findings."""
+    rules = rules if rules is not None else select_rules()
+    relpath = os.path.relpath(path, root) if root else path
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    try:
+        tree = ast.parse(src, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("DSTPU000", "error", f"syntax error: {e.msg}",
+                        file=relpath, line=e.lineno)]
+    sup = Suppressions(src)
+    out = []
+    for rule in rules:
+        for f in rule.check(tree, src, relpath):
+            if not sup.active(f.rule, f.line):
+                out.append(f)
+    return out
+
+
+def lint_paths(paths, rules=None, root=None):
+    """Run rules over files/directories; returns sorted findings."""
+    rules = rules if rules is not None else select_rules()
+    findings = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, rules=rules, root=root))
+    findings.sort(key=lambda f: (f.file or "", f.line or 0, f.rule))
+    return findings

@@ -57,7 +57,7 @@ def fsync_dir(path):
     fd = os.open(path, os.O_RDONLY)
     try:
         os.fsync(fd)
-    except OSError:
+    except OSError:  # dstpu: disable=DSTPU002
         pass  # some filesystems refuse fsync on directories; rename is still atomic
     finally:
         os.close(fd)
@@ -151,7 +151,10 @@ def verify_checkpoint(ckpt_dir, level="full"):
         return False, [f"missing or unreadable {MANIFEST_FILE} in {ckpt_dir}"]
     if level == "off":
         return True, []
-    for rel, rec in manifest.get("files", {}).items():
+    files = manifest.get("files", {})
+    if not isinstance(files, dict):
+        return False, [f"malformed {MANIFEST_FILE}: 'files' is not a map"]
+    for rel, rec in files.items():
         full = os.path.join(ckpt_dir, rel)
         try:
             if not os.path.isfile(full):
@@ -163,10 +166,13 @@ def verify_checkpoint(ckpt_dir, level="full"):
                 continue
             if level == "full" and sha256_file(full) != rec["sha256"]:
                 problems.append(f"{rel}: sha256 mismatch")
-        except OSError as e:
-            # an unreadable file makes THIS tag invalid; it must not abort
-            # the caller's newest-valid fallback scan over the other tags
-            problems.append(f"{rel}: unreadable ({e})")
+        except (OSError, KeyError, TypeError) as e:
+            # an unreadable file — or a manifest that parses but lacks the
+            # expected record fields (hand-edited, foreign tool, future
+            # format rev) — makes THIS tag invalid; it must not abort the
+            # caller's newest-valid fallback scan over the other tags
+            problems.append(f"{rel}: unreadable or malformed record "
+                            f"({type(e).__name__}: {e})")
     return not problems, problems
 
 

@@ -14,6 +14,7 @@ bf16× 2) and fuses the rescale into the consuming matmul — the reference's
 dedicated dequant+gemm kernels fall out of the compiler.
 """
 
+import re
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -40,6 +41,13 @@ def default_predicate(path: str, leaf) -> bool:
     if not (hasattr(leaf, "ndim") and leaf.ndim >= 2 and leaf.size >= 4096):
         return False
     if min(leaf.shape[-2:]) < 64:      # stacked vectors, tiny matrices
+        return False
+    # per-layer vector leaves named *_b (GPT-family bias convention):
+    # stacked to (n_layer, D) they pass the shape gate once n_layer >= 64,
+    # but they are still biases — elementwise adds, not matmul weights
+    components = re.findall(r"\w+", path)
+    if components and (components[-1] == "b"
+                       or components[-1].endswith("_b")):
         return False
     name = path.lower()
     return not any(t in name for t in ("ln", "bias", "scale", "norm"))

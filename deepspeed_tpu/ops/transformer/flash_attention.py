@@ -287,6 +287,21 @@ _N_KV_BUF = 3    # triple buffer: slot (j+2)%3 held block j-1 (consumed one
 #                  grid step ago), so the j+2 fetch can start BEFORE block
 #                  j's compute with no read/write hazard
 
+# full unroll of the slot walk is only worth its compile time on short
+# rows: at dense layouts num_k_blocks grows with T/block_k and unroll=True
+# emits one copy of the whole matmul+softmax body PER BLOCK — Mosaic
+# compile time blows up superlinearly in program size.  Above the
+# threshold, unrolling by the ring depth keeps the slot indices cheap
+# (every _N_KV_BUF-th iteration reuses the same slot rotation) at O(1)
+# program size.
+_FULL_UNROLL_MAX_K_BLOCKS = 16
+
+
+def _slot_walk_unroll(num_k_blocks):
+    """fori_loop unroll for the DMA slot walk: full below the threshold,
+    ring-depth (_N_KV_BUF) above it."""
+    return True if num_k_blocks <= _FULL_UNROLL_MAX_K_BLOCKS else _N_KV_BUF
+
 
 def _fwd_kernel_dma(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
                     seq_len, n_heads=1, use_merge=False):
@@ -387,7 +402,8 @@ def _fwd_kernel_dma(*refs, sm_scale, causal, block_q, block_k, num_k_blocks,
         m_ref[:] = m_new
         return carry
 
-    jax.lax.fori_loop(0, num_k_blocks, body, 0, unroll=True)
+    jax.lax.fori_loop(0, num_k_blocks, body, 0,
+                      unroll=_slot_walk_unroll(num_k_blocks))
 
     l = l_ref[:]
     l_safe = jnp.where(l == 0.0, 1.0, l)

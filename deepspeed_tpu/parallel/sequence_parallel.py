@@ -19,6 +19,9 @@ axis ``seq``:
 Both are differentiable end-to-end (``ppermute``/``all_to_all`` have
 transpose rules), so no custom VJP machinery is needed.
 """
+# dstpu: disable-file=DSTPU102 (reviewed: SP/ring/Ulysses ARE explicitly
+# scheduled comms — collective order/overlap is the algorithm here, same
+# standing as parallel/collectives.py)
 
 import functools
 from typing import Callable, Optional

@@ -24,6 +24,8 @@ TPU re-design:
 Called inside ``shard_map``; all shapes static.  Returns
 ``(result, new_worker_error, new_server_error)``.
 """
+# dstpu: disable-file=DSTPU102 (reviewed: this IS a comms-layer module --
+# the 1-bit wire protocol schedules its own collectives by design)
 
 from typing import Optional, Tuple
 

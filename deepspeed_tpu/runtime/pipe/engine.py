@@ -39,6 +39,8 @@ schedule — every tick of every stage — is a single ``lax.scan`` inside a
 EVALUATION (forward only) keeps the simpler all-forward scan
 (``_pipeline_loss``), which needs no saved activations at all.
 """
+# dstpu: disable-file=DSTPU102 (reviewed: the pipeline schedule IS the
+# collective choreography -- ppermute ring order is the 1F1B timetable)
 
 import numpy as np
 import jax

@@ -222,7 +222,21 @@ class ParamStreamRunner:
         if self.nvme and 0 <= l < self.L:
             if self.swapper.available_swap_in_buffers() < 1:
                 return                # pool busy; fetch_layer will block
-            self.swapper.swap_in([l], async_op=True)
+            try:
+                self.swapper.swap_in([l], async_op=True)
+            except RuntimeError as e:
+                # the availability check above races in-flight release/
+                # acquire (swap_out's drain, a concurrent prefetch): the
+                # pool can empty between check and acquire.  Same benign
+                # condition as the guarded return — fall back to the
+                # blocking fetch.  Anything else (AIO submit failures
+                # arrive as their own error types) still raises.
+                if "no free swap buffer" not in str(e):
+                    raise
+                logger.debug(
+                    f"prefetch_layer_nvme({l}): swap buffer pool drained "
+                    "between availability check and acquire; falling back "
+                    "to the blocking fetch")
 
     def _upload_nonblock(self):
         nb_shapes = self._nb_shapes
@@ -334,10 +348,14 @@ class ParamStreamRunner:
                 xs.append(x)
                 x = J["block_fwd"](p, x, rngs[l],
                                    jnp.asarray(self.local_flags[l]))
+                # dispatch epoch BEFORE the next fetch: reading x proves
+                # only uploads consumed by layers <= l completed — the
+                # l+1 fetch below postdates that proof
+                ep_proved = self._h2d.dispatch_epoch
                 # prefetch next layer's params while this block computes
                 p_next = (self.fetch_layer(l + 1) if l + 1 < self.L
                           else None)
-                self._throttle(l, x)
+                self._throttle(l, x, ep_proved)
             del p, p_next
 
             # ---------- head: loss + gradients ----------
@@ -347,29 +365,36 @@ class ParamStreamRunner:
             # ---------- backward: stream layers down, grads out ----------
             self.prefetch_layer_nvme(self.L - 1)
             p_next = self.fetch_layer(self.L - 1)
-            pending = None            # (handle, lo, hi) grad d2h in flight
+            pending = None    # (handle, lo, hi, epoch) grad d2h in flight
             for l in range(self.L - 1, -1, -1):
                 p = p_next
                 self.prefetch_layer_nvme(l - 1)
                 dx, dp_flat = J["block_bwd"](
                     p, xs[l], rngs[l], jnp.asarray(self.local_flags[l]), dx)
+                # epoch proven once THIS layer's grads land (its bwd
+                # consumed p's upload); the l-1 fetch below postdates it
+                ep = self._h2d.dispatch_epoch
                 p_next = self.fetch_layer(l - 1) if l > 0 else None
                 handle = wire.d2h_flat_start(dp_flat)
                 del dp_flat
                 if pending is not None:
+                    ph, plo, phi, pep = pending
                     t1 = time.time()
-                    self._land_add(*pending, flat)
+                    self._land_add(ph, plo, phi, flat)
                     t_d2h += time.time() - t1
                     # landing reads the bwd outputs — a barrier proving
-                    # the consumed param uploads completed
-                    self._h2d.release_parked()
+                    # the param uploads dispatched up to that layer's
+                    # bwd (epoch pep) completed; later fetches excluded
+                    self._h2d.release_parked(pep)
                 lo, hi = self.layer_bounds[l]
-                pending = (handle, lo, hi)
+                pending = (handle, lo, hi, ep)
                 xs[l] = None          # free the saved activation
             if pending is not None:
+                ph, plo, phi, pep = pending
                 t1 = time.time()
-                self._land_add(*pending, flat)
+                self._land_add(ph, plo, phi, flat)
                 t_d2h += time.time() - t1
+                self._h2d.release_parked(pep)
             del p, p_next, xs
 
             # ---------- nonblock grads (device-accumulated) ----------
@@ -428,7 +453,7 @@ class ParamStreamRunner:
     def GC_AT_THROTTLE(self):
         return os.environ.get("DS_TPU_STREAM_GC", "0") == "1"
 
-    def _throttle(self, l, x):
+    def _throttle(self, l, x, proved_epoch=None):
         """Backpressure for the forward stream: without it the Python loop
         dispatches EVERY layer's upload before any compute finishes, and
         the runtime buffers up to the whole model's bytes in host RAM
@@ -442,8 +467,11 @@ class ParamStreamRunner:
             # the value read above transitively proves every upload
             # consumed by layers <= l completed — recycle their staging
             # buffers (parked pairs never self-observe ready on this
-            # runtime once their settle target is donated downstream)
-            self._h2d.release_parked()
+            # runtime once their settle target is donated downstream).
+            # proved_epoch was captured BEFORE the l+1 fetch dispatched,
+            # so that fetch's pairs (settled, possibly deleted, DMA not
+            # provably landed) stay parked until their own barrier.
+            self._h2d.release_parked(proved_epoch)
             if self.GC_AT_THROTTLE:
                 import gc
                 gc.collect()      # drop cyclic refs pinning transfer state
@@ -482,8 +510,9 @@ class ParamStreamRunner:
             self.prefetch_layer_nvme(l + 1)
             x = J["block_fwd"](p, x, rngs[l],
                                jnp.asarray(self.local_flags[l]))
+            ep_proved = self._h2d.dispatch_epoch
             p_next = self.fetch_layer(l + 1) if l + 1 < self.L else None
-            self._throttle(l, x)
+            self._throttle(l, x, ep_proved)
         return J["head_eval"](self._nonblock_dev, x, labels)
 
     # --------------------------------------------------------- checkpoints

@@ -124,8 +124,15 @@ class H2DUploader:
         # target; once THAT is deleted downstream they are parked until
         # release_parked() — a later settle_on must NOT re-key them (it
         # would hide their deletion and defeat the recycling barrier).
-        self._fresh = []          # (device_array, staging_buf)
-        self._settled = []        # (settle_target, staging_buf)
+        # Every pair carries the DISPATCH EPOCH of its upload_flat call:
+        # release_parked(epoch) recycles only pairs dispatched at or
+        # before the caller's proven barrier, so an upload dispatched
+        # AFTER the barrier value was computed (prefetch racing the
+        # throttle read) can never have its staging buffer reused while
+        # its h2d DMA may still be reading it.
+        self._fresh = []          # (device_array, staging_buf, epoch)
+        self._settled = []        # (settle_target, staging_buf, epoch)
+        self._epoch = 0           # bumped once per upload_flat call
 
     def _get_staging(self, nbytes):
         for i, buf in enumerate(self._staging):
@@ -133,10 +140,18 @@ class H2DUploader:
                 return self._staging.pop(i)
         return np.empty(nbytes, np.uint8)
 
+    @property
+    def dispatch_epoch(self):
+        """Epoch of the latest ``upload_flat`` dispatch.  Capture this
+        BEFORE dispatching compute whose later value-read will serve as
+        the completion barrier, and hand it to :meth:`release_parked` —
+        uploads dispatched after the capture are excluded."""
+        return self._epoch
+
     def _reclaim(self, block=False):
         def sweep(pairs):
             still = []
-            for arr, buf in pairs:
+            for arr, buf, epoch in pairs:
                 # is_deleted (e.g. donated downstream) does NOT mean the
                 # h2d DMA finished reading the staging buffer — donation
                 # marks deletion at dispatch.  Only an observed is_ready()
@@ -152,7 +167,7 @@ class H2DUploader:
                     if buf is not None:
                         self._staging.append(buf)
                 else:
-                    still.append((arr, buf))
+                    still.append((arr, buf, epoch))
             return still
         self._settled = sweep(self._settled)
         self._fresh = sweep(self._fresh)
@@ -163,6 +178,7 @@ class H2DUploader:
         spans = _chunk_bounds(host_flat.shape[0], host_flat.dtype.itemsize,
                               self.chunk_bytes)
         self._reclaim()
+        self._epoch += 1
         out = []
         for a, b in spans:
             src = host_flat[a:b]
@@ -175,7 +191,7 @@ class H2DUploader:
             arr = (jax.device_put(src, device) if device is not None
                    else jax.device_put(src))
             out.append(arr)
-            self._fresh.append((arr, buf))
+            self._fresh.append((arr, buf, self._epoch))
         return out
 
     def settle_on(self, arr):
@@ -188,21 +204,33 @@ class H2DUploader:
         newer targets would hide the deletion and defeat
         :meth:`release_parked` (the r5 6.7B probe leaked a staging buffer
         per layer fetch exactly this way)."""
-        self._settled += [(arr, buf) for _, buf in self._fresh]
+        self._settled += [(arr, buf, epoch) for _, buf, epoch in self._fresh]
         self._fresh = []
 
-    def release_parked(self):
+    def release_parked(self, epoch=None):
         """Recycle parked pairs after the CALLER has executed a true
         completion barrier (a VALUE READ of a downstream result — on
         remote-attached runtimes ``is_ready``/``block_until_ready`` may
-        never observe donated-then-deleted settle targets).  Only call at
-        a point that PROVES every previously dispatched consumer ran
-        (e.g. after reading a value that transitively depends on them)."""
-        for arr, buf in self._settled:
-            if arr.is_deleted() and buf is not None:
+        never observe donated-then-deleted settle targets).
+
+        ``epoch`` scopes the barrier's proof: only pairs whose upload was
+        dispatched at or before that :attr:`dispatch_epoch` capture are
+        eligible.  A pair dispatched AFTER the barrier value was computed
+        (the next layer's prefetch races the throttle read) can be
+        settled-and-deleted — its scatter was dispatched and donated its
+        chunks — while its h2d DMA has not provably read the staging
+        buffer yet; recycling it would hand a buffer still on the wire to
+        the next upload.  ``epoch=None`` keeps the legacy behavior
+        (recycle every deleted pair) for callers whose barrier, by
+        construction, postdates every dispatch (e.g. final-step flush)."""
+        def eligible(pair_epoch):
+            return epoch is None or pair_epoch <= epoch
+        for arr, buf, pair_epoch in self._settled:
+            if eligible(pair_epoch) and arr.is_deleted() \
+                    and buf is not None:
                 self._staging.append(buf)
-        self._settled = [(a, b) for a, b in self._settled
-                         if not a.is_deleted()]
+        self._settled = [(a, b, e) for a, b, e in self._settled
+                         if not (eligible(e) and a.is_deleted())]
 
     def wait(self):
         self._reclaim(block=True)

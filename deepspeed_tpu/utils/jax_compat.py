@@ -76,6 +76,7 @@ def install():
             lambda self: tuple(self.shape.values()))
     if not hasattr(jax.lax, "axis_size"):
         # psum of a Python constant is evaluated statically -> the axis size
+        # dstpu: disable=DSTPU102 (backfilling jax.lax itself, not user comms)
         jax.lax.axis_size = lambda name: jax.lax.psum(1, name)
     if not hasattr(jax.lax, "pcast"):
         # vma (varying-manual-axes) typing does not exist on old jax and the

@@ -44,6 +44,25 @@ logger = _LoggerFactory.create_logger(
     level=LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO))
 
 
+def route_logs_to_stderr():
+    """Point the package logger's stream handlers at stderr.
+
+    For machine-readable stdout protocols — ``bench.py``'s final JSON
+    headline line and ``python -m deepspeed_tpu.analysis --json`` — the
+    engine's INFO chatter must never interleave with (or trail) the
+    payload the driver parses off stdout.
+    """
+    for h in logger.handlers:
+        if isinstance(h, logging.StreamHandler):
+            try:
+                h.setStream(sys.stderr)
+            except ValueError:
+                # setStream flushes the OLD stream first, which may
+                # already be closed (a captured stream from a finished
+                # pytest test); swap without the flush
+                h.stream = sys.stderr
+
+
 @functools.lru_cache(maxsize=None)
 def _process_index():
     # Lazy: jax.process_index() is only valid after backend init; cache it.

@@ -58,6 +58,7 @@ def test_curriculum_fixed_discrete():
     assert sched.get_difficulty(11) == 3
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_curriculum_engine_crops_batch(devices):
     """Engine crops token batches to the scheduled seqlen (the jitted step
     retraces per difficulty exactly as the reference recompiles)."""

@@ -1,0 +1,77 @@
+"""bench.py headline framing — the driver-parse contract.
+
+BENCH_r04/r05 came back ``parsed: null``: the driver tails stdout and
+json-parses the LAST line, and the headline lost the race (ballooned
+extras / interleaved output).  These tests round-trip the emit side
+through the same tail-capture + ``json.loads`` path the driver uses.
+"""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _headline(extra):
+    return {"metric": "gpt2_350m_seq1024_bf16_zero1_mfu", "value": 0.5123,
+            "unit": "fraction_of_peak", "vs_baseline": 1.1384,
+            "extra": extra}
+
+
+def test_headline_roundtrips_through_driver_path(bench):
+    line = bench.format_headline(_headline(
+        {"details_file": "BENCH_DETAILS.json",
+         "summary_mfu": {"gpt2_350m_T1024_z2": 0.51}}))
+    # simulate the driver: noise before the headline + tail-window capture
+    noise = "\n".join(f"[INFO] step {i} loss=2.345" for i in range(200))
+    tail = (noise + "\n" + line + "\n")[-bench.TAIL_CAPTURE_CHARS:]
+    parsed = bench.parse_headline_tail(tail)
+    assert parsed["metric"] == "gpt2_350m_seq1024_bf16_zero1_mfu"
+    assert parsed["value"] == 0.5123
+
+
+def test_oversize_extras_truncate_but_still_parse(bench):
+    # r4/r5 failure mode: extras balloon past the tail window
+    fat = {"details_file": "BENCH_DETAILS.json"}
+    for i in range(100):
+        fat[f"config_{i}"] = {"mfu": 0.5, "note": "x" * 80}
+    line = bench.format_headline(_headline(fat))
+    assert len(line) <= bench.HEADLINE_MAX_CHARS
+    parsed = bench.parse_headline_tail("garbage\n" + line)
+    assert parsed["value"] == 0.5123
+    assert parsed["extra"]["truncated"] is True
+    assert parsed["extra"]["details_file"] == "BENCH_DETAILS.json"
+
+
+def test_emit_headline_is_strict_final_stdout_line(bench):
+    from deepspeed_tpu.utils.logging import logger
+    stream = io.StringIO()
+    bench.emit_headline(_headline({"details_file": None}), stream=stream)
+    # logging now points at stderr: a post-emit log call must not be able
+    # to trail the headline on stdout
+    out = stream.getvalue()
+    assert out.endswith("\n") and out.count("\n") == 1
+    for h in logger.handlers:
+        if hasattr(h, "stream"):
+            assert h.stream is sys.stderr
+    parsed = bench.parse_headline_tail(out)
+    assert parsed["value"] == 0.5123
+
+
+def test_single_line_invariant(bench):
+    line = bench.format_headline(_headline({"note": "a\nb"}))  # embedded \n
+    assert "\n" not in line
+    assert json.loads(line)["extra"]["note"] == "a\nb"

@@ -38,6 +38,7 @@ def test_seq_parallel_training_matches_dense(devices, impl):
     np.testing.assert_allclose(sp, ref, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_seq_parallel_with_fsdp(devices):
     # seq × fsdp compose: ZeRO-2 sharding + ring attention in one step
     model = build("gpt2-tiny", dtype=jnp.float32, attention_impl="ring_flash",

@@ -86,3 +86,20 @@ def test_auto_blocks_heuristic():
     assert _auto_blocks(4096, 128, None, None) == (512, 512)
     # explicit overrides pass through
     assert _auto_blocks(4096, 64, 256, 128) == (256, 128)
+
+
+def test_dma_slot_walk_unroll_bounded():
+    """Dense layouts make num_k_blocks = T/block_k large (T=8k, block=128
+    -> 64 slots); full unroll there emits the whole softmax body per slot
+    and blows Mosaic compile time.  The walk fully unrolls only below the
+    threshold and falls back to ring-depth unrolling above it (slot
+    rotation still static per unrolled group)."""
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        _FULL_UNROLL_MAX_K_BLOCKS, _N_KV_BUF, _slot_walk_unroll)
+    assert _slot_walk_unroll(1) is True
+    assert _slot_walk_unroll(_FULL_UNROLL_MAX_K_BLOCKS) is True
+    assert _slot_walk_unroll(_FULL_UNROLL_MAX_K_BLOCKS + 1) == _N_KV_BUF
+    assert _slot_walk_unroll(64) == _N_KV_BUF
+    # the bounded unroll must divide into the ring without aliasing a
+    # live slot: ring depth itself is the safe group size
+    assert _N_KV_BUF >= 2

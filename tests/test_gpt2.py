@@ -59,6 +59,7 @@ def test_remat_matches_norematerialization():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_remat_policies_and_chunked_loss_match():
     """Selective remat policies and the chunked LM-head loss are pure
     memory/scheduling changes — losses and gradients must match the

@@ -122,6 +122,7 @@ def test_hf_gpt2_injection_matches_transformers(devices):
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_hf_injection_generate(devices):
     transformers = pytest.importorskip("transformers")
     import torch

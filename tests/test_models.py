@@ -56,6 +56,7 @@ def test_bert_forward_shapes_and_mask():
                                np.asarray(h2[:, :28]), atol=1e-5)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_bert_mlm_training_loss_decreases(devices):
     model = Bert(preset="bert-tiny", dtype=jnp.float32)
     rng = np.random.RandomState(1)

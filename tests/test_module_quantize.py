@@ -241,3 +241,35 @@ def test_quantized_decode_streams_int8_and_matches_hoisted_dequant():
     out_ref = np.asarray(eng2.generate(jnp.asarray(ids), max_new_tokens=8))
     agree = (out_direct == out_ref).mean()
     assert agree > 0.9, f"token agreement {agree}\n{out_direct}\n{out_ref}"
+
+
+def test_stacked_per_layer_biases_slip_past_shape_gate_but_stay_fp():
+    """Leaves named ``*_b`` are per-layer bias VECTORS stacked to
+    (n_layer, D).  At n_layer >= 64 they pass the ``min(shape[-2:]) < 64``
+    heuristic (64 "rows" of 256+) and used to get int8-quantized — biases
+    feed elementwise adds, where quantization error lands directly on the
+    activations.  The predicate must exclude them by name."""
+    from deepspeed_tpu.module_inject.module_quantize import default_predicate
+    rng = np.random.default_rng(0)
+    L, D = 64, 256
+    params = {"h": {
+        "c_attn_b": rng.normal(size=(L, 3 * D)).astype(np.float32),
+        "mlp_fc_b": rng.normal(size=(L, 4 * D)).astype(np.float32),
+        "b": rng.normal(size=(L, D)).astype(np.float32),
+        "c_attn_w": rng.normal(size=(L, D, 3 * D)).astype(np.float32),
+    }, "head_w": rng.normal(size=(D, D)).astype(np.float32)}
+
+    # the shape gate alone would admit every one of these bias stacks
+    for key in ("c_attn_b", "mlp_fc_b", "b"):
+        leaf = params["h"][key]
+        assert leaf.ndim >= 2 and leaf.size >= 4096 \
+            and min(leaf.shape[-2:]) >= 64
+        assert not default_predicate(f"['h']['{key}']", leaf)
+
+    qtree, _ = quantize_param_tree(params, bits=8, groups=1)
+    for key in ("c_attn_b", "mlp_fc_b", "b"):
+        assert not _is_quantized_leaf(qtree["h"][key]), key
+        np.testing.assert_array_equal(qtree["h"][key], params["h"][key])
+    # real matmul weights (stacked or flat) still quantize
+    assert _is_quantized_leaf(qtree["h"]["c_attn_w"])
+    assert _is_quantized_leaf(qtree["head_w"])

@@ -337,6 +337,7 @@ def test_moe_with_zero_stages(devices):
 
 
 # ---------------------------------------------------- engine MoE bookkeeping
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_engine_metrics_carry_moe_aux_and_overflow(devices):
     """Training GPT-MoE through DeepSpeedEngine must surface the gate's aux
     loss and token-overflow count in train_batch metrics (reference: the
@@ -368,6 +369,7 @@ def test_engine_metrics_carry_moe_aux_and_overflow(devices):
     assert float(m["moe_tokens_dropped"]) >= 0.0
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_gpt_moe_16e_ep8_converges(devices):
     """The graded 16-expert shape: GPT-MoE with num_experts=16 trains on an
     expert=8 mesh (EP groups of 2 experts per rank) and the loss drops —

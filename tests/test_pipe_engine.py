@@ -224,6 +224,7 @@ def test_pipe_forbids_forward(devices):
         engine.forward(None)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_gpt2_pipeline_trains(devices):
     """The PP×DP graded config: pipelined GPT-2 over pipe=2 × data=4."""
     from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline
@@ -322,6 +323,7 @@ def test_pipe_no_recompute_does_not_slot_weights(devices):
         f"being slotted into the circular buffer")
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_pipe_tensor_parallel_composition(devices):
     """PP×TP×DP 3D composition: pipelined GPT-2 with Megatron column/row
     specs inside each stage must train and match the PP×DP loss sequence

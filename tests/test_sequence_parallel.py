@@ -182,6 +182,7 @@ def test_ring_flash_gradients(devices):
                                atol=5e-3)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_ulysses_flash_branch_matches_dense(devices, monkeypatch):
     # the default attn_fn picks the Pallas kernel when "available"; force it
     # on CPU (interpret mode) to cover the flash + all_to_all composition

@@ -64,6 +64,7 @@ def test_extend_position_embedding():
         np.asarray(params["position_embeddings"][:128]))
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_moe_cached_decode_matches_forward():
     # ample capacity: with token dropping, routing depends on which tokens
     # share the batch, so cached decode can only equal the full forward when

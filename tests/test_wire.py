@@ -109,3 +109,67 @@ def test_engine_scatter_spans_chunk_boundaries(devices):
             host, dev)
     finally:
         w.DEFAULT_CHUNK_BYTES = old
+
+
+def _consume_donated(chunks):
+    """Jitted consumer that DONATES the uploaded chunks (like the chunk
+    scatter): its output is the settle target."""
+    n = len(chunks)
+    f = jax.jit(lambda *cs: jnp.concatenate(cs) * 1.0,
+                donate_argnums=tuple(range(n)))
+    return f(*chunks)
+
+
+def test_release_parked_respects_dispatch_epoch():
+    """A pair settled-then-deleted for an upload dispatched AFTER the
+    caller's barrier must NOT recycle at that barrier: its h2d DMA is
+    not covered by the proof, and reusing the staging buffer would hand
+    memory still on the wire to the next upload.  Epoch-scoped
+    release_parked keeps it parked until its own barrier."""
+    up = wire.H2DUploader(chunk_bytes=40)   # 10 fp32 per chunk
+    x = np.arange(95, dtype=np.float32)
+
+    # upload A: settle, then its target is donated downstream (deleted
+    # without an observable ready) -> parked
+    chunks_a = up.upload_flat(x, stage=True)
+    n_a = len(chunks_a)
+    epoch_a = up.dispatch_epoch
+    out_a = _consume_donated(chunks_a)
+    up.settle_on(out_a)
+    out_a.delete()
+
+    # upload B (e.g. the next layer's prefetch, dispatched after the
+    # barrier value was computed): same fate
+    chunks_b = up.upload_flat(x.copy(), stage=True)
+    n_b = len(chunks_b)
+    epoch_b = up.dispatch_epoch
+    assert epoch_b > epoch_a
+    out_b = _consume_donated(chunks_b)
+    up.settle_on(out_b)
+    out_b.delete()
+
+    # barrier proves only epoch_a: A recycles, B stays parked
+    up.release_parked(epoch_a)
+    assert len(up._staging) == n_a
+    assert len(up._settled) == n_b
+    assert all(e == epoch_b for _, _, e in up._settled)
+
+    # B's own barrier then recycles it
+    up.release_parked(epoch_b)
+    assert len(up._staging) == n_a + n_b
+    assert not up._settled
+
+
+def test_release_parked_default_recycles_all_deleted():
+    """epoch=None keeps the legacy behavior for flush-style callers whose
+    barrier postdates every dispatch."""
+    up = wire.H2DUploader(chunk_bytes=40)
+    x = np.arange(30, dtype=np.float32)
+    for _ in range(2):
+        chunks = up.upload_flat(x, stage=True)
+        out = _consume_donated(chunks)
+        up.settle_on(out)
+        out.delete()
+    up.release_parked()
+    assert not up._settled
+    assert len(up._staging) > 0
