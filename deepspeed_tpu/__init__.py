@@ -12,6 +12,7 @@ from .version import __version__
 from .runtime.activation_checkpointing import checkpointing
 from .runtime.engine import DeepSpeedEngine
 from .runtime.config import DeepSpeedConfig
+from .runtime.health import HealthMonitor, TrainingHealthError
 from .runtime.lr_schedules import get_lr_scheduler
 from .runtime import zero
 from .utils.logging import logger, log_dist

@@ -29,11 +29,28 @@ tokens:
 - ``io_delay_ms=<float>``          sleep this long at each io site
 - ``max_faults=<int>``             cap on injected io errors (determinism)
 - ``seed=<int>``                   seed for the probability draws
+- ``grad_nan=<a>[:<b>]``           VALUE corruption: NaN-fill the float
+                                   leaves of every batch whose data-stream
+                                   index is in [a, b) (b defaults to a+1) —
+                                   the deterministic numerical fault that
+                                   drives the health guardian's
+                                   skip/rewind ladder
+- ``loss_spike=<a>[:<b>]``         VALUE corruption: scale the float leaves
+                                   by ``spike_factor`` over the window —
+                                   finite but wildly out-of-distribution
+- ``spike_factor=<float>``         loss_spike multiplier (default 1e4)
 
 Known sites (kept in ``SITES`` so tests and docs can't drift): checkpoint
 commit protocol (``ckpt.*``), tree serialization (``io.read``/``io.write``),
 AIO submits (``aio.submit``), and the engine's host-side step boundary
 (``engine.step``).
+
+Value-corruption faults (``grad_nan``/``loss_spike``) are NOT call sites:
+the engine passes each drawn batch through :func:`corrupt_batch` with its
+data-stream index, entirely host-side and BEFORE ``device_put`` — the
+compiled step program is byte-identical with them armed (the poison rides
+the data, exactly like a real corrupt batch would), and a rewind that
+fast-forwards the stream past the window genuinely cures the run.
 """
 
 import os
@@ -68,9 +85,25 @@ class InjectedIOError(OSError):
     """Simulated transient IO failure (retriable by classification)."""
 
 
+def _parse_window(val):
+    """``"a:b"`` -> (a, b); ``"a"`` -> (a, a+1).  Batch-index window,
+    half-open."""
+    val = str(val).strip()
+    if ":" in val:
+        a, b = val.split(":", 1)
+        lo, hi = int(a), int(b)
+    else:
+        lo = int(val)
+        hi = lo + 1
+    if hi <= lo:
+        raise ValueError(f"empty fault window {val!r} (need start < stop)")
+    return (lo, hi)
+
+
 class FaultPlan:
     def __init__(self, crash_sites=(), io_error_p=0.0, io_delay_ms=0.0,
-                 max_faults=None, seed=0):
+                 max_faults=None, seed=0, grad_nan=None, loss_spike=None,
+                 spike_factor=1e4):
         unknown = set(crash_sites) - set(SITES)
         assert not unknown, f"unknown fault sites {sorted(unknown)}; " \
                             f"valid: {SITES}"
@@ -78,6 +111,10 @@ class FaultPlan:
         self.io_error_p = float(io_error_p)
         self.io_delay_ms = float(io_delay_ms)
         self.max_faults = max_faults
+        self.grad_nan = tuple(grad_nan) if grad_nan is not None else None
+        self.loss_spike = (tuple(loss_spike) if loss_spike is not None
+                           else None)
+        self.spike_factor = float(spike_factor)
         self.rng = random.Random(seed)
         self.injected_io_errors = 0
         self.hits = {}            # site -> visit count (test observability)
@@ -94,10 +131,12 @@ class FaultPlan:
                 key = key.strip()
                 if key == "crash_at":
                     crash.append(val.strip())
-                elif key in ("io_error_p", "io_delay_ms"):
+                elif key in ("io_error_p", "io_delay_ms", "spike_factor"):
                     kw[key] = float(val)
                 elif key in ("max_faults", "seed"):
                     kw[key] = int(val)
+                elif key in ("grad_nan", "loss_spike"):
+                    kw[key] = _parse_window(val)
                 else:
                     raise ValueError(f"unknown fault spec key {key!r}")
             elif "_crash_" in token:
@@ -159,6 +198,52 @@ def site(name, path=None):
                 raise InjectedIOError(
                     f"injected IO error at {name}"
                     + (f" ({path})" if path else ""))
+
+
+def _map_float_leaves(batch, fn):
+    """Apply ``fn`` to every float numpy leaf of a host batch pytree
+    (dict / tuple / list / ndarray), returning a new tree.  No jax import:
+    this runs on raw loader output, before any device placement."""
+    import numpy as np
+    if isinstance(batch, dict):
+        return {k: _map_float_leaves(v, fn) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_map_float_leaves(v, fn) for v in batch)
+    arr = np.asarray(batch)
+    if np.issubdtype(arr.dtype, np.floating):
+        return fn(arr)
+    return batch
+
+
+def corrupt_batch(batch, index):
+    """Deterministic VALUE-corruption hook for the numerical fault sites.
+
+    Host-side only, applied to the raw loader batch BEFORE ``device_put``:
+    the compiled step never changes (the DSTPU201 audit and the
+    armed-vs-disarmed jaxpr-equality test stay valid), the poison simply
+    rides the data.  ``index`` is the engine's monotonic data-stream batch
+    index — checkpointed and restored with the data-pipeline state, so a
+    rewind replays the SAME poison window and a fast-forward past it
+    genuinely clears the fault.
+
+    Zero overhead disarmed: one module-global load and an ``is None`` test.
+    """
+    if _PLAN is None:
+        return batch
+    import numpy as np
+    p = _PLAN
+    idx = int(index)
+
+    def in_window(w):
+        return w is not None and w[0] <= idx < w[1]
+
+    if in_window(p.grad_nan):
+        p.hits["fault.grad_nan"] = p.hits.get("fault.grad_nan", 0) + 1
+        return _map_float_leaves(batch, lambda a: np.full_like(a, np.nan))
+    if in_window(p.loss_spike):
+        p.hits["fault.loss_spike"] = p.hits.get("fault.loss_spike", 0) + 1
+        return _map_float_leaves(batch, lambda a: a * p.spike_factor)
+    return batch
 
 
 # env wiring: a preemption-test job (or `deepspeed --fault=...` launch) arms

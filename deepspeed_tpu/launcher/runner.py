@@ -61,6 +61,16 @@ def parse_args(args=None):
     parser.add_argument("--fault", type=str, default="",
                         help="Arm the fault-injection harness for the job "
                              "(sets DSTPU_FAULT=<spec>; test/chaos runs only)")
+    parser.add_argument("--health-check", default=None, action="store_true",
+                        dest="health_check",
+                        help="Force the training health guardian on (sets "
+                             "DSTPU_HEALTH_CHECK=1, overriding a config "
+                             "that disables it; see docs/health-monitor.md)")
+    parser.add_argument("--no-health-check", dest="health_check",
+                        action="store_false",
+                        help="Force the health guardian OFF (sets "
+                             "DSTPU_HEALTH_CHECK=0) — e.g. for numerics "
+                             "debugging where NaN steps must be applied")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -180,6 +190,8 @@ def main(args=None):
         env["DSTPU_AUTO_RESUME"] = "1"
     if args.fault:
         env["DSTPU_FAULT"] = args.fault
+    if args.health_check is not None:
+        env["DSTPU_HEALTH_CHECK"] = "1" if args.health_check else "0"
     cmd_tail = [args.user_script] + list(args.user_args)
 
     if not active or (len(active) == 1 and not args.force_multi):

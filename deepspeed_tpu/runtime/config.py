@@ -249,6 +249,10 @@ class DeepSpeedIORetryConfig:
             r, C.IO_RETRY_MAX_DELAY_S, C.IO_RETRY_MAX_DELAY_S_DEFAULT))
         self.jitter = float(get_scalar_param(
             r, C.IO_RETRY_JITTER, C.IO_RETRY_JITTER_DEFAULT))
+        self.full_jitter = bool(get_scalar_param(
+            r, C.IO_RETRY_FULL_JITTER, C.IO_RETRY_FULL_JITTER_DEFAULT))
+        self.max_elapsed_s = get_scalar_param(
+            r, C.IO_RETRY_MAX_ELAPSED_S, C.IO_RETRY_MAX_ELAPSED_S_DEFAULT)
         if self.max_attempts < 1:
             raise DeepSpeedConfigError("io_retry.max_attempts must be >= 1")
         if self.base_delay_s < 0 or self.max_delay_s < 0:
@@ -256,14 +260,91 @@ class DeepSpeedIORetryConfig:
                 "io_retry.base_delay_s/max_delay_s must be >= 0")
         if not (0.0 <= self.jitter < 1.0):
             raise DeepSpeedConfigError("io_retry.jitter must be in [0, 1)")
+        if self.max_elapsed_s is not None:
+            self.max_elapsed_s = float(self.max_elapsed_s)
+            if self.max_elapsed_s <= 0:
+                raise DeepSpeedConfigError(
+                    "io_retry.max_elapsed_s must be > 0 (or absent)")
 
     def policy(self, **overrides):
         from ..utils.retry import RetryPolicy
         kw = dict(max_attempts=self.max_attempts,
                   base_delay_s=self.base_delay_s,
-                  max_delay_s=self.max_delay_s, jitter=self.jitter)
+                  max_delay_s=self.max_delay_s, jitter=self.jitter,
+                  jitter_mode="full" if self.full_jitter else "proportional",
+                  max_elapsed_s=self.max_elapsed_s)
         kw.update(overrides)
         return RetryPolicy(**kw)
+
+
+class DeepSpeedHealthCheckConfig:
+    """Training health guardian knobs (``runtime/health.py``;
+    docs/health-monitor.md).  The escalation ladder:
+
+    - ``skip_nonfinite`` — branchless skip-step on any non-finite
+      loss/grad/param sentinel (default on; the bf16/fp32 extension of the
+      fp16 loss-scaler skip);
+    - ``spike_zmax``/``spike_window``/``skip_on_spike`` — EMA loss-spike
+      z-score sentinel (zmax 0 disables);
+    - ``consecutive_skip_budget`` exhausted -> in-process rewind to the
+      newest valid checkpoint + data fast-forward past the poison window;
+    - ``rewind_limit`` exhausted -> ``on_exhausted`` (abort with a forensic
+      JSON dump, or warn and continue unprotected).
+
+    Env ``DSTPU_HEALTH_CHECK`` (set by ``deepspeed --health-check``)
+    overrides ``enabled`` in either direction.
+    """
+
+    def __init__(self, param_dict):
+        import os as _os
+        h = get_dict_param(param_dict, C.HEALTH_CHECK, {}) or {}
+        self.enabled = bool(get_scalar_param(h, C.HEALTH_ENABLED,
+                                             C.HEALTH_ENABLED_DEFAULT))
+        env = _os.environ.get("DSTPU_HEALTH_CHECK")
+        if env:
+            self.enabled = env.lower() in ("1", "true", "yes")
+        self.skip_nonfinite = bool(get_scalar_param(
+            h, C.HEALTH_SKIP_NONFINITE, C.HEALTH_SKIP_NONFINITE_DEFAULT))
+        self.spike_window = int(get_scalar_param(
+            h, C.HEALTH_SPIKE_WINDOW, C.HEALTH_SPIKE_WINDOW_DEFAULT))
+        self.spike_zmax = float(get_scalar_param(
+            h, C.HEALTH_SPIKE_ZMAX, C.HEALTH_SPIKE_ZMAX_DEFAULT))
+        self.skip_on_spike = bool(get_scalar_param(
+            h, C.HEALTH_SKIP_ON_SPIKE, C.HEALTH_SKIP_ON_SPIKE_DEFAULT))
+        self.consecutive_skip_budget = int(get_scalar_param(
+            h, C.HEALTH_SKIP_BUDGET, C.HEALTH_SKIP_BUDGET_DEFAULT))
+        self.rewind_limit = int(get_scalar_param(
+            h, C.HEALTH_REWIND_LIMIT, C.HEALTH_REWIND_LIMIT_DEFAULT))
+        self.on_exhausted = get_scalar_param(
+            h, C.HEALTH_ON_EXHAUSTED, C.HEALTH_ON_EXHAUSTED_DEFAULT)
+        self.check_interval = int(get_scalar_param(
+            h, C.HEALTH_CHECK_INTERVAL, C.HEALTH_CHECK_INTERVAL_DEFAULT))
+        self.history = int(get_scalar_param(
+            h, C.HEALTH_HISTORY, C.HEALTH_HISTORY_DEFAULT))
+        self.forensic_dir = get_scalar_param(
+            h, C.HEALTH_FORENSIC_DIR, C.HEALTH_FORENSIC_DIR_DEFAULT)
+        if self.spike_window < 2:
+            raise DeepSpeedConfigError("health_check.spike_window must be >= 2")
+        if self.spike_zmax < 0:
+            raise DeepSpeedConfigError("health_check.spike_zmax must be >= 0")
+        if self.skip_on_spike and self.spike_zmax <= 0:
+            raise DeepSpeedConfigError(
+                "health_check.skip_on_spike needs spike_zmax > 0 (the "
+                "spike sentinel is off at zmax=0)")
+        if self.consecutive_skip_budget < 0:
+            raise DeepSpeedConfigError(
+                "health_check.consecutive_skip_budget must be >= 0")
+        if self.rewind_limit < 0:
+            raise DeepSpeedConfigError("health_check.rewind_limit must be >= 0")
+        if self.on_exhausted not in C.HEALTH_ON_EXHAUSTED_MODES:
+            raise DeepSpeedConfigError(
+                f"health_check.on_exhausted must be one of "
+                f"{C.HEALTH_ON_EXHAUSTED_MODES}")
+        if self.check_interval < 1:
+            raise DeepSpeedConfigError(
+                "health_check.check_interval must be >= 1")
+        if self.history < 1:
+            raise DeepSpeedConfigError("health_check.history must be >= 1")
 
 
 class DeepSpeedMeshConfig:
@@ -487,6 +568,7 @@ class DeepSpeedConfig:
         self.quantize_training = DeepSpeedQuantizeTrainingConfig(pd)
         self.checkpoint_config = DeepSpeedCheckpointConfig(pd)
         self.io_retry_config = DeepSpeedIORetryConfig(pd)
+        self.health_check = DeepSpeedHealthCheckConfig(pd)
         self.mesh_config = DeepSpeedMeshConfig(pd)
         self.sequence_parallel = DeepSpeedSequenceParallelConfig(pd)
         self.wall_clock_breakdown = get_scalar_param(pd, C.WALL_CLOCK_BREAKDOWN,

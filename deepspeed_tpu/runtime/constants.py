@@ -283,6 +283,38 @@ IO_RETRY_MAX_DELAY_S = "max_delay_s"
 IO_RETRY_MAX_DELAY_S_DEFAULT = 2.0
 IO_RETRY_JITTER = "jitter"
 IO_RETRY_JITTER_DEFAULT = 0.25
+IO_RETRY_FULL_JITTER = "full_jitter"
+IO_RETRY_FULL_JITTER_DEFAULT = False   # True = AWS-style uniform(0, nominal)
+IO_RETRY_MAX_ELAPSED_S = "max_elapsed_s"
+IO_RETRY_MAX_ELAPSED_S_DEFAULT = None  # None = no overall wall-clock cap
+
+#############################################
+# Health guardian (divergence sentinels + skip/rewind/abort escalation)
+#############################################
+HEALTH_CHECK = "health_check"
+HEALTH_ENABLED = "enabled"
+HEALTH_ENABLED_DEFAULT = True
+HEALTH_SKIP_NONFINITE = "skip_nonfinite"
+HEALTH_SKIP_NONFINITE_DEFAULT = True
+HEALTH_SPIKE_WINDOW = "spike_window"
+HEALTH_SPIKE_WINDOW_DEFAULT = 50       # EMA horizon (steps) for loss stats
+HEALTH_SPIKE_ZMAX = "spike_zmax"
+HEALTH_SPIKE_ZMAX_DEFAULT = 0.0        # 0 = spike detection off
+HEALTH_SKIP_ON_SPIKE = "skip_on_spike"
+HEALTH_SKIP_ON_SPIKE_DEFAULT = False
+HEALTH_SKIP_BUDGET = "consecutive_skip_budget"
+HEALTH_SKIP_BUDGET_DEFAULT = 10        # 0 = never escalate past skipping
+HEALTH_REWIND_LIMIT = "rewind_limit"
+HEALTH_REWIND_LIMIT_DEFAULT = 4        # per poison episode (in-process, cheap)
+HEALTH_ON_EXHAUSTED = "on_exhausted"
+HEALTH_ON_EXHAUSTED_DEFAULT = "abort"
+HEALTH_ON_EXHAUSTED_MODES = ["abort", "warn"]
+HEALTH_CHECK_INTERVAL = "check_interval"
+HEALTH_CHECK_INTERVAL_DEFAULT = 1      # monitor trails the device by N steps
+HEALTH_HISTORY = "history"
+HEALTH_HISTORY_DEFAULT = 64            # forensic ring-buffer length (steps)
+HEALTH_FORENSIC_DIR = "forensic_dir"
+HEALTH_FORENSIC_DIR_DEFAULT = None     # None -> checkpoint.dir or cwd
 
 #############################################
 # Dataloader

@@ -41,6 +41,19 @@ class RepeatingLoader:
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
 
+    # -- data-pipeline checkpoint state (docs/health-monitor.md) ----------
+    def state_dict(self):
+        sd = getattr(self.loader, "state_dict", None)
+        return sd() if callable(sd) else None
+
+    def load_state_dict(self, state):
+        lsd = getattr(self.loader, "load_state_dict", None)
+        if callable(lsd) and state is not None:
+            lsd(state)
+            # drop the in-flight epoch iterator: the restored position
+            # takes effect on the next __next__
+            self.data_iter = iter(self.loader)
+
 
 def _default_collate(samples):
     """Stack a list of samples into a batch pytree of numpy arrays."""
@@ -63,6 +76,8 @@ class DeepSpeedDataLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.epoch = 0
+        self.batch_index = 0      # batches yielded so far this epoch
+        self._resume_batch = 0    # one-shot __iter__ offset set by restore
         self.drop_last = drop_last
         self.collate_fn = collate_fn or _default_collate
         self._columnar = None
@@ -92,6 +107,26 @@ class DeepSpeedDataLoader:
 
     def new_epoch(self):
         self.epoch += 1
+        self.batch_index = 0
+        self._resume_batch = 0
+
+    # -- checkpointable sampler state (docs/health-monitor.md) -------------
+    # The batch stream is a pure function of (seed, epoch, batch_index):
+    # _order() derives the permutation from seed+epoch, so restoring these
+    # three integers resumes the EXACT stream — replay after a
+    # load_checkpoint / auto_resume / engine.rewind() sees the same batches
+    # in the same order instead of restarting the sampler from scratch.
+    def state_dict(self):
+        return {"seed": self.seed, "epoch": self.epoch,
+                "batch_index": self.batch_index}
+
+    def load_state_dict(self, state):
+        self.seed = int(state.get("seed", self.seed))
+        self.epoch = int(state.get("epoch", 0))
+        self.batch_index = int(state.get("batch_index", 0))
+        # consumed by the NEXT __iter__ only: a plain re-iteration (no
+        # restore) keeps the historical restart-from-zero semantics
+        self._resume_batch = self.batch_index
 
     def _order(self):
         idx = np.arange(self._len)
@@ -107,19 +142,30 @@ class DeepSpeedDataLoader:
             return tuple(np.asarray(a)[indices] for a in self.dataset)
         return self.collate_fn([self.dataset[int(i)] for i in indices])
 
-    def __iter__(self):
-        order = self._order()
+    def _batch_indices(self, order):
+        """The epoch's batch index-arrays, in yield order (deterministic
+        given (seed, epoch) — the contract state_dict restore relies on)."""
         n_full = self._len // self.batch_size
         for b in range(n_full):
-            yield self._take(order[b * self.batch_size:(b + 1) * self.batch_size])
+            yield order[b * self.batch_size:(b + 1) * self.batch_size]
         rem = self._len - n_full * self.batch_size
         if rem and not self.drop_last:
             # pad the tail by cycling (keeps shapes static for jit; np.resize
             # repeats the order as many times as needed for tiny datasets)
             tail = order[n_full * self.batch_size:]
             pad = np.resize(order, self.batch_size - rem)
-            yield self._take(np.concatenate([tail, pad]))
+            yield np.concatenate([tail, pad])
         elif self._len < self.batch_size and n_full == 0:
             # tiny dataset + drop_last: cycle to one full batch rather than
             # yielding nothing (RepeatingLoader would otherwise spin forever)
-            yield self._take(np.resize(order, self.batch_size))
+            yield np.resize(order, self.batch_size)
+
+    def __iter__(self):
+        start, self._resume_batch = self._resume_batch, 0
+        self.batch_index = start
+        order = self._order()
+        for i, idx in enumerate(self._batch_indices(order)):
+            if i < start:
+                continue
+            self.batch_index = i + 1
+            yield self._take(idx)

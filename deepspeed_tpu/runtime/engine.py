@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .config import DeepSpeedConfig
 from . import constants as C
+from . import health as hmod
 from .fp16 import loss_scaler as ls
 from .lr_schedules import get_lr_scheduler
 from .dataloader import DeepSpeedDataLoader, RepeatingLoader
@@ -55,11 +56,12 @@ class TrainState(NamedTuple):
     """Device-resident training state (one pytree, donated each step)."""
     global_steps: jnp.ndarray      # i32 — optimizer boundaries seen (incl. skipped)
     optimizer_steps: jnp.ndarray   # i32 — actual optimizer steps (Adam bias corr.)
-    skipped_steps: jnp.ndarray     # i32 — overflow-skipped steps
+    skipped_steps: jnp.ndarray     # i32 — overflow/health-skipped steps
     params: Any                    # compute-dtype params (sharded per ZeRO stage)
     master: Any                    # fp32 master params (None when training fp32)
     opt_state: Any
     scale: Any                     # LossScaleState (None unless fp16)
+    health: Any = None             # health.HealthState (None when guardian off)
 
 
 def _resolve_model(model, loss_fn, params, apply_fn, rng_seed,
@@ -141,6 +143,25 @@ class DeepSpeedEngine:
                               "float32": jnp.float32}[self.config.precision_dtype]
         self.fp16_enabled = self.config.fp16.enabled
         self.bfloat16_enabled = self.config.bf16.enabled
+
+        # ---- training health guardian (runtime/health.py) ----------------
+        # On-device divergence sentinels + branchless skip-step for EVERY
+        # precision (the fp16 scaler covers only fp16 overflow; a NaN/Inf
+        # gradient under bf16 — the TPU default — would otherwise be
+        # written irrecoverably into params), plus the host-side
+        # skip -> rewind -> abort escalation ladder.
+        self._health_cfg = self.config.health_check
+        self._health_enabled = self._health_cfg.enabled
+        self.health_monitor = (hmod.HealthMonitor(self._health_cfg)
+                               if self._health_enabled else None)
+        self._stream_step = 0        # monotonic data-stream batch index
+        self._last_batch_index = None  # stream index of the running step
+        # True while _stream_step and the live iterator agree (fresh engine,
+        # or a load that restored the loader state); loading a pre-guardian
+        # checkpoint loses the correspondence and disables fast-forward
+        self._stream_pos_known = True
+        self._ff_stride = 1          # same-episode rewind fast-forward stride
+        self._last_ckpt_dir = self.config.checkpoint_config.dir
 
         # ---- model ---------------------------------------------------------
         self.module = model
@@ -296,7 +317,13 @@ class DeepSpeedEngine:
                     grad_clip=self.config.gradient_clipping,
                     zero_config=self.config.zero_config,
                     aio_config=self.config.aio_config,
-                    retry=self.config.io_retry_config.policy())
+                    retry=self.config.io_retry_config.policy(),
+                    skip_nonfinite=(self._health_enabled
+                                    and self._health_cfg.skip_nonfinite),
+                    spike=((self._health_cfg.spike_window,
+                            self._health_cfg.spike_zmax,
+                            self._health_cfg.skip_on_spike)
+                           if self._health_enabled else None))
             else:
                 self._offload = HostOffloadOptimizer(
                     params0, self.config.zero_config, self.config.aio_config,
@@ -469,13 +496,15 @@ class DeepSpeedEngine:
 
         if self._param_stream is not None:
             # streamed params: nothing model-sized lives on the device;
-            # the runner owns the nonblock tree and the host owns the rest
+            # the runner owns the nonblock tree and the host owns the rest.
+            # Health sentinels for this path are host-side (the runner's
+            # metrics are host values already), so no device HealthState.
             self._scaler = None       # fp16 rejected for streamed mode
             z = lambda: jax.device_put(jnp.asarray(0, jnp.int32),
                                        self._repl_sh)
             return TrainState(global_steps=z(), optimizer_steps=z(),
                               skipped_steps=z(), params=None, master=None,
-                              opt_state=None, scale=None)
+                              opt_state=None, scale=None, health=None)
 
         # one jitted cast: in the offload path ON THE HOST backend (only the
         # 16-bit image then crosses the wire, placed in a second step);
@@ -501,7 +530,8 @@ class DeepSpeedEngine:
             z = lambda: jax.device_put(jnp.asarray(0, jnp.int32), self._repl_sh)
             return TrainState(global_steps=z(), optimizer_steps=z(),
                               skipped_steps=z(), params=params, master=None,
-                              opt_state=None, scale=scale)
+                              opt_state=None, scale=scale,
+                              health=self._init_health_device())
 
         master = jax.device_put(params0, self._master_sh) if needs_master else None
 
@@ -526,7 +556,15 @@ class DeepSpeedEngine:
         z = lambda: jax.device_put(jnp.asarray(0, jnp.int32), self._repl_sh)
         return TrainState(global_steps=z(), optimizer_steps=z(), skipped_steps=z(),
                           params=params, master=master, opt_state=opt_state,
-                          scale=scale)
+                          scale=scale, health=self._init_health_device())
+
+    def _init_health_device(self):
+        """Fresh (replicated) device HealthState, or None when the guardian
+        is off.  Also the post-load reset: a restored run must not inherit
+        the EMA statistics of the poisoned steps it just discarded."""
+        if not self._health_enabled:
+            return None
+        return jax.device_put(hmod.init_state(), self._repl_sh)
 
     def _opt_shardings(self, opt_state):
         """Optimizer-state leaves that are param-shaped inherit the master
@@ -626,8 +664,8 @@ class DeepSpeedEngine:
         cur_scale = (state.scale.cur_scale if state.scale is not None
                      else jnp.float32(1.0))
         out = self._grad_fn(base, batch, rng, cur_scale)
-        # PipelineEngine's override returns (grads, loss); the base path
-        # adds the model's aux-metric dict
+        # uniform (grads, loss, aux) contract; a 2-tuple from a legacy
+        # client override still unpacks
         grads, scaled_loss_sum, aux = out if len(out) == 3 else (*out, {})
         # unscale (fp16); loss for reporting is the true mean loss
         grads = jax.tree_util.tree_map(lambda g: g / cur_scale, grads)
@@ -646,11 +684,41 @@ class DeepSpeedEngine:
         metrics.update(aux)
         return grads, overflow, lr, metrics
 
+    def _health_sentinels(self, state, loss, grads, overflow):
+        """On-device divergence sentinels (traced into the step; pure jnp,
+        no host callbacks — the DSTPU201 audit stays clean).
+
+        Returns ``(skip, new_health, sentinel_metrics)`` where ``skip``
+        gates the branchless skip-step.  For fp16 the grad flag reuses the
+        scaler's overflow scan (one reduction, not two)."""
+        cfg = self._health_cfg
+        nf_grads = (overflow if self.fp16_enabled
+                    else hmod.tree_nonfinite(grads))
+        nf_loss = jnp.logical_not(jnp.isfinite(loss))
+        new_health, z, spike = hmod.update_ema(
+            state.health, loss, window=cfg.spike_window,
+            zmax=cfg.spike_zmax)
+        skip = overflow
+        if cfg.skip_nonfinite:
+            skip = skip | nf_grads | nf_loss
+        if cfg.skip_on_spike:
+            skip = skip | spike
+        sm = {"nonfinite_grads": nf_grads, "nonfinite_loss": nf_loss,
+              "health_z": z, "loss_spike": spike}
+        return skip, new_health, sm
+
     def _train_step(self, state: TrainState, batch, rng):
         """One full optimizer step: scan over gas microbatches, reduce, update.
 
         ``batch`` leaves are shaped (gas, global_micro_batch, ...) with the
         second axis sharded over the batch axes (data, fsdp, expert).
+
+        With the health guardian enabled (default), the fp16 scaler's
+        branchless skip-step generalizes to EVERY precision: a step whose
+        loss, gradients, or updated parameters are non-finite (or whose
+        loss z-score spikes, when ``skip_on_spike`` is set) is a ``where``-
+        selected no-op on params and optimizer state — no data-dependent
+        control flow, donation honored, no host round-trip.
         """
         dtype = self.compute_dtype
         needs_master = dtype != jnp.float32
@@ -658,17 +726,38 @@ class DeepSpeedEngine:
 
         grads, overflow, lr, metrics = self._grads_and_metrics(
             state, base, batch, rng)
+        if self._health_enabled:
+            skip, new_health, sm = self._health_sentinels(
+                state, metrics["loss"], grads, overflow)
+            metrics.update(sm)
+        else:
+            skip, new_health = overflow, state.health
         new_base, new_opt = self.optimizer.update(
             grads, state.opt_state, base, step=state.optimizer_steps + 1, lr=lr)
         new_base = zpart.constrain(new_base, self._master_specs if needs_master
                                    else self._param_specs, self.mesh)
 
-        if self.fp16_enabled:
-            # branchless skip-step on overflow
+        if self._health_enabled and self._health_cfg.skip_nonfinite:
+            # optimizer-minted non-finites (e.g. an Inf moment) are caught
+            # on the UPDATED base, before anything is committed
+            nf_params = hmod.tree_nonfinite(new_base)
+            skip = skip | nf_params
+            metrics["nonfinite_params"] = nf_params
+
+        gate = self.fp16_enabled or (
+            self._health_enabled and (self._health_cfg.skip_nonfinite
+                                      or self._health_cfg.skip_on_spike))
+        if gate:
+            # branchless skip-step: the unhealthy step is a no-op on
+            # params/optimizer state (reference _take_model_step overflow
+            # path, engine.py:1819-1871 — extended beyond fp16)
             sel = lambda new, old: jax.tree_util.tree_map(
-                lambda n, o: jnp.where(overflow, o, n), new, old)
+                lambda n, o: jnp.where(skip, o, n), new, old)
             new_base = sel(new_base, base)
             new_opt = sel(new_opt, state.opt_state)
+        if self.fp16_enabled:
+            # the loss scale reacts to OVERFLOW only — a health skip (loss
+            # spike, optimizer NaN) is not a scale-is-too-big signal
             new_scale = ls.update_scale(
                 state.scale, overflow, dynamic=self._scaler.dynamic,
                 scale_factor=self._scaler.scale_factor,
@@ -687,13 +776,14 @@ class DeepSpeedEngine:
             new_params = new_base
             new_master = None
 
-        ovf_i = overflow.astype(jnp.int32)
+        metrics["skip"] = skip
+        skip_i = skip.astype(jnp.int32)
         new_state = TrainState(
             global_steps=state.global_steps + 1,
-            optimizer_steps=state.optimizer_steps + (1 - ovf_i),
-            skipped_steps=state.skipped_steps + ovf_i,
+            optimizer_steps=state.optimizer_steps + (1 - skip_i),
+            skipped_steps=state.skipped_steps + skip_i,
             params=new_params, master=new_master, opt_state=new_opt,
-            scale=new_scale)
+            scale=new_scale, health=new_health)
         return new_state, metrics
 
     def _grad_only_step(self, state: TrainState, batch, rng):
@@ -712,6 +802,17 @@ class DeepSpeedEngine:
         next dispatch through device state with no host sync."""
         grads, overflow, _, metrics = self._grads_and_metrics(
             state, state.params, batch, rng)
+        if self._health_enabled:
+            # the host half reads metrics["skip"] and makes the skipped
+            # step a no-op on the host master/moments — the offload
+            # spelling of the branchless skip-step.  (No nonfinite_params
+            # sentinel here: the update happens on the host.)
+            skip, new_health, sm = self._health_sentinels(
+                state, metrics["loss"], grads, overflow)
+            metrics.update(sm)
+        else:
+            skip, new_health = overflow, state.health
+        metrics["skip"] = skip
         if self.fp16_enabled:
             new_scale = ls.update_scale(
                 state.scale, overflow, dynamic=self._scaler.dynamic,
@@ -741,7 +842,7 @@ class DeepSpeedEngine:
             # mesh the concatenate would gather sharded grads whole.
             grads = jnp.concatenate(
                 [g.reshape(-1) for g in jax.tree_util.tree_leaves(grads)])
-        return grads, metrics, new_scale
+        return grads, metrics, new_scale, new_health
 
     def _sparsify_grads(self, grads, batch):
         """Replace declared embedding-grad leaves with row-sparse
@@ -798,7 +899,14 @@ class DeepSpeedEngine:
         the flat fp32 master (moments on host RAM or streamed from NVMe) →
         h2d of the 16-bit payload."""
         state = self.state
-        overflow = bool(metrics["overflow"]) if self.fp16_enabled else False
+        # "skip" unifies fp16 overflow with the health guardian's
+        # non-finite/spike sentinels (all device scalars computed in
+        # _grad_only_step); the bool() read syncs, but this host path
+        # synchronizes on the grads right below anyway
+        if "skip" in metrics:
+            overflow = bool(metrics["skip"])
+        else:
+            overflow = bool(metrics["overflow"]) if self.fp16_enabled else False
         ovf = jnp.asarray(int(overflow), jnp.int32)
         # NOTE: checked only on non-overflow steps — a NaN/inf grad step makes
         # every row "nonzero" through the NaN-propagating clip; that path must
@@ -841,13 +949,15 @@ class DeepSpeedEngine:
             # the skipped step's grads are never landed; dropping the wire
             # handle (or tree) frees the device buffers
             params = state.params
-        # scale already advanced in-graph by _grad_only_step (kept as-is:
-        # under DPU `state` may carry newer scale than this pending step)
+        # scale/health already advanced in-graph by _grad_only_step (kept
+        # as-is: under DPU `state` may carry newer values than this
+        # pending step)
         self.state = TrainState(
             global_steps=state.global_steps + 1,
             optimizer_steps=state.optimizer_steps + (1 - ovf),
             skipped_steps=state.skipped_steps + ovf,
-            params=params, master=None, opt_state=None, scale=state.scale)
+            params=params, master=None, opt_state=None, scale=state.scale,
+            health=state.health)
 
     # ------------------------------------------------------------- public API
     def train_batch(self, data_iter=None):
@@ -860,8 +970,22 @@ class DeepSpeedEngine:
         fault.site("engine.step")    # host-side only; never traced
         it = data_iter if data_iter is not None else self._data_iterator
         assert it is not None, "train_batch needs training_data or a data_iter"
+        if it is not self._data_iterator:
+            # training is fed by an EXTERNAL iterator: the engine-owned
+            # loader no longer tracks the real stream, so a rewind must
+            # not "fast-forward" it (the warning path in rewind())
+            self._stream_pos_known = False
         gas = self.gradient_accumulation_steps()
         micro_batches = [next(it) for _ in range(gas)]
+        # data-stream position of THIS step (monotonic; checkpointed with
+        # the data-pipeline state, advanced by rewind's fast-forward) —
+        # also the index the value-corruption fault sites key on, so an
+        # injected grad_nan/loss_spike window rides the data deterministically
+        self._last_batch_index = self._stream_step
+        self._stream_step += 1
+        if fault.is_enabled():
+            micro_batches = [fault.corrupt_batch(mb, self._last_batch_index)
+                             for mb in micro_batches]
         if self.curriculum_scheduler is not None:
             micro_batches = [self._apply_curriculum(mb) for mb in micro_batches]
         if self._param_stream is not None:
@@ -906,11 +1030,13 @@ class DeepSpeedEngine:
         # constraints inside models (MoE expert axis, SP) bind to it
         with jax.set_mesh(self.mesh):
             if self._offload is not None:
-                grads, metrics, new_scale = self._jit_grad_step(
+                grads, metrics, new_scale, new_health = self._jit_grad_step(
                     self.state, batch, rng)
-                # loss scale advances eagerly (device-graph dependency): the
-                # NEXT dispatch sees a post-overflow halving with no host sync
-                self.state = self.state._replace(scale=new_scale)
+                # loss scale + health EMA advance eagerly (device-graph
+                # dependency): the NEXT dispatch sees a post-overflow
+                # halving / updated loss baseline with no host sync
+                self.state = self.state._replace(scale=new_scale,
+                                                 health=new_health)
                 # queue grad d2h behind the device compute (async copy
                 # engine; overlaps the host work below).  For the flat
                 # wire this swaps `grads` for a chunk handle — the
@@ -944,10 +1070,18 @@ class DeepSpeedEngine:
             metrics = self._param_stream.train_step(
                 micro_batches, rng, lr=lr,
                 step_no=int(self.state.optimizer_steps) + 1)
+        # the runner's skip-step (non-finite loss/grad-norm -> host Adam
+        # not applied) reports through metrics["skip"]; counters mirror
+        # the fused path's skipped-step accounting
+        skip = bool(metrics.get("skip", False))
         one = jnp.asarray(1, jnp.int32)
+        zero = jnp.asarray(0, jnp.int32)
         self.state = self.state._replace(
             global_steps=self.state.global_steps + one,
-            optimizer_steps=self.state.optimizer_steps + one)
+            optimizer_steps=self.state.optimizer_steps + (zero if skip
+                                                          else one),
+            skipped_steps=self.state.skipped_steps + (one if skip
+                                                      else zero))
         return self._finish_step(metrics)
 
     def _finish_step(self, metrics):
@@ -971,7 +1105,135 @@ class DeepSpeedEngine:
         self.tput_timer.stop(global_step=True,
                              sync_obj=metrics["loss"] if reporting else None)
         self._write_tensorboard(step_no, metrics)
+        if self.health_monitor is not None:
+            # trails the device by health_check.check_interval steps (the
+            # sentinel read then blocks only on already-finished work) and
+            # may rewind (in-process) or abort (with forensics)
+            self._health_observe(step_no, metrics)
         return metrics["loss"]
+
+    # ------------------------------------------------- health guardian (host)
+    def _health_observe(self, step_no, metrics):
+        """Feed the step's sentinels to the monitor and execute the action
+        it escalates to (docs/health-monitor.md)."""
+        action = self.health_monitor.observe(
+            step_no, self._last_batch_index, metrics)
+        if action == "rewind":
+            self._health_rewind()
+        elif action == "abort":
+            self._health_abort("consecutive-skip budget exhausted and "
+                               "rewind limit spent")
+
+    def _health_abort(self, reason):
+        # drain the monitor's lag window first: the newest steps —
+        # including the ones that tripped the abort — must reach the
+        # forensic history (their escalation verdict is moot now)
+        self.health_monitor.flush()
+        path = self.health_monitor.forensic_dump(
+            self._forensic_dir(), reason,
+            last_good_tag=self.loaded_checkpoint_tag)
+        raise hmod.TrainingHealthError(
+            f"training health: {reason}; "
+            f"counters={self.health_monitor.counters()}"
+            + (f"; forensics at {path}" if path else ""),
+            forensic_path=path)
+
+    def _forensic_dir(self):
+        return (self._health_cfg.forensic_dir
+                or self.config.checkpoint_config.dir
+                or self._last_ckpt_dir or os.getcwd())
+
+    def _health_rewind(self):
+        """Monitor-driven escalation: in-process rewind to the newest valid
+        checkpoint, then fast-forward the data stream past the last
+        observed poison batch.  A rewind that cannot run (no checkpoint
+        dir / no loadable tag) falls through to ``on_exhausted``.
+
+        When a rewind's replay runs STRAIGHT back into skips (no clean
+        step applied since the previous rewind — we are provably still
+        inside the same poison window), the fast-forward stride doubles:
+        a W-batch window is crossed in O(log W) rewinds instead of one
+        skip-budget's width per rewind, at the cost of over-skipping at
+        most W clean batches."""
+        mon = self.health_monitor
+        same_episode = mon.episode_rewinds > 0 and mon.clean_since_rewind == 0
+        self._ff_stride = self._ff_stride * 2 if same_episode else 1
+        target = mon.last_bad_stream_step
+        if target is not None:
+            target += self._ff_stride - 1
+        try:
+            self.rewind(replay_past=target)
+        except Exception as e:
+            # any ordinary failure (no dir, no valid tag, checkpoint IO
+            # errors after retry exhaustion) ends the ladder here;
+            # InjectedCrash/SIGKILL-like BaseExceptions still propagate
+            if self._health_cfg.on_exhausted == "warn":
+                logger.warning(f"health: rewind unavailable ({e}); "
+                               "on_exhausted=warn — continuing without it")
+                mon.consecutive_skips = 0
+                return
+            self._health_abort(f"rewind failed: {e}")
+        mon.record_rewind(tag=self.loaded_checkpoint_tag)
+
+    def rewind(self, load_dir=None, tag=None, replay_past=None):
+        """In-process rewind-and-replay: reload the newest *valid* (manifest-
+        verified) checkpoint without a process restart, then fast-forward
+        the restored data stream past ``replay_past`` (a data-stream batch
+        index, e.g. the last step poisoned by a bad batch) so replay
+        resumes on clean data instead of re-feeding the poison window.
+
+        Used by the health guardian's escalation ladder; also callable
+        directly (operator-driven rollback)."""
+        load_dir = load_dir or self._rewind_dir()
+        if load_dir is None:
+            raise ValueError(
+                "rewind needs a checkpoint directory: set checkpoint.dir "
+                "in the config or save/load a checkpoint first")
+        path, _ = self.load_checkpoint(load_dir, tag=tag)
+        if replay_past is not None:
+            if self._data_iterator is None:
+                logger.warning(
+                    "rewind: no engine-owned data iterator to fast-forward "
+                    "(external data_iter?); replay will re-feed the stream "
+                    "from the checkpointed position")
+            elif not self._stream_pos_known:
+                logger.warning(
+                    "rewind: data-stream position unknown (the checkpoint "
+                    "carried no data-pipeline state); fast-forward skipped "
+                    "— replay may re-feed already-seen batches")
+            else:
+                gas = self.gradient_accumulation_steps()
+                skipped = max(replay_past - self._stream_step + 1, 0)
+                loader = self.training_dataloader
+                if (skipped and isinstance(self._data_iterator,
+                                           RepeatingLoader)
+                        and self._data_iterator.loader is loader
+                        and hasattr(loader, "load_state_dict")):
+                    # O(1) jump: advance the loader's (epoch, batch_index)
+                    # arithmetic instead of collating every discarded batch
+                    # (a W-step window at model-scale batch sizes would
+                    # otherwise stall recovery on throwaway numpy stacking)
+                    per_epoch = max(len(loader), 1)
+                    sd = loader.state_dict()
+                    pos = sd["epoch"] * per_epoch + sd["batch_index"] \
+                        + skipped * gas
+                    loader.load_state_dict({
+                        "seed": sd["seed"], "epoch": pos // per_epoch,
+                        "batch_index": pos % per_epoch})
+                    self._data_iterator = iter(RepeatingLoader(loader))
+                    self._stream_step += skipped
+                else:
+                    while self._stream_step <= replay_past:
+                        for _ in range(gas):
+                            next(self._data_iterator)
+                        self._stream_step += 1
+                log_dist("rewind fast-forward: " + json.dumps(
+                    {"event": "health_fast_forward", "batches": skipped,
+                     "resume_stream_step": self._stream_step}), ranks=[0])
+        return path
+
+    def _rewind_dir(self):
+        return self.config.checkpoint_config.dir or self._last_ckpt_dir
 
     def _upload_offload_params(self):
         """Host master → device params as CHUNKED flat h2d transfers + a
@@ -1174,6 +1436,9 @@ class DeepSpeedEngine:
         if self.fp16_enabled:
             msg += (f", loss_scale={float(metrics['loss_scale']):.1f}"
                     f", skipped={int(self.state.skipped_steps)}")
+        elif self._health_enabled and bool(metrics.get("skip", False)):
+            msg += (f", SKIPPED (health sentinel; total "
+                    f"{int(self.state.skipped_steps)})")
         if "moe_aux_loss" in metrics:
             msg += f", moe_aux={float(metrics['moe_aux_loss']):.4f}"
         log_dist(msg, ranks=[0])
@@ -1269,6 +1534,13 @@ class DeepSpeedEngine:
         from ..checkpoint import atomic
         from .. import fault
         self._flush_offload()
+        if self.health_monitor is not None:
+            # drain the monitor's lag window so the saved run's history is
+            # complete; the returned action is intentionally discarded —
+            # if the drained steps warrant escalation, the still-elevated
+            # counters re-trigger it on the next training step, not from
+            # inside a save
+            self.health_monitor.flush()
         tag = tag or f"global_step{self.global_steps}"
         retry = self.config.io_retry_config.policy()
         fsync = self.config.checkpoint_config.fsync
@@ -1291,6 +1563,16 @@ class DeepSpeedEngine:
             "lr_scheduler": (self.lr_scheduler.state_dict()
                              if self.lr_scheduler is not None and
                              hasattr(self.lr_scheduler, "state_dict") else None),
+            # data-pipeline state: sampler (seed, epoch, batch index) +
+            # monotonic stream position, so load/auto_resume/rewind resume
+            # the EXACT batch stream (docs/health-monitor.md)
+            "data_state": {
+                "stream_step": self._stream_step,
+                "loader": (self.training_dataloader.state_dict()
+                           if self.training_dataloader is not None and
+                           hasattr(self.training_dataloader, "state_dict")
+                           else None),
+            },
         }
         params_out = (self._param_stream.full_params_host()
                       if self._param_stream is not None
@@ -1348,6 +1630,7 @@ class DeepSpeedEngine:
             # them all on every save would put O(keep_n · ckpt_bytes) of
             # SHA-256 on the training hot path
             atomic.rotate_checkpoints(save_dir, keep_n)
+        self._last_ckpt_dir = save_dir   # rewind target of last resort
         log_dist(f"saved checkpoint {final}", ranks=[0])
         return True
 
@@ -1538,10 +1821,38 @@ class DeepSpeedEngine:
         self._global_steps_host = int(meta["global_steps"])
         state = state._replace(global_steps=mk(meta["global_steps"]),
                                optimizer_steps=mk(meta["optimizer_steps"]),
-                               skipped_steps=mk(meta["skipped_steps"]))
+                               skipped_steps=mk(meta["skipped_steps"]),
+                               # fresh EMA: the loaded run must not inherit
+                               # loss statistics of the steps just discarded
+                               health=self._init_health_device()
+                               if state.health is not None else None)
         self.state = state
         self.micro_steps = meta.get("micro_steps", 0)
         self.global_samples = meta.get("global_samples", 0)
+        # data-pipeline state: restore the sampler position so replay
+        # resumes the exact batch stream (pre-guardian checkpoints carry
+        # none — the stream then restarts, as before)
+        data_state = meta.get("data_state") or {}
+        self._stream_step = int(data_state.get("stream_step", 0))
+        self._last_batch_index = None
+        if (data_state.get("loader") is not None
+                and self.training_dataloader is not None
+                and hasattr(self.training_dataloader, "load_state_dict")):
+            self.training_dataloader.load_state_dict(data_state["loader"])
+            # rebuild the engine-owned iterator over the restored position
+            self._data_iterator = iter(
+                RepeatingLoader(self.training_dataloader))
+            self._stream_pos_known = True
+        else:
+            # pre-guardian checkpoint (or no engine-owned loader): the live
+            # iterator's position no longer matches _stream_step, so a
+            # rewind must not fast-forward against it
+            self._stream_pos_known = False
+        if self.health_monitor is not None:
+            self.health_monitor.on_checkpoint_load()
+        if self._param_stream is not None:
+            self._param_stream.reset_health_ema()
+        self._last_ckpt_dir = load_dir
         if (load_lr_scheduler_states and self.lr_scheduler is not None
                 and meta.get("lr_scheduler") is not None
                 and hasattr(self.lr_scheduler, "load_state_dict")):

@@ -130,13 +130,20 @@ class PipelineEngine(DeepSpeedEngine):
         The cast (master→compute) and the sharding constraint are linear /
         identity maps, so gradients w.r.t. ``base`` equal the hand-computed
         gradients w.r.t. the casted params, cast back to fp32.
+
+        Returns the base engine's (grads, scaled_loss, aux) contract, so
+        the shared ``_train_step`` — including the health guardian's
+        on-device sentinels and branchless skip-step — applies unchanged to
+        the pipelined program: a NaN riding the ppermute ring propagates
+        into the psum'd loss/grads, trips the non-finite sentinels, and the
+        step is ``where``-selected to a no-op on every stage's params.
         """
         dtype = self.compute_dtype
         needs_master = dtype != jnp.float32
         p = tree_cast(base, dtype) if needs_master else base
         p = zpart.constrain(p, self._param_specs, self.mesh)
         scaled_loss, grads = self._pipeline_grads(p, batch, rng, cur_scale)
-        return grads, scaled_loss
+        return grads, scaled_loss, {}
 
     def _pipeline_grads(self, params, batch, rng, cur_scale):
         """Hand-scheduled 1F1B: returns ``(mean_loss * cur_scale, grads)``

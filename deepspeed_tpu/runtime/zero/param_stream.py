@@ -83,7 +83,8 @@ class ParamStreamRunner:
     """
 
     def __init__(self, model, host_opt, mesh, compute_dtype, *,
-                 gas, grad_clip, zero_config, aio_config, retry=None):
+                 gas, grad_clip, zero_config, aio_config, retry=None,
+                 skip_nonfinite=True, spike=None):
         assert mesh.size == 1, (
             "offload_param streaming is single-chip (scale-up) machinery; "
             "on a multi-chip mesh use ZeRO-3 sharding (stage 3 without "
@@ -94,6 +95,20 @@ class ParamStreamRunner:
         self.dtype = compute_dtype
         self.gas = int(gas)
         self.grad_clip = float(grad_clip or 0.0)
+        # health guardian skip-step: a non-finite step must be a no-op on
+        # the host master/moments (runtime/health.py; the streamed twin of
+        # the engine's branchless in-graph skip).  ``spike`` is the
+        # (window, zmax, skip_on_spike) tuple of the loss-spike sentinel —
+        # this path has no device HealthState, so the EMA runs host-side
+        # with the same formula (health.HostEma).
+        self.skip_nonfinite = bool(skip_nonfinite)
+        self._spike_ema = None
+        self._skip_on_spike = False
+        if spike is not None:
+            from ..health import HostEma
+            window, zmax, skip_on_spike = spike
+            self._spike_ema = HostEma(window, zmax)
+            self._skip_on_spike = bool(skip_on_spike)
         sf = model.stream_fns()
         self.sf = sf
         self.L = int(sf["n_layer"])
@@ -415,26 +430,53 @@ class ParamStreamRunner:
         # ---------- clip + host Adam + payload refresh ----------
         t1 = time.time()
         gnorm = self._host_global_norm(flat)
-        if self.grad_clip > 0 and gnorm > self.grad_clip:
-            np.multiply(flat, self.grad_clip / (gnorm + 1e-6), out=flat)
-        host.step(flat, step_no, lr)
-        t_adam = time.time() - t1
-        if self.nvme:
-            t2 = time.time()
-            self._flush_layers_to_nvme(range(self.L))
-            t_adam += time.time() - t2
-        self._upload_nonblock()
-
         loss = float(np.mean([float(l) for l in losses]))
+        # health-guardian skip-step, streamed spelling: the grads are
+        # already host-side (the wire crossed either way), so the no-op is
+        # simply not applying the host optimizer — master, moments, NVMe
+        # image and the device payload all stay at the pre-step state
+        z, spiked = (self._spike_ema.update(loss)
+                     if self._spike_ema is not None else (0.0, False))
+        skip = (self.skip_nonfinite and not (np.isfinite(gnorm)
+                                             and np.isfinite(loss))) \
+            or (self._skip_on_spike and spiked)
+        if skip:
+            logger.warning(
+                f"param-stream step {step_no}: unhealthy sentinels "
+                f"(loss={loss}, grad_norm={gnorm}, z={z:.2f}); host "
+                "optimizer step SKIPPED — params/optimizer state untouched")
+            t_adam = time.time() - t1
+        else:
+            if self.grad_clip > 0 and gnorm > self.grad_clip:
+                np.multiply(flat, self.grad_clip / (gnorm + 1e-6), out=flat)
+            host.step(flat, step_no, lr)
+            t_adam = time.time() - t1
+            if self.nvme:
+                t2 = time.time()
+                self._flush_layers_to_nvme(range(self.L))
+                t_adam += time.time() - t2
+            self._upload_nonblock()
+
         self.last_times = {
             "device_plus_wire_s": round(t_dev, 3),
             "grad_d2h_land_s": round(t_d2h, 3),
             "host_adam_s": round(t_adam, 3),
             "step_wall_s": round(time.time() - t0, 3),
         }
-        return {"loss": jnp.asarray(loss), "grad_norm": jnp.asarray(gnorm),
-                "overflow": jnp.asarray(False), "lr": jnp.asarray(lr),
-                "loss_scale": jnp.asarray(1.0)}
+        metrics = {"loss": jnp.asarray(loss), "grad_norm": jnp.asarray(gnorm),
+                   "overflow": jnp.asarray(False), "lr": jnp.asarray(lr),
+                   "loss_scale": jnp.asarray(1.0), "skip": jnp.asarray(skip)}
+        if self._spike_ema is not None:
+            # carried so the monitor uses THIS ema (no double accounting)
+            metrics["health_z"] = jnp.asarray(z)
+            metrics["loss_spike"] = jnp.asarray(spiked)
+        return metrics
+
+    def reset_health_ema(self):
+        """Post-checkpoint-load reset: the restored run must not inherit
+        loss statistics of the steps it just discarded."""
+        if self._spike_ema is not None:
+            self._spike_ema.reset()
 
     @property
     def THROTTLE_EVERY(self):

@@ -351,6 +351,43 @@ def test_engine_train_step_audit(mesh_2x4, stage):
     assert not [f for f in report.findings if f.rule == "DSTPU203"]
 
 
+# z2 (the acceptance configuration) stays in tier-1; z1/z3 ride the slow
+# tier per the conftest budget policy (each is one more engine build +
+# compile, and the sentinel graph is stage-independent)
+@pytest.mark.parametrize("stage", [
+    pytest.param(1, marks=pytest.mark.slow), 2,
+    pytest.param(3, marks=pytest.mark.slow)])
+def test_engine_train_step_audit_with_guardian(mesh_2x4, stage):
+    """Health-guardian acceptance companion: with the divergence sentinels
+    fully armed (non-finite flags over loss/grads/params, EMA z-score AND
+    the in-graph spike skip — a strictly larger sentinel graph than the
+    default), the compiled step must still contain ZERO host callbacks
+    (DSTPU201) and honor every donated state leaf (DSTPU204): the guardian
+    is pure jnp, never a host round-trip."""
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": 2,
+           "steps_per_print": 10 ** 9,
+           "bf16": {"enabled": True},
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": stage},
+           "health_check": {"spike_window": 16, "spike_zmax": 3.0,
+                            "skip_on_spike": True}}
+    rng = np.random.default_rng(0)
+    data = [(rng.normal(size=(8,)).astype(np.float32),
+             rng.normal(size=(8,)).astype(np.float32)) for _ in range(32)]
+    engine, _, _, _ = ds.initialize(config=cfg, model=SimpleModel(),
+                                    training_data=data, mesh=mesh_2x4)
+    assert engine._health_enabled
+    report = audit_engine(engine)
+    assert report.host_callbacks == [], [str(f) for f in report.findings]
+    d = report.donation
+    assert d["checked"] and d["source"] == "executable"
+    assert d["lowered_donors"] > 0
+    assert d["unhonored_args"] == [], d
+    assert d["honored"] == d["lowered_donors"]
+    assert not [f for f in report.findings if f.rule == "DSTPU204"]
+
+
 def test_engine_audit_seeded_callback_is_caught(mesh8):
     """End-to-end negative control: a model whose loss sneaks a
     debug_callback into the step is flagged by audit_engine."""

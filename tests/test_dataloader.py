@@ -55,3 +55,61 @@ def test_dict_dataset():
     b = next(iter(loader))
     assert set(b) == {"x", "y"}
     assert b["x"].shape == (5, 3)
+
+
+# ---------------------------------------------------------------------------
+# checkpointable sampler state (docs/health-monitor.md): the batch stream is
+# a pure function of (seed, epoch, batch_index), so restoring those three
+# integers resumes the EXACT stream
+# ---------------------------------------------------------------------------
+
+def test_state_dict_roundtrip_resumes_exact_stream():
+    data = random_dataset(n=40)
+    a = iter(RepeatingLoader(DeepSpeedDataLoader(data, batch_size=8, seed=3)))
+    for _ in range(7):          # mid-epoch-2 position (5 batches/epoch)
+        next(a)
+    state = a.state_dict()
+    assert state == {"seed": 3, "epoch": 1, "batch_index": 2}
+    expected = [next(a)[0] for _ in range(6)]   # crosses an epoch boundary
+
+    b = iter(RepeatingLoader(DeepSpeedDataLoader(data, batch_size=8,
+                                                 seed=999)))
+    b.load_state_dict(state)    # seed restored from the state, not the ctor
+    got = [next(b)[0] for _ in range(6)]
+    for x, y in zip(expected, got):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_state_restore_mid_iteration_discards_stale_iterator():
+    data = random_dataset(n=32)
+    rep = iter(RepeatingLoader(DeepSpeedDataLoader(data, batch_size=8)))
+    ref = [next(rep)[0] for _ in range(4)]      # epoch 0 fully consumed
+    state_after_2 = {"seed": 0, "epoch": 0, "batch_index": 2}
+    for _ in range(3):
+        next(rep)               # wander ahead
+    rep.load_state_dict(state_after_2)
+    np.testing.assert_array_equal(next(rep)[0], ref[2])
+    np.testing.assert_array_equal(next(rep)[0], ref[3])
+
+
+def test_plain_reiteration_still_restarts_from_zero():
+    """Without a restore, a second iter() keeps the historical restart
+    semantics (epoch replay) — resume offsets are one-shot."""
+    data = random_dataset(n=32)
+    loader = DeepSpeedDataLoader(data, batch_size=8, shuffle=False)
+    first = [b[0] for b in loader]
+    again = [b[0] for b in loader]
+    assert len(first) == len(again) == 4
+    for x, y in zip(first, again):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_state_dict_tracks_epoch_rollover():
+    data = random_dataset(n=16)
+    rep = iter(RepeatingLoader(DeepSpeedDataLoader(data, batch_size=8)))
+    assert rep.state_dict()["batch_index"] == 0
+    next(rep)
+    assert rep.state_dict() == {"seed": 0, "epoch": 0, "batch_index": 1}
+    next(rep)
+    next(rep)                   # rolls into epoch 1
+    assert rep.state_dict() == {"seed": 0, "epoch": 1, "batch_index": 1}

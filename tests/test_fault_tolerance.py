@@ -118,6 +118,82 @@ def test_retry_on_retry_hook_runs_before_backoff():
     assert events == ["call", "drain", "call"]
 
 
+def test_retry_full_jitter_bounds():
+    """AWS-style full jitter: delay ~ uniform(0, nominal) — decorrelates a
+    herd of retriers; bounds and the max_delay cap still hold."""
+    policy = RetryPolicy(max_attempts=8, base_delay_s=0.1, max_delay_s=1.0,
+                         jitter=0.25, jitter_mode="full", seed=7)
+    for attempt in range(8):
+        nominal = min(0.1 * 2 ** attempt, 1.0)
+        lo, hi = policy.delay_bounds(attempt)
+        assert lo == 0.0 and hi == pytest.approx(nominal)
+        for _ in range(50):
+            assert 0.0 <= policy.delay(attempt) <= nominal
+    # deterministic under seed, and the mode survives clone()
+    a = RetryPolicy(jitter_mode="full", seed=3)
+    b = RetryPolicy(jitter_mode="full", seed=3).clone(max_attempts=9)
+    assert [a.delay(k) for k in range(5)] == [b.delay(k) for k in range(5)]
+    with pytest.raises(AssertionError):
+        RetryPolicy(jitter_mode="thundering_herd")
+
+
+def test_retry_elapsed_cap_stops_retrying():
+    """max_elapsed_s bounds attempt-time + backoff with a FAKE clock (no
+    real sleeps in tier-1): once the next backoff would cross the cap, the
+    last error re-raises instead of sleeping past it."""
+    now = [0.0]
+    slept = []
+
+    def fake_sleep(d):
+        slept.append(d)
+        now[0] += d
+
+    calls = []
+
+    def always():
+        calls.append(1)
+        now[0] += 1.0           # each attempt itself costs 1s
+        raise OSError(f"fail #{len(calls)}")
+
+    policy = RetryPolicy(max_attempts=10, base_delay_s=0.5, max_delay_s=0.5,
+                         jitter=0.0, max_elapsed_s=4.0,
+                         sleep=fake_sleep, clock=lambda: now[0], seed=0)
+    with pytest.raises(OSError, match="fail #3"):
+        retry_call(always, policy=policy)
+    # t=1 (+0.5 backoff), t=2.5 (+0.5), t=4: next backoff would cross 4.0
+    assert len(calls) == 3
+    assert len(slept) == 2
+    assert now[0] <= 4.0
+
+    # no cap: the same schedule runs to attempt exhaustion
+    now[0] = 0.0
+    calls.clear()
+    slept.clear()
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.5, max_delay_s=0.5,
+                         jitter=0.0, sleep=fake_sleep,
+                         clock=lambda: now[0], seed=0)
+    with pytest.raises(OSError, match="fail #4"):
+        retry_call(always, policy=policy)
+    assert len(calls) == 4
+
+
+def test_retry_elapsed_cap_allows_success_within_budget():
+    now = [0.0]
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.01, jitter=0.0,
+                         max_elapsed_s=60.0,
+                         sleep=lambda d: now.__setitem__(0, now[0] + d),
+                         clock=lambda: now[0], seed=0)
+    assert retry_call(flaky, policy=policy) == "ok"
+
+
 def test_io_retry_config_validation():
     from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
                                               DeepSpeedIORetryConfig)
@@ -126,6 +202,13 @@ def test_io_retry_config_validation():
     policy = cfg.policy()
     assert policy.max_attempts == 3
     assert policy.base_delay_s == 0.01
+    assert policy.jitter_mode == "proportional" and policy.max_elapsed_s is None
+    cfg = DeepSpeedIORetryConfig({"io_retry": {"full_jitter": True,
+                                               "max_elapsed_s": 30}})
+    policy = cfg.policy()
+    assert policy.jitter_mode == "full" and policy.max_elapsed_s == 30.0
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedIORetryConfig({"io_retry": {"max_elapsed_s": 0}})
     with pytest.raises(DeepSpeedConfigError):
         DeepSpeedIORetryConfig({"io_retry": {"max_attempts": 0}})
     with pytest.raises(DeepSpeedConfigError):
@@ -739,7 +822,9 @@ def test_jitted_step_identical_with_harness_armed(mesh8, fault_harness):
 
     jaxpr_off = step_jaxpr()
     fault_harness.configure(
-        "engine_crash_step,io_error_p=1.0,io_delay_ms=100")
+        "engine_crash_step,io_error_p=1.0,io_delay_ms=100,"
+        "grad_nan=0:1000,loss_spike=2000:3000")   # value faults ride the
+    # DATA (corrupt_batch pre-device_put), never the program
     jaxpr_on = step_jaxpr()
     assert jaxpr_on == jaxpr_off
     # and none of the host-side sites fired during tracing

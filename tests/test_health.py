@@ -1,0 +1,572 @@
+"""Training health guardian (`deepspeed_tpu/runtime/health.py` +
+docs/health-monitor.md): on-device divergence sentinels, the bf16/fp32
+branchless skip-step, and the host escalation ladder
+(skip -> rewind-and-replay -> abort with forensics).
+
+Unit tests drive the pure pieces (EMA/z sentinel math, the monitor's
+policy, value-corruption fault windows) without an engine; the engine
+tests prove the acceptance scenario end to end: under bf16 ZeRO-2 an
+injected ``grad_nan`` batch skips the step with params bit-identical, a
+sustained poison window exhausts the skip budget and triggers an
+in-process rewind to the last good tag plus a data-stream fast-forward
+past the poison — and training continues to a finite loss.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu import fault
+from deepspeed_tpu.runtime import health as hmod
+from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
+                                          DeepSpeedHealthCheckConfig)
+
+from simple_model import SimpleModel, random_dataset, base_config
+
+pytestmark = pytest.mark.fault
+
+
+# ---------------------------------------------------------------------------
+# device sentinel unit tests (pure jnp; no engine)
+# ---------------------------------------------------------------------------
+
+def test_tree_nonfinite():
+    good = {"a": jnp.ones((3,)), "b": {"c": jnp.zeros((2, 2))}}
+    assert not bool(hmod.tree_nonfinite(good))
+    assert bool(hmod.tree_nonfinite({"a": jnp.array([1.0, np.inf])}))
+    assert bool(hmod.tree_nonfinite({"a": jnp.array([np.nan])}))
+    # bf16 leaves participate; integer leaves are ignored; empty is finite
+    assert bool(hmod.tree_nonfinite(
+        {"a": jnp.array([np.nan], jnp.bfloat16)}))
+    assert not bool(hmod.tree_nonfinite({"i": jnp.arange(4)}))
+    assert not bool(hmod.tree_nonfinite({}))
+
+
+def test_ema_z_score_flags_spike_after_warmup():
+    st = hmod.init_state()
+    # warmup: constant loss, z pinned to 0
+    for _ in range(12):
+        st, z, spike = hmod.update_ema(st, 1.0, window=8, zmax=3.0)
+        assert float(z) == 0.0 or abs(float(z)) < 1e-3
+        assert not bool(spike)
+    # a 100x loss jump is a spike
+    st2, z, spike = hmod.update_ema(st, 100.0, window=8, zmax=3.0)
+    assert float(z) > 3.0 and bool(spike)
+    # spikes are NOT absorbed into the EMA: the baseline stays put
+    assert float(st2.ema_loss) == pytest.approx(float(st.ema_loss))
+    assert int(st2.count) == int(st.count)
+
+
+def test_ema_ignores_nonfinite_loss():
+    st = hmod.init_state()
+    for _ in range(8):
+        st, _, _ = hmod.update_ema(st, 2.0, window=8, zmax=3.0)
+    before = float(st.ema_loss)
+    st, z, spike = hmod.update_ema(st, float("nan"), window=8, zmax=3.0)
+    assert float(st.ema_loss) == pytest.approx(before)
+    assert float(z) == 0.0 and not bool(spike)  # nonfinite sentinel owns it
+
+
+def test_update_ema_traces_without_host_ops():
+    """The sentinel update must be traceable (it runs inside the jitted
+    step) — and its jaxpr must contain no callback primitives."""
+    st = hmod.init_state()
+    jaxpr = jax.make_jaxpr(
+        lambda s, l: hmod.update_ema(s, l, window=16, zmax=2.5))(
+            st, jnp.float32(1.0))
+    assert "callback" not in str(jaxpr)
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+def test_health_config_defaults_and_validation():
+    cfg = DeepSpeedHealthCheckConfig({})
+    assert cfg.enabled and cfg.skip_nonfinite
+    assert cfg.spike_zmax == 0.0 and not cfg.skip_on_spike
+    assert cfg.consecutive_skip_budget == 10 and cfg.rewind_limit == 4
+    assert cfg.on_exhausted == "abort" and cfg.check_interval == 1
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedHealthCheckConfig({"health_check": {"spike_window": 1}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedHealthCheckConfig({"health_check": {"on_exhausted": "pray"}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedHealthCheckConfig(
+            {"health_check": {"skip_on_spike": True}})  # needs zmax > 0
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedHealthCheckConfig({"health_check": {"rewind_limit": -1}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedHealthCheckConfig({"health_check": {"check_interval": 0}})
+
+
+def test_health_config_env_override(monkeypatch):
+    monkeypatch.setenv("DSTPU_HEALTH_CHECK", "0")
+    assert not DeepSpeedHealthCheckConfig({}).enabled
+    monkeypatch.setenv("DSTPU_HEALTH_CHECK", "1")
+    assert DeepSpeedHealthCheckConfig(
+        {"health_check": {"enabled": False}}).enabled
+
+
+def test_launcher_health_check_flags():
+    from deepspeed_tpu.launcher.runner import parse_args
+    args = parse_args(["--health-check", "train.py"])
+    assert args.health_check is True
+    args = parse_args(["--no-health-check", "train.py"])
+    assert args.health_check is False
+    args = parse_args(["train.py"])
+    assert args.health_check is None   # config decides
+
+
+# ---------------------------------------------------------------------------
+# value-corruption fault windows
+# ---------------------------------------------------------------------------
+
+def test_fault_value_corruption_windows(fault_harness):
+    plan = fault_harness.FaultPlan.from_spec(
+        "grad_nan=5:8,loss_spike=10,spike_factor=100")
+    assert plan.grad_nan == (5, 8)
+    assert plan.loss_spike == (10, 11)   # bare index = one-step window
+    assert plan.spike_factor == 100.0
+    with pytest.raises(ValueError):
+        fault_harness.FaultPlan.from_spec("grad_nan=8:5")
+
+    fault_harness.configure(plan)
+    batch = (np.ones((4, 2), np.float32), np.arange(4))
+    # outside any window: identity
+    out = fault_harness.corrupt_batch(batch, 4)
+    np.testing.assert_array_equal(out[0], batch[0])
+    # grad_nan window: float leaves NaN-filled, integer leaves untouched
+    out = fault_harness.corrupt_batch(batch, 5)
+    assert np.isnan(out[0]).all()
+    np.testing.assert_array_equal(out[1], batch[1])
+    # the original batch is never mutated in place
+    assert np.isfinite(batch[0]).all()
+    # loss_spike window: scaled, still finite
+    out = fault_harness.corrupt_batch(batch, 10)
+    np.testing.assert_array_equal(out[0], batch[0] * 100.0)
+    assert fault_harness.plan().hits == {"fault.grad_nan": 1,
+                                         "fault.loss_spike": 1}
+
+
+def test_corrupt_batch_disarmed_is_identity(fault_harness):
+    batch = {"x": np.ones((2,), np.float32)}
+    assert fault_harness.corrupt_batch(batch, 0) is batch
+
+
+# ---------------------------------------------------------------------------
+# monitor policy unit tests (no engine)
+# ---------------------------------------------------------------------------
+
+def _mon(tmp_path=None, **over):
+    d = {"consecutive_skip_budget": 3, "rewind_limit": 1, "history": 8}
+    d.update(over)
+    cfg = DeepSpeedHealthCheckConfig({"health_check": d})
+    return hmod.HealthMonitor(cfg)
+
+
+def _metrics(loss=1.0, gnorm=1.0, skip=False, z=0.0, spike=False):
+    return {"loss": jnp.float32(loss), "grad_norm": jnp.float32(gnorm),
+            "skip": jnp.asarray(skip), "health_z": jnp.float32(z),
+            "loss_spike": jnp.asarray(spike)}
+
+
+def test_monitor_escalation_ladder():
+    """The monitor trails the device by check_interval (=1 here): entry s
+    is synced when entry s+1 arrives — so the 3rd consecutive skip
+    (budget 3) surfaces as "rewind" on the 4th observe."""
+    mon = _mon()
+    # clean steps: ok, counters quiet
+    for s in range(3):
+        assert mon.observe(s, s, _metrics()) == "ok"
+    # skips below budget: still ok; consecutive counts (trailing by one)
+    assert mon.observe(3, 3, _metrics(loss=np.nan, skip=True)) == "ok"
+    assert mon.observe(4, 4, _metrics(loss=np.nan, skip=True)) == "ok"
+    assert mon.consecutive_skips == 1     # entry 4 still pending
+    assert mon.flush() == "ok"
+    assert mon.consecutive_skips == 2
+    # a clean step resets the run
+    mon.observe(5, 5, _metrics())
+    assert mon.flush() == "ok"
+    assert mon.consecutive_skips == 0
+    # budget exhausted -> rewind (limit 1)
+    actions = [mon.observe(s, s, _metrics(loss=np.nan, skip=True))
+               for s in range(6, 10)]
+    assert actions == ["ok", "ok", "ok", "rewind"]
+    assert mon.last_bad_stream_step == 8  # entry 9 still pending
+    mon.record_rewind(tag="good")
+    assert mon.rewinds == 1 and mon.consecutive_skips == 0
+    # budget exhausted again with the rewind limit spent -> abort
+    mon._pending = []                     # rewind discarded the in-flight step
+    actions = [mon.observe(s, s, _metrics(loss=np.nan, skip=True))
+               for s in range(10, 14)]
+    assert actions[-1] == "abort"
+
+
+def test_monitor_rewind_limit_is_per_episode():
+    """A clean applied step after a rewind closes the poison episode and
+    re-arms the rewind budget — lifetime rewinds across distinct episodes
+    are unbounded (each is real forward progress), only consecutive
+    fruitless ones are capped."""
+    mon = _mon(rewind_limit=1)   # budget 3
+    for s in range(4):
+        action = mon.observe(s, s, _metrics(loss=np.nan, skip=True))
+    assert action == "rewind"
+    mon.record_rewind(tag="good")
+    assert mon.episode_rewinds == 1
+    mon._pending = []            # the engine's load clears in-flight entries
+    # replay applies a clean step: episode over, limit re-armed
+    mon.observe(4, 4, _metrics())
+    mon.flush()
+    assert mon.episode_rewinds == 0 and mon.rewinds == 1
+    # a NEW poison episode escalates to rewind again, not abort
+    for s in range(5, 9):
+        action = mon.observe(s, s, _metrics(loss=np.nan, skip=True))
+    assert action == "rewind"
+    # ...but within one episode the spent limit aborts
+    mon.record_rewind(tag="good")
+    mon._pending = []
+    for s in range(9, 13):
+        action = mon.observe(s, s, _metrics(loss=np.nan, skip=True))
+    assert action == "abort"
+
+
+def test_monitor_on_exhausted_warn_resets_and_continues():
+    mon = _mon(rewind_limit=0, on_exhausted="warn")
+    for s in range(3):
+        assert mon.observe(s, s, _metrics(loss=np.nan, skip=True)) == "ok"
+    assert mon.flush() == "ok"            # warned, not aborted
+    assert mon.consecutive_skips == 0     # re-armed
+
+
+def test_monitor_check_interval_sets_the_lag_window():
+    """check_interval=N keeps the newest N entries unsynced: the host read
+    happens only once the device has moved past them (async dispatch
+    survives); flush() drains everything."""
+    mon = _mon(check_interval=4)
+    for s in range(4):
+        assert mon.observe(s, s, _metrics(loss=np.nan, skip=True)) == "ok"
+        assert len(mon._pending) == s + 1  # nothing synced yet
+    assert mon.observe(4, 4, _metrics(loss=np.nan, skip=True)) == "ok"
+    assert len(mon._pending) == 4          # oldest entry processed
+    assert mon.consecutive_skips == 1
+    assert mon.flush() == "rewind"         # backlog drained -> budget hit
+    assert mon._pending == []
+
+
+def test_monitor_host_ema_fallback_for_streamed_metrics():
+    """Metrics without a device z (the streamed-offload path) get the
+    host-side EMA twin: a spike is still seen."""
+    mon = _mon(spike_zmax=3.0, spike_window=8)
+    for s in range(12):
+        mon.observe(s, s, {"loss": jnp.float32(1.0),
+                           "grad_norm": jnp.float32(1.0),
+                           "skip": jnp.asarray(False)})
+    mon.observe(12, 12, {"loss": jnp.float32(50.0),
+                         "grad_norm": jnp.float32(1.0),
+                         "skip": jnp.asarray(False)})
+    mon.flush()
+    assert mon.total_spikes == 1
+    assert mon.history[-1]["z"] > 3.0
+
+
+def test_forensic_dump_format(tmp_path):
+    mon = _mon()
+    for s in range(4):
+        mon.observe(s, s, _metrics(loss=np.nan, gnorm=np.inf, skip=True))
+    mon.flush()
+    path = mon.forensic_dump(str(tmp_path), "unit test",
+                             last_good_tag="global_step2")
+    # strict RFC-8259 JSON: the non-finite values that MOTIVATE the dump
+    # must be encoded as strings, not bare NaN/Infinity tokens that jq /
+    # JSON.parse reject
+    payload = json.loads(
+        open(path).read(),
+        parse_constant=lambda tok: pytest.fail(f"non-RFC token {tok}"))
+    assert payload["history"][-1]["loss"] == "nan"
+    assert payload["history"][-1]["grad_norm"] == "inf"
+    assert payload["event"] == "health_forensics"
+    assert payload["reason"] == "unit test"
+    assert payload["last_good_tag"] == "global_step2"
+    assert payload["counters"]["total_skips"] == 4
+    assert payload["counters"]["consecutive_skips"] == 4
+    assert payload["policy"]["consecutive_skip_budget"] == 3
+    assert len(payload["history"]) == 4
+    rec = payload["history"][-1]
+    assert rec["skip"] is True and rec["step"] == 3
+
+
+# ---------------------------------------------------------------------------
+# engine: sentinels + branchless skip-step (the cheap tier-1 acceptance)
+# ---------------------------------------------------------------------------
+
+def _engine(mesh, stage=2, **cfg_kw):
+    cfg = base_config(bf16={"enabled": True},
+                      zero_optimization={"stage": stage}, **cfg_kw)
+    engine, _, _, _ = ds.initialize(config=cfg, model=SimpleModel(),
+                                    training_data=random_dataset(n=64),
+                                    mesh=mesh)
+    return engine
+
+
+def test_bf16_zero2_grad_nan_skips_step_params_bit_identical(mesh_2x4,
+                                                             fault_harness):
+    """Acceptance scenario, first rung: an injected grad_nan at step k is a
+    no-op on params AND optimizer state (bit-identical), counted as a
+    skipped step, and training resumes cleanly on the next batch."""
+    engine = _engine(mesh_2x4)
+    for _ in range(2):
+        engine.train_batch()
+    ref_p = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    ref_m = jax.tree_util.tree_map(np.asarray, engine.state.master)
+    ref_o = jax.tree_util.tree_map(np.asarray, engine.state.opt_state)
+
+    fault_harness.configure("grad_nan=2")   # poison stream step 2 only
+    loss = engine.train_batch()
+    assert not np.isfinite(float(loss))
+    assert bool(engine._last_metrics["skip"])
+    assert bool(engine._last_metrics["nonfinite_grads"])
+    assert engine.skipped_steps == 1
+    assert int(engine.state.optimizer_steps) == 2   # not advanced
+    assert engine.global_steps == 3                 # boundary still counted
+    for ref, cur in ((ref_p, engine.state.params),
+                     (ref_m, engine.state.master),
+                     (ref_o, engine.state.opt_state)):
+        for a, b in zip(jax.tree_util.tree_leaves(ref),
+                        jax.tree_util.tree_leaves(
+                            jax.tree_util.tree_map(np.asarray, cur))):
+            np.testing.assert_array_equal(a, b)
+
+    # window passed: the very next step trains (finite loss, params move)
+    loss = float(engine.train_batch())
+    assert np.isfinite(loss)
+    assert engine.skipped_steps == 1
+    assert int(engine.state.optimizer_steps) == 3
+    moved = any(not np.array_equal(a, b) for a, b in zip(
+        jax.tree_util.tree_leaves(ref_p),
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, engine.state.params))))
+    assert moved
+
+
+@pytest.mark.slow
+def test_guardian_disabled_restores_legacy_nan_propagation(mesh8,
+                                                           fault_harness):
+    """health_check.enabled=false reverts to the pre-guardian contract: a
+    NaN batch poisons the params (documents exactly what the default now
+    protects against)."""
+    engine = _engine(mesh8, stage=0, health_check={"enabled": False})
+    engine.train_batch()
+    fault_harness.configure("grad_nan=1")
+    engine.train_batch()
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, engine.state.params))
+    assert any(not np.isfinite(l).all() for l in leaves)
+    assert engine.skipped_steps == 0
+
+
+def test_loss_spike_sentinel_skips_when_configured(mesh8, fault_harness):
+    """spike_zmax + skip_on_spike: a finite but wildly out-of-distribution
+    loss is skipped on-device, params untouched, z reported."""
+    engine = _engine(
+        mesh8, stage=0,
+        health_check={"spike_window": 8, "spike_zmax": 4.0,
+                      "skip_on_spike": True,
+                      "consecutive_skip_budget": 0})
+    for _ in range(10):   # warm the EMA past warmup (window//4 >= 4)
+        engine.train_batch()
+    ref = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    fault_harness.configure("loss_spike=10,spike_factor=1000")
+    loss = float(engine.train_batch())
+    assert np.isfinite(loss)              # finite — only the z-score trips
+    assert bool(engine._last_metrics["loss_spike"])
+    assert float(engine._last_metrics["health_z"]) > 4.0
+    assert bool(engine._last_metrics["skip"])
+    assert engine.skipped_steps == 1
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                        np.asarray, engine.state.params))):
+        np.testing.assert_array_equal(a, b)
+    # clean step afterwards: trains, EMA baseline unpoisoned
+    loss = float(engine.train_batch())
+    assert np.isfinite(loss)
+    assert not bool(engine._last_metrics["skip"])
+
+
+# ---------------------------------------------------------------------------
+# engine: rewind-and-replay + abort (the full ladder)
+# ---------------------------------------------------------------------------
+
+def test_rewind_and_replay_recovers_through_poison_window(mesh_2x4, tmp_path,
+                                                          fault_harness):
+    """THE acceptance scenario: under bf16 ZeRO-2 a sustained grad_nan
+    window exhausts the consecutive-skip budget, the engine rewinds
+    IN-PROCESS to the last good (manifest-verified) tag, fast-forwards the
+    restored data stream past the poison, and training continues to a
+    finite loss — no process restart."""
+    save_dir = str(tmp_path)
+    engine = _engine(mesh_2x4,
+                     checkpoint={"dir": save_dir},
+                     health_check={"consecutive_skip_budget": 2,
+                                   "rewind_limit": 3})
+    for _ in range(3):
+        engine.train_batch()
+    engine.save_checkpoint(save_dir, tag="good")
+    good_params = jax.tree_util.tree_map(np.asarray, engine.state.params)
+
+    fault_harness.configure("grad_nan=3:8")   # 5 poisoned steps > budget 2
+    for _ in range(9):   # monitor trails by check_interval=1 step
+        engine.train_batch()
+    mon = engine.health_monitor
+    assert mon.rewinds >= 1
+    assert engine.loaded_checkpoint_tag == "good"
+    # the poison window is behind the stream now
+    assert engine._stream_step > 8
+    # post-recovery: training continues to a finite loss and params move
+    losses = [float(engine.train_batch()) for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses)
+    assert not bool(engine._last_metrics["skip"])
+    moved = any(not np.array_equal(a, b) for a, b in zip(
+        jax.tree_util.tree_leaves(good_params),
+        jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+            np.asarray, engine.state.params))))
+    assert moved
+    # the rewind discarded the poisoned steps: the optimizer-visible step
+    # count trails the data-stream position it replayed through
+    assert engine.global_steps < engine._stream_step
+
+
+def test_exhausted_ladder_aborts_with_forensics(mesh8, tmp_path,
+                                                fault_harness):
+    """rewind_limit=0 + abort: budget exhaustion raises
+    TrainingHealthError and writes the forensic JSON dump."""
+    engine = _engine(
+        mesh8, stage=0,
+        health_check={"consecutive_skip_budget": 2, "rewind_limit": 0,
+                      "forensic_dir": str(tmp_path)})
+    engine.train_batch()
+    fault_harness.configure("grad_nan=1:100")
+    with pytest.raises(ds.TrainingHealthError) as ei:
+        for _ in range(5):
+            engine.train_batch()
+    dump = ei.value.forensic_path
+    assert dump is not None and os.path.isfile(dump)
+    payload = json.load(open(dump))
+    assert payload["counters"]["consecutive_skips"] >= 2
+    assert payload["policy"]["rewind_limit"] == 0
+    assert any(r["skip"] for r in payload["history"])
+
+
+@pytest.mark.slow
+def test_rewind_without_checkpoint_dir_aborts_not_loops(mesh8, tmp_path,
+                                                        fault_harness):
+    """Escalating to rewind with no checkpoint dir configured must abort
+    with forensics, not spin forever re-trying."""
+    engine = _engine(mesh8, stage=0,
+                     health_check={"consecutive_skip_budget": 2,
+                                   "rewind_limit": 2,
+                                   "forensic_dir": str(tmp_path)})
+    engine.train_batch()
+    fault_harness.configure("grad_nan=1:100")
+    with pytest.raises(ds.TrainingHealthError, match="rewind failed"):
+        for _ in range(5):
+            engine.train_batch()
+
+
+# ---------------------------------------------------------------------------
+# data-pipeline state (satellite): exact-stream resume
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_restores_exact_batch_stream(mesh8, tmp_path):
+    """Loader state (seed, epoch, batch index) rides the checkpoint: the
+    restored engine draws the SAME next batch the original would have —
+    not a restarted sampler."""
+    save_dir = str(tmp_path)
+    engine = _engine(mesh8, stage=0)
+    for _ in range(3):
+        engine.train_batch()
+    engine.save_checkpoint(save_dir, tag="s3")
+    expected_next = [np.asarray(next(engine._data_iterator)[0])
+                     for _ in range(3)]
+
+    cfg = base_config(bf16={"enabled": True},
+                      zero_optimization={"stage": 0})
+    engine2, _, _, _ = ds.initialize(config=cfg, model=SimpleModel(),
+                                     training_data=random_dataset(n=64),
+                                     mesh=mesh8, rng_seed=7)
+    engine2.load_checkpoint(save_dir)
+    assert engine2._stream_step == 3
+    got_next = [np.asarray(next(engine2._data_iterator)[0])
+                for _ in range(3)]
+    for a, b in zip(expected_next, got_next):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_rewind_fast_forward_jumps_to_exact_position(mesh8, tmp_path):
+    """The fast-forward advances the loader's (epoch, batch_index) state
+    arithmetically (no per-batch collation of discarded data) and lands on
+    the exact stream position sequential draining would have reached."""
+    engine = _engine(mesh8, stage=0)
+    for _ in range(2):
+        engine.train_batch()
+    engine.save_checkpoint(str(tmp_path), tag="s2")
+    # reference: batches at stream positions 2, 3, 4, 5, 6, 7
+    ref = [np.asarray(next(engine._data_iterator)[0]) for _ in range(6)]
+    engine.rewind(load_dir=str(tmp_path), replay_past=5)
+    assert engine._stream_step == 6
+    got = np.asarray(next(engine._data_iterator)[0])
+    np.testing.assert_array_equal(got, ref[4])   # position 6
+
+
+@pytest.mark.slow
+def test_rewind_zero3_variant(mesh_2x4, tmp_path, fault_harness):
+    """The same rewind-and-replay ladder under ZeRO-3 sharded state."""
+    save_dir = str(tmp_path)
+    engine = _engine(mesh_2x4, stage=3,
+                     checkpoint={"dir": save_dir},
+                     health_check={"consecutive_skip_budget": 2,
+                                   "rewind_limit": 3})
+    for _ in range(2):
+        engine.train_batch()
+    engine.save_checkpoint(save_dir, tag="good")
+    fault_harness.configure("grad_nan=2:6")
+    for _ in range(8):   # monitor trails by one step; window is 4 long
+        engine.train_batch()
+    assert engine.health_monitor.rewinds >= 1
+    assert engine._stream_step > 6
+    assert np.isfinite(float(engine.train_batch()))
+
+
+@pytest.mark.slow
+def test_offload_bf16_skip_step(mesh8, fault_harness, tmp_path):
+    """The offload route (device grads -> host Adam) must also no-op on a
+    poisoned step: the host master/moments and the device payload stay at
+    the pre-step state."""
+    cfg = base_config(
+        bf16={"enabled": True},
+        zero_optimization={"stage": 2,
+                           "offload_optimizer": {"device": "cpu"}})
+    engine, _, _, _ = ds.initialize(config=cfg, model=SimpleModel(),
+                                    training_data=random_dataset(n=64),
+                                    mesh=mesh8)
+    engine.train_batch()
+    ref_p = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    ref_master = jax.tree_util.tree_map(np.array,
+                                        engine._offload.master_tree())
+    fault_harness.configure("grad_nan=1")
+    engine.train_batch()
+    assert engine.skipped_steps == 1
+    for a, b in zip(jax.tree_util.tree_leaves(ref_p),
+                    jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                        np.asarray, engine.state.params))):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_master),
+                    jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                        np.array, engine._offload.master_tree()))):
+        np.testing.assert_array_equal(a, b)
+    assert np.isfinite(float(engine.train_batch()))
