@@ -23,10 +23,19 @@ def _default_paths():
 
 def _audit_builtin_steps(stages):
     """Jaxpr-audit a tiny bf16 MLP engine's compiled step per ZeRO stage
-    on whatever devices this process sees (CPU works)."""
+    on whatever devices this process sees (CPU works).
+
+    Each stage is built TWICE through a throwaway compile cache: a cold
+    engine populates it, then a WARM-STARTED engine — whose step is the
+    deserialized executable — is the one audited.  That makes DSTPU204
+    (donation declared vs honored via ``input_output_alias``) hold for
+    AOT warm starts, not just fresh compiles (docs/compile-cache.md)."""
+    import shutil
+    import tempfile
     import numpy as np
     import jax.numpy as jnp
     import deepspeed_tpu as ds
+    from .findings import Finding
     from .jaxpr_audit import audit_engine
 
     class _MLP:
@@ -45,19 +54,53 @@ def _audit_builtin_steps(stages):
     findings = []
     data = (np.ones((8, 16), np.float32), np.ones((8, 16), np.float32))
     dataset = [(data[0][i], data[1][i]) for i in range(8)]
-    for stage in stages:
-        cfg = {"train_micro_batch_size_per_gpu": 4,
-               "gradient_accumulation_steps": 1,
-               "steps_per_print": 10 ** 9,
-               "bf16": {"enabled": True},
-               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
-               "zero_optimization": {"stage": stage}}
-        engine, _, _, _ = ds.initialize(config=cfg, model=_MLP(),
-                                        training_data=dataset)
-        report = audit_engine(engine)
-        for f in report.findings:
-            f.extra = dict(f.extra, zero_stage=stage)
-        findings.extend(report.findings)
+    cache_dir = tempfile.mkdtemp(prefix="dstpu-audit-cc-")
+    try:
+        for stage in stages:
+            cfg = {"train_micro_batch_size_per_gpu": 4,
+                   "gradient_accumulation_steps": 1,
+                   "steps_per_print": 10 ** 9,
+                   "bf16": {"enabled": True},
+                   "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                   "zero_optimization": {"stage": stage},
+                   "compile_cache": {"dir": cache_dir}}
+            cold, _, _, _ = ds.initialize(config=cfg, model=_MLP(),
+                                          training_data=dataset)
+            cache_on = cold.compile_report().get("enabled", False)
+            warm_started = False
+            if cache_on:
+                cold.train_batch()  # compiles + persists the executable
+                cold.close()
+                engine, _, _, _ = ds.initialize(config=cfg, model=_MLP(),
+                                                training_data=dataset)
+                engine.train_batch()   # deserializes (or the finding below)
+                rep = engine.compile_report()
+                warm_started = bool(rep.get("hits"))
+                if not warm_started:
+                    findings.append(Finding(
+                        "DSTPU200", "warning",
+                        f"--audit-step z{stage}: warm start did not hit "
+                        "the compile cache (hits=0); auditing a fresh "
+                        "executable instead of a deserialized one",
+                        eqn_path="warm-start",
+                        extra={"zero_stage": stage,
+                               "compile_report": {k: rep.get(k) for k in
+                                                  ("hits", "misses",
+                                                   "corrupt",
+                                                   "put_errors")}}))
+            else:
+                # operator kill switch (DSTPU_COMPILE_CACHE=0): audit the
+                # cold engine directly — disabling the cache is a choice,
+                # not a finding
+                engine = cold
+            report = audit_engine(engine)
+            for f in report.findings:
+                f.extra = dict(f.extra, zero_stage=stage,
+                               warm_started=warm_started)
+            findings.extend(report.findings)
+            engine.close()
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
     return findings
 
 

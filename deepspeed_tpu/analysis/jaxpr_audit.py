@@ -426,13 +426,24 @@ def audit_fn(fn, *example_args, donate_argnums=(), compute_dtype=None,
         lowered = wrapped.lower(*example_args, **example_kwargs)
         compiled = None
         if compile:
-            try:
-                compiled = lowered.compile()
-            except Exception as e:
-                report.findings.append(Finding(
-                    "DSTPU200", "warning",
-                    f"could not compile for executable-level checks: {e}",
-                    eqn_path="compile"))
+            # CachedStep entry points: audit THE executable that is (or
+            # will be) dispatching — for a warm-started engine that is the
+            # DESERIALIZED executable, so DSTPU204 (donation honored) is
+            # proven for AOT warm starts, not just fresh compiles.
+            live = getattr(wrapped, "live_executable", None)
+            if live is not None:
+                compiled = live(*example_args, **example_kwargs)
+            if compiled is None:
+                acquire = getattr(wrapped, "executable", None)
+                try:
+                    compiled = (acquire(*example_args, **example_kwargs)
+                                if acquire is not None
+                                else lowered.compile())
+                except Exception as e:
+                    report.findings.append(Finding(
+                        "DSTPU200", "warning",
+                        f"could not compile for executable-level checks: {e}",
+                        eqn_path="compile"))
         _audit_donation(lowered, compiled, report)
         _audit_hlo_collectives(compiled, report)
     if comms_budget is not None:

@@ -103,8 +103,42 @@ def _safe(fn):
         return f"<unavailable: {e}>"
 
 
+def compile_cache_report():
+    """Persistent compiled-step cache status (docs/compile-cache.md):
+    directory, entry count, total bytes, and the last run's hit/miss
+    counters — read-only, safe beside a live trainer."""
+    from .runtime.compile_cache import disk_report
+
+    print("-" * 64)
+    print("Compile cache (DSTPU_COMPILE_CACHE / config `compile_cache`):")
+    print("-" * 64)
+    rep = disk_report()
+    if not rep.get("configured"):
+        print("not configured (set --compile-cache-dir, env "
+              "DSTPU_COMPILE_CACHE, or config compile_cache.dir)")
+        return
+    print(f"dir ................... {rep['dir']}")
+    if not rep.get("exists"):
+        print("status ................ directory does not exist yet "
+              "(created on first engine build)")
+        return
+    print(f"entries ............... {rep['entries']}")
+    print(f"total bytes ........... {rep['total_bytes']:,}")
+    last = rep.get("last_run")
+    if last and isinstance(last.get("stats"), dict):
+        s = last["stats"]
+        print(f"last run .............. hits={s.get('hits', 0)} "
+              f"misses={s.get('misses', 0)} corrupt={s.get('corrupt', 0)} "
+              f"compile_ms={round(s.get('compile_ms', 0))} "
+              f"deserialize_ms={round(s.get('deserialize_ms', 0))} "
+              f"(pid {last.get('pid')})")
+    else:
+        print("last run .............. no stats recorded yet")
+
+
 def main():
     op_report()
+    compile_cache_report()
     debug_report()
 
 

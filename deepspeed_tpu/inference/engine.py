@@ -40,7 +40,7 @@ class InferenceEngine:
                  mesh=None, moe: bool = False, moe_experts: int = 1,
                  quantization_setting=None, enable_cuda_graph: bool = False,
                  mpu=None, ep_size: int = 1, config=None, max_seq=None,
-                 rng_seed: int = 0):
+                 rng_seed: int = 0, compile_cache=None):
         # HF torch module → convert through the injection layer
         if _is_torch_module(model):
             from ..module_inject.replace_module import replace_transformer_layer
@@ -157,11 +157,42 @@ class InferenceEngine:
             params = jax.device_put(params, NamedSharding(self.mesh, P()))
         self.params = params
 
+        # ---- persistent compiled-step cache (AOT warm-start) --------------
+        # prefill + per-(steps, sampling) decode loops are this engine's
+        # compile cost; a serving restart warm-starts them from disk.
+        # ``compile_cache`` accepts a CompileCache, a directory path, or
+        # None (then env DSTPU_COMPILE_CACHE decides).
+        from ..runtime import compile_cache as ccache
+        if isinstance(compile_cache, str):
+            compile_cache = ccache.from_dir(compile_cache)
+        elif compile_cache is None:
+            compile_cache = ccache.from_dir()
+        self.compile_cache = compile_cache
+        self._cc_key_slice = {
+            "engine": "InferenceEngine",
+            "dtype": str(self.dtype),
+            "quantized": self.quantized,
+            "tp": self.mp_world_size,
+            "mesh": dict(self.mesh.shape),
+        }
+
         self._jit_forward = None
         self._jit_prefill = None
         self._decode_loops = {}    # (steps, do_sample, top_k) → fn
         log_dist(f"InferenceEngine ready: tp={self.mp_world_size} "
                  f"mesh={dict(self.mesh.shape)}", ranks=[0])
+
+    def _wrap_step(self, name, fn, donate_argnums=()):
+        from ..runtime import compile_cache as ccache
+        return ccache.wrap_step(f"InferenceEngine.{name}", fn,
+                                cache=self.compile_cache,
+                                key_extra=self._cc_key_slice,
+                                donate_argnums=donate_argnums)
+
+    def compile_report(self):
+        """Compile-cache status/hit-miss stats (docs/compile-cache.md)."""
+        from ..runtime import compile_cache as ccache
+        return ccache.report(self.compile_cache)
 
     # ---------------------------------------------------------------- forward
     def forward(self, tokens, **kwargs):
@@ -169,7 +200,7 @@ class InferenceEngine:
         if self._jit_forward is None:
             def fwd(params, toks):
                 return self.module.apply(params, toks)
-            self._jit_forward = jax.jit(fwd)
+            self._jit_forward = self._wrap_step("forward", fwd)
         tokens = jnp.asarray(tokens)
         with jax.set_mesh(self.mesh):
             return self._jit_forward(self.params, tokens)
@@ -226,7 +257,7 @@ class InferenceEngine:
                 logits, cache = inner.apply_with_cache(deq(params), toks,
                                                        cache)
                 return logits[:, -1], cache
-            self._jit_prefill = jax.jit(prefill)
+            self._jit_prefill = self._wrap_step("prefill", prefill)
 
         # temperature is a RUNTIME operand (no recompile per value); the
         # compile key is only what changes the program structure
@@ -257,8 +288,9 @@ class InferenceEngine:
             # cache (without it, input + updated cache coexist — double the
             # KV memory).  The 1-token path never touches the cache, where
             # donation would only warn.
-            loop = jax.jit(decode_loop,
-                           donate_argnums=(2,) if max_new_tokens > 1 else ())
+            loop = self._wrap_step(
+                f"decode[{max_new_tokens},{do_sample},{top_k}]", decode_loop,
+                donate_argnums=(2,) if max_new_tokens > 1 else ())
             if len(self._decode_loops) >= 8:   # bound the executable cache
                 self._decode_loops.pop(next(iter(self._decode_loops)))
             self._decode_loops[key] = loop

@@ -58,6 +58,13 @@ def parse_args(args=None):
                              "the config's checkpoint.dir (sets "
                              "DSTPU_AUTO_RESUME=1 for the job; see "
                              "docs/fault-tolerance.md)")
+    parser.add_argument("--compile-cache-dir", type=str, default="",
+                        dest="compile_cache_dir",
+                        help="Persistent compiled-step cache directory "
+                             "(sets DSTPU_COMPILE_CACHE; engines AOT "
+                             "warm-start their jitted steps from it — "
+                             "see docs/compile-cache.md). Pass '0' to "
+                             "force the cache off.")
     parser.add_argument("--fault", type=str, default="",
                         help="Arm the fault-injection harness for the job "
                              "(sets DSTPU_FAULT=<spec>; test/chaos runs only)")
@@ -190,6 +197,8 @@ def main(args=None):
         env["DSTPU_AUTO_RESUME"] = "1"
     if args.fault:
         env["DSTPU_FAULT"] = args.fault
+    if args.compile_cache_dir:
+        env["DSTPU_COMPILE_CACHE"] = args.compile_cache_dir
     if args.health_check is not None:
         env["DSTPU_HEALTH_CHECK"] = "1" if args.health_check else "0"
     cmd_tail = [args.user_script] + list(args.user_args)

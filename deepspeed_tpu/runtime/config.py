@@ -347,6 +347,38 @@ class DeepSpeedHealthCheckConfig:
             raise DeepSpeedConfigError("health_check.history must be >= 1")
 
 
+class DeepSpeedCompileCacheConfig:
+    """Persistent compiled-step cache (``runtime/compile_cache.py``;
+    docs/compile-cache.md).  Active when ``enabled`` (default) AND a
+    directory resolves: an explicit ``dir`` wins, else env
+    ``DSTPU_COMPILE_CACHE`` (set by ``deepspeed --compile-cache-dir``).
+    An env value of ``0``/``off`` is the operator kill switch — it
+    disables the cache even against a config-provided dir.  ``readonly``
+    serves a shared CI cache (reads verify + deserialize; nothing is
+    written, touched or evicted); ``max_entries`` bounds the store with
+    LRU eviction (0 = unbounded)."""
+
+    def __init__(self, param_dict):
+        from .compile_cache import resolve_env_dir, env_disabled
+        cc = get_dict_param(param_dict, C.COMPILE_CACHE, {}) or {}
+        self.enabled = bool(get_scalar_param(
+            cc, C.COMPILE_CACHE_ENABLED, C.COMPILE_CACHE_ENABLED_DEFAULT))
+        self.dir = get_scalar_param(cc, C.COMPILE_CACHE_DIR,
+                                    C.COMPILE_CACHE_DIR_DEFAULT)
+        if self.dir is None:
+            self.dir = resolve_env_dir()
+        if env_disabled():
+            self.enabled = False
+        self.max_entries = int(get_scalar_param(
+            cc, C.COMPILE_CACHE_MAX_ENTRIES,
+            C.COMPILE_CACHE_MAX_ENTRIES_DEFAULT))
+        if self.max_entries < 0:
+            raise DeepSpeedConfigError(
+                "compile_cache.max_entries must be >= 0")
+        self.readonly = bool(get_scalar_param(
+            cc, C.COMPILE_CACHE_READONLY, C.COMPILE_CACHE_READONLY_DEFAULT))
+
+
 class DeepSpeedMeshConfig:
     """TPU-native extension: declared mesh axis sizes.
 
@@ -569,6 +601,7 @@ class DeepSpeedConfig:
         self.checkpoint_config = DeepSpeedCheckpointConfig(pd)
         self.io_retry_config = DeepSpeedIORetryConfig(pd)
         self.health_check = DeepSpeedHealthCheckConfig(pd)
+        self.compile_cache_config = DeepSpeedCompileCacheConfig(pd)
         self.mesh_config = DeepSpeedMeshConfig(pd)
         self.sequence_parallel = DeepSpeedSequenceParallelConfig(pd)
         self.wall_clock_breakdown = get_scalar_param(pd, C.WALL_CLOCK_BREAKDOWN,

@@ -317,6 +317,19 @@ HEALTH_FORENSIC_DIR = "forensic_dir"
 HEALTH_FORENSIC_DIR_DEFAULT = None     # None -> checkpoint.dir or cwd
 
 #############################################
+# Compile cache (persistent AOT executables; runtime/compile_cache.py)
+#############################################
+COMPILE_CACHE = "compile_cache"
+COMPILE_CACHE_ENABLED = "enabled"
+COMPILE_CACHE_ENABLED_DEFAULT = True   # active iff a dir resolves
+COMPILE_CACHE_DIR = "dir"
+COMPILE_CACHE_DIR_DEFAULT = None       # None -> env DSTPU_COMPILE_CACHE
+COMPILE_CACHE_MAX_ENTRIES = "max_entries"
+COMPILE_CACHE_MAX_ENTRIES_DEFAULT = 0  # 0 = unbounded (no LRU eviction)
+COMPILE_CACHE_READONLY = "readonly"
+COMPILE_CACHE_READONLY_DEFAULT = False # True = shared CI cache, never writes
+
+#############################################
 # Dataloader
 #############################################
 DATALOADER_DROP_LAST = "dataloader_drop_last"

@@ -511,7 +511,7 @@ class PipelineEngine(DeepSpeedEngine):
         if self._jit_eval is None:
             def eval_fn(params, b, r):
                 return self._pipeline_loss(params, b, r, train=False)
-            self._jit_eval = jax.jit(eval_fn)
+            self._jit_eval = self._wrap_step("eval_step", eval_fn)
         # promote a single micro-batch to a stack of one
         batch = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[None], batch)
         return self._jit_eval(self.state.params, batch, rng)

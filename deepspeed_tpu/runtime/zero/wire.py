@@ -234,3 +234,12 @@ class H2DUploader:
 
     def wait(self):
         self._reclaim(block=True)
+
+    def close(self):
+        """Engine shutdown: drop every staging buffer and tracked pair.
+        The r5 bench ladder leaked these across configs (`del engine`
+        does not free buffers still referenced here) until later rungs
+        died RESOURCE_EXHAUSTED."""
+        self._fresh = []
+        self._settled = []
+        self._staging = []

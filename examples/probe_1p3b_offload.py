@@ -53,7 +53,7 @@ def main():
 
     t0 = time.time()
     with jax.set_mesh(engine.mesh):
-        grads, metrics, _ = engine._jit_grad_step(engine.state, batch, rngk)
+        grads, metrics, *_ = engine._jit_grad_step(engine.state, batch, rngk)
     loss = float(metrics["loss"])  # sync: real device read
     print(f"compile+step1: {time.time()-t0:.1f}s loss={loss:.3f}", flush=True)
 
@@ -61,8 +61,8 @@ def main():
     for i in range(2):
         t0 = time.time()
         with jax.set_mesh(engine.mesh):
-            grads, metrics, _ = engine._jit_grad_step(engine.state, batch,
-                                                      rngk)
+            grads, metrics, *_ = engine._jit_grad_step(engine.state, batch,
+                                                       rngk)
         loss = float(metrics["loss"])
         print(f"device grad step: {time.time()-t0:.2f}s", flush=True)
 

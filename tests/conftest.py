@@ -9,7 +9,10 @@ exercised through ``jax.sharding.Mesh`` over them (SURVEY.md §4 lesson).
 Must set env BEFORE jax is imported anywhere.
 """
 
+import atexit
 import os
+import shutil
+import tempfile
 
 # Force CPU: the session env may pin JAX_PLATFORMS to a real accelerator
 # (e.g. 'axon' single-chip TPU) which can't model an 8-device mesh.
@@ -17,6 +20,18 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Session-scoped persistent compile cache (runtime/compile_cache.py):
+# compile-heavy tier-1 tests that build identical engines share their
+# serialized executables within a run — the first build per step shape
+# compiles cold, later ones deserialize.  Tests needing an isolated cache
+# set config `compile_cache.dir` explicitly (it wins over this env
+# default); setting DSTPU_COMPILE_CACHE=0 in the outer env disables.
+_cc_dir = os.environ.get("DSTPU_COMPILE_CACHE")
+if not _cc_dir:
+    _cc_dir = tempfile.mkdtemp(prefix="dstpu-compile-cache-")
+    os.environ["DSTPU_COMPILE_CACHE"] = _cc_dir
+    atexit.register(shutil.rmtree, _cc_dir, ignore_errors=True)
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -51,6 +66,34 @@ def pytest_configure(config):
         "markers",
         "fault: fault-injection / fault-tolerance test (crash-consistent "
         "checkpointing, retry/backoff IO, recovery paths)")
+
+
+def pytest_report_header(config):
+    from deepspeed_tpu.runtime.compile_cache import env_disabled
+    if env_disabled():
+        return ["dstpu compile cache: DISABLED via DSTPU_COMPILE_CACHE"]
+    return [f"dstpu compile cache: {_cc_dir} (session-scoped; first "
+            "engine per step shape compiles cold, later ones warm-start "
+            "— cold-vs-warm totals in the terminal summary)"]
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Cold-vs-warm compile timing for the run, so the tier-1 budget
+    trend stays visible as the suite grows."""
+    from deepspeed_tpu.runtime.compile_cache import GLOBAL_STATS as g
+    if not (g["hits"] or g["misses"]):
+        return
+    terminalreporter.write_sep("-", "dstpu compile cache (cold vs warm)")
+    terminalreporter.write_line(
+        f"cold compiles: {g['misses']} ({g['compile_ms'] / 1000:.1f}s)   "
+        f"warm hits: {g['hits']} ({g['deserialize_ms'] / 1000:.1f}s "
+        f"deserialize)   corrupt: {g['corrupt']}   "
+        f"not-persisted: {g['put_errors']}")
+    if g["misses"]:
+        avg_ms = g["compile_ms"] / g["misses"]
+        saved = (g["hits"] * avg_ms - g["deserialize_ms"]) / 1000
+        terminalreporter.write_line(
+            f"estimated compile time avoided this run: ~{saved:.0f}s")
 
 
 def pytest_collection_modifyitems(config, items):

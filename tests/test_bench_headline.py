@@ -75,3 +75,45 @@ def test_single_line_invariant(bench):
     line = bench.format_headline(_headline({"note": "a\nb"}))  # embedded \n
     assert "\n" not in line
     assert json.loads(line)["extra"]["note"] == "a\nb"
+
+
+# ===========================================================================
+# Memory-preflighted ladder: the halving planner (ISSUE 4 — the r5 ladder
+# died RESOURCE_EXHAUSTED mid-run; rungs must back off instead)
+# ===========================================================================
+
+def test_backoff_planner_halves_until_fit(bench):
+    peaks = {24: 30e9, 12: 18e9, 6: 11e9, 3: 7e9}
+    micro, attempts = bench.plan_micro_backoff(24, lambda m: peaks[m],
+                                               budget=16e9, safety=0.9)
+    assert micro == 6                      # 11e9 <= 0.9 * 16e9
+    assert [a["micro"] for a in attempts] == [24, 12, 6]
+    assert attempts[-1]["peak_bytes"] == 11e9
+
+
+def test_backoff_planner_stops_at_micro_one(bench):
+    micro, attempts = bench.plan_micro_backoff(8, lambda m: 1e12,
+                                               budget=16e9)
+    assert micro == 1                      # nothing left to halve
+    assert [a["micro"] for a in attempts] == [8, 4, 2, 1]
+
+
+def test_backoff_planner_disabled_without_budget_or_analysis(bench):
+    # no budget (unknown backend) or no memory_analysis: run as asked
+    assert bench.plan_micro_backoff(8, lambda m: 1e12, budget=None)[0] == 8
+    assert bench.plan_micro_backoff(8, lambda m: None, budget=16e9)[0] == 8
+
+
+def test_headline_carries_warm_start_keys(bench):
+    # the driver-facing acceptance surface: compile_cold_s /
+    # compile_warm_s / cache ride the headline and survive the tail path
+    line = bench.format_headline(_headline(
+        {"details_file": "BENCH_DETAILS.json", "compile_cold_s": 52.1,
+         "compile_warm_s": 9.7, "cache": {"hits": 1, "misses": 0},
+         "backoff": {"gpt2_350m_T1024_z2": "8->4"},
+         "summary_mfu": {"gpt2_350m_T1024_z2": 0.51}}))
+    parsed = bench.parse_headline_tail("noise\n" + line)
+    assert parsed["extra"]["compile_cold_s"] == 52.1
+    assert parsed["extra"]["compile_warm_s"] == 9.7
+    assert parsed["extra"]["cache"]["hits"] == 1
+    assert parsed["extra"]["backoff"]["gpt2_350m_T1024_z2"] == "8->4"

@@ -1,0 +1,342 @@
+"""Persistent compiled-step cache (``runtime/compile_cache.py``).
+
+Acceptance (ISSUE 4): warm-start produces BIT-IDENTICAL losses/params vs
+a cold compile on z1/z2/z3 and the offload route; the cache key
+invalidates on config change (dtype, gas, remat policy); a poisoned or
+unpicklable entry falls back to a fresh compile (never crashes); LRU
+eviction honors ``max_entries``; and the step audit (DSTPU201/204) is
+clean on a WARM-STARTED engine — donation aliasing must survive
+``serialize_executable`` round-trips (the jax-native persistent cache
+measurably does NOT preserve it on this jax; see tests/conftest.py).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.parallel.mesh import make_mesh
+from deepspeed_tpu.runtime import compile_cache as cc
+
+from simple_model import SimpleModel, random_dataset, base_config
+
+
+# ===========================================================================
+# Store-level behavior (no engine, no compile)
+# ===========================================================================
+
+def test_put_get_roundtrip_and_corruption(tmp_path):
+    cache = cc.CompileCache(str(tmp_path / "cc"))
+    key = "a" * 64
+    assert cache.get(key) is None
+    assert cache.put(key, b"payload-bytes", meta={"name": "t"})
+    assert cache.get(key) == b"payload-bytes"
+    # corrupt the payload: SHA-256 manifest verification rejects the
+    # entry, removes it, and reports a miss — never raises
+    with open(os.path.join(cache.dir, key, cc.PAYLOAD_FILE), "wb") as f:
+        f.write(b"tampered")
+    assert cache.get(key) is None
+    assert cache.stats["corrupt"] == 1
+    assert not os.path.isdir(os.path.join(cache.dir, key))
+
+
+def test_lru_eviction_honors_max_entries(tmp_path):
+    cache = cc.CompileCache(str(tmp_path / "cc"), max_entries=3)
+    keys = [ch * 64 for ch in "abcde"]
+    for i, k in enumerate(keys[:3]):
+        cache.put(k, b"x%d" % i)
+        os.utime(cache._entry_dir(k), (i, i))   # deterministic recency
+    # touch "a" via get: it becomes most-recent and must survive
+    assert cache.get(keys[0]) is not None
+    cache.put(keys[3], b"x3")
+    cache.put(keys[4], b"x4")
+    held = {k for k, _, _ in cache.entries()}
+    assert len(held) == 3
+    assert keys[0] in held          # recently used: kept
+    assert keys[1] not in held      # LRU: evicted
+    assert keys[2] not in held
+
+
+def test_readonly_mode_never_writes(tmp_path):
+    d = str(tmp_path / "cc")
+    writer = cc.CompileCache(d)
+    key = "b" * 64
+    writer.put(key, b"shared-ci-artifact")
+    ro = cc.CompileCache(d, readonly=True)
+    assert ro.get(key) == b"shared-ci-artifact"
+    assert not ro.put("c" * 64, b"nope")
+    assert not os.path.isdir(os.path.join(d, "c" * 64))
+    # a corrupt entry is reported but NOT deleted under readonly (the
+    # cache may be another owner's)
+    with open(os.path.join(d, key, cc.PAYLOAD_FILE), "wb") as f:
+        f.write(b"tampered")
+    assert ro.get(key) is None
+    assert os.path.isdir(os.path.join(d, key))
+
+
+def test_env_kill_switch(monkeypatch, tmp_path):
+    monkeypatch.setenv(cc.ENV_DIR, str(tmp_path))
+    assert cc.resolve_env_dir() == str(tmp_path)
+    assert cc.from_dir() is not None
+    monkeypatch.setenv(cc.ENV_DIR, "0")
+    assert cc.resolve_env_dir() is None
+    assert cc.env_disabled()
+    # the kill switch beats an explicit dir too
+    assert cc.from_dir(str(tmp_path)) is None
+
+
+# ===========================================================================
+# Engine warm-start: bit-identical numerics (z1/z2/z3 + offload route)
+# ===========================================================================
+
+def _run(cache_dir, steps=4, over=None, mesh_axes=None, seed=0):
+    cfg = base_config(micro=4, over=over or {})
+    cfg["compile_cache"] = {"dir": str(cache_dir)}
+    engine, _, _, _ = ds.initialize(
+        config=cfg, model=SimpleModel(dim=8),
+        training_data=random_dataset(n=64, seed=seed),
+        mesh=make_mesh(mesh_axes or {"data": 2, "fsdp": 4}))
+    losses = [float(engine.train_batch()) for _ in range(steps)]
+    params = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    report = engine.compile_report()
+    engine.close()
+    return losses, params, report
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_warm_start_bit_identical(tmp_path, devices, stage):
+    """A warm-started engine dispatches the DESERIALIZED executable —
+    losses and final params must equal the cold run bit for bit."""
+    over = {"bf16": {"enabled": True}, "zero_optimization": {"stage": stage}}
+    cold_losses, cold_params, cold_rep = _run(tmp_path, over=over)
+    assert cold_rep["enabled"] and cold_rep["misses"] >= 1
+    warm_losses, warm_params, warm_rep = _run(tmp_path, over=over)
+    assert warm_rep["hits"] >= 1, warm_rep
+    assert warm_rep["misses"] == 0, warm_rep
+    assert cold_losses == warm_losses
+    jax.tree_util.tree_map(np.testing.assert_array_equal,
+                           cold_params, warm_params)
+
+
+def test_warm_start_bit_identical_offload(tmp_path, devices):
+    """The offload route (`_grad_only_step` device half + host Adam):
+    cold vs warm must match exactly, including the host master."""
+    over = {"bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}}}
+    cold_losses, cold_params, cold_rep = _run(tmp_path, over=over)
+    assert cold_rep["misses"] >= 1
+    warm_losses, warm_params, warm_rep = _run(tmp_path, over=over)
+    assert warm_rep["hits"] >= 1, warm_rep
+    assert cold_losses == warm_losses
+    jax.tree_util.tree_map(np.testing.assert_array_equal,
+                           cold_params, warm_params)
+
+
+# ===========================================================================
+# Key invalidation
+# ===========================================================================
+
+def test_key_invalidates_on_config_change(tmp_path, devices):
+    """dtype / gas changes must MISS — never serve another config's
+    executable.  (The config slice is keyed alongside the lowering hash:
+    either alone would catch these, both together are the contract.)"""
+    base = {"bf16": {"enabled": True}, "zero_optimization": {"stage": 1}}
+    _, _, rep0 = _run(tmp_path, steps=1, over=base)
+    assert rep0["misses"] >= 1
+    # same config: warm
+    _, _, rep1 = _run(tmp_path, steps=1, over=base)
+    assert rep1["hits"] >= 1 and rep1["misses"] == 0
+    # dtype change: cold again
+    _, _, rep2 = _run(tmp_path, steps=1,
+                      over={"zero_optimization": {"stage": 1}})
+    assert rep2["misses"] >= 1 and rep2["hits"] == 0, rep2
+    # gas change: cold again
+    cfg_gas = dict(base)
+    _, _, rep3 = _run(tmp_path, steps=1, over=cfg_gas)
+    assert rep3["hits"] >= 1          # sanity: unchanged config still warm
+    gas_over = {"bf16": {"enabled": True},
+                "gradient_accumulation_steps": 2,
+                "zero_optimization": {"stage": 1}}
+    _, _, rep4 = _run(tmp_path, steps=1, over=gas_over)
+    assert rep4["misses"] >= 1 and rep4["hits"] == 0, rep4
+
+
+def test_key_invalidates_on_remat_policy(tmp_path, devices):
+    """A remat (checkpoint) policy changes the traced program — the
+    lowering hash must fork the key even with an identical config
+    slice and identical avals."""
+    cache = cc.CompileCache(str(tmp_path / "cc"))
+
+    def f(x):
+        return jnp.sum(jnp.tanh(x) ** 2)
+
+    x = jnp.ones((8, 8))
+    plain = cc.CachedStep("t.f", jax.jit(jax.grad(f)), cache=cache)
+    remat = cc.CachedStep("t.f", jax.jit(jax.grad(jax.checkpoint(f))),
+                          cache=cache)
+    plain.executable(x)
+    remat.executable(x)
+    k1, k2 = plain.keys()[0], remat.keys()[0]
+    assert k1 != k2
+    assert cache.stats["misses"] == 2   # no cross-serving
+
+
+# ===========================================================================
+# Corruption / fallback
+# ===========================================================================
+
+def _first_entry(cache_dir):
+    for name in os.listdir(cache_dir):
+        payload = os.path.join(cache_dir, name, cc.PAYLOAD_FILE)
+        if os.path.isfile(payload):
+            return os.path.join(cache_dir, name)
+    raise AssertionError(f"no cache entries in {cache_dir}")
+
+
+def test_poisoned_entry_falls_back_to_compile(tmp_path, devices):
+    """Flipped payload bytes: the SHA-256 manifest catches it, the entry
+    is dropped, and the engine compiles fresh — numerics unchanged."""
+    over = {"zero_optimization": {"stage": 1}}
+    cold_losses, _, _ = _run(tmp_path, steps=2, over=over)
+    entry = _first_entry(str(tmp_path))
+    with open(os.path.join(entry, cc.PAYLOAD_FILE), "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    poisoned_losses, _, rep = _run(tmp_path, steps=2, over=over)
+    assert rep["corrupt"] >= 1, rep
+    assert rep["misses"] >= 1           # fell back to a fresh compile
+    assert poisoned_losses == cold_losses
+
+
+def test_unpicklable_entry_falls_back_to_compile(tmp_path, devices):
+    """A payload whose manifest VERIFIES but whose pickle is garbage
+    (foreign tool, partial format migration): deserialization failure is
+    a miss + invalidation, not a crash (DSTPU102-clean handling)."""
+    from deepspeed_tpu.checkpoint import atomic
+    over = {"zero_optimization": {"stage": 1}}
+    cold_losses, _, _ = _run(tmp_path, steps=2, over=over)
+    entry = _first_entry(str(tmp_path))
+    with open(os.path.join(entry, cc.PAYLOAD_FILE), "wb") as f:
+        f.write(b"not-a-pickle")
+    os.remove(os.path.join(entry, atomic.MANIFEST_FILE))
+    atomic.write_manifest(entry)        # re-manifest: sha now matches
+    losses, _, rep = _run(tmp_path, steps=2, over=over)
+    assert rep["corrupt"] >= 1, rep
+    assert losses == cold_losses
+    # the poisoned entry was invalidated, then re-populated by the fresh
+    # compile under the same content key — the garbage is gone
+    with open(os.path.join(entry, cc.PAYLOAD_FILE), "rb") as f:
+        assert f.read() != b"not-a-pickle"
+
+
+# ===========================================================================
+# Warm-started step audit (DSTPU201 / DSTPU204 on the DESERIALIZED exe)
+# ===========================================================================
+
+def test_step_audit_clean_on_warm_started_engine(tmp_path, devices):
+    """Donation honored + zero host callbacks for the executable a
+    warm-started engine actually dispatches (acceptance: DSTPU201/204
+    clean on a warm-started engine)."""
+    from deepspeed_tpu.analysis.jaxpr_audit import audit_engine
+    over = {"bf16": {"enabled": True}, "zero_optimization": {"stage": 2}}
+    _, _, cold_rep = _run(tmp_path, steps=1, over=over)
+    assert cold_rep["misses"] >= 1
+    cfg = base_config(micro=4, over=over)
+    cfg["compile_cache"] = {"dir": str(tmp_path)}
+    engine, _, _, _ = ds.initialize(
+        config=cfg, model=SimpleModel(dim=8),
+        training_data=random_dataset(n=64),
+        mesh=make_mesh({"data": 2, "fsdp": 4}))
+    engine.train_batch()
+    rep = engine.compile_report()
+    assert rep["hits"] >= 1, rep        # the step IS deserialized
+    report = audit_engine(engine)
+    assert report.host_callbacks == [], [str(f) for f in report.findings]
+    d = report.donation
+    assert d["checked"] and d["source"] == "executable"
+    assert d["lowered_donors"] > 0
+    assert d["unhonored_args"] == [], d
+    assert not [f for f in report.findings if f.rule == "DSTPU204"]
+    engine.close()
+
+
+def test_warm_step_does_not_mutate_exported_numpy_views(tmp_path, devices):
+    """`np.asarray` of a CPU jax array is a zero-copy VIEW holding an
+    external buffer reference; normal jit dispatch backs donation off to
+    a copy while such a view is alive.  A DESERIALIZED executable on
+    this jaxlib donates unconditionally (must-alias) — without the
+    CachedStep copy-on-donate guard the view mutates in place mid-step,
+    which is byte-for-byte the corruption jax's own compilation cache
+    shows on this container (tests/conftest.py) and what broke
+    checkpoint save/ref comparisons under the session cache."""
+    over = {"zero_optimization": {"stage": 1}}
+    _run(tmp_path, steps=1, over=over)               # populate
+    cfg = base_config(micro=4, over=over)
+    cfg["compile_cache"] = {"dir": str(tmp_path)}
+    engine, _, _, _ = ds.initialize(
+        config=cfg, model=SimpleModel(dim=8),
+        training_data=random_dataset(n=64),
+        mesh=make_mesh({"data": 2, "fsdp": 4}))
+    engine.train_batch()                             # warm-started step
+    assert engine.compile_report()["hits"] >= 1
+    views = jax.tree_util.tree_map(np.asarray, engine.state.params)
+    frozen = jax.tree_util.tree_map(np.array, views)  # deep copies
+    engine.train_batch()                             # donates the state
+    jax.tree_util.tree_map(np.testing.assert_array_equal, views, frozen)
+    engine.close()
+
+
+# ===========================================================================
+# Engine surface: preflight + report + close
+# ===========================================================================
+
+def test_preflight_memory_and_compile_report(tmp_path, devices):
+    cfg = base_config(micro=4, over={"zero_optimization": {"stage": 1}})
+    cfg["compile_cache"] = {"dir": str(tmp_path)}
+    engine, _, _, _ = ds.initialize(
+        config=cfg, model=SimpleModel(dim=8),
+        training_data=random_dataset(n=64), mesh=make_mesh({"data": 8}))
+    batch = engine._stack_microbatches([next(engine._data_iterator)])
+    pre = engine.preflight_memory(batch)
+    # CPU backends may expose no memory analysis; when they do, the
+    # numbers must be coherent
+    if pre is not None:
+        assert pre["peak_bytes"] >= 0
+        assert pre["peak_bytes"] == (
+            pre["argument_bytes"] + pre["output_bytes"]
+            - pre["alias_bytes"] + pre["temp_bytes"]
+            + pre["generated_code_bytes"])
+    # acquisition must not have consumed the donated state
+    loss0 = float(engine.train_batch())
+    assert np.isfinite(loss0)
+    rep = engine.compile_report()
+    assert rep["enabled"] and rep["dir"] == str(tmp_path)
+    assert rep["entries"] >= 1 and rep["total_bytes"] > 0
+    assert rep["hits"] + rep["misses"] >= 1
+    assert any(e["name"].endswith("train_step") for e in rep["events"])
+    # the stats file ds_report reads is beside the entries
+    with open(os.path.join(str(tmp_path), cc.STATS_FILE)) as f:
+        stats = json.load(f)
+    assert "stats" in stats
+    engine.close()
+    assert engine.state is None
+
+
+def test_close_releases_device_state(tmp_path, devices):
+    cfg = base_config(micro=4, over={"zero_optimization": {"stage": 2}})
+    cfg["compile_cache"] = {"dir": str(tmp_path)}
+    engine, _, _, _ = ds.initialize(
+        config=cfg, model=SimpleModel(dim=8),
+        training_data=random_dataset(n=64),
+        mesh=make_mesh({"data": 2, "fsdp": 4}))
+    engine.train_batch()
+    leaves = [l for l in jax.tree_util.tree_leaves(engine.state)
+              if hasattr(l, "is_deleted")]
+    assert leaves
+    engine.close()
+    assert all(l.is_deleted() for l in leaves)
+    assert engine._jit_train_step._exes == {}
