@@ -376,6 +376,147 @@ def measure_offload(preset, seq, micro, *, gas=1, steps=1, warmup=1,
     return out
 
 
+class _WireProbeMLP:
+    """Self-contained MLP for the wire probe: rows >> width, so the SPMD
+    partitioner's cheapest baseline schedule moves WEIGHTS (the ZeRO-3
+    gather route) rather than activations — the comparison then measures
+    the route the compression targets."""
+
+    def __init__(self, dim=64, hidden=256, nlayers=3):
+        self.dim, self.hidden, self.nlayers = dim, hidden, nlayers
+
+    def init(self, rng):
+        import jax
+        import jax.numpy as jnp
+        params = {}
+        sizes = [self.dim] + [self.hidden] * (self.nlayers - 1) + [self.dim]
+        for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+            k, rng = jax.random.split(rng)
+            params[f"layer_{i}"] = {
+                "w": jax.random.normal(k, (din, dout), jnp.float32)
+                / np.sqrt(din),
+                "b": jnp.zeros((dout,), jnp.float32)}
+        return params
+
+    def loss(self, params, batch, rng):
+        import jax
+        import jax.numpy as jnp
+        x, y = batch
+        h = x
+        for i in range(self.nlayers):
+            p = params[f"layer_{i}"]
+            h = h @ p["w"].astype(h.dtype) + p["b"].astype(h.dtype)
+            if i < self.nlayers - 1:
+                h = jax.nn.relu(h)
+        return jnp.mean(jnp.square(h.astype(jnp.float32)
+                                   - y.astype(jnp.float32)))
+
+
+def measure_wire_compression(steps=8, micro=64):
+    """ZeRO-3 quantized-collectives rung (docs/comms-compression.md):
+    trains the same model full-width and compressed on a data×fsdp mesh,
+    reports per-step wire bytes from the compiled step's collective
+    census (``analysis/comms.py wire_report``), the loss delta, and the
+    step audit (zero host callbacks, donation honored, census within the
+    engine's declared CommsBudget).  Needs a multi-device mesh — the
+    driver runs it in a CPU subprocess with 8 virtual devices."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    from deepspeed_tpu.analysis.jaxpr_audit import audit_engine
+    from deepspeed_tpu.analysis.comms import wire_report
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        return {"skipped": f"needs a multi-device mesh (got {n_dev})"}
+    fsdp = 4 if n_dev % 4 == 0 else 2
+    mesh = make_mesh({"data": -1, "fsdp": fsdp})
+    rng = np.random.default_rng(0)
+    model = _WireProbeMLP()
+    data = [(rng.normal(size=(model.dim,)).astype(np.float32),
+             rng.normal(size=(model.dim,)).astype(np.float32))
+            for _ in range(512)]
+
+    def run(policy):
+        cfg = {"train_micro_batch_size_per_gpu": micro,
+               "gradient_accumulation_steps": 1,
+               "steps_per_print": 10 ** 9,
+               "bf16": {"enabled": True},
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+               "zero_optimization": {
+                   "stage": 3, "stage3_param_persistence_threshold": 0}}
+        if policy is not None:
+            cfg["comms_compression"] = policy
+        engine, _, _, _ = ds.initialize(config=cfg,
+                                        model=_WireProbeMLP(),
+                                        training_data=data, mesh=mesh)
+        budget = engine.comms_budget()
+        report = audit_engine(engine, comms_budget=budget)
+        wr = wire_report([c for c in report.census if c.level == "hlo"])
+        loss = None
+        for _ in range(steps):
+            loss = float(engine.train_batch())
+        rec = {
+            "final_loss": round(loss, 5),
+            "wire_bytes_per_step": wr["wire_bytes"],
+            "quantized_wire_bytes": wr["quantized_wire_bytes"],
+            "logical_bytes": wr["logical_bytes"],
+            "by_kind": {k: v["bytes"] for k, v in wr["by_kind"].items()},
+            "audit": {
+                "host_callbacks": len(report.host_callbacks),
+                "donation_unhonored":
+                    len(report.donation.get("unhonored_args", [])),
+                "budget_declared": budget is not None,
+                "budget_ok": not [f for f in report.findings
+                                  if f.rule == "DSTPU203"],
+            },
+        }
+        engine.close()
+        return rec
+
+    full = run(None)
+    out = {"mesh": dict(mesh.shape), "steps": steps, "full": full}
+    for name, policy in (
+            ("int8", {"enabled": True, "min_tensor_bytes": 256,
+                      "block_size": 256, "weights_bits": 8}),
+            ("int4_weights", {"enabled": True, "min_tensor_bytes": 256,
+                              "block_size": 256, "weights_bits": 4})):
+        comp = run(policy)
+        comp["reduction_x"] = round(
+            full["wire_bytes_per_step"]
+            / max(comp["wire_bytes_per_step"], 1), 2)
+        comp["loss_rel_delta"] = round(
+            abs(comp["final_loss"] - full["final_loss"])
+            / max(abs(full["final_loss"]), 1e-9), 4)
+        out[name] = comp
+    return out
+
+
+def wire_probe_subprocess(timeout_s=600):
+    """Run :func:`measure_wire_compression` in a CPU child with 8 virtual
+    devices (the in-process backend is already bound to the real chip)."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    env["DSTPU_COMPILE_CACHE"] = "0"
+    # the probe's full-vs-compressed comparison sets its own per-run
+    # policy; an inherited env override (deepspeed --comms-compression)
+    # would silently compress the baseline or veto the compressed rungs
+    env.pop("DSTPU_COMMS_COMPRESSION", None)
+    out = subprocess.run([sys.executable, os.path.abspath(__file__),
+                          "--wire-probe"], capture_output=True, text=True,
+                         timeout=timeout_s, env=env)
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    if out.returncode != 0 or not lines:
+        return {"error": (out.stderr or "no output")[-160:]}
+    return json.loads(lines[-1])
+
+
 TIME_BUDGET_S = 27 * 60   # never run past this: the driver must see output
 
 # the driver tails stdout and json-parses the LAST line; everything about
@@ -430,6 +571,10 @@ def main():
     # stdout is the headline protocol; engine INFO chatter goes to stderr
     # from the start so nothing can trail the final line
     route_logs_to_stderr()
+    if "--wire-probe" in sys.argv:
+        # child mode (wire_probe_subprocess): one JSON line on stdout
+        print(json.dumps(measure_wire_compression()), flush=True)
+        return
     t_start = time.time()
     left = lambda: TIME_BUDGET_S - (time.time() - t_start)
     cache_dir = bench_cache_dir()
@@ -476,6 +621,19 @@ def main():
             "cache": warm.get("cache")}
     except Exception as e:
         extra["warm_start"] = {"error": str(e)[:160]}
+
+    # ---- quantized ZeRO collectives rung (CPU-mesh subprocess) ---------
+    # wire_bytes_per_step full vs compressed on a z3 data×fsdp mesh —
+    # the qwZ/qgZ headline evidence (docs/comms-compression.md); a CPU
+    # child because this process is bound to the single real chip
+    if left() > 4 * 60:
+        try:
+            extra["zero3_wire_compression_cpu8"] = wire_probe_subprocess(
+                timeout_s=min(600, max(int(left() - 120), 60)))
+        except Exception as e:
+            extra["zero3_wire_compression_cpu8"] = {"error": str(e)[:160]}
+    else:
+        extra["zero3_wire_compression_cpu8"] = {"skipped": "time budget"}
 
     # graded config #3: GPT-2 1.3B ZeRO-3 + host-offload optimizer.  A full
     # cycle of that point takes ~25 tunnel-bound minutes (measured; see
@@ -596,6 +754,15 @@ def main():
                             if k not in ("environment", "warm_start")},
         },
     }
+    wirec = extra.get("zero3_wire_compression_cpu8") or {}
+    if "full" in wirec:
+        headline["extra"]["wire_bytes_per_step"] = {
+            "full": wirec["full"]["wire_bytes_per_step"],
+            "int8": (wirec.get("int8") or {}).get("wire_bytes_per_step"),
+            "int8_reduction_x": (wirec.get("int8") or {}).get("reduction_x"),
+            "int4w_reduction_x": (wirec.get("int4_weights")
+                                  or {}).get("reduction_x"),
+        }
     backoffs = _backoff_summary()
     if backoffs:
         headline["extra"]["backoff"] = backoffs

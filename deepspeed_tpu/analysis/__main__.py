@@ -54,9 +54,15 @@ def _audit_builtin_steps(stages):
     findings = []
     data = (np.ones((8, 16), np.float32), np.ones((8, 16), np.float32))
     dataset = [(data[0][i], data[1][i]) for i in range(8)]
+    # each stage spec pins its own compression policy; an inherited env
+    # override would veto the `3q` variant's explicit enabled=true (or
+    # silently compress the plain stages)
+    os.environ.pop("DSTPU_COMMS_COMPRESSION", None)
     cache_dir = tempfile.mkdtemp(prefix="dstpu-audit-cc-")
     try:
-        for stage in stages:
+        for spec in stages:
+            compressed = str(spec).endswith("q")
+            stage = int(str(spec).rstrip("q"))
             cfg = {"train_micro_batch_size_per_gpu": 4,
                    "gradient_accumulation_steps": 1,
                    "steps_per_print": 10 ** 9,
@@ -64,6 +70,18 @@ def _audit_builtin_steps(stages):
                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
                    "zero_optimization": {"stage": stage},
                    "compile_cache": {"dir": cache_dir}}
+            if compressed:
+                # quantized-collectives variant (docs/comms-compression.md):
+                # fsdp absorbs the devices so qwZ/qgZ engage whenever this
+                # process sees more than one; the audit then additionally
+                # gates the census against the engine's declared
+                # CommsBudget (wire-byte accounting, DSTPU203)
+                cfg["mesh"] = {"axes": {"fsdp": -1, "data": 1}}
+                cfg["zero_optimization"][
+                    "stage3_param_persistence_threshold"] = 0
+                cfg["comms_compression"] = {"enabled": True,
+                                            "min_tensor_bytes": 0,
+                                            "block_size": 4}
             cold, _, _, _ = ds.initialize(config=cfg, model=_MLP(),
                                           training_data=dataset)
             cache_on = cold.compile_report().get("enabled", False)
@@ -93,9 +111,25 @@ def _audit_builtin_steps(stages):
                 # cold engine directly — disabling the cache is a choice,
                 # not a finding
                 engine = cold
-            report = audit_engine(engine)
+            budget = engine.comms_budget() if compressed else None
+            report = audit_engine(engine, comms_budget=budget)
+            if compressed and budget is not None:
+                from .comms import wire_report
+                wr = wire_report([c for c in report.census
+                                  if c.level == "hlo"])
+                if wr["quantized_wire_bytes"] == 0:
+                    findings.append(Finding(
+                        "DSTPU200", "warning",
+                        f"--audit-step z{stage}q: compression routes were "
+                        "active but the compiled step moved no quantized "
+                        "collective payload",
+                        eqn_path="comms-compression",
+                        extra={"wire_report": {k: wr[k] for k in
+                                               ("wire_bytes",
+                                                "quantized_wire_bytes")}}))
             for f in report.findings:
                 f.extra = dict(f.extra, zero_stage=stage,
+                               compressed=compressed,
                                warm_started=warm_started)
             findings.extend(report.findings)
             engine.close()
@@ -120,7 +154,10 @@ def main(argv=None):
                     help="warnings also fail the run")
     ap.add_argument("--audit-step", default=None, metavar="STAGES",
                     help="also jaxpr-audit built-in tiny engines, e.g. "
-                         "--audit-step 1,2,3 (compiles; needs jax)")
+                         "--audit-step 1,2,3 (compiles; needs jax). A "
+                         "'q' suffix (e.g. 3q) audits the quantized-"
+                         "collectives variant and additionally gates the "
+                         "census against the engine's declared CommsBudget")
     args = ap.parse_args(argv)
 
     # findings are the stdout payload (the tier-1 gate parses --json);
@@ -139,7 +176,7 @@ def main(argv=None):
     root = os.getcwd()
     findings = lint_paths(paths, rules=rules, root=root)
     if args.audit_step:
-        stages = [int(s) for s in args.audit_step.split(",")]
+        stages = [s.strip() for s in args.audit_step.split(",")]
         findings.extend(_audit_builtin_steps(stages))
 
     counts = counts_by_severity(findings)

@@ -41,6 +41,12 @@ def canonical_kind(name: str) -> Optional[str]:
     return KIND_ALIASES.get(name)
 
 
+# wire dtypes that mark a QUANTIZED collective (the int8/int4 payloads of
+# runtime/comm/quantized.py; u16 excluded — bf16 parses as u16 in HLO)
+QUANT_DTYPE_NAMES = frozenset({"s8", "u8", "int8", "uint8", "s4", "u4",
+                               "int4", "uint4"})
+
+
 @dataclass
 class CensusEntry:
     kind: str                 # canonical kind
@@ -49,21 +55,63 @@ class CensusEntry:
     bytes: int = 0            # payload bytes (sum of output aval bytes)
     eqn_path: Optional[str] = None
     level: str = "jaxpr"      # "jaxpr" | "hlo"
+    dtypes: tuple = ()        # payload dtype names (classification)
+    groups: int = 0           # replica-group count (HLO; 0 = unknown).
+    #                           >1 marks a sub-axis ("two-level") phase
+
+    @property
+    def quantized(self) -> bool:
+        """True when every payload dtype is an int8/int4 wire format."""
+        return bool(self.dtypes) and all(d in QUANT_DTYPE_NAMES
+                                         for d in self.dtypes)
 
     def to_dict(self):
         return {"kind": self.kind, "op": self.op, "axes": list(self.axes),
                 "bytes": self.bytes, "eqn_path": self.eqn_path,
-                "level": self.level}
+                "level": self.level, "dtypes": list(self.dtypes),
+                "groups": self.groups, "quantized": self.quantized}
 
 
 def summarize(census) -> dict:
-    """{kind: {"count": n, "bytes": total}} over both census levels."""
+    """{kind: {"count", "bytes", "quantized_count", "quantized_bytes"}}
+    over both census levels."""
     out = {}
     for e in census:
-        rec = out.setdefault(e.kind, {"count": 0, "bytes": 0})
+        rec = out.setdefault(e.kind, {"count": 0, "bytes": 0,
+                                      "quantized_count": 0,
+                                      "quantized_bytes": 0})
         rec["count"] += 1
         rec["bytes"] += e.bytes
+        if e.quantized:
+            rec["quantized_count"] += 1
+            rec["quantized_bytes"] += e.bytes
     return out
+
+
+def wire_report(census, *, full_itemsize: int = 4) -> dict:
+    """Wire vs logical accounting for a (possibly compressed) step.
+
+    ``wire_bytes`` is what the census actually measured; for quantized
+    entries ``logical_bytes`` re-prices the payload at ``full_itemsize``
+    bytes/element (int8: numel == wire bytes; packed int4 is counted as
+    its int8 equivalent — the census cannot see through the packing).
+    ``grouped`` counts sub-axis (two-level) collective phases.
+    """
+    wire = logical = q_wire = grouped = 0
+    for e in census:
+        wire += e.bytes
+        if e.quantized:
+            q_wire += e.bytes
+            logical += e.bytes * full_itemsize
+        else:
+            logical += e.bytes
+        if e.groups > 1:
+            grouped += 1
+    return {"wire_bytes": wire, "logical_bytes": logical,
+            "quantized_wire_bytes": q_wire,
+            "quantized_fraction": (q_wire / wire if wire else 0.0),
+            "grouped_collectives": grouped,
+            "by_kind": summarize(census)}
 
 
 @dataclass

@@ -180,9 +180,12 @@ def _audit_jaxpr(closed, compute_dtype, report):
             if not isinstance(axes, (tuple, list)):
                 axes = (axes,)
             payload = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+            dtypes = tuple(d for d in (_dtype_name(v.aval)
+                                       for v in eqn.outvars) if d)
             report.census.append(CensusEntry(
                 kind=kind, op=name, axes=tuple(str(a) for a in axes),
-                bytes=payload, eqn_path=path, level="jaxpr"))
+                bytes=payload, eqn_path=path, level="jaxpr",
+                dtypes=dtypes))
 
         # --- dtype promotion ------------------------------------------
         for v in eqn.outvars:
@@ -272,13 +275,32 @@ _HLO_DTYPE_NP = {"bf16": "uint16", "f16": "float16", "f32": "float32",
                  "s16": "int16"}
 
 
+# replica_groups={{0,4},{1,5}} (explicit) or =[2,4]<=[8] (iota: 2 groups of 4)
+_REPLICA_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),\d+\]")
+_REPLICA_GROUPS_EXPL_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+
+
+def _group_count(line: str) -> int:
+    m = _REPLICA_GROUPS_IOTA_RE.search(line)
+    if m:
+        return int(m.group(1))
+    m = _REPLICA_GROUPS_EXPL_RE.search(line)
+    if m:
+        return m.group(1).count("{")
+    return 0
+
+
 def census_from_hlo_text(hlo_text):
     """Collective census entries from an HLO module's text (parses both
-    array-result and variadic tuple-result collectives)."""
+    array-result and variadic tuple-result collectives).  Entries carry
+    the payload dtype names (int8/int4 wire = a QUANTIZED collective,
+    ``comms.QUANT_DTYPE_NAMES``) and the replica-group count (>1 marks a
+    sub-axis phase of a two-level decomposition)."""
     out = []
     for m in _HLO_COLLECTIVE_RE.finditer(hlo_text):
         result, op = m.group(1), m.group(2)
         payload = 0
+        dtypes = []
         for dtype_name, dims in _HLO_SHAPE_RE.findall(result):
             try:
                 itemsize = np.dtype(
@@ -288,9 +310,13 @@ def census_from_hlo_text(hlo_text):
             numel = int(np.prod([int(d) for d in dims.split(",") if d]
                                 or [1]))
             payload += numel * itemsize
+            dtypes.append(dtype_name)
+        line_end = hlo_text.find("\n", m.end())
+        line = hlo_text[m.start():line_end if line_end > 0 else len(hlo_text)]
         out.append(CensusEntry(
             kind=canonical_kind(op) or op, op=op, axes=(),
-            bytes=payload, eqn_path=None, level="hlo"))
+            bytes=payload, eqn_path=None, level="hlo",
+            dtypes=tuple(dtypes), groups=_group_count(line)))
     return out
 
 

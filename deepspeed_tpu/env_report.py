@@ -136,9 +136,42 @@ def compile_cache_report():
         print("last run .............. no stats recorded yet")
 
 
+def comms_compression_report():
+    """Active quantized-collectives policy (docs/comms-compression.md):
+    config defaults + the DSTPU_COMMS_COMPRESSION env override, exactly
+    as an engine built in this environment would resolve them."""
+    import os as _os
+    from .runtime.config import DeepSpeedCommsCompressionConfig
+
+    print("-" * 64)
+    print("Comms compression (DSTPU_COMMS_COMPRESSION / config "
+          "`comms_compression`):")
+    print("-" * 64)
+    pol = _safe(lambda: DeepSpeedCommsCompressionConfig({}).describe())
+    if not isinstance(pol, dict):
+        print(f"policy ................ {pol}")
+        return
+    env = _os.environ.get("DSTPU_COMMS_COMPRESSION")
+    src = (f"env DSTPU_COMMS_COMPRESSION={env}" if env
+           else "config default (off)")
+    print(f"enabled ............... {pol['enabled']} ({src})")
+    print(f"weights ............... int{pol['weights_bits']} qwZ "
+          "all-gather" if pol["weights_bits"] else
+          "weights ............... full width")
+    print(f"grads ................. int{pol['grads_bits']} qgZ "
+          "reduce (error-fed)" if pol["grads_bits"] else
+          "grads ................. full width")
+    print(f"block_size ............ {pol['block_size']}")
+    print(f"hierarchical .......... {pol['hierarchical']}")
+    print(f"min_tensor_bytes ...... {pol['min_tensor_bytes']}")
+    print(f"excluded .............. {', '.join(pol['excluded'])}")
+    print(f"routes ................ {', '.join(pol['routes'])}")
+
+
 def main():
     op_report()
     compile_cache_report()
+    comms_compression_report()
     debug_report()
 
 

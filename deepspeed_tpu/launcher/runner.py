@@ -78,6 +78,19 @@ def parse_args(args=None):
                         help="Force the health guardian OFF (sets "
                              "DSTPU_HEALTH_CHECK=0) — e.g. for numerics "
                              "debugging where NaN steps must be applied")
+    parser.add_argument("--comms-compression", default=None,
+                        action="store_true", dest="comms_compression",
+                        help="Force quantized ZeRO collectives ON (sets "
+                             "DSTPU_COMMS_COMPRESSION=1: int8 qwZ param "
+                             "gathers + error-fed int8 qgZ grad reduce, "
+                             "overriding a config that disables them; "
+                             "see docs/comms-compression.md)")
+    parser.add_argument("--no-comms-compression", dest="comms_compression",
+                        action="store_false",
+                        help="Force the ZeRO wire back to full width "
+                             "(sets DSTPU_COMMS_COMPRESSION=0) — e.g. to "
+                             "bisect a numerics question against the "
+                             "lossless wire")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -201,6 +214,9 @@ def main(args=None):
         env["DSTPU_COMPILE_CACHE"] = args.compile_cache_dir
     if args.health_check is not None:
         env["DSTPU_HEALTH_CHECK"] = "1" if args.health_check else "0"
+    if args.comms_compression is not None:
+        env["DSTPU_COMMS_COMPRESSION"] = \
+            "1" if args.comms_compression else "0"
     cmd_tail = [args.user_script] + list(args.user_args)
 
     if not active or (len(active) == 1 and not args.force_multi):

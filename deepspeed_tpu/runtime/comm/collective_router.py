@@ -1,0 +1,401 @@
+"""Collective router: per-route compression policy for the ZeRO wire.
+
+One object owns every "should this tensor move compressed, and how"
+decision (ZeRO++-style policy, arXiv:2306.10209):
+
+- **qwZ** (``gather_params``): ZeRO-3 parameter all-gathers move per-block
+  int8 (or packed int4) + fp32 scales instead of the compute dtype;
+- **qgZ** (``reduce_grads``): the gradient reduction consumes per-rank
+  PARTIAL gradients, quantizes them with persistent per-shard error
+  feedback, and lands the reduced gradient on the ZeRO-2/3 sharding —
+  two-level (intra full-width / inter quantized) when the mesh and leaf
+  shape allow it;
+- **1-bit transport** (``onebit_comm``): the error-compensated 1-bit
+  allreduce (``comm/compressed.py``) wired onto a real mesh axis via
+  ``shard_map`` for the 1-bit optimizers — policy-independent (the 1-bit
+  algorithm is the optimizer's own semantics; the router only provides
+  the wire).
+
+Per-leaf policy: a leaf compresses iff its route is enabled, it is at
+least ``min_tensor_bytes``, and its path matches none of ``excluded``
+(norm/bias-style leaves train badly through a lossy wire and are tiny
+anyway).  Leaves that do not fit a scheme (odd int4 dims, no axis
+divisible by the dp world for the two-level reduce) fall back to the
+full-width wire — compression must never be a correctness cliff.
+
+The router's ``describe()`` dict is part of the compile-cache key: the
+compression policy is part of the executable's identity.
+"""
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import quantized as Q
+from ...parallel import mesh as M
+from ...utils.logging import logger
+
+EF_DTYPE = jnp.bfloat16      # error-feedback storage (docs/comms-compression.md)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for e in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(e, attr):
+                parts.append(str(getattr(e, attr)))
+                break
+        else:
+            parts.append(str(e))
+    return "/".join(parts).lower()
+
+
+def _spec_entries(spec: Optional[P], ndim: int):
+    ent = tuple(spec) if spec is not None else ()
+    return ent + (None,) * (ndim - len(ent))
+
+
+def _entry_axes(entry):
+    if entry is None:
+        return ()
+    return (entry,) if isinstance(entry, str) else tuple(entry)
+
+
+class CollectiveRouter:
+    def __init__(self, policy, mesh, mesh_ctx, zero_stage: int, *,
+                 supports_zero_routes: bool = True):
+        self.policy = policy                  # DeepSpeedCommsCompressionConfig
+        self.mesh = mesh
+        self.mesh_ctx = mesh_ctx
+        self.zero_stage = int(zero_stage)
+        self.dp_world = mesh_ctx.dp_world_size
+        self.fsdp = mesh_ctx.fsdp_size
+        enabled = bool(policy is not None and policy.enabled)
+        route = f"z{min(max(zero_stage, 0), 3)}"
+        self._zero_route_on = (enabled and supports_zero_routes
+                               and route in policy.routes)
+        if enabled and not supports_zero_routes and zero_stage > 0:
+            logger.warning(
+                "comms_compression: this engine's ZeRO wire does not "
+                "support compression (pipeline schedules its own "
+                "collectives); gradients/params stay full-width")
+        self.weights_active = (self._zero_route_on and zero_stage >= 3
+                               and self.fsdp > 1
+                               and policy.weights_bits is not None)
+        self.grads_active = (self._zero_route_on and self.dp_world > 1
+                             and policy.grads_bits is not None)
+        # batch axes actually present on the mesh; fsdp-major ordering so
+        # the two-level regather (mid -> out) is a pure outer-axis move
+        self.batch_axes = tuple(M.BATCH_AXES)
+        self.mid_axes = ("fsdp",) + tuple(a for a in M.BATCH_AXES
+                                          if a != "fsdp")
+
+    # ----------------------------------------------------------- plumbing
+    def _ns(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def _constrain_leaf(self, x, spec: Optional[P]):
+        return jax.lax.with_sharding_constraint(
+            x, self._ns(spec if spec is not None else P()))
+
+    def _excluded(self, path_str: str) -> bool:
+        return any(pat in path_str for pat in self.policy.excluded)
+
+    def _big_enough(self, shape, itemsize) -> bool:
+        return (int(np.prod(shape or (1,))) * itemsize
+                >= self.policy.min_tensor_bytes)
+
+    # -------------------------------------------------------- qwZ weights
+    def _weight_plan(self, path_str, shape, itemsize, spec) -> Optional[int]:
+        """bits for this parameter's gather, or None (full width)."""
+        if not self.weights_active or not shape or shape[-1] == 0:
+            return None
+        # exactly one sharded dim, and it must be plain fsdp (any
+        # tensor-parallel composition — composed entry OR a separate
+        # tp-sharded dim — keeps the full-width wire: the explicit fsdp
+        # all-gather would not reassemble it and the full-manual region
+        # would silently treat the tp dim as replicated)
+        ent = _spec_entries(spec, len(shape))
+        sharded = [i for i, e in enumerate(ent) if e is not None]
+        if len(sharded) != 1 or ent[sharded[0]] not in ("fsdp", ("fsdp",)):
+            return None               # replicated (persistence threshold)
+        dims = sharded
+        if not self._big_enough(shape, itemsize) or self._excluded(path_str):
+            return None
+        bits = int(self.policy.weights_bits)
+        if bits == 4:
+            if Q.pick_block(shape[-1], self.policy.block_size,
+                            even=True) % 2 != 0:
+                bits = 8              # no even block: int4 cannot pack
+            elif dims[0] == len(shape) - 1 and \
+                    (shape[-1] // 2) % self.fsdp != 0:
+                bits = 8              # packed last dim no longer shards
+        return bits
+
+    def gather_params(self, params, specs):
+        """The ZeRO-3 parameter wire: quantized all-gather for planned
+        leaves, the plain sharding constraint for everything else.  With
+        the weights route inactive this IS ``zpart.constrain``."""
+        mesh = self.mesh
+
+        def one(path, leaf, spec):
+            bits = self._weight_plan(_path_str(path), np.shape(leaf),
+                                     np.dtype(leaf.dtype).itemsize, spec)
+            if bits is None:
+                return self._constrain_leaf(leaf, spec)
+            return Q.gather_quantized(
+                leaf, mesh, spec, block_size=self.policy.block_size,
+                bits=bits, out_dtype=leaf.dtype, ste=True)
+
+        return jax.tree_util.tree_map_with_path(one, params, specs)
+
+    # --------------------------------------------------------- qgZ grads
+    def _grad_block(self, shape, dim) -> int:
+        """Effective quantization block for a leaf scattered D-ways on
+        ``dim``: when that is the LAST dim, blocks must tile the
+        per-device chunk so the level-1 scale side-channel splits along
+        (a smaller block, never a full-width fallback)."""
+        K = shape[-1]
+        if dim < len(shape) - 1:
+            return Q.pick_block(K, self.policy.block_size)
+        return Q.pick_block(K // self.dp_world, self.policy.block_size)
+
+    def _grad_plan(self, path_str, shape, out_spec):
+        """(bits, chunk_dim, lvl2_axes, block) for this gradient's
+        reduction — chunk_dim None means the single-level constraint
+        reshard (``hierarchical: false``) — or None (full-width)."""
+        if not self.grads_active or not shape or shape[-1] == 0:
+            return None
+        if not self._big_enough(shape, 4) or self._excluded(path_str):
+            return None
+        bits = int(self.policy.grads_bits)
+        D = self.dp_world
+        ent = _spec_entries(out_spec, len(shape))
+        sharded = [i for i, e in enumerate(ent) if e is not None]
+        if len(sharded) > 1 or (sharded and ent[sharded[0]] not in
+                                ("fsdp", ("fsdp",))):
+            return None               # tensor-parallel composition: full width
+        if not self.policy.hierarchical:
+            return (bits, None, (),
+                    Q.pick_block(shape[-1], self.policy.block_size))
+        # two-level: the scatter axis must be divisible by the dp world
+        # AND be the axis the output sharding owns (level 2 is then a
+        # pure outer-axis regather landing exactly on out_spec);
+        # ZeRO-1's replicated output frees the choice to any axis.
+        if sharded:
+            a = sharded[0]
+            if shape[a] % D != 0:
+                return None
+            lvl2 = tuple(x for x in self.mid_axes if x != "fsdp")
+            return (bits, a, lvl2, self._grad_block(shape, a))
+        cands = [i for i in range(len(shape)) if shape[i] % D == 0]
+        if not cands:
+            return None
+        a = max(cands, key=lambda i: shape[i])
+        # regather over EVERY dp axis (replicated ZeRO-1 gradients)
+        return (bits, a, self.mid_axes, self._grad_block(shape, a))
+
+    def init_error_feedback(self, base_like, out_specs):
+        """Persistent per-shard error-feedback state: one ``(D, *shape)``
+        buffer (bf16, axis 0 sharded over the batch axes) per gradient
+        leaf the policy compresses; a ``(1,)`` placeholder otherwise.
+        Lives in ``TrainState.comm_error`` — donated each step,
+        checkpointed, rewind-safe (docs/comms-compression.md)."""
+        if not self.grads_active:
+            return None
+        D = self.dp_world
+        lead = self._ns(P(self.batch_axes))
+        repl = self._ns(P())
+        flat, treedef = jax.tree_util.tree_flatten(base_like)
+        paths = [p for p, _ in
+                 jax.tree_util.tree_flatten_with_path(base_like)[0]]
+        specs = treedef.flatten_up_to(out_specs)
+
+        def one(path, leaf, spec):
+            if self._grad_plan(_path_str(path), np.shape(leaf),
+                               spec) is None:
+                return jax.device_put(jnp.zeros((1,), EF_DTYPE), repl)
+            return jax.device_put(
+                jnp.zeros((D,) + tuple(np.shape(leaf)), EF_DTYPE), lead)
+
+        return treedef.unflatten(
+            [one(p, l, s) for p, l, s in zip(paths, flat, specs)])
+
+    def reduce_grads(self, partials, ef, out_specs):
+        """The gradient wire: partial ``(D, *shape)`` grads → reduced
+        grads on the ZeRO sharding.  Returns ``(grads, new_ef)``."""
+        mesh = self.mesh
+
+        def one(path, pg, e, spec):
+            plan = self._grad_plan(_path_str(path), pg.shape[1:], spec)
+            if plan is None:
+                red = jnp.sum(pg.astype(jnp.float32), axis=0)
+                return self._constrain_leaf(red, spec), e
+            bits, chunk_dim, lvl2, block = plan
+            red, new_e = Q.reduce_partials_quantized(
+                pg, e, mesh, spec if spec is not None else P(),
+                batch_axes=self.batch_axes,
+                block_size=block, bits=bits,
+                chunk_dim=chunk_dim, lvl2_axes=lvl2,
+                out_dtype=jnp.float32)
+            return red, (new_e if new_e is not None else e)
+
+        flat_p, treedef = jax.tree_util.tree_flatten(partials)
+        paths = [p for p, _ in
+                 jax.tree_util.tree_flatten_with_path(partials)[0]]
+        flat_e = treedef.flatten_up_to(ef)
+        flat_s = treedef.flatten_up_to(out_specs)
+        outs = [one(pp, pg, e, s) for pp, pg, e, s in
+                zip(paths, flat_p, flat_e, flat_s)]
+        grads = treedef.unflatten([o[0] for o in outs])
+        new_ef = treedef.unflatten([o[1] for o in outs])
+        return grads, new_ef
+
+    # ------------------------------------------------ budget + reporting
+    def describe(self) -> dict:
+        """Stable policy fingerprint (compile-cache key, ds_report)."""
+        pol = self.policy
+        return {
+            "enabled": bool(pol is not None and pol.enabled),
+            "weights_active": self.weights_active,
+            "grads_active": self.grads_active,
+            "weights_bits": getattr(pol, "weights_bits", None),
+            "grads_bits": getattr(pol, "grads_bits", None),
+            "block_size": getattr(pol, "block_size", None),
+            "hierarchical": getattr(pol, "hierarchical", None),
+            "min_tensor_bytes": getattr(pol, "min_tensor_bytes", None),
+            "excluded": tuple(getattr(pol, "excluded", ())),
+            "routes": tuple(getattr(pol, "routes", ())),
+        }
+
+    def expected_wire_bytes(self, params, param_specs, grad_specs,
+                            compute_itemsize: int) -> dict:
+        """Approximate per-kind wire ceilings for the compressed step's
+        static census (one count per program site; loops count once —
+        the same accounting ``analysis/comms.py`` uses).  Components:
+
+        - all_gather: quantized param payloads + full-width leaves +
+          scale/mask side-channels + the level-2 grad regathers;
+        - all_to_all: the level-1 quantized partial-grad exchange.
+        """
+        ag = ata = 0
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        p_specs = jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda x: isinstance(x, P))
+        g_specs = jax.tree_util.tree_leaves(
+            grad_specs, is_leaf=lambda x: isinstance(x, P))
+        for (path, leaf), psp, gsp in zip(leaves, p_specs, g_specs):
+            shape = np.shape(leaf)
+            n = int(np.prod(shape or (1,)))
+            ps = _path_str(path)
+            wbits = self._weight_plan(ps, shape, compute_itemsize, psp)
+            if self.zero_stage >= 3:
+                if wbits is not None:
+                    B = Q.pick_block(shape[-1], self.policy.block_size,
+                                     even=(wbits == 4))
+                    ag += n * wbits // 8 + 3 * 4 * (n // max(B, 1))
+                else:
+                    ag += n * compute_itemsize
+            gplan = self._grad_plan(ps, shape, gsp)
+            if gplan is None:
+                # full-width reduction: all-reduce/reduce-scatter of f32
+                ag += 4 * n
+            else:
+                bits, chunk_dim, lvl2, B = gplan
+                nb = n // max(B, 1)
+                if chunk_dim is None:
+                    # single-level: every chunk owner receives all D slices
+                    ag += self.dp_world * n * bits // 8 + 12 * nb
+                else:
+                    O = int(np.prod([M.mesh_axis_size(self.mesh, x)
+                                     for x in lvl2]))
+                    ata += n * bits // 8 + 4 * nb        # q + scales
+                    ag += ((n * bits // 8) * O // self.dp_world
+                           + 4 * nb * O // self.dp_world + 4 * nb)
+        return {"all_gather": ag, "all_to_all": ata}
+
+    def comms_budget(self, params, param_specs, grad_specs,
+                     compute_itemsize: int, *, slack: float = 1.6,
+                     floor: int = 1 << 16):
+        """A :class:`analysis.comms.CommsBudget` for the compressed step:
+        per-kind ceilings at ``slack`` over the expected quantized wire
+        (+ a small floor for loss/norm reductions).  Declared tight
+        enough that the FULL-WIDTH step violates it — the budget is an
+        accounting statement, not a formality."""
+        from ...analysis.comms import CommsBudget
+        exp = self.expected_wire_bytes(params, param_specs, grad_specs,
+                                       compute_itemsize)
+        per_kind = {
+            "all_gather": {"max_bytes": int(exp["all_gather"] * slack)
+                           + floor},
+            "all_to_all": {"max_bytes": int(exp["all_to_all"] * slack)
+                           + floor},
+        }
+        total = int(sum(exp.values()) * slack) + 4 * floor
+        return CommsBudget(per_kind=per_kind, total_max_bytes=total)
+
+    # -------------------------------------------------- 1-bit transport
+    def onebit_comm(self):
+        """A transport for the 1-bit optimizers' compressed allreduce:
+        per-rank error feedback inside ``shard_map`` on the (single)
+        data-parallel mesh axis.  Returns None when the mesh gives the
+        compression nothing to do (dp world of 1) or the dp extent spans
+        multiple named axes (the two-phase wire wants one ring).
+        Policy-independent: 1-bit is the optimizer's own algorithm."""
+        live = [a for a in M.BATCH_AXES
+                if M.mesh_axis_size(self.mesh, a) > 1]
+        if len(live) != 1:
+            if len(live) > 1:
+                logger.warning(
+                    "1-bit allreduce: dp world spans multiple mesh axes "
+                    f"{live}; falling back to the local (no-wire) path")
+            return None
+        return OnebitTransport(self.mesh, live[0])
+
+
+class OnebitTransport:
+    """Engine-provided wire for ``fp16/onebit`` optimizers: runs
+    ``compressed_allreduce`` with true per-rank error buffers (leading
+    ``(D, ...)`` axis sharded over the dp axis) inside ``shard_map``."""
+
+    def __init__(self, mesh, axis: str):
+        self.mesh = mesh
+        self.axis = axis
+        self.world_size = M.mesh_axis_size(mesh, axis)
+
+    def init_error_buffers(self, params):
+        from .compressed import padded_size, server_chunk_size
+        D = self.world_size
+
+        def werr(p):
+            return jnp.zeros(
+                (D, padded_size(int(np.prod(np.shape(p))), D)), jnp.float32)
+
+        def serr(p):
+            return jnp.zeros(
+                (D, server_chunk_size(int(np.prod(np.shape(p))), D)),
+                jnp.float32)
+
+        return (jax.tree_util.tree_map(werr, params),
+                jax.tree_util.tree_map(serr, params))
+
+    def __call__(self, x, werr, serr):
+        """x: replicated tensor; werr/serr: (D, ...) per-rank buffers.
+        Returns (allreduced x, new werr, new serr)."""
+        from .compressed import compressed_allreduce
+        axis = self.axis
+        D = self.world_size
+
+        def per_rank(m, we, se):
+            out, we_n, se_n = compressed_allreduce(
+                m, we[0], se[0], axis_name=axis, world_size=D)
+            return out, we_n[None], se_n[None]
+
+        fn = jax.shard_map(per_rank, mesh=self.mesh,
+                           in_specs=(P(), P(axis), P(axis)),
+                           out_specs=(P(), P(axis), P(axis)),
+                           check_vma=False)
+        return fn(x, werr, serr)

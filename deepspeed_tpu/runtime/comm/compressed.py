@@ -53,6 +53,11 @@ def _sign(x):
 
 
 def _scale(x):
+    """||x||₂ / √numel with a zero-size guard: an empty (or fully padded
+    away) tensor must produce scale 0, not 0/0 = NaN — the NaN would ride
+    the scale all-gather and poison every rank's chunk."""
+    if x.size == 0:
+        return jnp.float32(0.0)
     return jnp.linalg.norm(x) / np.sqrt(x.size)
 
 
@@ -77,6 +82,9 @@ def compressed_allreduce(x, worker_error, server_error,
     flat = x.astype(jnp.float32).reshape(-1)
     n = world_size
     L = worker_error.shape[0]
+    if L == 0:
+        # zero-length tensor: nothing on the wire; errors stay zero-size
+        return (jnp.zeros(shape, jnp.float32), worker_error, server_error)
     if flat.size != L:
         flat = jnp.pad(flat, (0, L - flat.size))
 

@@ -379,6 +379,81 @@ class DeepSpeedCompileCacheConfig:
             cc, C.COMPILE_CACHE_READONLY, C.COMPILE_CACHE_READONLY_DEFAULT))
 
 
+class DeepSpeedCommsCompressionConfig:
+    """Quantized ZeRO collectives (ZeRO++-style; docs/comms-compression.md):
+    qwZ int8/int4 parameter all-gathers, qgZ block-quantized gradient
+    reduction with persistent error feedback, hierarchical two-level
+    decomposition.  Default OFF — full-width wire, tier-1 numerics
+    untouched.  Env ``DSTPU_COMMS_COMPRESSION`` (set by
+    ``deepspeed --comms-compression``/``--no-comms-compression``)
+    overrides ``enabled`` in either direction."""
+
+    def __init__(self, param_dict):
+        import os as _os
+        cc = get_dict_param(param_dict, C.COMMS_COMPRESSION, {}) or {}
+        self.enabled = bool(get_scalar_param(
+            cc, C.COMMS_COMPRESSION_ENABLED,
+            C.COMMS_COMPRESSION_ENABLED_DEFAULT))
+        env = _os.environ.get("DSTPU_COMMS_COMPRESSION")
+        if env:
+            self.enabled = env.lower() in ("1", "true", "yes", "on")
+        self.weights_bits = get_scalar_param(
+            cc, C.COMMS_COMPRESSION_WEIGHTS_BITS,
+            C.COMMS_COMPRESSION_WEIGHTS_BITS_DEFAULT)
+        self.grads_bits = get_scalar_param(
+            cc, C.COMMS_COMPRESSION_GRADS_BITS,
+            C.COMMS_COMPRESSION_GRADS_BITS_DEFAULT)
+        if self.weights_bits is not None and \
+                int(self.weights_bits) not in (4, 8):
+            raise DeepSpeedConfigError(
+                "comms_compression.weights_bits must be 4, 8 or null "
+                "(null = weights stay full-width)")
+        if self.grads_bits is not None and int(self.grads_bits) != 8:
+            raise DeepSpeedConfigError(
+                "comms_compression.grads_bits must be 8 or null (the "
+                "error-fed int8 reduce is the supported gradient scheme; "
+                "null = gradients stay full-width)")
+        self.weights_bits = (None if self.weights_bits is None
+                             else int(self.weights_bits))
+        self.grads_bits = (None if self.grads_bits is None
+                           else int(self.grads_bits))
+        self.block_size = int(get_scalar_param(
+            cc, C.COMMS_COMPRESSION_BLOCK_SIZE,
+            C.COMMS_COMPRESSION_BLOCK_SIZE_DEFAULT))
+        if self.block_size < 2:
+            raise DeepSpeedConfigError(
+                "comms_compression.block_size must be >= 2")
+        self.hierarchical = bool(get_scalar_param(
+            cc, C.COMMS_COMPRESSION_HIERARCHICAL,
+            C.COMMS_COMPRESSION_HIERARCHICAL_DEFAULT))
+        self.min_tensor_bytes = int(get_scalar_param(
+            cc, C.COMMS_COMPRESSION_MIN_TENSOR_BYTES,
+            C.COMMS_COMPRESSION_MIN_TENSOR_BYTES_DEFAULT))
+        if self.min_tensor_bytes < 0:
+            raise DeepSpeedConfigError(
+                "comms_compression.min_tensor_bytes must be >= 0")
+        excluded = get_scalar_param(cc, C.COMMS_COMPRESSION_EXCLUDED,
+                                    C.COMMS_COMPRESSION_EXCLUDED_DEFAULT)
+        self.excluded = tuple(str(p).lower() for p in (excluded or []))
+        routes = get_scalar_param(cc, C.COMMS_COMPRESSION_ROUTES,
+                                  C.COMMS_COMPRESSION_ROUTES_DEFAULT)
+        self.routes = tuple(routes or [])
+        bad = [r for r in self.routes
+               if r not in C.COMMS_COMPRESSION_ROUTES_VALID]
+        if bad:
+            raise DeepSpeedConfigError(
+                f"comms_compression.routes {bad} unknown; valid: "
+                f"{C.COMMS_COMPRESSION_ROUTES_VALID}")
+
+    def describe(self) -> dict:
+        return {"enabled": self.enabled, "weights_bits": self.weights_bits,
+                "grads_bits": self.grads_bits, "block_size": self.block_size,
+                "hierarchical": self.hierarchical,
+                "min_tensor_bytes": self.min_tensor_bytes,
+                "excluded": list(self.excluded),
+                "routes": list(self.routes)}
+
+
 class DeepSpeedMeshConfig:
     """TPU-native extension: declared mesh axis sizes.
 
@@ -602,6 +677,7 @@ class DeepSpeedConfig:
         self.io_retry_config = DeepSpeedIORetryConfig(pd)
         self.health_check = DeepSpeedHealthCheckConfig(pd)
         self.compile_cache_config = DeepSpeedCompileCacheConfig(pd)
+        self.comms_compression = DeepSpeedCommsCompressionConfig(pd)
         self.mesh_config = DeepSpeedMeshConfig(pd)
         self.sequence_parallel = DeepSpeedSequenceParallelConfig(pd)
         self.wall_clock_breakdown = get_scalar_param(pd, C.WALL_CLOCK_BREAKDOWN,

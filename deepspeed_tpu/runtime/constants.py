@@ -330,6 +330,31 @@ COMPILE_CACHE_READONLY = "readonly"
 COMPILE_CACHE_READONLY_DEFAULT = False # True = shared CI cache, never writes
 
 #############################################
+# Quantized ZeRO collectives (runtime/comm/quantized.py + collective_router.py)
+#############################################
+COMMS_COMPRESSION = "comms_compression"
+COMMS_COMPRESSION_ENABLED = "enabled"
+COMMS_COMPRESSION_ENABLED_DEFAULT = False   # tier-1 numerics untouched
+COMMS_COMPRESSION_WEIGHTS_BITS = "weights_bits"
+COMMS_COMPRESSION_WEIGHTS_BITS_DEFAULT = 8  # qwZ: int8 param all-gather
+COMMS_COMPRESSION_GRADS_BITS = "grads_bits"
+COMMS_COMPRESSION_GRADS_BITS_DEFAULT = 8    # qgZ: int8 grad reduce
+COMMS_COMPRESSION_BLOCK_SIZE = "block_size"
+COMMS_COMPRESSION_BLOCK_SIZE_DEFAULT = 1024
+COMMS_COMPRESSION_HIERARCHICAL = "hierarchical"
+COMMS_COMPRESSION_HIERARCHICAL_DEFAULT = True
+COMMS_COMPRESSION_MIN_TENSOR_BYTES = "min_tensor_bytes"
+COMMS_COMPRESSION_MIN_TENSOR_BYTES_DEFAULT = 65536
+COMMS_COMPRESSION_EXCLUDED = "excluded"
+# norm/bias-style leaves keep the full-width wire (lossy delivery of
+# scale/shift vectors is all pain, no bytes — they are tiny)
+COMMS_COMPRESSION_EXCLUDED_DEFAULT = ["bias", "norm", "ln_", "layernorm",
+                                      "/b"]
+COMMS_COMPRESSION_ROUTES = "routes"
+COMMS_COMPRESSION_ROUTES_DEFAULT = ["z1", "z2", "z3", "param_stream"]
+COMMS_COMPRESSION_ROUTES_VALID = ["z1", "z2", "z3", "param_stream", "pipe"]
+
+#############################################
 # Dataloader
 #############################################
 DATALOADER_DROP_LAST = "dataloader_drop_last"

@@ -62,6 +62,8 @@ class TrainState(NamedTuple):
     opt_state: Any
     scale: Any                     # LossScaleState (None unless fp16)
     health: Any = None             # health.HealthState (None when guardian off)
+    comm_error: Any = None         # qgZ per-shard error feedback (None unless
+    #                                comms_compression grads route is active)
 
 
 def _resolve_model(model, loss_fn, params, apply_fn, rng_seed,
@@ -120,6 +122,11 @@ def _resolve_model(model, loss_fn, params, apply_fn, rng_seed,
 
 class DeepSpeedEngine:
     """Config-driven training engine over a jitted SPMD step."""
+
+    # The fused SPMD step's ZeRO wire routes through the collective
+    # router (qwZ/qgZ); PipelineEngine schedules its own collectives and
+    # opts out (the pipe route is accepted-but-full-width for now).
+    _supports_comms_compression = True
 
     def __init__(self, model=None, optimizer=None, config=None, config_params=None,
                  training_data=None, lr_scheduler=None, mesh=None, collate_fn=None,
@@ -251,6 +258,20 @@ class DeepSpeedEngine:
         else:
             params0 = jax.jit(f32)(params0)
 
+        # ---- quantized-collectives router (runtime/comm/) ----------------
+        # Per-route wire policy: qwZ int8 param gathers, qgZ error-fed
+        # int8 grad reduction, 1-bit optimizer transport.  Default-off
+        # policy => the router degrades to plain sharding constraints.
+        from .comm.collective_router import CollectiveRouter
+        self._router = CollectiveRouter(
+            self.config.comms_compression, self.mesh, self.mesh_ctx,
+            self.zero_stage,
+            supports_zero_routes=self._supports_comms_compression)
+        self._onebit_transport = None
+        if self._router.weights_active or self._router.grads_active:
+            log_dist("comms_compression active: "
+                     f"{self._router.describe()}", ranks=[0])
+
         # ---- optimizer -----------------------------------------------------
         self.optimizer = self._configure_optimizer(optimizer)
         # ---- lr scheduler --------------------------------------------------
@@ -335,7 +356,8 @@ class DeepSpeedEngine:
                             self._health_cfg.skip_on_spike)
                            if self._health_enabled else None),
                     compile_cache=self.compile_cache,
-                    cache_key_extra=self._cc_key_slice)
+                    cache_key_extra=self._cc_key_slice,
+                    comms_compression=self.config.comms_compression)
             else:
                 self._offload = HostOffloadOptimizer(
                     params0, self.config.zero_config, self.config.aio_config,
@@ -473,6 +495,13 @@ class DeepSpeedEngine:
         elif name == C.ONEBIT_ADAM_OPTIMIZER:
             from .fp16.onebit.adam import OnebitAdam
             opt = OnebitAdam(**p)
+            # route the 1-bit compressed allreduce over the REAL dp mesh
+            # axis (per-rank error feedback inside shard_map) — without
+            # this, compressed_allreduce runs in its degenerate local
+            # mode and is dead code from the engine's perspective
+            self._onebit_transport = self._router.onebit_comm()
+            if self._onebit_transport is not None:
+                opt.set_comm(self._onebit_transport)
         elif name == C.ONEBIT_LAMB_OPTIMIZER:
             from .fp16.onebit.lamb import OnebitLamb
             opt = OnebitLamb(**p)
@@ -549,7 +578,8 @@ class DeepSpeedEngine:
             return TrainState(global_steps=z(), optimizer_steps=z(),
                               skipped_steps=z(), params=params, master=None,
                               opt_state=None, scale=scale,
-                              health=self._init_health_device())
+                              health=self._init_health_device(),
+                              comm_error=self._init_comm_error(params))
 
         master = jax.device_put(params0, self._master_sh) if needs_master else None
 
@@ -574,7 +604,8 @@ class DeepSpeedEngine:
         z = lambda: jax.device_put(jnp.asarray(0, jnp.int32), self._repl_sh)
         return TrainState(global_steps=z(), optimizer_steps=z(), skipped_steps=z(),
                           params=params, master=master, opt_state=opt_state,
-                          scale=scale, health=self._init_health_device())
+                          scale=scale, health=self._init_health_device(),
+                          comm_error=self._init_comm_error(base))
 
     def _init_health_device(self):
         """Fresh (replicated) device HealthState, or None when the guardian
@@ -584,13 +615,31 @@ class DeepSpeedEngine:
             return None
         return jax.device_put(hmod.init_state(), self._repl_sh)
 
+    def _init_comm_error(self, base_like):
+        """Fresh qgZ error-feedback state (``TrainState.comm_error``), or
+        None when the grads compression route is inactive."""
+        if base_like is None or not self._router.grads_active:
+            return None
+        return self._router.init_error_feedback(base_like, self._grad_specs)
+
     def _opt_shardings(self, opt_state):
         """Optimizer-state leaves that are param-shaped inherit the master
-        sharding; anything else (scalars, counters) is replicated."""
-        def sh_for(leaf):
+        sharding; anything else (scalars, counters) is replicated.  The
+        1-bit transport's per-rank error buffers (leading ``(D, ...)``
+        axis) shard over the dp axis — replicating them would cost a
+        world-size multiple of the padded model."""
+        onebit_fields = ("worker_error", "server_error")
+        axis = (self._onebit_transport.axis
+                if self._onebit_transport is not None else None)
+
+        def sh_for(path, leaf):
+            if axis is not None and any(
+                    getattr(e, "name", getattr(e, "key", None))
+                    in onebit_fields for e in path):
+                return NamedSharding(self.mesh, P(axis))
             spec = self._shape_spec_cache.get(np.shape(leaf))
             return NamedSharding(self.mesh, spec if spec is not None else P())
-        return jax.tree_util.tree_map(sh_for, opt_state)
+        return jax.tree_util.tree_map_with_path(sh_for, opt_state)
 
     # ----------------------------------------------------- compile cache/AOT
     def _cache_key_slice(self):
@@ -621,6 +670,9 @@ class DeepSpeedEngine:
             "offload_optimizer": cfg.zero_config.offload_optimizer_device(),
             "offload_param": cfg.zero_config.offload_param_device(),
             "sparse_gradients": cfg.sparse_gradients_enabled,
+            # the wire policy changes the traced program (quantize ops,
+            # partial-grad layout) — part of the executable's identity
+            "comms_compression": cfg.comms_compression.describe(),
         }
 
     def _wrap_step(self, name, fn, donate_argnums=()):
@@ -637,6 +689,22 @@ class DeepSpeedEngine:
         for this engine's cache (surfaced by bench.py and ds_report)."""
         from . import compile_cache as ccache
         return ccache.report(self.compile_cache)
+
+    def comms_budget(self):
+        """Declared per-step wire ceiling for the compressed step's
+        collective census (``analysis/comms.py CommsBudget``), computed
+        from the compression policy — tight enough that the FULL-WIDTH
+        step violates it.  None when no compression route is active or
+        the engine streams params."""
+        if self._param_stream is not None or self.state is None:
+            return None
+        if not (self._router.weights_active or self._router.grads_active):
+            return None
+        base = (self.state.master if self.state.master is not None
+                else self.state.params)
+        return self._router.comms_budget(
+            base, self._param_specs, self._grad_specs,
+            np.dtype(self.compute_dtype).itemsize)
 
     def preflight_memory(self, batch, rng=None):
         """Peak-HBM preflight of the compiled step via the executable's
@@ -712,6 +780,44 @@ class DeepSpeedEngine:
         gc.collect()
 
     # ------------------------------------------------------------- train step
+    def _micro_loss_fn(self):
+        """The ``(base_params, mb, r) -> (loss, aux)`` callable shared by
+        the full-width ``_grad_fn`` and the qgZ partials path (the two
+        must never drift): cast to the compute dtype, deliver params over
+        the ZeRO-3 wire (quantized qwZ all-gather for routed leaves, the
+        plain sharding constraint otherwise), then the model's OWN
+        ``loss_with_metrics`` when the engine trains on the model's loss
+        (MoE aux metrics, reference engine.py:1639) — a client ``loss_fn=``
+        stays authoritative and is never silently displaced."""
+        dtype = self.compute_dtype
+        needs_master = dtype != jnp.float32
+        own_loss = (getattr(self._loss_fn, "__self__", None)
+                    is self.module
+                    and getattr(self._loss_fn, "__name__", "") == "loss")
+        lwm = (getattr(self.module, "loss_with_metrics", None)
+               if own_loss else None)
+
+        def fn(base_params, mb, r):
+            p = tree_cast(base_params, dtype) if needs_master else base_params
+            p = self._router.gather_params(p, self._param_specs)
+            if lwm is not None:
+                return lwm(p, mb, r)
+            return self._loss_fn(p, mb, r), {}
+
+        return fn
+
+    @staticmethod
+    def _acc_aux_fn(gas):
+        """Aux-metric accumulation rule of the gas scan, shared by both
+        gradient paths: losses/ratios average over microbatches; COUNTS
+        (keys ending in "_dropped") sum — "tokens dropped this step" must
+        mean the step's total, not a per-microbatch mean."""
+        def acc_aux(acc_tree, aux_tree):
+            return {k: acc_tree[k] + (v if k.endswith("_dropped")
+                                      else v / gas)
+                    for k, v in aux_tree.items()}
+        return acc_aux
+
     def _grad_fn(self, base, batch, rng, cur_scale):
         """Gradient computation inside the jitted step.
 
@@ -721,27 +827,16 @@ class DeepSpeedEngine:
         pipelined forward/backward.  Returns ``(grads, scaled_loss_sum)``
         where ``scaled_loss_sum == mean_loss * cur_scale``.
         """
+        if self._router.grads_active:
+            # qgZ: gradients leave this function as per-dp-slice PARTIALS
+            # (leading (D, ...) axis); _grads_and_metrics routes them
+            # through the quantized reduction
+            return self._grad_fn_partials(base, batch, rng, cur_scale)
         gas = self.gradient_accumulation_steps()
-        dtype = self.compute_dtype
-        needs_master = dtype != jnp.float32
-        # models exposing loss_with_metrics (MoE: aux loss, token overflow)
-        # get their aux dict carried into the engine's step metrics
-        # (reference: engine-side MoE bookkeeping, engine.py:1639).  Only
-        # when the engine is training on the MODEL'S OWN loss — a client
-        # loss_fn= must stay authoritative, not be silently displaced.
-        own_loss = (getattr(self._loss_fn, "__self__", None)
-                    is self.module
-                    and getattr(self._loss_fn, "__name__", "") == "loss")
-        lwm = (getattr(self.module, "loss_with_metrics", None)
-               if own_loss else None)
+        loss_fn = self._micro_loss_fn()
 
         def micro_loss(base_params, mb, r):
-            p = tree_cast(base_params, dtype) if needs_master else base_params
-            p = zpart.constrain(p, self._param_specs, self.mesh)
-            if lwm is not None:
-                loss, aux = lwm(p, mb, r)
-            else:
-                loss, aux = self._loss_fn(p, mb, r), {}
+            loss, aux = loss_fn(base_params, mb, r)
             return loss * cur_scale / gas, aux
 
         vgrad = jax.value_and_grad(micro_loss, has_aux=True)
@@ -759,14 +854,7 @@ class DeepSpeedEngine:
 
         acc_dtype = (jnp.bfloat16 if self.config.grad_accum_dtype == "bf16"
                      else jnp.float32)
-
-        def acc_aux(acc_tree, aux_tree):
-            # losses/ratios average over microbatches; COUNTS (keys ending
-            # in "_dropped") sum — "tokens dropped this step" must mean the
-            # step's total, not a per-microbatch mean
-            return {k: acc_tree[k] + (v if k.endswith("_dropped")
-                                      else v / gas)
-                    for k, v in aux_tree.items()}
+        acc_aux = self._acc_aux_fn(gas)
 
         def body(carry, xs):
             gacc, lacc, aacc, idx = carry
@@ -792,12 +880,100 @@ class DeepSpeedEngine:
             lambda g: g.astype(jnp.float32), grads)
         return grads, scaled_loss_sum, aux
 
+    def _grad_fn_partials(self, base, batch, rng, cur_scale):
+        """qgZ gradient computation: PARTIAL gradients per data-parallel
+        slice instead of XLA's implicit full-width reduction.
+
+        The global microbatch reshapes to ``(D, micro_per_rank, ...)``
+        (a shard-local reshape: sharding already splits axis 0 into D
+        contiguous chunks) and a vmapped ``value_and_grad`` produces one
+        gradient slice per dp rank — each device computes exactly the
+        backward it computed before, but the cross-device sum is now OURS
+        to schedule, so the reduction wire can move int8 with error
+        feedback (``comm/quantized.py reduce_partials_quantized``).
+        Returns ``(partial_grads (D, *shape), scaled_loss_sum, aux)``;
+        also note the reduction now happens ONCE per step (after the gas
+        scan) rather than per microbatch.
+
+        Normalization: each slice loss is a mean over ``micro/D`` rows,
+        so the per-slice loss is scaled by ``1/D`` here — the SUMMED
+        partial gradients then equal the gradient of the global-batch
+        mean exactly.  (Without it the summed partials are D× the
+        full-width gradient — invisible under Adam, an effective-lr
+        explosion under any scale-sensitive optimizer.)
+        """
+        gas = self.gradient_accumulation_steps()
+        D = self.mesh_ctx.dp_world_size
+        loss_fn = self._micro_loss_fn()
+        lead = NamedSharding(self.mesh, P(M.BATCH_AXES))
+
+        def slice_loss(base_params, mb, r):
+            loss, aux = loss_fn(base_params, mb, r)
+            return loss * cur_scale / (gas * D), aux
+
+        vgrad = jax.vmap(jax.value_and_grad(slice_loss, has_aux=True),
+                         in_axes=(None, 0, 0))
+
+        def split_dp(mb):
+            def r(a):
+                a = jnp.reshape(a, (D, a.shape[0] // D) + a.shape[1:])
+                return jax.lax.with_sharding_constraint(a, lead)
+            return jax.tree_util.tree_map(r, mb)
+
+        def one_micro(mb, r):
+            rs = jax.random.split(r, D)
+            (sl, aux), pg = vgrad(base, split_dp(mb), rs)
+            pg = jax.tree_util.tree_map(
+                lambda g: jax.lax.with_sharding_constraint(g, lead), pg)
+            # per-slice aux -> microbatch aux (counts sum, ratios average)
+            aux = {k: (jnp.sum(v, axis=0) if k.endswith("_dropped")
+                       else jnp.mean(v, axis=0)) for k, v in aux.items()}
+            # per-slice losses carry 1/D, so the sum IS the scaled mean
+            return pg, jnp.sum(sl), aux
+
+        if gas == 1:
+            mb = jax.tree_util.tree_map(lambda a: a[0], batch)
+            pg, scaled_loss, aux = one_micro(mb, jax.random.fold_in(rng, 0))
+            pg = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), pg)
+            return pg, scaled_loss, aux
+
+        acc_dtype = (jnp.bfloat16 if self.config.grad_accum_dtype == "bf16"
+                     else jnp.float32)
+        acc_aux = self._acc_aux_fn(gas)
+
+        def body(carry, xs):
+            gacc, lacc, aacc, idx = carry
+            pg, sl, aux = one_micro(xs, jax.random.fold_in(rng, idx))
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(acc_dtype), gacc, pg)
+            return (gacc, lacc + sl, acc_aux(aacc, aux), idx + 1), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jax.lax.with_sharding_constraint(
+                jnp.zeros((D,) + p.shape, acc_dtype), lead), base)
+        mb0 = jax.tree_util.tree_map(lambda a: a[0], batch)
+        aux_zeros = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(lambda m, r: one_micro(m, r)[2], mb0, rng))
+        (pg, scaled_loss_sum, aux, _), _ = jax.lax.scan(
+            body, (zeros, jnp.float32(0.0), aux_zeros, jnp.int32(0)), batch)
+        pg = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), pg)
+        return pg, scaled_loss_sum, aux
+
     def _grads_and_metrics(self, state: TrainState, base, batch, rng):
         """Shared gradient post-processing contract, used by the fused
         in-device step AND the offload grad-only step: scan microbatches,
         unscale, overflow check, clip, constrain to ZeRO-2 sharding
         (reference clip order: unscale → clip → step,
-        ``stage_1_and_2.py:1736 unscale_and_clip``)."""
+        ``stage_1_and_2.py:1736 unscale_and_clip``).
+
+        With the qgZ route active the grad function returns PARTIALS;
+        overflow/non-finite sentinels run on the partials (quantization
+        would launder an Inf into finite garbage) and the reduction goes
+        through the router's error-fed int8 wire.  Returns
+        ``(grads, overflow, lr, metrics, new_comm_error)`` — the last is
+        None on the full-width path."""
         cur_scale = (state.scale.cur_scale if state.scale is not None
                      else jnp.float32(1.0))
         out = self._grad_fn(base, batch, rng, cur_scale)
@@ -809,6 +985,28 @@ class DeepSpeedEngine:
         loss = scaled_loss_sum / cur_scale
         overflow = (ls.has_overflow(grads) if self.fp16_enabled
                     else jnp.asarray(False))
+        new_ef = None
+        wire_nf = None
+        if self._router.grads_active:
+            # non-finite flags come from the RAW partials: the quantizer
+            # sanitizes NaN/Inf to 0 (the int cast is undefined on them),
+            # so without this a poisoned gradient would silently train as
+            # zeros.  Re-injecting NaN into the reduced grads restores
+            # full-width semantics exactly — the post-reduce sentinels
+            # catch it when the guardian is armed, and with the guardian
+            # OFF (numerics debugging) the NaN propagates visibly, as it
+            # would on the lossless wire.  (fp16 needs no twin: its
+            # overflow scan below already runs on the partials and the
+            # scaler skip-step is unconditional.)
+            wire_nf = (None if self.fp16_enabled
+                       else hmod.tree_nonfinite(grads))
+            grads, new_ef = self._router.reduce_grads(
+                grads, state.comm_error, self._grad_specs)
+            if wire_nf is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(
+                        wire_nf, jnp.full(g.shape, jnp.nan, g.dtype), g),
+                    grads)
         if self.config.gradient_clipping > 0:
             grads, gnorm = clip_by_global_norm(grads, self.config.gradient_clipping)
         else:
@@ -818,8 +1016,10 @@ class DeepSpeedEngine:
         lr = self._lr_at(state.global_steps)
         metrics = {"loss": loss, "grad_norm": gnorm, "overflow": overflow,
                    "lr": lr, "loss_scale": cur_scale}
+        if wire_nf is not None:
+            metrics["nonfinite_wire"] = wire_nf
         metrics.update(aux)
-        return grads, overflow, lr, metrics
+        return grads, overflow, lr, metrics, new_ef
 
     def _health_sentinels(self, state, loss, grads, overflow):
         """On-device divergence sentinels (traced into the step; pure jnp,
@@ -861,7 +1061,7 @@ class DeepSpeedEngine:
         needs_master = dtype != jnp.float32
         base = state.master if needs_master else state.params
 
-        grads, overflow, lr, metrics = self._grads_and_metrics(
+        grads, overflow, lr, metrics, new_ef = self._grads_and_metrics(
             state, base, batch, rng)
         if self._health_enabled:
             skip, new_health, sm = self._health_sentinels(
@@ -892,6 +1092,10 @@ class DeepSpeedEngine:
                 lambda n, o: jnp.where(skip, o, n), new, old)
             new_base = sel(new_base, base)
             new_opt = sel(new_opt, state.opt_state)
+            if new_ef is not None:
+                # error feedback computed from a skipped step's garbage
+                # gradients must not poison future compensation
+                new_ef = sel(new_ef, state.comm_error)
         if self.fp16_enabled:
             # the loss scale reacts to OVERFLOW only — a health skip (loss
             # spike, optimizer NaN) is not a scale-is-too-big signal
@@ -920,7 +1124,9 @@ class DeepSpeedEngine:
             optimizer_steps=state.optimizer_steps + (1 - skip_i),
             skipped_steps=state.skipped_steps + skip_i,
             params=new_params, master=new_master, opt_state=new_opt,
-            scale=new_scale, health=new_health)
+            scale=new_scale, health=new_health,
+            comm_error=(new_ef if new_ef is not None
+                        else state.comm_error))
         return new_state, metrics
 
     def _grad_only_step(self, state: TrainState, batch, rng):
@@ -937,7 +1143,7 @@ class DeepSpeedEngine:
         reach it one step late — one overflow would then cost two skipped
         steps and two halvings.  In-graph, the halved scale flows to the
         next dispatch through device state with no host sync."""
-        grads, overflow, _, metrics = self._grads_and_metrics(
+        grads, overflow, _, metrics, new_ef = self._grads_and_metrics(
             state, state.params, batch, rng)
         if self._health_enabled:
             # the host half reads metrics["skip"] and makes the skipped
@@ -950,6 +1156,12 @@ class DeepSpeedEngine:
         else:
             skip, new_health = overflow, state.health
         metrics["skip"] = skip
+        if new_ef is not None:
+            # the error feedback advances in-graph (like scale/health);
+            # a skipped step must leave it untouched
+            new_ef = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(skip, o, n), new_ef,
+                state.comm_error)
         if self.fp16_enabled:
             new_scale = ls.update_scale(
                 state.scale, overflow, dynamic=self._scaler.dynamic,
@@ -979,7 +1191,7 @@ class DeepSpeedEngine:
             # mesh the concatenate would gather sharded grads whole.
             grads = jnp.concatenate(
                 [g.reshape(-1) for g in jax.tree_util.tree_leaves(grads)])
-        return grads, metrics, new_scale, new_health
+        return grads, metrics, new_scale, new_health, new_ef
 
     def _sparsify_grads(self, grads, batch):
         """Replace declared embedding-grad leaves with row-sparse
@@ -1094,7 +1306,7 @@ class DeepSpeedEngine:
             optimizer_steps=state.optimizer_steps + (1 - ovf),
             skipped_steps=state.skipped_steps + ovf,
             params=params, master=None, opt_state=None, scale=state.scale,
-            health=state.health)
+            health=state.health, comm_error=state.comm_error)
 
     # ------------------------------------------------------------- public API
     def train_batch(self, data_iter=None):
@@ -1167,13 +1379,16 @@ class DeepSpeedEngine:
         # constraints inside models (MoE expert axis, SP) bind to it
         with jax.set_mesh(self.mesh):
             if self._offload is not None:
-                grads, metrics, new_scale, new_health = self._jit_grad_step(
-                    self.state, batch, rng)
-                # loss scale + health EMA advance eagerly (device-graph
-                # dependency): the NEXT dispatch sees a post-overflow
-                # halving / updated loss baseline with no host sync
-                self.state = self.state._replace(scale=new_scale,
-                                                 health=new_health)
+                grads, metrics, new_scale, new_health, new_ef = \
+                    self._jit_grad_step(self.state, batch, rng)
+                # loss scale + health EMA + qgZ error feedback advance
+                # eagerly (device-graph dependency): the NEXT dispatch
+                # sees a post-overflow halving / updated loss baseline /
+                # compensated error with no host sync
+                self.state = self.state._replace(
+                    scale=new_scale, health=new_health,
+                    comm_error=(new_ef if new_ef is not None
+                                else self.state.comm_error))
                 # queue grad d2h behind the device compute (async copy
                 # engine; overlaps the host work below).  For the flat
                 # wire this swaps `grads` for a chunk handle — the
@@ -1741,6 +1956,10 @@ class DeepSpeedEngine:
                 optim_tree["master"] = self.state.master
         if self.state.scale is not None:
             optim_tree["scale"] = self.state.scale
+        if self.state.comm_error is not None:
+            # qgZ error feedback: without it a resumed run would re-pay
+            # the compensation warm-up (rewind-safe like `health`)
+            optim_tree["comm_error"] = self.state.comm_error
         save_tree(os.path.join(path, OPTIM_FILE), optim_tree,
                   fsync=False, retry=retry)
         fault.site("ckpt.after_optim_file")
@@ -1909,6 +2128,7 @@ class DeepSpeedEngine:
                     lambda x: np.asarray(x).astype(np.float32), loaded_master),
                 self._master_sh))
 
+        loaded_ef = None
         if self._offload is not None:
             # host tier: master/moments restored into the offload buffers;
             # the device payload is refreshed from the loaded master.
@@ -1933,6 +2153,7 @@ class DeepSpeedEngine:
                     state = state._replace(scale=jax.device_put(
                         restore_like(state.scale, optim_tree["scale"]),
                         self._repl_sh))
+                loaded_ef = optim_tree.get("comm_error")
             if self._param_stream is not None:
                 self._param_stream.reload_from_host()
             else:
@@ -1953,6 +2174,35 @@ class DeepSpeedEngine:
                 scale = jax.device_put(
                     restore_like(scale, optim_tree["scale"]), self._repl_sh)
             state = state._replace(opt_state=opt_state, master=master, scale=scale)
+            loaded_ef = optim_tree.get("comm_error")
+
+        if state.comm_error is not None:
+            # qgZ error feedback: reset, then restore when the checkpoint
+            # carries a matching state (a pre-compression checkpoint, or
+            # one from a different mesh/policy, restarts compensation
+            # from zero — EF is an accumulator, resetting is always safe)
+            def _ef_leaf(cur, new):
+                new = np.asarray(new)
+                if new.shape != cur.shape:
+                    raise ValueError(
+                        f"comm_error leaf shape {new.shape} != {cur.shape}")
+                return jax.device_put(new.astype(cur.dtype), cur.sharding)
+
+            ef = jax.tree_util.tree_map(
+                lambda cur: jax.device_put(
+                    np.zeros(cur.shape, cur.dtype), cur.sharding),
+                state.comm_error)
+            if loaded_ef is not None:
+                try:
+                    ef = jax.tree_util.tree_map(
+                        _ef_leaf, state.comm_error,
+                        restore_like(state.comm_error, loaded_ef))
+                except Exception as e:
+                    logger.warning(
+                        "checkpoint comm_error does not match the current "
+                        f"compression policy/mesh ({e}); error feedback "
+                        "reset to zero")
+            state = state._replace(comm_error=ef)
 
         mk = lambda v: jax.device_put(jnp.asarray(v, jnp.int32), self._repl_sh)
         self._global_steps_host = int(meta["global_steps"])

@@ -57,16 +57,33 @@ class OnebitAdam:
         self.axis_name = axis_name
         self.exp_avg_mask = exp_avg_mask
         self.world_size = 1
+        # engine-provided transport (collective_router.OnebitTransport):
+        # runs the compressed allreduce with TRUE per-rank error buffers
+        # inside shard_map on the dp mesh axis.  Without it (and without
+        # axis_name) the quantization math runs in its degenerate local
+        # mode — algorithmically identical, no wire savings.
+        self.comm = None
+
+    def set_comm(self, transport):
+        """Engine hook (``runtime/comm/collective_router.py``): route the
+        compression stage's momentum allreduce over a real mesh axis."""
+        self.comm = transport
+        if transport is not None:
+            self.world_size = int(transport.world_size)
 
     def set_world_size(self, n: int):
         """Engine hook: extent of the compression axis (reference reads it
         from the comm backend, ``adam.py:106-108``)."""
-        self.world_size = int(n) if self.axis_name is not None else 1
+        if self.comm is None:
+            self.world_size = int(n) if self.axis_name is not None else 1
 
     def init(self, params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
-        werr, serr = init_error_buffers(
-            params, self.world_size if self.axis_name is not None else 1)
+        if self.comm is not None:
+            werr, serr = self.comm.init_error_buffers(params)
+        else:
+            werr, serr = init_error_buffers(
+                params, self.world_size if self.axis_name is not None else 1)
         return OnebitAdamState(
             exp_avg=jax.tree_util.tree_map(zeros, params),
             exp_avg_sq=jax.tree_util.tree_map(zeros, params),
@@ -83,9 +100,12 @@ class OnebitAdam:
             m_local = b1 * m + (1.0 - b1) * g
             # variance frozen in compression stage (adam.py:206)
             v_new = jnp.where(frozen, v, b2 * v + (1.0 - b2) * jnp.square(g))
-            m_comm, werr_n, serr_n = compressed_allreduce(
-                m_local, werr, serr, axis_name=self.axis_name,
-                world_size=self.world_size)
+            if self.comm is not None:
+                m_comm, werr_n, serr_n = self.comm(m_local, werr, serr)
+            else:
+                m_comm, werr_n, serr_n = compressed_allreduce(
+                    m_local, werr, serr, axis_name=self.axis_name,
+                    world_size=self.world_size)
             m_new = jnp.where(frozen, m_comm, m_local)
             if mask is not None:
                 m_new = m_new * mask

@@ -84,6 +84,12 @@ class PipelineEngine(DeepSpeedEngine):
     ``pipe/engine.py:302``).
     """
 
+    # 1F1B schedules its own collectives (ppermute activations, per-tick
+    # grad accumulation); the qwZ/qgZ wire rewrite does not apply — the
+    # `pipe` comms_compression route is accepted-but-full-width
+    # (docs/comms-compression.md)
+    _supports_comms_compression = False
+
     def __init__(self, model=None, **kwargs):
         assert isinstance(model, PipelineModule), \
             "PipelineEngine requires a PipelineModule"

@@ -85,7 +85,7 @@ class ParamStreamRunner:
     def __init__(self, model, host_opt, mesh, compute_dtype, *,
                  gas, grad_clip, zero_config, aio_config, retry=None,
                  skip_nonfinite=True, spike=None, compile_cache=None,
-                 cache_key_extra=None):
+                 cache_key_extra=None, comms_compression=None):
         assert mesh.size == 1, (
             "offload_param streaming is single-chip (scale-up) machinery; "
             "on a multi-chip mesh use ZeRO-3 sharding (stage 3 without "
@@ -164,6 +164,39 @@ class ParamStreamRunner:
         else:
             self.swapper = None
 
+        # ---- quantized layer wire (docs/comms-compression.md) ------------
+        # qwZ for the h2d hop: the 16-bit layer payload crosses as a
+        # block-quantized int8/int4 image + fp32 scales, dequantized
+        # inside the jitted scatter (half / quarter the wire bytes — the
+        # route that matters on the slow host<->device tunnel).  The
+        # fp32 master and host optimizer stay exact; only the COMPUTE
+        # copy is lossy, exactly like the fused engine's qwZ gathers.
+        # Quantized images are cached per host-payload version (one host
+        # quantization pass per optimizer step, ~numel/2 extra host RAM);
+        # excluded leaves ride a separate full-width image.  The NVMe
+        # tier keeps the full-width wire (its payload lives on disk).
+        cc = comms_compression
+        self._quant = bool(
+            cc is not None and cc.enabled and "param_stream" in cc.routes
+            and cc.weights_bits is not None and not self.nvme
+            and host_opt.out_dtype is not None)
+        if self._quant:
+            self._q_bits = int(cc.weights_bits)
+            self._q_block = int(cc.block_size)
+            if self._q_bits == 4 and self._q_block % 2:
+                self._q_block += 1
+            self._q_plan = self._build_quant_plan(cc)
+            self._q_cache = {}
+            self._payload_version = 0
+            if self._q_plan["q_total"] == 0:
+                self._quant = False     # policy excluded every layer leaf
+        if self._quant:
+            log_dist("param_stream comms_compression: layer wire "
+                     f"int{self._q_bits} block={self._q_block} "
+                     f"(q {self._q_plan['q_total']} / fw "
+                     f"{self._q_plan['fw_total']} elems per layer)",
+                     ranks=[0])
+
         # ---- device-resident nonblock params + jitted programs -----------
         self._h2d = wire.H2DUploader()
         self._jit_cache = {}
@@ -182,6 +215,95 @@ class ParamStreamRunner:
     def _payload_seg(self, lo, hi):
         """16-bit (or fp32) host view of flat range [lo, hi)."""
         return self.host.payload_flat()[lo:hi]
+
+    # ------------------------------------------- quantized layer wire
+    def _build_quant_plan(self, cc):
+        """Per-leaf wire plan for one layer block: quantized leaves get
+        block-ALIGNED ranges of the int8 image (a shared block would mix
+        a weight tail with e.g. an LN vector and ruin the block scale);
+        excluded / sub-threshold leaves ride a full-width image."""
+        from ..comm.collective_router import _path_str
+        dummy = jax.tree_util.tree_unflatten(
+            self.layer_treedef, list(range(len(self.layer_shapes))))
+        paths = [p for p, _ in
+                 jax.tree_util.tree_flatten_with_path(dummy)[0]]
+        B = self._q_block
+        entries, q_off, fw_off = [], 0, 0
+        for path, shape in zip(paths, self.layer_shapes):
+            n = int(np.prod(shape or (1,)))
+            ps = _path_str(path)
+            if n * 2 < cc.min_tensor_bytes or \
+                    any(pat in ps for pat in cc.excluded):
+                entries.append(("fw", fw_off, n))
+                fw_off += n
+            else:
+                npad = ((n + B - 1) // B) * B
+                entries.append(("q", q_off, n, npad))
+                q_off += npad
+        return {"entries": tuple(entries), "q_total": q_off,
+                "fw_total": fw_off}
+
+    def _wire_dtype_np(self):
+        import ml_dtypes
+        return (ml_dtypes.bfloat16 if self.host.out_dtype == "bfloat16"
+                else np.float16)
+
+    def _quant_images(self, l, lo, hi):
+        """(q_img u8, scales f32, fw_img 16-bit) for layer ``l``, cached
+        per host-payload version (one host quantization pass per applied
+        optimizer step, not per fetch — fetches run L×gas×2 per step)."""
+        hit = self._q_cache.get(l)
+        if hit is not None and hit[0] == self._payload_version:
+            return hit[1]
+        from ..comm.quantized import quantize_flat_np
+        seg16 = self._payload_seg(lo, hi)
+        if seg16.dtype == np.uint16:
+            seg16 = seg16.view(self._wire_dtype_np())
+        pl = self._q_plan
+        B = self._q_block
+        pack = 2 if self._q_bits == 4 else 1
+        q_img = np.empty(pl["q_total"] // pack, np.uint8)
+        scales = np.empty(pl["q_total"] // B, np.float32)
+        fw_img = np.empty(pl["fw_total"], seg16.dtype)
+        off = 0
+        for entry, shape in zip(pl["entries"], self.layer_shapes):
+            n = int(np.prod(shape or (1,)))
+            leaf = seg16[off:off + n]
+            off += n
+            if entry[0] == "fw":
+                fw_img[entry[1]:entry[1] + n] = leaf
+            else:
+                _, qo, _, npad = entry
+                q, s = quantize_flat_np(leaf, block_size=B,
+                                        bits=self._q_bits)
+                q_img[qo // pack:(qo + npad) // pack] = q
+                scales[qo // B:(qo + npad) // B] = s
+        imgs = (q_img, scales, fw_img)
+        self._q_cache[l] = (self._payload_version, imgs)
+        return imgs
+
+    def _upload_layer_quantized(self, l, lo, hi):
+        q_img, scales, fw_img = self._quant_images(l, lo, hi)
+        B = self._q_block
+        pack = 2 if self._q_bits == 4 else 1
+        bpb = B // pack                     # packed bytes per block
+        cb = max(bpb, (wire.DEFAULT_CHUNK_BYTES // bpb) * bpb)
+        q_chunks = self._h2d.upload_flat(q_img, chunk_bytes=cb)
+        fw_chunks = (self._h2d.upload_flat(fw_img) if fw_img.size else [])
+        sc_dev = jax.device_put(scales)     # tiny; ref held by _q_cache
+        key = ("layerq", len(q_chunks), len(fw_chunks))
+        if key not in self._jit_cache:
+            out_dtype = (jnp.bfloat16 if self.host.out_dtype == "bfloat16"
+                         else jnp.float16)
+            per_fw = (int(fw_chunks[0].shape[0]) if fw_chunks else 1)
+            self._jit_cache[key] = wire.make_quantized_chunk_scatter(
+                tuple(self.layer_shapes), self.layer_treedef,
+                self._q_plan["entries"], int(q_chunks[0].shape[0]),
+                len(q_chunks), per_fw, len(fw_chunks),
+                bits=self._q_bits, block=B, out_dtype=out_dtype)
+        tree = self._jit_cache[key](sc_dev, *q_chunks, *fw_chunks)
+        self._h2d.settle_on(jax.tree_util.tree_leaves(tree)[0])
+        return tree
 
     # ---------------------------------------------------------- NVMe tier
     def _flush_layers_to_nvme(self, layer_ids):
@@ -232,6 +354,8 @@ class ParamStreamRunner:
             self.swapper.release([l])
             return tree
         lo, hi = self.layer_bounds[l]
+        if self._quant:
+            return self._upload_layer_quantized(l, lo, hi)
         seg = self._payload_seg(lo, hi)
         return self._upload_segment(seg, "layer", self.layer_shapes)
 
@@ -473,6 +597,11 @@ class ParamStreamRunner:
                 self._flush_layers_to_nvme(range(self.L))
                 t_adam += time.time() - t2
             self._upload_nonblock()
+            if self._quant:
+                # payload changed: next fetch of each layer re-quantizes
+                # (a SKIPPED step leaves the payload — and the cached
+                # quantized images — untouched)
+                self._payload_version += 1
 
         self.last_times = {
             "device_plus_wire_s": round(t_dev, 3),
@@ -502,6 +631,8 @@ class ParamStreamRunner:
                     fn.clear()
         self._jit_cache.clear()
         self._nonblock_dev = None
+        if self._quant:
+            self._q_cache.clear()
         self._h2d.close()
         swapper, self.swapper = self.swapper, None
         if swapper is not None:
@@ -630,3 +761,5 @@ class ParamStreamRunner:
         if self.nvme:
             self._flush_layers_to_nvme(range(self.L))
         self._upload_nonblock()
+        if self._quant:
+            self._payload_version += 1

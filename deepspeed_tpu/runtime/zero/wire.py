@@ -69,6 +69,22 @@ def d2h_tree_start(tree):
             leaf.copy_to_host_async()
 
 
+def gather_span(parts, per, start, end):
+    """Concatenate the flat range ``[start, end)`` out of equally-sized
+    chunks (``per`` elements each, last chunk may be short) — no
+    full-size concatenate of the whole buffer."""
+    import jax.numpy as jnp
+    pieces = []
+    s = start
+    while s < end:
+        c = s // per
+        base = c * per
+        e = min(end, base + int(parts[c].shape[0]))
+        pieces.append(parts[c][s - base:e - base])
+        s = e
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
 def make_chunk_scatter(shapes, treedef, per, nchunks, *, out_shardings=None):
     """Build the jitted chunks→pytree scatter shared by every h2d upload
     path: each leaf is sliced straight out of the chunk(s) covering it —
@@ -78,28 +94,57 @@ def make_chunk_scatter(shapes, treedef, per, nchunks, *, out_shardings=None):
     ``shapes``: leaf shapes in treedef order (leaves tile the flat buffer
     contiguously); ``per``: elements per chunk (all chunks but the last).
     """
-    import jax.numpy as jnp
 
     def scatter(*parts):
         leaves = []
         o = 0
         for s in shapes:
             n = int(np.prod(s or (1,)))
-            pieces = []
-            start = o
-            while start < o + n:
-                c = start // per
-                base = c * per
-                end = min(o + n, base + int(parts[c].shape[0]))
-                pieces.append(parts[c][start - base:end - base])
-                start = end
-            flat = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
-            leaves.append(flat.reshape(s))
+            leaves.append(gather_span(parts, per, o, o + n).reshape(s))
             o += n
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
     kw = {"out_shardings": out_shardings} if out_shardings is not None else {}
     return jax.jit(scatter, donate_argnums=tuple(range(nchunks)), **kw)
+
+
+def make_quantized_chunk_scatter(shapes, treedef, plan, per_q, nq,
+                                 per_fw, nfw, *, bits, block,
+                                 out_dtype):
+    """Chunks→pytree scatter for the QUANTIZED layer wire
+    (docs/comms-compression.md, the ``param_stream`` route): quantized
+    leaves are sliced out of the int8 image chunks and dequantized
+    per-leaf on device; excluded/full-width leaves come from the
+    (possibly empty) full-width image.
+
+    ``plan``: per-leaf ``("q", q_off, n, npad)`` or ``("fw", fw_off, n)``
+    entries in treedef order — offsets in ELEMENTS of the respective
+    image (quantized leaves are block-aligned so each leaf owns whole
+    scale blocks; the int4 image packs two elements per byte).
+    Call: ``scatter(scales, *q_chunks, *fw_chunks)`` (chunks donated).
+    """
+    from ..comm.quantized import dequantize_flat_jnp
+    pack = 2 if bits == 4 else 1
+
+    def scatter(scales, *parts):
+        q_parts, fw_parts = parts[:nq], parts[nq:]
+        leaves = []
+        for entry, shape in zip(plan, shapes):
+            if entry[0] == "fw":
+                _, off, n = entry
+                flat = gather_span(fw_parts, per_fw, off, off + n)
+            else:
+                _, off, n, npad = entry
+                qflat = gather_span(q_parts, per_q, off // pack,
+                                    (off + npad) // pack)
+                sc = scales[off // block:(off + npad) // block]
+                flat = dequantize_flat_jnp(qflat, sc, bits=bits,
+                                           out_dtype=out_dtype)[:n]
+            leaves.append(flat.reshape(shape))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    return jax.jit(scatter,
+                   donate_argnums=tuple(range(1, 1 + nq + nfw)))
 
 
 class H2DUploader:
@@ -172,11 +217,15 @@ class H2DUploader:
         self._settled = sweep(self._settled)
         self._fresh = sweep(self._fresh)
 
-    def upload_flat(self, host_flat, *, device=None, stage=False):
-        """host flat array -> list of device chunk arrays (async)."""
+    def upload_flat(self, host_flat, *, device=None, stage=False,
+                    chunk_bytes=None):
+        """host flat array -> list of device chunk arrays (async).
+        ``chunk_bytes`` overrides the uploader default for payloads with
+        alignment needs (the quantized layer wire keeps chunks on scale-
+        block boundaries so each chunk dequantizes independently)."""
         host_flat = host_flat.reshape(-1)
         spans = _chunk_bounds(host_flat.shape[0], host_flat.dtype.itemsize,
-                              self.chunk_bytes)
+                              chunk_bytes or self.chunk_bytes)
         self._reclaim()
         self._epoch += 1
         out = []

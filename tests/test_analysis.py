@@ -18,6 +18,7 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 import deepspeed_tpu as ds
 from deepspeed_tpu.analysis import (
@@ -498,3 +499,97 @@ def test_verify_checkpoint_malformed_manifest_record(tmp_path):
     (ckpt / atomic.MANIFEST_FILE).write_text(_json.dumps({"files": [1]}))
     ok, problems = atomic.verify_checkpoint(str(ckpt))
     assert not ok and "not a map" in problems[0]
+
+
+# ===========================================================================
+# quantized-collectives census (DSTPU203 extension; docs/comms-compression.md)
+# ===========================================================================
+
+def test_census_classifies_quantized_and_grouped(mesh_2x4):
+    """The HLO census must carry payload dtypes (int8 => quantized) and
+    replica-group counts (>1 => a sub-axis / two-level phase), and
+    wire_report must price logical vs wire bytes accordingly."""
+    from jax.sharding import NamedSharding
+    from deepspeed_tpu.analysis.comms import wire_report
+
+    def body(x):
+        q = jnp.clip(jnp.round(x * 10), -127, 127).astype(jnp.int8)
+        qf = jax.lax.all_gather(q, "fsdp", axis=0,
+                                tiled=True)  # dstpu: disable=DSTPU102
+        return qf.astype(jnp.float32) / 10.0
+
+    sm = jax.shard_map(body, mesh=mesh_2x4, in_specs=P("fsdp"),
+                       out_specs=P(), check_vma=False)
+    x = jax.device_put(jnp.ones((64, 16)),
+                       NamedSharding(mesh_2x4, P("fsdp")))
+    report = audit_fn(sm, x)
+    hlo = [c for c in report.census if c.level == "hlo"]
+    quant = [c for c in hlo if c.quantized]
+    assert quant, [c.to_dict() for c in hlo]
+    # fsdp sub-axis collective on a 2x4 mesh: data-many replica groups
+    assert all(c.groups == 2 for c in quant), [c.groups for c in quant]
+    assert quant[0].bytes == 64 * 16                    # 1 byte/element
+    wr = wire_report(hlo)
+    assert wr["quantized_wire_bytes"] >= 64 * 16
+    assert wr["logical_bytes"] >= wr["wire_bytes"] + 3 * 64 * 16
+    assert wr["grouped_collectives"] >= 1
+    # jaxpr level classifies by dtype too
+    jx = [c for c in report.census if c.level == "jaxpr"]
+    assert any(c.quantized for c in jx)
+
+
+def test_engine_compressed_step_audit(mesh_2x4):
+    """CI gate (satellite): the quantized z3 step introduces no host
+    callbacks (DSTPU201), honors donation for every kept leaf —
+    including the new error-feedback state — and its wire-byte census
+    fits the engine's declared CommsBudget (DSTPU203); an artificially
+    tiny budget must fire."""
+    from deepspeed_tpu.analysis.comms import CommsBudget as CB
+    cfg = {"train_micro_batch_size_per_gpu": 16,
+           "gradient_accumulation_steps": 1,
+           "steps_per_print": 10 ** 9,
+           "bf16": {"enabled": True},
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3,
+                                 "stage3_param_persistence_threshold": 0},
+           "comms_compression": {"enabled": True, "min_tensor_bytes": 256,
+                                 "block_size": 256}}
+    rng = np.random.default_rng(0)
+    data = [(rng.normal(size=(64,)).astype(np.float32),
+             rng.normal(size=(64,)).astype(np.float32)) for _ in range(256)]
+    engine, _, _, _ = ds.initialize(
+        config=cfg, model=SimpleModel(dim=64, hidden=256),
+        training_data=data, mesh=mesh_2x4)
+    assert engine._router.weights_active and engine._router.grads_active
+    budget = engine.comms_budget()
+    assert budget is not None
+    report = audit_engine(engine, comms_budget=budget)
+    assert report.host_callbacks == [], [str(f) for f in report.findings]
+    d = report.donation
+    assert d["checked"] and d["unhonored_args"] == [], d
+    assert not [f for f in report.findings if f.rule == "DSTPU203"], \
+        [str(f) for f in report.findings]
+    hlo = [c for c in report.census if c.level == "hlo"]
+    assert any(c.quantized for c in hlo), \
+        "compressed step must move int8 collectives"
+    tiny = audit_engine(engine, comms_budget=CB(
+        per_kind={}, total_max_bytes=16))
+    assert [f for f in tiny.findings if f.rule == "DSTPU203"]
+    engine.close()
+
+
+@pytest.mark.slow
+def test_cli_audit_step_compressed_variant():
+    """`--audit-step 3q` builds the quantized z3 engine and exits 0 with
+    zero findings (host-callback-free, budget-clean) on this mesh."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.analysis", "--audit-step",
+         "3q", os.path.join(REPO_ROOT, "deepspeed_tpu", "analysis",
+                            "findings.py"), "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+             "DSTPU_COMPILE_CACHE": "0"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True

@@ -277,3 +277,50 @@ def test_every_parsed_zero_key_is_consumed_or_registered():
                "stage3_param_persistence_threshold"}
     assert unaccounted - aliases == set(), \
         f"silently-dead ZeRO config keys: {sorted(unaccounted - aliases)}"
+
+
+# ------------------------------------------------- comms_compression block
+def test_comms_compression_defaults_off():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, world_size=1)
+    cc = cfg.comms_compression
+    assert cc.enabled is False
+    assert cc.weights_bits == 8 and cc.grads_bits == 8
+    assert cc.hierarchical is True
+    assert "z3" in cc.routes and "param_stream" in cc.routes
+    assert any("bias" in p for p in cc.excluded)
+
+
+def test_comms_compression_validation():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    import pytest as _pytest
+    base = {"train_batch_size": 8}
+    with _pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(dict(base, comms_compression={"weights_bits": 3}),
+                        world_size=1)
+    with _pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(dict(base, comms_compression={"grads_bits": 4}),
+                        world_size=1)
+    with _pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(dict(base, comms_compression={"routes": ["zz9"]}),
+                        world_size=1)
+    with _pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(dict(base, comms_compression={"block_size": 1}),
+                        world_size=1)
+    # null bits = that route stays full width, valid
+    cfg = DeepSpeedConfig(dict(base, comms_compression={
+        "enabled": True, "weights_bits": None}), world_size=1)
+    assert cfg.comms_compression.weights_bits is None
+
+
+def test_comms_compression_env_override(monkeypatch):
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    monkeypatch.setenv("DSTPU_COMMS_COMPRESSION", "1")
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, world_size=1)
+    assert cfg.comms_compression.enabled is True
+    monkeypatch.setenv("DSTPU_COMMS_COMPRESSION", "0")
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 8, "comms_compression": {"enabled": True}},
+        world_size=1)
+    assert cfg.comms_compression.enabled is False

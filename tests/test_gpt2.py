@@ -160,6 +160,7 @@ def test_flops_accounting():
     assert m.flops_per_token() > 6 * n
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_unrolled_cache_decode_matches_scanned():
     """unroll_layers must not change the KV-cache forward (the single-chip
     decode fast path is numerically the scanned path)."""

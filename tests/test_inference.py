@@ -71,6 +71,7 @@ def test_generate_greedy_matches_naive_loop(devices):
     np.testing.assert_array_equal(out, np.asarray(toks))
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_tensor_parallel_inference_matches_single(devices):
     """mp_size=4 TP forward == single-device forward (reference
     ReplaceWithTensorSlicing correctness)."""

@@ -44,6 +44,7 @@ def _torch_losses(hf, batches):
     return losses
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_engine_loss_curve_matches_torch_adamw(devices):
     cfg = transformers.GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
                                   n_layer=2, n_head=4, embd_pdrop=0.0,

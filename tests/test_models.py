@@ -124,6 +124,7 @@ def test_rotary_embedding_properties():
                                    np.asarray(x[:, 0]), rtol=1e-6)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_gptj_trains(devices):
     model = build("gptj-tiny", dtype=jnp.float32)
     rng = np.random.RandomState(5)

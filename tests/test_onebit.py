@@ -182,3 +182,107 @@ def test_onebit_lamb_scaling_coeff_set_at_freeze():
     # scaling coeffs set (≠1) and inversely related to momentum magnitude
     sa, sb = float(st.scaling_coeff["a"]), float(st.scaling_coeff["b"])
     assert sa != 1.0 and sb != 1.0 and sa > sb
+
+
+# ---------------------------------------- quantizer/compressed edge cases
+def test_compressed_allreduce_zero_length_tensor():
+    """A zero-length tensor must round-trip without NaN (the scale is
+    ||x||/sqrt(numel) — numel 0 used to divide by zero)."""
+    x = jnp.zeros((0,), jnp.float32)
+    we = jnp.zeros((0,), jnp.float32)
+    se = jnp.zeros((0,), jnp.float32)
+    out, we_n, se_n = compressed_allreduce(x, we, se)
+    assert out.shape == (0,) and we_n.shape == (0,)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+@pytest.mark.parametrize("numel", [1, 7, 37, 63, 65])
+def test_compressed_allreduce_odd_sizes_pack_correctly(devices, numel):
+    """Odd shard sizes whose padding changes the packbits layout: the
+    two-phase wire must still reproduce the numpy oracle exactly."""
+    n = 8
+    mesh = make_mesh({"data": 8})
+    rng = np.random.default_rng(numel)
+    xs = [rng.normal(size=numel).astype(np.float32) for _ in range(n)]
+    L = padded_size(numel, n)
+    chunk = server_chunk_size(numel, n)
+    wes = [np.zeros(L, np.float32) for _ in range(n)]
+    ses = [np.zeros(chunk, np.float32) for _ in range(n)]
+    expected, _, _ = np_compressed_allreduce(xs, wes, ses)
+
+    fn = jax.shard_map(
+        lambda x, we, se: compressed_allreduce(x, we, se, axis_name="data",
+                                               world_size=n),
+        mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+        out_specs=(P("data"), P("data"), P("data")), check_vma=False)
+    with jax.set_mesh(mesh):
+        out, _, _ = jax.jit(fn)(np.stack(xs).reshape(-1),
+                                np.stack(wes).reshape(-1),
+                                np.stack(ses).reshape(-1))
+    out = np.asarray(out).reshape(n, numel)
+    for r in range(n):
+        np.testing.assert_allclose(out[r], expected[:numel],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_compressed_allreduce_all_zero_tensor():
+    """All-zero input: scale 0 (not NaN), result exactly zero, error
+    buffers stay zero."""
+    numel = 32
+    x = jnp.zeros((numel,), jnp.float32)
+    L = padded_size(numel, 1)
+    we = jnp.zeros((L,), jnp.float32)
+    se = jnp.zeros((L,), jnp.float32)
+    out, we_n, se_n = compressed_allreduce(x, we, se)
+    assert np.all(np.asarray(out) == 0.0)
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert np.all(np.asarray(we_n) == 0.0)
+    assert np.all(np.asarray(se_n) == 0.0)
+
+
+# --------------------------------------- engine-wired 1-bit transport
+def test_onebit_adam_router_transport_smoke(devices):
+    """Satellite acceptance: OneBitAdam built BY THE ENGINE runs its
+    compression stage over a real multi-device mesh axis (per-rank error
+    buffers, packed-sign all_to_all/all_gather in the census) and the
+    loss keeps decreasing through the freeze boundary."""
+    model = SimpleModel(dim=8)
+    cfg = base_config(micro=4, over={
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-2, "freeze_step": 4}}})
+    engine, _, _, _ = ds.initialize(config=cfg, model=model,
+                                    training_data=random_dataset(n=256),
+                                    mesh=make_mesh({"data": 8}))
+    assert engine._onebit_transport is not None
+    assert engine.optimizer.comm is engine._onebit_transport
+    # per-rank error buffers: leading (world, ...) axis
+    we = jax.tree_util.tree_leaves(engine.state.opt_state.worker_error)[0]
+    assert we.shape[0] == 8
+    losses = [float(engine.train_batch()) for _ in range(16)]
+    assert np.isfinite(losses).all(), losses
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+    # the 1-bit wire is real: packed uint8 collectives inside the step
+    from deepspeed_tpu.analysis.jaxpr_audit import audit_engine
+    rep = audit_engine(engine)
+    assert rep.host_callbacks == []
+    u8 = [c for c in rep.census if c.level == "jaxpr"
+          and c.kind in ("all_to_all", "all_gather")
+          and "uint8" in c.dtypes]
+    assert u8, "expected packed-sign uint8 collectives in the jaxpr census"
+    engine.close()
+
+
+def test_onebit_transport_single_device_degrades(devices):
+    """On a dp-world-of-1 mesh the router provides no transport and the
+    optimizer falls back to the local (no-wire) quantization path."""
+    model = SimpleModel(dim=8)
+    cfg = base_config(micro=4, over={
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-2, "freeze_step": 3}}})
+    engine, _, _, _ = ds.initialize(
+        config=cfg, model=model, training_data=random_dataset(n=64),
+        mesh=make_mesh({"data": 1}, devices=jax.devices()[:1]))
+    assert engine._onebit_transport is None
+    losses = [float(engine.train_batch()) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    engine.close()

@@ -65,6 +65,7 @@ def test_stream_loss_matches_nonstream(devices):
     np.testing.assert_allclose(ref, got, rtol=3e-4)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_stream_gas_accumulation_matches(devices):
     _, ref = _train(_config(2, gas=2))
     eng, got = _train(_config(2, gas=2, offload_param={"device": "cpu"}))

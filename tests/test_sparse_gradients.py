@@ -81,7 +81,7 @@ def test_sparse_wire_format(devices):
         lambda a: jnp.asarray(a)[None], next(iter([
             (np.zeros((32, 8), np.int32) + 3, np.zeros((32,), np.float32))])))
     rng = jax.random.PRNGKey(0)
-    grads, _, _, _ = engine._jit_grad_step(engine.state, batch, rng)
+    grads, *_ = engine._jit_grad_step(engine.state, batch, rng)
     leaf = grads["emb"]["table"]
     assert isinstance(leaf, dict) and "sparse_indices" in leaf, type(leaf)
     n_ids = 32 * 8
