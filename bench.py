@@ -376,6 +376,73 @@ def measure_offload(preset, seq, micro, *, gas=1, steps=1, warmup=1,
     return out
 
 
+def measure_serving(preset="gpt2-125m", *, streams=8, batch_slots=8,
+                    prompt_len=64, new_tokens=64, block_size=32,
+                    kv_bits=16, int8_weights=False, cache_dir=None):
+    """Continuous-batching serving rung (docs/serving.md): N concurrent
+    request streams through the ServingEngine's fused paged decode.
+
+    Reports generated tokens/sec and per-request p50/p99 latency +
+    time-to-first-token; admission is memory-preflighted (the scheduler
+    refuses to start a configuration that cannot fit), so this rung
+    cannot die RESOURCE_EXHAUSTED mid-traffic."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import build
+    from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
+                                         ServingConfig, Request)
+
+    model = build(preset, dtype=jnp.bfloat16, max_seq=prompt_len + new_tokens,
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+    eng = InferenceEngine(
+        model=model, quantization_setting=1 if int8_weights else None,
+        compile_cache=cache_dir)
+    srv = ServingEngine(engine=eng, config=ServingConfig(
+        batch_slots=batch_slots, block_size=block_size, kv_bits=kv_bits,
+        max_new_tokens=new_tokens))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    reqs = [Request(tokens=rng.integers(0, V, (prompt_len,)),
+                    max_new_tokens=new_tokens, seed=i)
+            for i in range(streams)]
+    try:
+        # warm the executables on one short request so the timed window
+        # measures serving, not compile/deserialize; drop it from the
+        # stats so percentiles cover only the measured traffic
+        srv.run([Request(tokens=rng.integers(0, V, (prompt_len,)),
+                         max_new_tokens=2, seed=10 ** 6)])
+        srv.reset_stats()
+        t0 = time.time()
+        srv.run(reqs)
+        dt = time.time() - t0
+        st = srv.stats()
+        cap = srv.capacity()
+        gen = sum(len(srv.results[r.uid]["tokens"]) for r in reqs)
+        rec = {
+            "streams": streams,
+            "batch_slots": batch_slots,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "block_size": block_size,
+            "kv_bits": kv_bits,
+            "int8_weights": int8_weights,
+            "tokens_per_sec": round(gen / dt, 1),
+            "p50_ms": st["latency_ms"]["p50"],
+            "p99_ms": st["latency_ms"]["p99"],
+            "ttft_p50_ms": st["ttft_ms"]["p50"],
+            "decode_steps": st["decode_steps"],
+            "capacity": {k: cap[k] for k in
+                         ("num_blocks", "capacity_tokens", "pool_bytes")},
+            "preflight": srv.preflight_memory(),
+        }
+        cache = _cache_stats(eng)
+        if cache is not None:
+            rec["cache"] = cache
+        return rec
+    finally:
+        srv.close()
+
+
 class _WireProbeMLP:
     """Self-contained MLP for the wire probe: rows >> width, so the SPMD
     partitioner's cheapest baseline schedule moves WEIGHTS (the ZeRO-3
@@ -668,6 +735,19 @@ def main():
     # (examples/bench_offload_dpu.py) — too slow to re-measure inside the
     # driver budget every round.
 
+    # ---- serving rung: continuous batching over the paged KV cache ----
+    # tokens/s + p50/p99 under N concurrent streams through the fused
+    # stacked-scan decode (docs/serving.md; ROADMAP #1 done-looks-like)
+    if left() > 5 * 60:
+        try:
+            extra["serving_125m_b8"] = measure_serving(
+                "gpt2-125m", streams=8, batch_slots=8, prompt_len=64,
+                new_tokens=64, cache_dir=cache_dir)
+        except Exception as e:
+            extra["serving_125m_b8"] = {"error": str(e)[:160]}
+    else:
+        extra["serving_125m_b8"] = {"skipped": "time budget"}
+
     # 760M remat: the largest on-chip model (Adam states + remat'd
     # activations fill the 16GB HBM) — the VERDICT r2 MFU target (>=0.45)
     if left() > 4 * 60:
@@ -763,6 +843,12 @@ def main():
             "int4w_reduction_x": (wirec.get("int4_weights")
                                   or {}).get("reduction_x"),
         }
+    serving = extra.get("serving_125m_b8") or {}
+    if "tokens_per_sec" in serving:
+        headline["extra"]["serving"] = {
+            "tok_s": serving["tokens_per_sec"],
+            "p50_ms": serving["p50_ms"], "p99_ms": serving["p99_ms"],
+            "streams": serving["streams"]}
     backoffs = _backoff_summary()
     if backoffs:
         headline["extra"]["backoff"] = backoffs

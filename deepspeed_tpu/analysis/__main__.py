@@ -61,6 +61,9 @@ def _audit_builtin_steps(stages):
     cache_dir = tempfile.mkdtemp(prefix="dstpu-audit-cc-")
     try:
         for spec in stages:
+            if str(spec) == "decode":
+                findings.extend(_audit_decode_step())
+                continue
             compressed = str(spec).endswith("q")
             stage = int(str(spec).rstrip("q"))
             cfg = {"train_micro_batch_size_per_gpu": 4,
@@ -138,6 +141,65 @@ def _audit_builtin_steps(stages):
     return findings
 
 
+def _audit_decode_step():
+    """Jaxpr-audit the serving layer's fused paged decode step (and the
+    InferenceEngine's fused token-scan decode loop) on a tiny GPT-2:
+    zero host callbacks (DSTPU201), donation declared-vs-honored on the
+    KV pool/cache (DSTPU204), and no weak-scalar recompile hazards
+    (DSTPU205) — the serving hot loop must stay a single clean
+    executable (docs/serving.md)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from .jaxpr_audit import audit_fn
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
+                                         ServingConfig, Request)
+
+    cfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0))
+    findings = []
+    for kv_bits in (16, 8):
+        srv = ServingEngine(
+            model=model, params=params,
+            config=ServingConfig(batch_slots=2, block_size=8,
+                                 kv_bits=kv_bits, max_new_tokens=4,
+                                 preflight=False))
+        # one request warms the executables audit_fn will inspect
+        srv.run([Request(tokens=np.arange(5), max_new_tokens=2)])
+        srv._build_decode()
+        report = audit_fn(srv._decode, *srv._decode_args(),
+                          donate_argnums=(1,), mesh=srv.engine.mesh)
+        for f in report.findings:
+            f.extra = dict(f.extra, audit="serving-decode",
+                           kv_bits=kv_bits)
+        findings.extend(report.findings)
+        srv.close()
+    # the generate() fused token scan (prefill + ONE scan executable)
+    eng = InferenceEngine(model, params=params)
+    eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=4)
+    loop = next(iter(eng._decode_loops.values()))
+    cache = model.init_cache(1, 8)
+    last = jnp.zeros((1, cfg.vocab_size), jnp.float32)
+    report = audit_fn(loop, eng.params, last, cache,
+                      jax.random.PRNGKey(0), jnp.float32(1.0),
+                      donate_argnums=(2,), mesh=eng.mesh)
+    for f in report.findings:
+        # the decode loop DISCARDS the final cache (tokens are the only
+        # output), so jax cannot alias the donated cache to an output —
+        # a known, documented non-aliasing, not a regression (DSTPU204
+        # flags declared-but-unhonored donation)
+        if f.rule == "DSTPU204":
+            continue
+        f.extra = dict(f.extra, audit="generate-decode-loop")
+        findings.append(f)
+    eng.close()
+    return findings
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.analysis",
@@ -157,7 +219,9 @@ def main(argv=None):
                          "--audit-step 1,2,3 (compiles; needs jax). A "
                          "'q' suffix (e.g. 3q) audits the quantized-"
                          "collectives variant and additionally gates the "
-                         "census against the engine's declared CommsBudget")
+                         "census against the engine's declared CommsBudget; "
+                         "'decode' audits the serving layer's fused paged "
+                         "decode step + generate()'s fused token scan")
     args = ap.parse_args(argv)
 
     # findings are the stdout payload (the tier-1 gate parses --json);

@@ -1,5 +1,9 @@
-"""Inference engine. Parity: reference ``deepspeed/inference/``."""
+"""Inference engine + serving layer. Parity: reference
+``deepspeed/inference/`` (engine); the continuous-batching serving layer
+(``serving.py``) is this repo's production-traffic addition
+(docs/serving.md)."""
 
 from .engine import InferenceEngine
+from .serving import ServingConfig, ServingEngine, Request
 
-__all__ = ["InferenceEngine"]
+__all__ = ["InferenceEngine", "ServingEngine", "ServingConfig", "Request"]

@@ -178,7 +178,14 @@ class InferenceEngine:
 
         self._jit_forward = None
         self._jit_prefill = None
-        self._decode_loops = {}    # (steps, do_sample, top_k) → fn
+        # (steps, do_sample, top_k) → CachedStep, LRU-ordered.  Each loop
+        # routes through the persistent compile cache, so an evicted
+        # config RE-ENTERS via AOT warm start (deserialize, no XLA
+        # compile) instead of paying a fresh compile — the dict only
+        # bounds LIVE executables' device programs, not compile work.
+        from collections import OrderedDict
+        self._decode_loops = OrderedDict()
+        self._decode_loops_cap = 8
         log_dist(f"InferenceEngine ready: tp={self.mp_world_size} "
                  f"mesh={dict(self.mesh.shape)}", ranks=[0])
 
@@ -231,26 +238,18 @@ class InferenceEngine:
         assert max_len >= total, "max_len must cover prompt + new tokens"
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-        # int8 weight handling, two tiers:
+        # int8 weight handling, two tiers (shared helper — serving.py
+        # routes through the same function, so the paths cannot drift):
         #  - models whose decode path consumes quantized leaves directly
-        #    (supports_quantized_decode: q_matmul → Pallas weight-int8
-        #    kernel) get the params UNTOUCHED — weights stream int8 from
-        #    HBM through the matmuls, halving decode's binding byte term;
+        #    (supports_quantized_decode) get the params UNTOUCHED —
+        #    weights stream int8 from HBM through the decode matmuls,
+        #    halving decode's binding byte term;
         #  - otherwise dequantize ONCE per jitted call, outside the token
         #    scan (re-materializing per token measured 1.6x slower than
         #    bf16; hoisted it matches bf16 speed but still streams
         #    full-width)
-        from ..module_inject.module_quantize import (QuantizedModel,
-                                                     dequantize_tree)
-        if isinstance(self.module, QuantizedModel):
-            inner = self.module._model
-            if getattr(inner, "supports_quantized_decode", False):
-                deq = lambda p: p
-            else:
-                deq = lambda p: dequantize_tree(p, self.module._dtype)
-        else:
-            inner = self.module
-            deq = lambda p: p
+        from ..module_inject.module_quantize import resolve_decode_params
+        inner, deq = resolve_decode_params(self.module)
 
         if self._jit_prefill is None:
             def prefill(params, toks, cache):
@@ -263,7 +262,9 @@ class InferenceEngine:
         # compile key is only what changes the program structure
         key = (max_new_tokens, bool(do_sample), top_k)
         loop = self._decode_loops.get(key)
-        if loop is None:
+        if loop is not None:
+            self._decode_loops.move_to_end(key)    # LRU touch
+        else:
             def decode_loop(params, last_logits, cache, r, temp):
                 params = deq(params)      # once, OUTSIDE the token scan
                 first = _select_token(last_logits, temp, do_sample,
@@ -291,8 +292,14 @@ class InferenceEngine:
             loop = self._wrap_step(
                 f"decode[{max_new_tokens},{do_sample},{top_k}]", decode_loop,
                 donate_argnums=(2,) if max_new_tokens > 1 else ())
-            if len(self._decode_loops) >= 8:   # bound the executable cache
-                self._decode_loops.pop(next(iter(self._decode_loops)))
+            # bound LIVE executables, least-recently-USED out (the old
+            # dict popped in FIFO insertion order, so a hot config could
+            # be evicted while a cold one idled); clear() frees the
+            # evicted device programs, and the next use of that config
+            # deserializes from the compile cache (AOT warm start)
+            while len(self._decode_loops) >= self._decode_loops_cap:
+                _, old = self._decode_loops.popitem(last=False)
+                old.clear()
             self._decode_loops[key] = loop
 
         with jax.set_mesh(self.mesh):
@@ -323,6 +330,20 @@ class InferenceEngine:
 
     def profile_model_time(self, *a, **k):
         logger.warning("profile_model_time: use jax.profiler traces on TPU")
+
+    def close(self):
+        """Release live compiled executables and the param tree.
+        ``del engine`` alone does not free device programs (the bench-
+        ladder lesson, ``DeepSpeedEngine.close``); call between engine
+        lifetimes sharing one process.  Idempotent."""
+        for wrapper in ([self._jit_forward, self._jit_prefill]
+                        + list(self._decode_loops.values())):
+            if wrapper is not None and hasattr(wrapper, "clear"):
+                wrapper.clear()
+        self._jit_forward = None
+        self._jit_prefill = None
+        self._decode_loops.clear()
+        self.params = None
 
 
 def _quantized_tp_specs(base_specs, qparams):

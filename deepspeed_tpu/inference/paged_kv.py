@@ -1,0 +1,205 @@
+"""Paged/block KV cache: a shared device pool + per-sequence block lists.
+
+Role parity: the reference's inference workspace — one pre-allocated
+``layer_past`` arena sized for the max batch×seq
+(``csrc/transformer/inference/csrc/pt_binding.cpp`` workspace alloc) —
+generalized to the continuous-batching serving layer the reference never
+shipped: sequences of different lengths share one fixed pool of
+``block_size``-token blocks (the vLLM PagedAttention layout), so a slot
+holds exactly the blocks its sequence needs and frees them on
+completion instead of reserving max_seq tokens per slot.
+
+Device layout (pure pytree — jit-carry/donation friendly):
+
+- ``pool["k"]/["v"]``: (L, num_blocks, block_size, H, hd) in the cache
+  dtype, or int8 when the pool is quantized;
+- ``pool["k_scale"]/["v_scale"]`` (int8 pools only): fp32 per-block
+  quantization scales, (L, num_blocks, block_size, H, hd//qb) — the
+  ``runtime/comm/quantized.py`` block quantizer over the head dim.
+
+Block 0 is a reserved SCRATCH block: inactive batch slots carry
+all-zero block tables, so their (masked, discarded) decode writes land
+in scratch instead of corrupting a live sequence's block.  The
+host-side :class:`BlockAllocator` therefore hands out ids from
+``[1, num_blocks)``.
+
+XLA cost note (honest roofline accounting, docs/serving.md): the
+per-layer ``gather_kv`` materializes each slot's gathered block view —
+a dense (B, nb_max·block_size, H, hd) copy per layer per token — where
+a hand-written paged-attention kernel would read blocks in place.  KV
+bytes are small next to the weight stream at the serving batch sizes
+this targets, and the int8 pool halves them again; the kernel is the
+known next step, not a hidden cost.
+"""
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..runtime.comm.quantized import (quantize_blockwise,
+                                      dequantize_blockwise, pick_block)
+
+SCRATCH_BLOCK = 0     # reserved; never allocated (see module docstring)
+
+
+def blocks_needed(total_tokens: int, block_size: int) -> int:
+    """Blocks a sequence of ``total_tokens`` (prompt + max new) occupies."""
+    return max(1, -(-int(total_tokens) // int(block_size)))
+
+
+class BlockAllocator:
+    """Host-side free-list over pool block ids ``[1, num_blocks)``.
+
+    Allocation is all-or-nothing (a request either gets every block its
+    admission math asked for, or is left queued); ``free`` returns
+    blocks for reuse in LIFO order so hot blocks stay hot.
+    """
+
+    def __init__(self, num_blocks: int):
+        assert num_blocks >= 2, \
+            "need >= 2 blocks (block 0 is the reserved scratch block)"
+        self.num_blocks = int(num_blocks)
+        self._free = list(range(self.num_blocks - 1, SCRATCH_BLOCK, -1))
+        self._in_use = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._in_use)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int):
+        """``n`` block ids, or None when the pool cannot serve them."""
+        if n < 1 or n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._in_use.update(out)
+        return out
+
+    def free(self, blocks):
+        for b in blocks:
+            assert b in self._in_use, f"double free of block {b}"
+            self._in_use.discard(b)
+            self._free.append(b)
+
+
+# ------------------------------------------------------------- device pool
+def init_pool(n_layer: int, num_blocks: int, block_size: int, n_head: int,
+              head_dim: int, dtype=jnp.bfloat16, kv_bits: int = 16,
+              quant_block: int = 64):
+    """Zeroed pool pytree (see module docstring for the layout).
+
+    ``kv_bits=8`` stores int8 payloads + fp32 block scales over the head
+    dim (``quant_block`` clipped to a divisor of ``head_dim``)."""
+    assert kv_bits in (8, 16), f"kv_bits must be 8 or 16, got {kv_bits}"
+    shape = (n_layer, num_blocks, block_size, n_head, head_dim)
+    if kv_bits == 16:
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    qb = pick_block(head_dim, quant_block)
+    sshape = shape[:-1] + (head_dim // qb,)
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            # scale 1 ≡ the quantizer's all-zero-block convention
+            "k_scale": jnp.ones(sshape, jnp.float32),
+            "v_scale": jnp.ones(sshape, jnp.float32)}
+
+
+def is_quantized_pool(pool) -> bool:
+    return "k_scale" in pool
+
+
+def pool_quant_block(pool) -> Optional[int]:
+    """The int8 pool's quantization block over the head dim (None for a
+    full-width pool)."""
+    if not is_quantized_pool(pool):
+        return None
+    return pool["k"].shape[-1] // pool["k_scale"].shape[-1]
+
+
+def pool_bytes(pool) -> int:
+    return sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(pool))
+
+
+def capacity_tokens(pool) -> int:
+    """Token capacity of the allocatable pool (scratch block excluded)."""
+    return (pool["k"].shape[1] - 1) * pool["k"].shape[2]
+
+
+def write_token(pool, layer, block_tables, lengths, k, v):
+    """Scatter one decode token's K/V per slot into the pool.
+
+    ``layer``: scalar (traced inside the layer scan); ``block_tables``:
+    (B, nb_max) int32; ``lengths``: (B,) int32 — the new token's
+    position; ``k``/``v``: (B, H, hd) in compute dtype.  Slots whose
+    tables are all-scratch write into block 0 (discarded)."""
+    bs = pool["k"].shape[2]
+    blk = jnp.take_along_axis(block_tables, (lengths // bs)[:, None],
+                              axis=1)[:, 0]
+    off = lengths % bs
+    if not is_quantized_pool(pool):
+        dt = pool["k"].dtype
+        return dict(pool,
+                    k=pool["k"].at[layer, blk, off].set(k.astype(dt)),
+                    v=pool["v"].at[layer, blk, off].set(v.astype(dt)))
+    qb = pool_quant_block(pool)
+    qk, sk = quantize_blockwise(k, block_size=qb, bits=8)
+    qv, sv = quantize_blockwise(v, block_size=qb, bits=8)
+    return dict(pool,
+                k=pool["k"].at[layer, blk, off].set(qk),
+                v=pool["v"].at[layer, blk, off].set(qv),
+                k_scale=pool["k_scale"].at[layer, blk, off].set(sk),
+                v_scale=pool["v_scale"].at[layer, blk, off].set(sv))
+
+
+def gather_kv(pool, layer, block_tables, dtype=jnp.bfloat16):
+    """Per-slot gathered cache views for one layer.
+
+    Returns ``(keys, vals)`` of shape (B, nb_max·block_size, H, hd) in
+    ``dtype`` — position p of slot b is row p of its view, so the
+    caller's causal mask over ``lengths`` is layout-independent."""
+    def view(name):
+        x = pool[name][layer][block_tables]     # (B, nb, bs, H, hd)
+        B, nb, bs = x.shape[0], x.shape[1], x.shape[2]
+        x = x.reshape(B, nb * bs, *x.shape[3:])
+        if not is_quantized_pool(pool):
+            return x.astype(dtype)
+        s = pool[name + "_scale"][layer][block_tables]
+        s = s.reshape(B, nb * bs, *s.shape[3:])
+        return dequantize_blockwise(x, s, bits=8, out_dtype=dtype)
+    return view("k"), view("v")
+
+
+def write_prefill(pool, blocks, k, v):
+    """Scatter a prefilled sequence's K/V into its assigned blocks.
+
+    ``blocks``: (nb,) int32 block ids; ``k``/``v``: (L, T, H, hd) with
+    ``T == nb · block_size`` (the prompt padded up to a block multiple —
+    pad rows are masked by the slot's length at attention time)."""
+    L, T, H, hd = k.shape
+    bs = pool["k"].shape[2]
+    nb = T // bs
+    assert nb * bs == T, f"prefill length {T} is not a multiple of {bs}"
+    assert blocks.shape == (nb,), (
+        f"write_prefill needs exactly T//block_size={nb} block ids, got "
+        f"{blocks.shape} (pass the sequence's FIRST nb blocks; later "
+        "blocks fill during decode)")
+
+    def put(name, x):
+        x = x.reshape(L, nb, bs, *x.shape[2:])
+        return pool[name].at[:, blocks].set(x)
+
+    if not is_quantized_pool(pool):
+        dt = pool["k"].dtype
+        return dict(pool, k=put("k", k.astype(dt)), v=put("v", v.astype(dt)))
+    qb = pool_quant_block(pool)
+    qk, sk = quantize_blockwise(k, block_size=qb, bits=8)
+    qv, sv = quantize_blockwise(v, block_size=qb, bits=8)
+    return dict(pool, k=put("k", qk), v=put("v", qv),
+                k_scale=put("k_scale", sk), v_scale=put("v_scale", sv))

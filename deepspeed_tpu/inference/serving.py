@@ -1,0 +1,588 @@
+"""Production inference serving: continuous batching over the paged KV pool.
+
+Role parity: the reference ships fused inference kernels and an
+``InferenceEngine`` but no request scheduler — serving is delegated to
+MII/externals.  This module is that missing layer, built TPU-first:
+
+- **continuous (in-flight) batching** — a FIFO request queue feeds a
+  fixed-width decode batch (``batch_slots``); sequences JOIN a free slot
+  the step after their prefill and EVICT the step they finish, so the
+  decode executable never re-specializes while traffic churns (one
+  compiled step per serving configuration, AOT-warm-started from the
+  persistent compile cache across restarts);
+- **paged KV cache** — slots hold per-sequence block lists into one
+  shared pool (``paged_kv.py``), with slot/block reuse on completion and
+  an optional int8 pool (block-quantized via the ZeRO++ quantizer,
+  ``runtime/comm/quantized.py``) halving the KV byte term;
+- **fused decode** — the token step is the models' stacked-scan paged
+  decode (``GPT2.decode_step_paged``): ONE executable per step for all
+  slots, not 4·L separately scheduled small matmuls (the measured b=8
+  scheduling-gap term, DECODE_PROFILE.json);
+- **admission control** — capacity math (blocks needed vs free) gates
+  the queue, and the decode executable's ``memory_analysis()`` is
+  preflighted against the HBM budget BEFORE any step executes (the same
+  protocol as ``DeepSpeedEngine.preflight_memory`` / the bench ladder),
+  so a mis-sized pool refuses to start instead of dying
+  RESOURCE_EXHAUSTED mid-traffic;
+- **latency accounting** — per-request submit→first-token and
+  submit→done stamps, p50/p99 over a bounded window of completions
+  (``stats()``); long-running servers drain finished records with
+  ``pop_result(uid)`` so ``results`` never grows unbounded.
+
+Determinism: each request's sampling stream is
+``fold_in(PRNGKey(request.seed), token_index)`` — a function of the
+request alone, never of batch composition — and slots compute
+independently (row-independent matmuls, per-slot attention masks), so
+the same requests produce the same tokens REGARDLESS of arrival order,
+slot assignment, or what else shares the batch (tested:
+``tests/test_serving.py::test_arrival_order_determinism``).
+"""
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import paged_kv as pk
+from ..utils.logging import logger, log_dist
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Knobs for one serving deployment (docs/serving.md has the
+    capacity math; JSON surface: the ``serving`` block in
+    docs/config-json.md)."""
+    batch_slots: int = 8            # fixed decode batch width
+    block_size: int = 16            # tokens per KV block
+    # pool blocks INCLUDING the reserved scratch block 0; 0 → auto:
+    # every slot can hold max_seq tokens (the no-eviction-safe maximum)
+    num_blocks: int = 0
+    kv_bits: int = 16               # 16 | 8 (int8 payloads + block scales)
+    kv_quant_block: int = 64        # quantizer block over the head dim
+    max_new_tokens: int = 64        # per-request default
+    top_k: Optional[int] = None     # static: part of the compiled step
+    eos_token_id: Optional[int] = None
+    preflight: bool = True          # memory-gate startup (see preflight())
+    hbm_budget_bytes: Optional[int] = None   # None → backend memory_stats
+    preflight_safety: float = 0.92  # allocator headroom (bench.py's margin)
+    max_queue: int = 4096
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServingConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown serving config keys: {sorted(unknown)}"
+                             f" (known: {sorted(known)})")
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  ``seed`` alone determines the sampling
+    stream (see module docstring); ``uid`` is assigned by ``submit``
+    when absent."""
+    tokens: Any                     # 1-D int32 prompt
+    max_new_tokens: Optional[int] = None
+    temperature: float = 1.0
+    do_sample: bool = False
+    seed: int = 0
+    uid: Optional[int] = None
+
+
+def _mem_analysis(exe) -> Optional[dict]:
+    """Shared executable-memory reading (``runtime/compile_cache.py``)
+    — one implementation for every preflight gate."""
+    from ..runtime.compile_cache import executable_memory_analysis
+    return executable_memory_analysis(exe)
+
+
+class _Slot:
+    """Host-side state of one active decode-batch slot."""
+
+    def __init__(self, req: Request, blocks: List[int], prompt_len: int,
+                 max_new: int):
+        self.req = req
+        self.blocks = blocks
+        self.prompt_len = prompt_len
+        self.max_new = max_new
+        self.out_tokens: List[int] = []
+
+
+class ServingEngine:
+    """Continuous-batching scheduler over an :class:`InferenceEngine`.
+
+    Build from a model (``ServingEngine(model=..., params=...)``) or an
+    existing engine (``ServingEngine(engine=...)`` — int8 weights, TP
+    mesh and the persistent compile cache carry over).  ``config`` is a
+    :class:`ServingConfig`, a plain dict (the JSON ``serving`` block),
+    or None for defaults.
+    """
+
+    def __init__(self, model=None, params=None, engine=None, config=None,
+                 mesh=None, compile_cache=None, **engine_kwargs):
+        from .engine import InferenceEngine
+        self._owns_engine = engine is None
+        if engine is None:
+            engine = InferenceEngine(model=model, params=params, mesh=mesh,
+                                     compile_cache=compile_cache,
+                                     **engine_kwargs)
+        self.engine = engine
+        if config is None:
+            config = ServingConfig()
+        elif isinstance(config, dict):
+            config = ServingConfig.from_dict(config)
+        self.config = config
+        assert config.kv_bits in (8, 16)
+        assert config.batch_slots >= 1 and config.block_size >= 1
+
+        # quantized-weight routing: the SAME helper InferenceEngine
+        # .generate uses (models whose decode consumes int8 leaves
+        # directly get raw params; otherwise dequantize once per jitted
+        # call) — one implementation, no drift between the paths
+        from ..module_inject.module_quantize import resolve_decode_params
+        inner, self._deq = resolve_decode_params(engine.module)
+        assert getattr(inner, "supports_paged_decode", False), \
+            f"{type(inner).__name__} has no paged decode path"
+        self.model = inner
+        mc = inner.config
+        self.max_seq = mc.max_seq
+        self.nb_max = pk.blocks_needed(mc.max_seq, config.block_size)
+        self.num_blocks = config.num_blocks or (
+            1 + config.batch_slots * self.nb_max)
+        assert self.num_blocks >= 2, "num_blocks must be >= 2"
+
+        cache_dtype = getattr(inner, "dtype", jnp.bfloat16)
+        with jax.set_mesh(engine.mesh):
+            self.pool = pk.init_pool(
+                mc.n_layer, self.num_blocks, config.block_size, mc.n_head,
+                mc.head_dim, cache_dtype, kv_bits=config.kv_bits,
+                quant_block=config.kv_quant_block)
+        self.allocator = pk.BlockAllocator(self.num_blocks)
+
+        S = config.batch_slots
+        self._slots: List[Optional[_Slot]] = [None] * S
+        self._tables = np.zeros((S, self.nb_max), np.int32)
+        self._lengths = np.zeros((S,), np.int32)
+        self._toks = np.zeros((S,), np.int32)
+        self._seeds = np.zeros((S,), np.int32)
+        self._ngen = np.zeros((S,), np.int32)
+        self._temps = np.ones((S,), np.float32)
+        self._flags = np.zeros((S,), bool)
+
+        self.queue: deque = deque()
+        # uid → record; completed records stay until the caller
+        # pop_result()s them.  The latency aggregates live in BOUNDED
+        # deques + counters so a long-running server's stats() stays
+        # O(1)-ish even if the caller drains results promptly.
+        self.results: Dict[int, dict] = {}
+        self._lat_ms: deque = deque(maxlen=4096)
+        self._ttft_ms: deque = deque(maxlen=4096)
+        self._completed_total = 0
+        self._generated_total = 0
+        self._next_uid = 0
+        self._steps = 0
+        self._decode = None
+        self._prefills = {}       # bucket length → CachedStep
+        self._preflight_done = False
+        log_dist(
+            f"ServingEngine ready: slots={S} block_size={config.block_size} "
+            f"blocks={self.num_blocks} (nb_max={self.nb_max}) "
+            f"kv_bits={config.kv_bits} "
+            f"pool={pk.pool_bytes(self.pool) / 1e6:.1f} MB", ranks=[0])
+
+    # ------------------------------------------------------------- capacity
+    def capacity(self) -> dict:
+        """The admission math (docs/serving.md): pool size, per-request
+        block cost at the default generation length, concurrent-request
+        bound."""
+        c = self.config
+        per_req = pk.blocks_needed(
+            min(self.max_seq, c.block_size + c.max_new_tokens), c.block_size)
+        return {
+            "batch_slots": c.batch_slots,
+            "block_size": c.block_size,
+            "num_blocks": self.num_blocks,
+            "allocatable_blocks": self.num_blocks - 1,
+            "capacity_tokens": pk.capacity_tokens(self.pool),
+            "pool_bytes": pk.pool_bytes(self.pool),
+            "kv_bits": c.kv_bits,
+            "blocks_per_request_at_defaults": per_req,
+            "free_blocks": self.allocator.free_blocks,
+        }
+
+    # ------------------------------------------------------------ preflight
+    def preflight_memory(self) -> Optional[dict]:
+        """Peak-HBM estimate of the serving executables via
+        ``memory_analysis()``, BEFORE anything executes — same protocol
+        as ``DeepSpeedEngine.preflight_memory``.  Covers the decode step
+        (the hot loop; its detail is the flat keys) AND the largest
+        prefill bucket — a near-max_seq prompt arriving mid-traffic must
+        not be the first time that executable's peak is discovered.
+        ``peak_bytes`` is the max of the two.  None when the backend
+        exposes no analysis."""
+        self._build_decode()
+        c = self.config
+        bucket = self.nb_max * c.block_size
+        pf = self._prefill_fn(bucket)
+        toks = jnp.zeros((1, min(bucket, self.max_seq)), jnp.int32)
+        blocks = jnp.zeros((bucket // c.block_size,), jnp.int32)
+        with jax.set_mesh(self.engine.mesh):
+            dec_exe = self._decode.executable(*self._decode_args())
+            pre_exe = pf.executable(
+                self.engine.params, toks, self.pool, blocks, jnp.int32(1),
+                jnp.int32(0), jnp.float32(1.0), jnp.asarray(False))
+        dec = _mem_analysis(dec_exe)
+        if dec is None:
+            return None
+        out = dict(dec)
+        pre = _mem_analysis(pre_exe)
+        if pre is not None:
+            out["prefill_max_bucket_peak_bytes"] = pre["peak_bytes"]
+            out["peak_bytes"] = max(dec["peak_bytes"], pre["peak_bytes"])
+        return out
+
+    def _budget_bytes(self) -> Optional[int]:
+        if self.config.hbm_budget_bytes is not None:
+            return int(self.config.hbm_budget_bytes)
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            if stats.get("bytes_limit"):
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+        return None
+
+    def _preflight_gate(self):
+        """Refuse to serve a configuration whose decode step cannot fit
+        the chip (admission control's outer gate; the inner gate is the
+        per-request block math).  ``_preflight_done`` is only set on a
+        PASS — a caller catching the MemoryError and calling ``step()``
+        again re-runs the gate (and re-raises) instead of serving the
+        configuration the preflight just rejected."""
+        if not self.config.preflight:
+            self._preflight_done = True
+            return
+        budget = self._budget_bytes()
+        if budget is None:       # no budget, nothing to gate on — and no
+            self._preflight_done = True       # point compiling the max-
+            return                            # bucket prefill eagerly
+        pre = self.preflight_memory()
+        if pre is None:
+            self._preflight_done = True
+            return
+        if pre["peak_bytes"] > budget * self.config.preflight_safety:
+            raise MemoryError(
+                f"serving preflight: decode step peak "
+                f"{pre['peak_bytes'] / 1e9:.2f} GB exceeds "
+                f"{self.config.preflight_safety:.0%} of the "
+                f"{budget / 1e9:.2f} GB budget — shrink num_blocks/"
+                "batch_slots, use kv_bits=8, or quantize the weights "
+                "(docs/serving.md capacity math)")
+        self._preflight_done = True
+
+    # ------------------------------------------------------------ submission
+    def submit(self, req: Request) -> int:
+        """Queue a request; returns its uid.  Rejects prompts whose
+        worst-case length cannot fit ``max_seq`` or the pool."""
+        toks = np.asarray(req.tokens, np.int32).reshape(-1)
+        if toks.size == 0:
+            raise ValueError("empty prompt")
+        new = (self.config.max_new_tokens if req.max_new_tokens is None
+               else int(req.max_new_tokens))
+        if new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {new}")
+        total = toks.size + new
+        if total > self.max_seq:
+            raise ValueError(
+                f"prompt {toks.size} + max_new_tokens {new} = {total} "
+                f"exceeds max_seq {self.max_seq}")
+        nb = pk.blocks_needed(total, self.config.block_size)
+        if nb > self.num_blocks - 1:
+            raise ValueError(
+                f"request needs {nb} blocks; the pool only has "
+                f"{self.num_blocks - 1} allocatable")
+        if len(self.queue) >= self.config.max_queue:
+            raise RuntimeError(f"queue full ({self.config.max_queue})")
+        # mutate in place: the caller's handle keeps the uid submit
+        # assigns and the resolved generation length
+        req.tokens = toks
+        req.max_new_tokens = new
+        if req.uid is None:
+            req.uid = self._next_uid
+        elif req.uid in self.results:
+            raise ValueError(
+                f"uid {req.uid} already submitted — a duplicate would "
+                "corrupt that request's result record")
+        self._next_uid = max(self._next_uid, req.uid) + 1
+        self.results[req.uid] = {"tokens": None, "t_submit": time.monotonic(),
+                                 "t_first": None, "t_done": None,
+                                 "prompt_len": int(toks.size)}
+        self.queue.append(req)
+        return req.uid
+
+    # ---------------------------------------------------------- jitted steps
+    def _decode_args(self):
+        return (self.engine.params, self.pool, jnp.asarray(self._tables),
+                jnp.asarray(self._lengths), jnp.asarray(self._toks),
+                jnp.asarray(self._seeds), jnp.asarray(self._ngen),
+                jnp.asarray(self._temps), jnp.asarray(self._flags))
+
+    def _sample_tokens(self, logits, seeds, ngen, temps, flags):
+        """(B, V) fp32 → (B,) int32: per-slot greedy/sampled select with
+        the request-deterministic key stream (module docstring)."""
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits / jnp.maximum(temps, 1e-6)[:, None]
+        if self.config.top_k is not None:
+            kth = jax.lax.top_k(lg, self.config.top_k)[0][:, -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        keys = jax.vmap(lambda s, n: jax.random.fold_in(
+            jax.random.PRNGKey(s), n))(seeds, ngen)
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row))(keys, lg)
+        return jnp.where(flags, sampled.astype(jnp.int32), greedy)
+
+    def _build_decode(self):
+        if self._decode is not None:
+            return
+        deq = self._deq
+
+        def step(params, pool, tables, lengths, toks, seeds, ngen, temps,
+                 flags):
+            logits, pool = self.model.decode_step_paged(
+                deq(params), toks, pool, tables, lengths)
+            nxt = self._sample_tokens(logits, seeds, ngen, temps, flags)
+            return nxt, pool
+
+        c = self.config
+        self._decode = self.engine._wrap_step(
+            f"serving.decode[{c.batch_slots}x{self.nb_max}"
+            f"x{c.block_size},kv{c.kv_bits},{c.top_k}]",
+            step, donate_argnums=(1,))
+
+    def _prefill_fn(self, bucket: int):
+        """Jitted prefill for prompts padded to ``bucket`` tokens: runs
+        the model's contiguous cached forward on ONE sequence, scatters
+        its K/V into the slot's first blocks, and returns the real last
+        token's logits.  One executable per bucket (buckets are
+        block-size multiples, so their count is bounded by nb_max).
+
+        The FORWARD runs at ``min(bucket, max_seq)`` tokens — a bucket
+        rounded past ``max_seq`` (max_seq not a block multiple) would
+        trip ``init_cache``'s position-table guard — and the extracted
+        K/V rows zero-pad up to the bucket for the block scatter (pad
+        rows sit beyond the slot's length: masked, then overwritten by
+        decode writes).  The FIRST generated token samples inside this
+        executable (same ``_sample_tokens`` stream as the decode step)
+        — an eager per-request sampling tail would sit directly on the
+        time-to-first-token metric."""
+        fn = self._prefills.get(bucket)
+        if fn is not None:
+            return fn
+        deq = self._deq
+        model = self.model
+        fwd_len = min(bucket, self.max_seq)
+
+        def prefill(params, toks, pool, blocks, t_real, seed, temp, flag):
+            cache = model.init_cache(1, fwd_len)
+            logits, cache = model.apply_with_cache(deq(params), toks, cache)
+            # both cache layouts expose (L, T, H, hd) at B=1
+            if cache["k"].shape[1] == 1:          # legacy (L, B, S, H, hd)
+                k, v = cache["k"][:, 0], cache["v"][:, 0]
+            else:                                  # seq-major (L, S, B, ...)
+                k, v = cache["k"][:, :, 0], cache["v"][:, :, 0]
+            if fwd_len < bucket:
+                pad = ((0, 0), (0, bucket - fwd_len), (0, 0), (0, 0))
+                k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+            pool = pk.write_prefill(pool, blocks, k, v)
+            first = self._sample_tokens(
+                logits[0, t_real - 1][None], seed[None],
+                jnp.zeros((1,), jnp.int32), temp[None], flag[None])
+            return first[0], pool
+
+        fn = self.engine._wrap_step(
+            f"serving.prefill[{bucket},kv{self.config.kv_bits}]", prefill,
+            donate_argnums=(2,))
+        self._prefills[bucket] = fn
+        return fn
+
+    # ------------------------------------------------------------- scheduler
+    def _admit(self):
+        """Move queue-head requests into free slots while capacity lasts
+        (strict FIFO: a blocked head waits for blocks rather than being
+        overtaken — no starvation)."""
+        c = self.config
+        while self.queue:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if not free:
+                return
+            req: Request = self.queue[0]
+            new = req.max_new_tokens       # resolved >= 1 by submit()
+            nb = pk.blocks_needed(len(req.tokens) + new, c.block_size)
+            blocks = self.allocator.alloc(nb)
+            if blocks is None:
+                return
+            self.queue.popleft()
+            self._start(free[0], req, blocks, new)
+
+    def _start(self, slot: int, req: Request, blocks: List[int], new: int):
+        c = self.config
+        T = int(len(req.tokens))
+        bucket = pk.blocks_needed(T, c.block_size) * c.block_size
+        toks = np.zeros((1, min(bucket, self.max_seq)), np.int32)
+        toks[0, :T] = req.tokens
+        nb_pre = bucket // c.block_size
+        blk = jnp.asarray(np.asarray(blocks[:nb_pre], np.int32))
+        fn = self._prefill_fn(bucket)
+        with jax.set_mesh(self.engine.mesh):
+            first, self.pool = fn(
+                self.engine.params, jnp.asarray(toks), self.pool, blk,
+                jnp.int32(T), jnp.int32(req.seed),
+                jnp.float32(req.temperature), jnp.asarray(req.do_sample))
+        first = int(np.asarray(first))
+
+        s = _Slot(req, blocks, T, new)
+        s.out_tokens.append(first)
+        self._slots[slot] = s
+        self._tables[slot] = 0
+        self._tables[slot, :len(blocks)] = blocks
+        self._lengths[slot] = T
+        self._toks[slot] = first
+        self._seeds[slot] = req.seed
+        self._ngen[slot] = 1
+        self._temps[slot] = req.temperature
+        self._flags[slot] = req.do_sample
+        rec = self.results[req.uid]
+        rec["t_first"] = time.monotonic()
+        if new == 1 or first == c.eos_token_id:
+            self._finish(slot)
+
+    def _finish(self, slot: int):
+        s = self._slots[slot]
+        self.allocator.free(s.blocks)
+        rec = self.results[s.req.uid]
+        rec["tokens"] = list(s.out_tokens)
+        rec["t_done"] = time.monotonic()
+        self._completed_total += 1
+        self._generated_total += len(s.out_tokens)
+        self._lat_ms.append((rec["t_done"] - rec["t_submit"]) * 1e3)
+        if rec["t_first"] is not None:
+            self._ttft_ms.append((rec["t_first"] - rec["t_submit"]) * 1e3)
+        self._slots[slot] = None
+        self._tables[slot] = 0
+        self._lengths[slot] = 0
+        self._toks[slot] = 0
+        self._seeds[slot] = 0
+        self._ngen[slot] = 0
+        self._temps[slot] = 1.0
+        self._flags[slot] = False
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit from the queue, ONE fused
+        decode dispatch for the whole batch, sample, join/evict.
+        Returns False when there is nothing left to do."""
+        if not self._preflight_done:
+            self._preflight_gate()
+        self._admit()
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return bool(self.queue)
+        self._build_decode()
+        with jax.set_mesh(self.engine.mesh):
+            nxt, self.pool = self._decode(*self._decode_args())
+        nxt = np.asarray(nxt)
+        self._steps += 1
+        c = self.config
+        for i in active:
+            s = self._slots[i]
+            tok = int(nxt[i])
+            s.out_tokens.append(tok)
+            self._lengths[i] += 1
+            self._toks[i] = tok
+            self._ngen[i] += 1
+            if len(s.out_tokens) >= s.max_new or tok == c.eos_token_id:
+                self._finish(i)
+        return True
+
+    def run(self, requests=None, max_steps: int = 10 ** 6) -> Dict[int, dict]:
+        """Submit ``requests`` (if given) and drive :meth:`step` until
+        the queue drains and every slot completes.  Returns
+        ``self.results`` (uid → tokens + stamps)."""
+        for r in requests or ():
+            self.submit(r)
+        steps = 0
+        while self.step():
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"serving run exceeded {max_steps} steps")
+        return self.results
+
+    # ------------------------------------------------------------- reporting
+    def pop_result(self, uid: int) -> dict:
+        """Take ownership of a completed request's record (tokens +
+        stamps) and drop it from ``results`` — the drain API a
+        long-running server uses so records don't accumulate.  The
+        latency aggregates behind :meth:`stats` are kept separately and
+        survive the pop.  Raises KeyError for an unknown uid,
+        RuntimeError for one still in flight."""
+        rec = self.results[uid]
+        if rec["t_done"] is None:
+            raise RuntimeError(f"request {uid} is still in flight")
+        return self.results.pop(uid)
+
+    def reset_stats(self):
+        """Zero the latency/throughput aggregates and drop completed
+        records; in-flight requests are untouched (bench warmup
+        hygiene)."""
+        for uid in [u for u, r in self.results.items()
+                    if r["t_done"] is not None]:
+            del self.results[uid]
+        self._lat_ms.clear()
+        self._ttft_ms.clear()
+        self._completed_total = 0
+        self._generated_total = 0
+        self._steps = 0
+
+    def stats(self) -> dict:
+        """Latency/throughput summary over completed requests: p50/p99
+        submit→done and submit→first-token (ms), generated tokens.
+        Percentiles cover the last ≤4096 completions (bounded window);
+        the counts are totals since the last :meth:`reset_stats`."""
+        out = {"completed": self._completed_total,
+               "pending": len(self.queue) + sum(
+                   s is not None for s in self._slots),
+               "decode_steps": self._steps,
+               "generated_tokens": self._generated_total}
+        if self._lat_ms:
+            lat = np.asarray(self._lat_ms)
+            out["latency_ms"] = {
+                "p50": round(float(np.percentile(lat, 50)), 2),
+                "p99": round(float(np.percentile(lat, 99)), 2),
+                "max": round(float(lat.max()), 2)}
+        if self._ttft_ms:
+            ttft = np.asarray(self._ttft_ms)
+            out["ttft_ms"] = {
+                "p50": round(float(np.percentile(ttft, 50)), 2),
+                "p99": round(float(np.percentile(ttft, 99)), 2)}
+        return out
+
+    def compile_report(self):
+        return self.engine.compile_report()
+
+    def close(self):
+        """Drop live executables and the pool (bench hygiene — the same
+        contract as ``DeepSpeedEngine.close``).  An engine the CALLER
+        passed in (``engine=``) stays usable — only an internally built
+        one is torn down."""
+        for fn in [self._decode] + list(self._prefills.values()):
+            if fn is not None and hasattr(fn, "clear"):
+                fn.clear()
+        self._decode = None
+        self._prefills.clear()
+        self.pool = None
+        if self._owns_engine:
+            self.engine.close()

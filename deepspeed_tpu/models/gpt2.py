@@ -65,6 +65,25 @@ class GPT2Config:
     unroll_layers: bool = False
     # attention implementation: "auto" picks pallas flash on TPU, jnp elsewhere
     attention_impl: str = "auto"
+    # KV-cache decode path:
+    #   "fused"       — ONE lax.scan over the stacked layer weights per
+    #                   forward, seq-major (L, S, B, H, hd) cache carried
+    #                   in place.  The token step is a single executable
+    #                   (2 dispatches per generate(): prefill + token
+    #                   scan) instead of 4·L+1 separately scheduled small
+    #                   matmuls — the b=8 scheduling-gap term
+    #                   DECODE_PROFILE.json attributed (49 matmuls at
+    #                   0.68 of the weight-byte bound).  int8 weight
+    #                   payloads slice per layer INSIDE the scan, so
+    #                   quantized decode is also one fused launch.
+    #   "unroll"      — static per-layer loop over the same seq-major
+    #                   stacked cache (the pre-fusion fast path; kept for
+    #                   A/B measurement)
+    #   "legacy_scan" — per-layer batch-major (L, B, S, H, hd) cache
+    #                   restacked each call (the original scan path; a
+    #                   full cache copy per decoded token)
+    #   "auto"        — "fused"
+    decode_impl: str = "auto"
     # GPT-Neo compatibility knobs (HFGPTNEOLayerPolicy): no score scaling and
     # a local attention window on alternating (odd) layers
     scale_attn: bool = True
@@ -430,6 +449,16 @@ class GPT2:
         return logits
 
     # ------------------------------------------------------- KV-cache decode
+    def decode_impl(self) -> str:
+        """Resolve ``config.decode_impl`` ("auto" → "fused")."""
+        impl = self.config.decode_impl
+        if impl == "auto":
+            impl = "fused"
+        assert impl in ("fused", "unroll", "legacy_scan"), (
+            f"decode_impl must be auto|fused|unroll|legacy_scan, got "
+            f"{impl!r}")
+        return impl
+
     def init_cache(self, batch_size: int, max_len: Optional[int] = None,
                    dtype=None):
         """Empty KV cache pytree: k/v stacked over layers
@@ -443,7 +472,7 @@ class GPT2:
             f"init_cache max_len={max_len} exceeds config.max_seq="
             f"{c.max_seq}; raise max_seq when building the model")
         dtype = dtype or self.dtype
-        if c.unroll_layers:
+        if self.decode_impl() in ("fused", "unroll"):
             # SEQ-MAJOR stacked cache (L, S, B, H, hd): the per-token
             # update writes ONE contiguous (B, H, hd) block per layer —
             # batch-major (L, B, S, ...) scatters B strided 1.5 KB rows
@@ -480,21 +509,32 @@ class GPT2:
         return (q.reshape(B, T, H, hd), k.reshape(B, T, H, hd),
                 v.reshape(B, T, H, hd))
 
-    def _attend_cached(self, q, cache_k, cache_v, index, is_local=None,
-                       seq_major=False):
-        """Masked softmax attention of ``q`` over a cache view — the
-        shared scoring core for both cache layouts, so scale_attn /
-        local-window semantics cannot drift between decode paths.
-        ``seq_major``: cache is (S, B, H, hd) (stacked decode path)
-        instead of (B, S, H, hd)."""
+    def _masked_attend(self, q, keys, vals, valid, seq_major=False):
+        """The decode attention core shared by EVERY cache layout
+        (contiguous batch-major, contiguous seq-major, paged): fp32
+        scores, scale_attn, mask, softmax, AV.  ``valid`` must broadcast
+        to (B, H, T, S); keeping this in one place is what stops the
+        scoring semantics drifting between decode paths."""
         c = self.config
         B, T = q.shape[0], q.shape[1]
-        S = cache_k.shape[0] if seq_major else cache_k.shape[1]
         k_eq = "kbhd" if seq_major else "bkhd"
-        scores = jnp.einsum(f"bqhd,{k_eq}->bhqk", q,
-                            cache_k).astype(jnp.float32)
+        scores = jnp.einsum(f"bqhd,{k_eq}->bhqk", q, keys).astype(jnp.float32)
         if c.scale_attn:
             scores = scores / np.sqrt(c.head_dim)
+        scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum(f"bhqk,{k_eq}->bqhd", probs, vals).reshape(
+            B, T, q.shape[2] * q.shape[3])
+
+    def _attend_cached(self, q, cache_k, cache_v, index, is_local=None,
+                       seq_major=False):
+        """Contiguous-cache attention (both layouts): builds the causal/
+        local-window mask from the scalar write ``index`` and defers to
+        :meth:`_masked_attend`.  ``seq_major``: cache is (S, B, H, hd)
+        (stacked decode path) instead of (B, S, H, hd)."""
+        c = self.config
+        T = q.shape[1]
+        S = cache_k.shape[0] if seq_major else cache_k.shape[1]
         q_pos = index + jnp.arange(T)[:, None]          # (T, 1)
         k_pos = jnp.arange(S)[None, :]                  # (1, S)
         valid = k_pos <= q_pos                          # causal within cache
@@ -502,10 +542,18 @@ class GPT2:
             # GPT-Neo local layers: same sliding window as apply()
             local = valid & (k_pos > q_pos - c.local_attn_window)
             valid = jnp.where(is_local, local, valid)
-        scores = jnp.where(valid[None, None], scores, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        return jnp.einsum(f"bhqk,{k_eq}->bqhd", probs, cache_v).reshape(
-            B, T, q.shape[2] * q.shape[3])
+        return self._masked_attend(q, cache_k, cache_v, valid[None, None],
+                                   seq_major=seq_major)
+
+    def _ffn(self, p, x):
+        """The decode MLP half-block (LN2 → fc → gelu → fc_proj +
+        residual), shared by every decode path — int8-aware via
+        ``_mm``."""
+        c = self.config
+        h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
+        h = self._mm(h, p["fc_w"], p["fc_b"])
+        h = jax.nn.gelu(h, approximate=True)
+        return x + self._mm(h, p["fc_proj_w"], p["fc_proj_b"])
 
     def _cached_attention(self, p, h, cache_k, cache_v, index, is_local=None):
         """Per-layer-cache variant (scan decode path; also GPT2MoE).
@@ -548,13 +596,7 @@ class GPT2:
         attn = self._attend_cached(q, ck_all[layer], cv_all[layer], index,
                                    is_local, seq_major=True)
         attn = self._mm(attn, p["proj_w"], p["proj_b"])
-        x = x + attn
-
-        h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
-        h = self._mm(h, p["fc_w"], p["fc_b"])
-        h = jax.nn.gelu(h, approximate=True)
-        h = self._mm(h, p["fc_proj_w"], p["fc_proj_b"])
-        return x + h, ck_all, cv_all
+        return self._ffn(p, x + attn), ck_all, cv_all
 
     def _block_with_cache(self, x, layer_params, cache_k, cache_v, index,
                           is_local=None):
@@ -568,13 +610,7 @@ class GPT2:
         h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"], c.layer_norm_eps)
         attn, cache_k, cache_v = self._cached_attention(
             p, h, cache_k, cache_v, index, is_local)
-        x = x + attn
-
-        h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"], c.layer_norm_eps)
-        h = self._mm(h, p["fc_w"], p["fc_b"])
-        h = jax.nn.gelu(h, approximate=True)
-        h = self._mm(h, p["fc_proj_w"], p["fc_proj_b"])
-        return x + h, cache_k, cache_v
+        return self._ffn(p, x + attn), cache_k, cache_v
 
     def apply_with_cache(self, params, tokens, cache):
         """Forward ``tokens: (B, T)`` starting at ``cache['index']``.
@@ -594,8 +630,32 @@ class GPT2:
             q_gather(params["wpe"], pos, dtype)
 
         local_flags = jnp.arange(c.n_layer) % 2 == 1
+        impl = self.decode_impl()
 
-        if c.unroll_layers:
+        if impl == "fused":
+            # ONE lax.scan over the stacked layer weights: the whole
+            # layer stack is a single fused executable (an XLA while
+            # loop) — no scheduling gaps between 4·L separately
+            # dispatched small matmuls, the b=8 decode term
+            # DECODE_PROFILE.json isolated.  The seq-major stacked cache
+            # rides the carry (donated at the jit boundary → in-place);
+            # weights are scan xs, so each iteration dynamic-slices ONE
+            # layer's stack — including int8 {"q","scale"} payloads,
+            # whose per-layer slices stream int8 through q_matmul inside
+            # the same launch (the fix for the 49-pallas_call-per-token
+            # int8 route, ops/transformer/int8_matmul.py).
+            def fused_body(carry, xs):
+                h, ck, cv, layer = carry
+                lp, is_local = xs
+                h, ck, cv = self._block_with_cache_stacked(
+                    h, lp, ck, cv, layer, index, is_local)
+                return (h, ck, cv, layer + 1), None
+
+            (x, new_k, new_v, _), _ = jax.lax.scan(
+                fused_body,
+                (x, cache["k"], cache["v"], jnp.zeros((), jnp.int32)),
+                (params["blocks"], local_flags))
+        elif impl == "unroll":
             # static layer indices AND an in-place threaded cache: the
             # stacked (L,B,S,H,hd) arrays flow through every layer's
             # dynamic_update_slice, so a donated cache updates in place —
@@ -629,6 +689,70 @@ class GPT2:
                           out_dtype=jnp.float32)
         new_cache = {"k": new_k, "v": new_v, "index": index + T}
         return logits, new_cache
+
+    # ---------------------------------------------------- paged-KV decode
+    # the serving layer's decode path (inference/serving.py): per-slot
+    # block lists into a shared pool instead of one contiguous cache
+    supports_paged_decode = True
+
+    def _attend_paged(self, q, keys, vals, lengths):
+        """Per-slot masked attention of one query token over gathered
+        pool blocks — builds the paged mask and defers to the shared
+        :meth:`_masked_attend` core.  ``q``: (B, 1, H, hd);
+        ``keys``/``vals``: (B, S, H, hd) gathered block content
+        (S = nb_max·block_size); ``lengths``: (B,) int32 position of the
+        CURRENT token (its K/V already written), so ``k_pos <= lengths``
+        is the causal mask and everything past it — pad tail, scratch
+        blocks, stale block content — masks out."""
+        valid = jnp.arange(keys.shape[1])[None, :] <= lengths[:, None]
+        return self._masked_attend(q, keys, vals, valid[:, None, None, :])
+
+    def decode_step_paged(self, params, toks, pool, block_tables, lengths):
+        """One decode token for B slots over a paged/block KV pool.
+
+        ``toks``: (B,) int32 current input token per slot; ``lengths``:
+        (B,) int32 tokens already cached per slot (== the new token's
+        position); ``block_tables``: (B, nb_max) int32 pool block ids
+        (unused entries point at the reserved scratch block 0).  Returns
+        ``(logits (B, V) fp32, new_pool)``.
+
+        Same fused shape as ``decode_impl="fused"``: one ``lax.scan``
+        over the stacked layer weights, the pool carried in place, int8
+        weight payloads sliced per layer inside the scan.  Inactive
+        slots decode garbage into scratch block 0 — the scheduler
+        discards their outputs (fixed shapes keep ONE executable per
+        (batch_slots, nb_max) config; see inference/serving.py).
+        """
+        from ..inference import paged_kv as pk
+        from ..module_inject.module_quantize import q_gather, q_matmul
+        c = self.config
+        assert c.local_attn_window is None, \
+            "paged decode supports standard causal attention only"
+        pos = jnp.minimum(lengths, c.max_seq - 1)
+        x = q_gather(params["wte"], toks, self.dtype) + \
+            q_gather(params["wpe"], pos, self.dtype)
+        x = x[:, None, :]                               # (B, 1, D)
+
+        def body(carry, lp):
+            h, pool, layer = carry
+            hn = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"],
+                             c.layer_norm_eps)
+            q, k, v = self._qkv(lp, hn)                 # (B, 1, H, hd)
+            pool = pk.write_token(pool, layer, block_tables, lengths,
+                                  k[:, 0], v[:, 0])
+            keys, vals = pk.gather_kv(pool, layer, block_tables,
+                                      self.dtype)
+            attn = self._attend_paged(q, keys, vals, lengths)
+            attn = self._mm(attn, lp["proj_w"], lp["proj_b"])
+            return (self._ffn(lp, h + attn), pool, layer + 1), None
+
+        (x, pool, _), _ = jax.lax.scan(
+            body, (x, pool, jnp.zeros((), jnp.int32)), params["blocks"])
+        x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                        c.layer_norm_eps)
+        logits = q_matmul(x[:, 0], params["wte"], w_transposed=True,
+                          out_dtype=jnp.float32)
+        return logits, pool
 
     # ------------------------------------------------------------------ loss
     def loss(self, params, batch, rng):

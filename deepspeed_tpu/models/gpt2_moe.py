@@ -255,7 +255,13 @@ class GPT2MoE:
     # expert weights directly (no q_matmul routing yet), so int8 MoE
     # decode takes the hoisted-dequant route in the inference engine
     supports_quantized_decode = False
+    # NOT paged-decode-capable either: GPT2.decode_step_paged scans the
+    # DENSE block stack; the alternating MoE blocks need their own paged
+    # step before ServingEngine can host this family (serving.py asserts
+    # on this flag instead of mis-running the dense math)
+    supports_paged_decode = False
     _qkv = GPT2._qkv
+    _masked_attend = GPT2._masked_attend
     _attend_cached = GPT2._attend_cached
     _cached_attention = GPT2._cached_attention
 

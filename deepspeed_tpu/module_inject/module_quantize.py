@@ -177,6 +177,22 @@ class QuantizedModel:
             dequantize_tree(params, self._dtype), *a, **kw)
 
 
+def resolve_decode_params(module):
+    """``(inner_model, deq)`` routing for cached/paged decode, shared by
+    ``InferenceEngine.generate`` and ``ServingEngine`` so the two paths
+    cannot drift: a :class:`QuantizedModel` whose inner model consumes
+    int8 leaves directly (``supports_quantized_decode`` — weights stream
+    int8 from HBM through the decode matmuls) gets the params UNTOUCHED;
+    otherwise the params dequantize ONCE per jitted call via ``deq``
+    (outside any token scan); plain models pass through."""
+    if isinstance(module, QuantizedModel):
+        inner = module._model
+        if getattr(inner, "supports_quantized_decode", False):
+            return inner, lambda p: p
+        return inner, lambda p, _d=module._dtype: dequantize_tree(p, _d)
+    return module, lambda p: p
+
+
 def quantize_transformer_layer(model, params, megatron=False, preln=False,
                                bits: int = 8, groups: int = 1):
     """Reference-named entry (``module_quantize.py:quantize_transformer_layer``):

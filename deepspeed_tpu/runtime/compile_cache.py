@@ -552,6 +552,37 @@ class CachedStep:
         cache.put(key, payload, meta=material)
 
 
+def executable_memory_analysis(exe):
+    """One shared reading of an executable's ``memory_analysis()`` for
+    every preflight gate (train engine, serving, bench): byte-count dict
+    with ``peak_bytes`` approximating execution-time live memory
+    (arguments + outputs − donated aliases + temps + program), or None
+    when the backend exposes no analysis.  Backend quirks (list-wrapped
+    results, missing fields) are handled HERE so the gates cannot
+    drift."""
+    try:
+        ma = exe.memory_analysis()
+    except Exception as e:
+        logger.warning(f"memory preflight unavailable: {e}")
+        return None
+    if isinstance(ma, (list, tuple)):
+        ma = ma[0] if ma else None
+    if ma is None:
+        return None
+    g = lambda k: int(getattr(ma, k, 0) or 0)
+    out = {
+        "argument_bytes": g("argument_size_in_bytes"),
+        "output_bytes": g("output_size_in_bytes"),
+        "temp_bytes": g("temp_size_in_bytes"),
+        "alias_bytes": g("alias_size_in_bytes"),
+        "generated_code_bytes": g("generated_code_size_in_bytes"),
+    }
+    out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                         - out["alias_bytes"] + out["temp_bytes"]
+                         + out["generated_code_bytes"])
+    return out
+
+
 def wrap_step(name, fn, cache=None, key_extra=None, donate_argnums=()):
     """jit + CachedStep in one place — the factory every engine's
     ``_wrap_step`` delegates to, so dispatch-policy changes land once."""

@@ -727,27 +727,8 @@ class DeepSpeedEngine:
               else self._jit_train_step)
         with jax.set_mesh(self.mesh):
             exe = fn.executable(self.state, batch, rng)
-        try:
-            ma = exe.memory_analysis()
-        except Exception as e:
-            logger.warning(f"memory preflight unavailable: {e}")
-            return None
-        if isinstance(ma, (list, tuple)):
-            ma = ma[0] if ma else None
-        if ma is None:
-            return None
-        g = lambda k: int(getattr(ma, k, 0) or 0)
-        out = {
-            "argument_bytes": g("argument_size_in_bytes"),
-            "output_bytes": g("output_size_in_bytes"),
-            "temp_bytes": g("temp_size_in_bytes"),
-            "alias_bytes": g("alias_size_in_bytes"),
-            "generated_code_bytes": g("generated_code_size_in_bytes"),
-        }
-        out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
-                             - out["alias_bytes"] + out["temp_bytes"]
-                             + out["generated_code_bytes"])
-        return out
+        from .compile_cache import executable_memory_analysis
+        return executable_memory_analysis(exe)
 
     def close(self):
         """Release device state, live compiled executables and staging

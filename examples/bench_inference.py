@@ -30,15 +30,25 @@ def main():
     ap.add_argument("--int8", action="store_true")
     ap.add_argument("--unroll", action="store_true",
                     help="unroll the layer loop (single-chip fast path)")
+    ap.add_argument("--decode-impl", default="auto",
+                    choices=["auto", "fused", "unroll", "legacy_scan"],
+                    help="KV-cache decode path (auto=fused: ONE lax.scan "
+                         "over the stacked layer weights per token — the "
+                         "DECODE_PROFILE scheduling-gap fix; 'unroll' is "
+                         "the pre-fusion 4·L-matmul path for A/B)")
     args = ap.parse_args()
 
     import jax.numpy as jnp
     from deepspeed_tpu.models import build
     from deepspeed_tpu.inference.engine import InferenceEngine
 
-    model = build(args.preset, dtype=jnp.bfloat16,
-                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
-                  unroll_layers=args.unroll)
+    kw = dict(dtype=jnp.bfloat16, embd_pdrop=0.0, attn_pdrop=0.0,
+              resid_pdrop=0.0, unroll_layers=args.unroll)
+    if args.decode_impl != "auto":
+        # decode_impl is a GPT2Config knob; forwarding it unconditionally
+        # would TypeError on gptj/gptneox presets (their configs lack it)
+        kw["decode_impl"] = args.decode_impl
+    model = build(args.preset, **kw)
     eng = InferenceEngine(model=model,
                           quantization_setting=1 if args.int8 else None)
     rng = np.random.default_rng(0)
@@ -84,6 +94,7 @@ def main():
     bound_tps = args.batch / bound_ms * 1000
     print(json.dumps({
         "preset": args.preset, "int8": bool(args.int8),
+        "decode_impl": args.decode_impl,
         "batch": args.batch, "prompt_len": args.prompt,
         "new_tokens": args.new,
         "prefill_ms": round(t_prefill * 1e3, 2),

@@ -14,14 +14,23 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(ROOT, "examples", "bench_inference.py")
 
 CONFIGS = {
+    # fused stacked-scan decode (the default since PR 6: ONE lax.scan
+    # over the stacked layer weights per token — the DECODE_PROFILE
+    # scheduling-gap fix); the *_unroll twins keep the pre-fusion path
+    # measured for the before/after record (docs/serving.md)
+    "gpt2_125m_b8_fused": ["--preset", "gpt2-125m", "--batch", "8"],
+    "gpt2_350m_b8_fused": ["--preset", "gpt2-350m", "--batch", "8"],
+    "gpt2_125m_b8_int8_fused": ["--preset", "gpt2-125m", "--batch", "8",
+                                "--int8"],
+    "gpt2_125m_b1_fused": ["--preset", "gpt2-125m", "--batch", "1"],
     "gpt2_125m_b8_unroll": ["--preset", "gpt2-125m", "--batch", "8",
-                            "--unroll"],
+                            "--unroll", "--decode-impl", "unroll"],
     "gpt2_350m_b8_unroll": ["--preset", "gpt2-350m", "--batch", "8",
-                            "--unroll"],
+                            "--unroll", "--decode-impl", "unroll"],
     "gpt2_125m_b8_int8": ["--preset", "gpt2-125m", "--batch", "8", "--int8",
-                          "--unroll"],
+                          "--unroll", "--decode-impl", "unroll"],
     "gpt2_125m_b1_unroll": ["--preset", "gpt2-125m", "--batch", "1",
-                            "--unroll"],
+                            "--unroll", "--decode-impl", "unroll"],
 }
 
 

@@ -107,7 +107,10 @@ def _run(cache_dir, steps=4, over=None, mesh_axes=None, seed=0):
     return losses, params, report
 
 
-@pytest.mark.parametrize("stage", [1, 2, 3])
+@pytest.mark.parametrize("stage", [
+    # z1 is the heaviest compile of the family; z2/z3 remain the
+    # fast-tier twins (conftest budget policy)
+    pytest.param(1, marks=pytest.mark.slow), 2, 3])
 def test_warm_start_bit_identical(tmp_path, devices, stage):
     """A warm-started engine dispatches the DESERIALIZED executable —
     losses and final params must equal the cold run bit for bit."""

@@ -142,6 +142,108 @@ def test_hf_injection_generate(devices):
     np.testing.assert_array_equal(out, ref)
 
 
+_DECODE_IMPL_BASE = dict(vocab_size=128, max_seq=64, n_embd=32, n_layer=2,
+                         n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                         resid_pdrop=0.0, attention_impl="jnp")
+
+
+def _decode_logits(model, params, toks):
+    cache = model.init_cache(2, 16)
+    lg, cache = model.apply_with_cache(params, toks[:, :6], cache)
+    outs = [lg]
+    for t in range(6, toks.shape[1]):
+        lg, cache = model.apply_with_cache(params, toks[:, t:t + 1], cache)
+        outs.append(lg)
+    return np.asarray(jnp.concatenate(outs, axis=1))
+
+
+def test_fused_decode_matches_unroll(devices):
+    """The fused stacked-scan decode (decode_impl="fused", the default)
+    must produce the same logits as the unrolled static-index path — the
+    fusion is a scheduling change, not a math change (DECODE_PROFILE's
+    b=8 scheduling-gap fix)."""
+    models = {impl: GPT2(GPT2Config(**_DECODE_IMPL_BASE, decode_impl=impl),
+                         dtype=jnp.float32) for impl in ("fused", "unroll")}
+    params = models["fused"].init(jax.random.PRNGKey(5))
+    toks = jnp.asarray(np.random.default_rng(3).integers(0, 128, (2, 8)),
+                       jnp.int32)
+    np.testing.assert_allclose(
+        _decode_logits(models["fused"], params, toks),
+        _decode_logits(models["unroll"], params, toks),
+        rtol=1e-6, atol=1e-6)
+    assert models["fused"].decode_impl() == "fused"
+    # the default IS fused
+    assert GPT2(GPT2Config(**_DECODE_IMPL_BASE),
+                dtype=jnp.float32).decode_impl() == "fused"
+
+
+@pytest.mark.slow   # the legacy twin of test_fused_decode_matches_unroll
+def test_fused_decode_matches_legacy_scan(devices):
+    models = {impl: GPT2(GPT2Config(**_DECODE_IMPL_BASE, decode_impl=impl),
+                         dtype=jnp.float32)
+              for impl in ("fused", "legacy_scan")}
+    params = models["fused"].init(jax.random.PRNGKey(5))
+    toks = jnp.asarray(np.random.default_rng(3).integers(0, 128, (2, 8)),
+                       jnp.int32)
+    np.testing.assert_allclose(
+        _decode_logits(models["fused"], params, toks),
+        _decode_logits(models["legacy_scan"], params, toks),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_int8_weights_in_fused_scan_match_dequant(devices):
+    """int8 weight payloads slice per layer INSIDE the fused decode scan
+    (one launch per step — the VERDICT r5 weak-#4 fix); logits must
+    track an explicit full-width dequantization of the same payloads
+    within the quantizer's error (identical int8 values, so the only
+    delta is accumulation order)."""
+    from deepspeed_tpu.module_inject.module_quantize import (
+        quantize_param_tree, dequantize_tree)
+    model = _tiny_model()
+    params = model.init(jax.random.PRNGKey(6))
+    qparams, _ = quantize_param_tree(params, bits=8, groups=1)
+    toks = jnp.asarray(np.random.default_rng(4).integers(0, 128, (2, 6)),
+                       jnp.int32)
+
+    cache = model.init_cache(2, 8)
+    lg_q, _ = model.apply_with_cache(qparams, toks, cache)
+
+    deq = dequantize_tree(qparams, jnp.float32)
+    cache = model.init_cache(2, 8)
+    lg_d, _ = model.apply_with_cache(deq, toks, cache)
+    np.testing.assert_allclose(np.asarray(lg_q), np.asarray(lg_d),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_decode_loop_lru_eviction(devices):
+    """The decode-executable cache evicts least-recently-USED (the old
+    dict popped FIFO insertion order, evicting hot configs while cold
+    ones idled); evicted configs re-enter through the compile cache."""
+    model = _tiny_model()
+    params = model.init(jax.random.PRNGKey(7))
+    eng = InferenceEngine(model, params=params)
+    eng._decode_loops_cap = 2
+    prompt = np.array([[1, 2]], np.int32)
+    # 1-token loops: distinct (steps, do_sample, top_k) keys, no scan
+    eng.generate(prompt, max_new_tokens=1)                    # key A
+    eng.generate(prompt, max_new_tokens=1, do_sample=True)    # key B
+    key_a = (1, False, None)
+    key_b = (1, True, None)
+    eng.generate(prompt, max_new_tokens=1)                    # touch A
+    eng.generate(prompt, max_new_tokens=1, do_sample=True,
+                 top_k=5)                                     # key C
+    keys = list(eng._decode_loops)
+    assert len(keys) == 2
+    assert key_a in keys, "recently-USED config was evicted (FIFO bug)"
+    assert key_b not in keys, "least-recently-used config survived"
+    # the evicted config still answers (fresh wrap; AOT warm start when
+    # the compile cache is on)
+    out = np.asarray(eng.generate(prompt, max_new_tokens=1, do_sample=True,
+                                  rng=jax.random.PRNGKey(1)))
+    assert out.shape == (1, 3)
+    eng.close()
+
+
 def test_init_cache_rejects_max_len_beyond_max_seq(devices):
     """Positions past max_seq would clamp into the last rotary/wpe row and
     decode silently wrong — init_cache must refuse instead."""

@@ -321,6 +321,8 @@ def test_moe_e2e_matches_data_parallel_only(devices):
     np.testing.assert_allclose(losses["dp"], losses["ep"], rtol=2e-4)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget
+                    # (conftest policy — moe e2e/dp-match twins stay fast)
 def test_moe_with_zero_stages(devices):
     """MoE composes with ZeRO sharding (reference ``test_moe.py`` zero-stage
     parametrization)."""

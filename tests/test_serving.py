@@ -1,0 +1,336 @@
+"""Serving-layer tests: paged KV cache + continuous batching
+(docs/serving.md).
+
+Oracles: ``InferenceEngine.generate`` (the sequential per-request path
+every serving answer must match token-for-token under greedy decoding)
+and the model's contiguous cached decode (logit-level equivalence for
+the paged cache)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
+                                     ServingConfig, Request)
+from deepspeed_tpu.inference import paged_kv as pk
+
+
+def _tiny_model(dtype=jnp.float32, **kw):
+    cfg = GPT2Config(vocab_size=128, max_seq=64, n_embd=32, n_layer=2,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp", **kw)
+    return GPT2(cfg, dtype=dtype)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = _tiny_model()
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+# ------------------------------------------------------------- allocator
+def test_block_allocator_alloc_free_reuse():
+    a = pk.BlockAllocator(6)              # ids 1..5 (0 = scratch)
+    assert a.free_blocks == 5
+    got = a.alloc(3)
+    assert len(got) == 3 and pk.SCRATCH_BLOCK not in got
+    assert a.alloc(3) is None             # all-or-nothing admission
+    b2 = a.alloc(2)
+    assert set(got).isdisjoint(b2)
+    assert a.free_blocks == 0
+    a.free(got)
+    assert a.free_blocks == 3
+    again = a.alloc(3)
+    assert set(again) == set(got)         # freed blocks recycle
+    with pytest.raises(AssertionError, match="double free"):
+        a.free([again[0], again[0]])
+
+
+def test_blocks_needed_math():
+    assert pk.blocks_needed(1, 8) == 1
+    assert pk.blocks_needed(8, 8) == 1
+    assert pk.blocks_needed(9, 8) == 2
+    assert pk.blocks_needed(0, 8) == 1    # a sequence occupies >= 1 block
+
+
+# ------------------------------------------- paged decode == contiguous
+def test_paged_decode_matches_contiguous_cache(tiny, devices):
+    """decode_step_paged over scattered pool blocks must produce the
+    SAME logits as the contiguous cached decode (the paged layout is a
+    storage change, not a math change)."""
+    model, params = tiny
+    rng = np.random.default_rng(0)
+    B, T, bs = 2, 8, 4
+    toks = jnp.asarray(rng.integers(0, 128, (B, T)), jnp.int32)
+
+    cache = model.init_cache(B, 32)
+    lg, cache = model.apply_with_cache(params, toks, cache)
+    ref = [lg[:, -1]]
+    cur = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+    for _ in range(1):
+        lg, cache = model.apply_with_cache(params, cur[:, None], cache)
+        ref.append(lg[:, -1])
+        cur = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+
+    c = model.config
+    pool = pk.init_pool(c.n_layer, 9, bs, c.n_head, c.head_dim, jnp.float32)
+    alloc = pk.BlockAllocator(9)
+    tables = np.zeros((B, 4), np.int32)
+    for b in range(B):
+        blks = alloc.alloc(3)
+        tables[b, :3] = blks
+        c1 = model.init_cache(1, T)
+        _, c1 = model.apply_with_cache(params, toks[b:b + 1], c1)
+        pool = pk.write_prefill(pool, jnp.asarray(blks[:T // bs], jnp.int32),
+                                c1["k"][:, :, 0], c1["v"][:, :, 0])
+    tables = jnp.asarray(tables)
+    lengths = jnp.full((B,), T, jnp.int32)
+    cur = jnp.argmax(ref[0], -1).astype(jnp.int32)
+    step = jax.jit(model.decode_step_paged)   # compile once, not op-by-op
+    for i in range(1):
+        logits, pool = step(params, cur, pool, tables, lengths)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref[i + 1]),
+                                   rtol=1e-5, atol=1e-5)
+        cur = jnp.argmax(logits, -1).astype(jnp.int32)
+        lengths = lengths + 1
+
+
+def test_int8_kv_pool_within_tolerance(tiny, devices):
+    """int8 KV (block-quantized per head dim) must track the full-width
+    pool's logits within the quantizer's error bound."""
+    model, params = tiny
+    rng = np.random.default_rng(1)
+    T, bs = 8, 4
+    toks = jnp.asarray(rng.integers(0, 128, (1, T)), jnp.int32)
+    c1 = model.init_cache(1, T)
+    lg, c1 = model.apply_with_cache(params, toks, c1)
+    k, v = c1["k"][:, :, 0], c1["v"][:, :, 0]
+    cur = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+
+    c = model.config
+    step = jax.jit(model.decode_step_paged)
+    outs = {}
+    for bits in (16, 8):
+        pool = pk.init_pool(c.n_layer, 5, bs, c.n_head, c.head_dim,
+                            jnp.float32, kv_bits=bits, quant_block=8)
+        pool = pk.write_prefill(pool, jnp.asarray([1, 2], jnp.int32), k, v)
+        tables = jnp.asarray([[1, 2, 3, 0]], jnp.int32)
+        logits, _ = step(params, cur, pool, tables,
+                         jnp.asarray([T], jnp.int32))
+        outs[bits] = np.asarray(logits)
+    scale = np.abs(outs[16]).max()
+    err = np.abs(outs[8] - outs[16]).max()
+    assert err < 0.02 * scale, (err, scale)    # int8 ~ 1/254 per block
+
+
+# -------------------------------------------------- continuous batching
+def test_serving_matches_sequential_generate(tiny, devices):
+    """Greedy answers under continuous batching (slot churn, shared
+    decode batch, block reuse) == the sequential engine, per request."""
+    model, params = tiny
+    rng = np.random.default_rng(2)
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=2, block_size=8,
+                                             max_new_tokens=6))
+    # 4 requests over 2 slots (slot churn + queueing), but only TWO
+    # distinct max_new values — the sequential oracle compiles one
+    # decode loop per distinct config, the dominant cost of this test
+    reqs = [Request(tokens=rng.integers(0, 128, (5 + i,)),
+                    max_new_tokens=3 + (i % 2), seed=i) for i in range(4)]
+    res = srv.run(reqs)
+    st = srv.stats()
+    assert st["completed"] == 4 and st["pending"] == 0
+    assert st["latency_ms"]["p99"] >= st["latency_ms"]["p50"] > 0
+    assert st["ttft_ms"]["p50"] > 0
+    # every block returned to the pool after eviction
+    assert srv.allocator.free_blocks == srv.num_blocks - 1
+
+    eng = InferenceEngine(_tiny_model(), params=params)
+    for r in reqs:
+        out = np.asarray(eng.generate(np.asarray(r.tokens)[None],
+                                      max_new_tokens=r.max_new_tokens))
+        assert res[r.uid]["tokens"] == out[0, len(r.tokens):].tolist(), \
+            f"request {r.uid} diverged from the sequential oracle"
+
+    # drain API: pop_result hands over the record, frees the uid, and
+    # the latency aggregates survive (long-running-server hygiene)
+    rec = srv.pop_result(reqs[0].uid)
+    assert rec["tokens"] and reqs[0].uid not in srv.results
+    with pytest.raises(KeyError):
+        srv.pop_result(reqs[0].uid)
+    assert srv.stats()["completed"] == 4      # aggregates unaffected
+    srv.reset_stats()
+    assert srv.stats()["completed"] == 0
+    assert "latency_ms" not in srv.stats()
+    srv.close()
+
+
+def test_arrival_order_determinism(tiny, devices):
+    """The same (sampled!) requests arriving in different orders produce
+    identical per-request tokens: each request's RNG stream is keyed on
+    (seed, token_index) alone, never on batch composition."""
+    model, params = tiny
+
+    def run_order(order):
+        srv = ServingEngine(
+            model=model, params=params,
+            config=ServingConfig(batch_slots=2, block_size=8,
+                                 max_new_tokens=5, top_k=8))
+        reqs = [Request(tokens=np.arange(3 + i) % 100, max_new_tokens=5,
+                        seed=100 + i, do_sample=True, temperature=0.7,
+                        uid=i) for i in range(4)]
+        out = srv.run([reqs[j] for j in order])
+        srv.close()
+        return {u: r["tokens"] for u, r in out.items()}
+
+    a = run_order([0, 1, 2, 3])
+    b = run_order([3, 1, 0, 2])
+    assert a == b
+
+
+def test_admission_queues_past_capacity(tiny, devices):
+    """More streams than slots AND a pool too small for all slots at
+    once: requests queue, join as blocks free, and all complete."""
+    model, params = tiny
+    # 2 slots but only 5 allocatable blocks; each request needs 2 blocks
+    # (8 prompt + 4 new over block_size=8) — pool-capacity-bound, with
+    # the strict-FIFO queue absorbing the rest
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=2, block_size=8,
+                                             num_blocks=6, max_new_tokens=4))
+    rng = np.random.default_rng(3)
+    reqs = [Request(tokens=rng.integers(0, 128, (8,)), seed=i)
+            for i in range(5)]
+    res = srv.run(reqs)
+    assert all(len(res[r.uid]["tokens"]) == 4 for r in reqs)
+    assert srv.allocator.free_blocks == 5
+    srv.close()
+
+
+def test_submit_rejects_oversized_requests(tiny, devices):
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=2, block_size=8,
+                                             num_blocks=4))
+    with pytest.raises(ValueError, match="max_seq"):
+        srv.submit(Request(tokens=np.arange(60), max_new_tokens=30))
+    with pytest.raises(ValueError, match="blocks"):
+        # fits max_seq (64) but not the 3 allocatable blocks (24 tokens)
+        srv.submit(Request(tokens=np.arange(20), max_new_tokens=20))
+    with pytest.raises(ValueError, match="empty"):
+        srv.submit(Request(tokens=np.zeros((0,), np.int32)))
+    with pytest.raises(ValueError, match=">= 1"):
+        # max_new_tokens=0 must be rejected, not silently replaced by
+        # the config default (falsy-zero trap)
+        srv.submit(Request(tokens=np.arange(4), max_new_tokens=0))
+    srv.submit(Request(tokens=np.arange(4), max_new_tokens=1, uid=7))
+    with pytest.raises(ValueError, match="already submitted"):
+        # a duplicate uid would corrupt the in-flight result record
+        srv.submit(Request(tokens=np.arange(4), max_new_tokens=1, uid=7))
+    srv.close()
+
+
+def test_prefill_bucket_past_max_seq(devices):
+    """A prompt whose block-rounded prefill bucket exceeds max_seq
+    (max_seq not a block multiple) must still serve: the forward runs at
+    max_seq and the K/V scatter zero-pads the last block."""
+    cfg = GPT2Config(vocab_size=64, max_seq=20, n_embd=16, n_layer=1,
+                     n_head=2, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(9))
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8))
+    r = Request(tokens=np.arange(19) % 64, max_new_tokens=1, seed=0)
+    res = srv.run([r])         # bucket = 24 > max_seq = 20
+    eng = InferenceEngine(GPT2(cfg, dtype=jnp.float32), params=params)
+    out = np.asarray(eng.generate(np.asarray(r.tokens)[None],
+                                  max_new_tokens=1))
+    assert res[r.uid]["tokens"] == out[0, 19:].tolist()
+    srv.close()
+
+
+@pytest.mark.slow   # compile-heavy (serving + a generate); the ownership
+                    # logic itself is a two-line flag checked here
+def test_close_leaves_caller_engine_usable(tiny, devices):
+    """close() must not tear down an engine the caller passed in —
+    only an internally built one is owned."""
+    model, params = tiny
+    eng = InferenceEngine(_tiny_model(), params=params)
+    srv = ServingEngine(engine=eng,
+                        config=ServingConfig(batch_slots=1, block_size=8,
+                                             max_new_tokens=2))
+    srv.run([Request(tokens=np.arange(4), seed=0)])
+    srv.close()
+    assert eng.params is not None
+    out = np.asarray(eng.generate(np.array([[1, 2]], np.int32),
+                                  max_new_tokens=2))
+    assert out.shape == (1, 4)
+    eng.close()
+
+
+@pytest.mark.slow   # compile-heavy (two engines); eviction/block-reuse
+                    # stays fast-tier via the admission + oracle tests
+def test_eos_evicts_early(tiny, devices):
+    """A request hitting eos frees its slot + blocks before max_new."""
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8,
+                                             max_new_tokens=8))
+    r = Request(tokens=np.arange(4), max_new_tokens=8, seed=0)
+    res = srv.run([r])
+    toks = res[r.uid]["tokens"]
+    # re-run with a token from that greedy stream declared eos: the
+    # request must stop at its FIRST occurrence (eos included) and
+    # return its blocks
+    eos = int(toks[1])
+    srv2 = ServingEngine(model=model, params=params,
+                         config=ServingConfig(batch_slots=1, block_size=8,
+                                              max_new_tokens=8,
+                                              eos_token_id=eos))
+    r2 = Request(tokens=np.arange(4), max_new_tokens=8, seed=0)
+    res2 = srv2.run([r2])
+    assert res2[r2.uid]["tokens"] == toks[:toks.index(eos) + 1]
+    assert srv2.allocator.free_blocks == srv2.num_blocks - 1
+    srv.close()
+    srv2.close()
+
+
+@pytest.mark.slow   # compile-heavy (two quantized engines); int8-in-scan
+                    # numerics stay fast-tier in test_inference.py
+def test_serving_int8_weights_runs(tiny, devices):
+    """int8-quantized weights stream through the fused paged decode (the
+    stacked-scan per-layer slice path) and still answer deterministic
+    greedy requests."""
+    model, params = tiny
+    eng = InferenceEngine(_tiny_model(), params=params,
+                          quantization_setting=1)
+    srv = ServingEngine(engine=eng,
+                        config=ServingConfig(batch_slots=2, block_size=8,
+                                             max_new_tokens=4))
+    reqs = [Request(tokens=np.arange(5 + i), seed=i) for i in range(2)]
+    res = srv.run(reqs)
+    a = [res[r.uid]["tokens"] for r in reqs]
+    eng2 = InferenceEngine(_tiny_model(), params=params,
+                           quantization_setting=1)
+    for r, got in zip(reqs, a):
+        out = np.asarray(eng2.generate(np.asarray(r.tokens)[None],
+                                       max_new_tokens=4))
+        assert got == out[0, len(r.tokens):].tolist()
+    srv.close()
+
+
+def test_capacity_report(tiny, devices):
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=2, block_size=8,
+                                             kv_bits=8))
+    cap = srv.capacity()
+    assert cap["allocatable_blocks"] == srv.num_blocks - 1
+    assert cap["capacity_tokens"] == (srv.num_blocks - 1) * 8
+    assert cap["pool_bytes"] == pk.pool_bytes(srv.pool)
+    assert cap["kv_bits"] == 8
+    srv.close()
