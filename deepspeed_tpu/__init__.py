@@ -22,7 +22,7 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                training_data=None, lr_scheduler=None, mpu=None,
                dist_init_required=None, collate_fn=None, config=None,
                config_params=None, mesh=None, loss_fn=None, params=None,
-               apply_fn=None, rng_seed=0, auto_resume=None):
+               apply_fn=None, rng_seed=0, auto_resume=None, elastic=None):
     """Initialize the engine. Returns ``(engine, optimizer, dataloader, lr_scheduler)``.
 
     Parity: reference ``deepspeed/__init__.py:51-151``.  ``args.deepspeed_config``
@@ -36,6 +36,15 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
     one exists — the restart path of a preempted TPU job
     (docs/fault-tolerance.md).  A missing or empty checkpoint dir is a
     normal cold start, not an error.
+
+    ``elastic=True`` (or env ``DSTPU_ELASTIC=1`` as set by ``deepspeed
+    --elastic``) turns the config's ``elasticity`` block on without editing
+    the JSON: the (micro_batch, gas) pair is recomputed from the elastic
+    schedule at THIS world size, so a preempted job relaunched on a
+    different chip count keeps its global batch and ``auto_resume`` can
+    re-partition the checkpoint onto the new mesh (docs/elasticity.md).
+    Combined, ``--elastic --auto-resume`` is the full
+    preemption-survival path.
     """
     if config is None and config_params is not None:
         config = config_params
@@ -55,7 +64,8 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
         engine = PipelineEngine(model=model, optimizer=optimizer, config=config,
                                 training_data=training_data,
                                 lr_scheduler=lr_scheduler, mesh=mesh,
-                                collate_fn=collate_fn, rng_seed=rng_seed)
+                                collate_fn=collate_fn, rng_seed=rng_seed,
+                                elastic=elastic)
     else:
         engine = DeepSpeedEngine(model=model, optimizer=optimizer, config=config,
                                  training_data=training_data,
@@ -63,7 +73,8 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                                  collate_fn=collate_fn, loss_fn=loss_fn,
                                  params=params, apply_fn=apply_fn,
                                  rng_seed=rng_seed, mpu=mpu,
-                                 dist_init_required=dist_init_required)
+                                 dist_init_required=dist_init_required,
+                                 elastic=elastic)
     _maybe_auto_resume(engine, auto_resume)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 

@@ -21,6 +21,24 @@ def _default_paths():
     return [os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))]
 
 
+class _MLP:
+    """Tiny bf16 MLP the built-in audit stages train (CPU works)."""
+
+    def init(self, rng):
+        import jax
+        import jax.numpy as jnp
+        k1, k2 = jax.random.split(rng)
+        return {"w1": jax.random.normal(k1, (16, 32), jnp.float32),
+                "w2": jax.random.normal(k2, (32, 16), jnp.float32)}
+
+    def loss(self, params, batch, rng):
+        import jax.numpy as jnp
+        x, y = batch
+        h = jnp.maximum(x.astype(jnp.bfloat16) @ params["w1"], 0)
+        p = (h @ params["w2"]).astype(jnp.float32)
+        return jnp.mean(jnp.square(p - y))
+
+
 def _audit_builtin_steps(stages):
     """Jaxpr-audit a tiny bf16 MLP engine's compiled step per ZeRO stage
     on whatever devices this process sees (CPU works).
@@ -33,23 +51,9 @@ def _audit_builtin_steps(stages):
     import shutil
     import tempfile
     import numpy as np
-    import jax.numpy as jnp
     import deepspeed_tpu as ds
     from .findings import Finding
     from .jaxpr_audit import audit_engine
-
-    class _MLP:
-        def init(self, rng):
-            import jax
-            k1, k2 = jax.random.split(rng)
-            return {"w1": jax.random.normal(k1, (16, 32), jnp.float32),
-                    "w2": jax.random.normal(k2, (32, 16), jnp.float32)}
-
-        def loss(self, params, batch, rng):
-            x, y = batch
-            h = jnp.maximum(x.astype(jnp.bfloat16) @ params["w1"], 0)
-            p = (h @ params["w2"]).astype(jnp.float32)
-            return jnp.mean(jnp.square(p - y))
 
     findings = []
     data = (np.ones((8, 16), np.float32), np.ones((8, 16), np.float32))
@@ -63,6 +67,9 @@ def _audit_builtin_steps(stages):
         for spec in stages:
             if str(spec) == "decode":
                 findings.extend(_audit_decode_step())
+                continue
+            if str(spec) == "elastic":
+                findings.extend(_audit_elastic_resume())
                 continue
             compressed = str(spec).endswith("q")
             stage = int(str(spec).rstrip("q"))
@@ -200,6 +207,74 @@ def _audit_decode_step():
     return findings
 
 
+def _audit_elastic_resume():
+    """--audit-step elastic: audit the FIRST compiled step after an elastic
+    reshard-on-resize (docs/elasticity.md) — a ZeRO-2 elastic engine saves
+    on the full device set, a second engine auto-resumes on HALF of it, and
+    the resumed engine's train step must show zero host callbacks
+    (DSTPU201) and every declared donation honored on the NEW mesh
+    (DSTPU204)."""
+    import shutil
+    import tempfile
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    from .findings import Finding
+    from .jaxpr_audit import audit_engine
+
+    # both n and n//2 must be schedulable by the fixed elastic block below
+    # (micro [2,4], max 16 -> valid world sizes {1,2,4,8})
+    n = jax.device_count()
+    if n not in (2, 4, 8):
+        return [Finding(
+            "DSTPU200", "warning",
+            f"--audit-step elastic needs a device count in (2,4,8) so the "
+            f"built-in elastic schedule covers both the full and the "
+            f"halved mesh (got {n}); skipped",
+            eqn_path="elastic-resume")]
+
+    import numpy as np
+    data = (np.ones((32, 16), np.float32), np.ones((32, 16), np.float32))
+    dataset = [(data[0][i], data[1][i]) for i in range(32)]
+    cfg = {"steps_per_print": 10 ** 9,
+           "bf16": {"enabled": True},
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 2},
+           "elasticity": {"enabled": True, "max_train_batch_size": 16,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 64, "version": 0.1}}
+    findings = []
+    ckpt_dir = tempfile.mkdtemp(prefix="dstpu-audit-elastic-")
+    try:
+        a, _, _, _ = ds.initialize(config=dict(cfg), model=_MLP(),
+                                   training_data=dataset,
+                                   mesh=make_mesh({"data": n}))
+        a.train_batch()
+        a.save_checkpoint(ckpt_dir)
+        a.close()
+
+        half = n // 2
+        cfg_b = dict(cfg, checkpoint={"dir": ckpt_dir, "auto_resume": True})
+        b, _, _, _ = ds.initialize(
+            config=cfg_b, model=_MLP(), training_data=dataset,
+            mesh=make_mesh({"data": half}, devices=jax.devices()[:half]))
+        if b.global_steps != 1:
+            findings.append(Finding(
+                "DSTPU200", "warning",
+                f"--audit-step elastic: resume on {half} devices did not "
+                f"restore the checkpointed step (global_steps="
+                f"{b.global_steps})", eqn_path="elastic-resume"))
+        report = audit_engine(b)
+        for f in report.findings:
+            f.extra = dict(f.extra, audit="elastic-resume",
+                           from_world=n, to_world=half)
+        findings.extend(report.findings)
+        b.close()
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return findings
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.analysis",
@@ -221,7 +296,10 @@ def main(argv=None):
                          "collectives variant and additionally gates the "
                          "census against the engine's declared CommsBudget; "
                          "'decode' audits the serving layer's fused paged "
-                         "decode step + generate()'s fused token scan")
+                         "decode step + generate()'s fused token scan; "
+                         "'elastic' audits the first resharded step after "
+                         "an elastic resume on half the devices "
+                         "(docs/elasticity.md)")
     args = ap.parse_args(argv)
 
     # findings are the stdout payload (the tier-1 gate parses --json);

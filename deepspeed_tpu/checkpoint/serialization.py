@@ -90,6 +90,30 @@ def restore_like(target_tree, loaded):
         jax.tree_util.tree_structure(target_tree), leaves)
 
 
+def reshard_put(loaded, like_tree, shardings, cast=None):
+    """The re-shard half of the gather→re-shard path: place a loaded
+    (full, host) nested-dict onto devices under ``shardings``.
+
+    ``save_tree`` gathers every shard to one full host array;  this is the
+    inverse — each leaf is rebuilt into ``like_tree``'s pytree structure,
+    cast (to the matching ``like_tree`` leaf's dtype, or ``cast`` when
+    given), and ``device_put`` under the TARGET sharding.  Because the
+    on-disk form is the full array, the target mesh is free to differ from
+    the one that saved: ZeRO re-partitioning across a device-count change
+    is exactly this device_put (the reference needs dedicated
+    ``elastic_checkpoint``/universal-checkpoint machinery for the same
+    move).
+    """
+    restored = restore_like(like_tree, loaded)
+    # .dtype reads metadata only — never np.asarray(like leaf), which
+    # would gather the current (possibly sharded, device) array to host
+    dtype_of = ((lambda leaf: cast) if cast is not None
+                else (lambda leaf: np.dtype(leaf.dtype)))
+    host = jax.tree_util.tree_map(
+        lambda x, p: np.asarray(x).astype(dtype_of(p)), restored, like_tree)
+    return jax.device_put(host, shardings)
+
+
 def load_tree(path, with_meta=False, retry=None):
     """Read back as a nested dict (dict-of-dicts mirror of the saved pytree).
 

@@ -46,6 +46,14 @@ class ElasticityConfig:
         if not all(map(lambda m: m > 0, self.micro_batches)):
             raise ElasticityConfigError(
                 f"{EC.MICRO_BATCHES} must contain only positive ints, got {self.micro_batches}")
+        if self.micro_batches and \
+                max(self.micro_batches) > self.max_acceptable_batch_size:
+            # caught here so a bad elasticity block fails at config parse
+            # (initialize) with a typed error, not as a ValueError deep in
+            # the candidate search
+            raise ElasticityConfigError(
+                f"every micro batch must be <= {EC.MAX_ACCEPTABLE_BATCH_SIZE} "
+                f"({self.max_acceptable_batch_size}); got {self.micro_batches}")
 
         self.min_gpus = param_dict.get(EC.MIN_GPUS, EC.MIN_GPUS_DEFAULT)
         self.max_gpus = param_dict.get(EC.MAX_GPUS, EC.MAX_GPUS_DEFAULT)

@@ -58,6 +58,19 @@ def parse_args(args=None):
                              "the config's checkpoint.dir (sets "
                              "DSTPU_AUTO_RESUME=1 for the job; see "
                              "docs/fault-tolerance.md)")
+    parser.add_argument("--elastic", default=None, action="store_true",
+                        dest="elastic",
+                        help="Enable batch-size elasticity (sets "
+                             "DSTPU_ELASTIC=1): the config's `elasticity` "
+                             "block picks a (micro_batch, gas) pair that "
+                             "preserves the global batch at THIS world "
+                             "size, so a preempted job can resume on a "
+                             "different chip count — pair with "
+                             "--auto-resume (docs/elasticity.md)")
+    parser.add_argument("--no-elastic", dest="elastic",
+                        action="store_false",
+                        help="Force elasticity OFF (sets DSTPU_ELASTIC=0) "
+                             "even when the config enables it")
     parser.add_argument("--compile-cache-dir", type=str, default="",
                         dest="compile_cache_dir",
                         help="Persistent compiled-step cache directory "
@@ -210,6 +223,8 @@ def main(args=None):
         env["DSTPU_AUTO_RESUME"] = "1"
     if args.fault:
         env["DSTPU_FAULT"] = args.fault
+    if args.elastic is not None:
+        env["DSTPU_ELASTIC"] = "1" if args.elastic else "0"
     if args.compile_cache_dir:
         env["DSTPU_COMPILE_CACHE"] = args.compile_cache_dir
     if args.health_check is not None:

@@ -491,8 +491,11 @@ class DeepSpeedConfig:
     data-parallel extent (data×fsdp mesh axes product).
     """
 
-    def __init__(self, config, world_size=None, mesh=None):
-        self._param_dict = load_config_dict(config)
+    def __init__(self, config, world_size=None, mesh=None, elastic=None):
+        # shallow-copy: _apply_elasticity (and the elastic override below)
+        # write batch keys into the dict; a caller's config object must not
+        # be mutated behind its back
+        self._param_dict = dict(load_config_dict(config))
 
         if world_size is None:
             if mesh is not None:
@@ -503,8 +506,27 @@ class DeepSpeedConfig:
                 world_size = 1
         self.world_size = world_size
 
-        # Elasticity may overwrite batch keys pre-parse (reference config.py:815-830)
+        # Elasticity may overwrite batch keys pre-parse (reference config.py:815-830).
+        # ``elastic`` (initialize kwarg > env DSTPU_ELASTIC as set by
+        # ``deepspeed --elastic`` > config) can force it on/off without
+        # editing the JSON — the preempted-job restart path, where the
+        # relaunch decides elasticity, not the original config author.
         self.elasticity_enabled = False
+        self.elastic_record = None
+        if elastic is None:
+            import os as _os
+            env = _os.environ.get("DSTPU_ELASTIC")
+            if env:
+                elastic = env.lower() in ("1", "true", "yes", "on")
+        if elastic is not None:
+            if elastic and C.ELASTICITY not in self._param_dict:
+                raise DeepSpeedConfigError(
+                    "--elastic/DSTPU_ELASTIC needs an `elasticity` config "
+                    "block (micro_batch_sizes + max_train_batch_size) to "
+                    "compute the batch schedule from (docs/elasticity.md)")
+            if C.ELASTICITY in self._param_dict:
+                self._param_dict[C.ELASTICITY] = dict(
+                    self._param_dict[C.ELASTICITY], enabled=bool(elastic))
         if C.ELASTICITY in self._param_dict and \
                 self._param_dict[C.ELASTICITY].get("enabled", False):
             self._apply_elasticity()
@@ -584,8 +606,13 @@ class DeepSpeedConfig:
 
     # -- elasticity hook ---------------------------------------------------
     def _apply_elasticity(self):
-        from ..elasticity import compute_elastic_config
+        from ..elasticity import (compute_elastic_config,
+                                  ElasticityIncompatibleWorldSize)
         from ..elasticity.constants import ELASTICITY
+        # raises ElasticityIncompatibleWorldSize here — at initialize —
+        # when the current world size is not in the elastic schedule's
+        # valid set (resuming a preempted job on an unschedulable chip
+        # count must fail fast, not as a shard-shape mismatch mid-load)
         final_batch_size, valid_gpus, micro_batch_size = compute_elastic_config(
             ds_config=self._param_dict,
             target_deepspeed_version="any",
@@ -599,11 +626,40 @@ class DeepSpeedConfig:
                     raise DeepSpeedConfigError(
                         f"Elasticity is enabled, but {key} is also set; set "
                         f"elasticity.ignore_non_elastic_batch_info to override.")
-        self._param_dict[C.TRAIN_BATCH_SIZE] = final_batch_size
-        if micro_batch_size is not None:
-            self._param_dict[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
-            self._param_dict[C.GRADIENT_ACCUMULATION_STEPS] = \
-                final_batch_size // (micro_batch_size * self.world_size)
+            self._param_dict[C.TRAIN_BATCH_SIZE] = final_batch_size
+            if micro_batch_size is not None:
+                self._param_dict[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+                self._param_dict[C.GRADIENT_ACCUMULATION_STEPS] = \
+                    final_batch_size // (micro_batch_size * self.world_size)
+        else:
+            # reference parity (config.py:815-830): with
+            # ignore_non_elastic_batch_info the USER's batch keys stay
+            # authoritative.  They must still be schedulable at THIS world
+            # size — previously the overwrite hid any conflict and an
+            # incompatible train_batch_size surfaced only later, as a
+            # batch-stacking/shard-shape failure inside the engine.
+            tb = self._param_dict.get(C.TRAIN_BATCH_SIZE)
+            mb = self._param_dict.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+            if tb is not None:
+                if tb % self.world_size != 0:
+                    raise ElasticityIncompatibleWorldSize(
+                        f"elasticity (ignore_non_elastic_batch_info): "
+                        f"train_batch_size {tb} is not divisible by the "
+                        f"current world size {self.world_size}")
+                if mb is not None and (tb // self.world_size) % mb != 0:
+                    raise ElasticityIncompatibleWorldSize(
+                        f"elasticity (ignore_non_elastic_batch_info): "
+                        f"train_batch_size {tb} cannot be factored as "
+                        f"micro_batch {mb} x gas x world_size "
+                        f"{self.world_size}")
+        self.elastic_record = {
+            "train_batch_size": self._param_dict.get(C.TRAIN_BATCH_SIZE,
+                                                     final_batch_size),
+            "elastic_batch_size": final_batch_size,
+            "micro_batch": self._param_dict.get(
+                C.TRAIN_MICRO_BATCH_SIZE_PER_GPU),
+            "world_size": self.world_size,
+        }
 
     # -- param init --------------------------------------------------------
     def _initialize_params(self, pd):

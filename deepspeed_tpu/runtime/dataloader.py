@@ -49,10 +49,12 @@ class RepeatingLoader:
     def load_state_dict(self, state):
         lsd = getattr(self.loader, "load_state_dict", None)
         if callable(lsd) and state is not None:
-            lsd(state)
+            exact = lsd(state)
             # drop the in-flight epoch iterator: the restored position
             # takes effect on the next __next__
             self.data_iter = iter(self.loader)
+            return exact
+        return None
 
 
 def _default_collate(samples):
@@ -117,16 +119,45 @@ class DeepSpeedDataLoader:
     # load_checkpoint / auto_resume / engine.rewind() sees the same batches
     # in the same order instead of restarting the sampler from scratch.
     def state_dict(self):
+        # batch_size makes the position RESHARDABLE: an elastic resume on a
+        # different mesh changes the global micro-batch, so batch_index has
+        # to be converted through the invariant unit (rows consumed)
         return {"seed": self.seed, "epoch": self.epoch,
-                "batch_index": self.batch_index}
+                "batch_index": self.batch_index,
+                "batch_size": self.batch_size}
 
     def load_state_dict(self, state):
+        """Restore the sampler position.  Returns True when the restored
+        position is exact, False when a batch-size change (elastic resume
+        on a different mesh) landed between batch boundaries and the
+        position was floored — the caller then knows up to one batch of
+        rows may replay."""
         self.seed = int(state.get("seed", self.seed))
         self.epoch = int(state.get("epoch", 0))
-        self.batch_index = int(state.get("batch_index", 0))
+        idx = int(state.get("batch_index", 0))
+        saved_bs = int(state.get("batch_size", self.batch_size))
+        exact = True
+        if saved_bs != self.batch_size:
+            # the row stream is a pure function of (seed, epoch) — only the
+            # grouping into batches changes with the global micro-batch, so
+            # position converts through rows.  Checkpoints land on optimizer
+            # -step boundaries, where rows are a multiple of the (preserved)
+            # global batch — exact whenever the global batch really was
+            # preserved across the resize.
+            rows = idx * saved_bs
+            idx, rem = divmod(rows, self.batch_size)
+            if rem:
+                exact = False
+                logger.warning(
+                    f"data-loader position ({rows} rows at saved batch_size "
+                    f"{saved_bs}) does not land on a batch boundary at the "
+                    f"new batch_size {self.batch_size}; resuming at batch "
+                    f"{idx} — up to {rem} rows replay")
+        self.batch_index = idx
         # consumed by the NEXT __iter__ only: a plain re-iteration (no
         # restore) keeps the historical restart-from-zero semantics
         self._resume_batch = self.batch_index
+        return exact
 
     def _order(self):
         idx = np.arange(self._len)

@@ -131,7 +131,7 @@ class DeepSpeedEngine:
     def __init__(self, model=None, optimizer=None, config=None, config_params=None,
                  training_data=None, lr_scheduler=None, mesh=None, collate_fn=None,
                  loss_fn=None, params=None, apply_fn=None, rng_seed=0, mpu=None,
-                 dist_init_required=None, dont_change_device=False):
+                 dist_init_required=None, dont_change_device=False, elastic=None):
         config = config if config is not None else config_params
         assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
 
@@ -143,7 +143,8 @@ class DeepSpeedEngine:
             config = raw
         self.mesh = mesh
         self.mesh_ctx = M.MeshContext(mesh)
-        self.config = DeepSpeedConfig(config, world_size=self.mesh_ctx.dp_world_size)
+        self.config = DeepSpeedConfig(config, world_size=self.mesh_ctx.dp_world_size,
+                                      elastic=elastic)
 
         self.zero_stage = self.config.zero_optimization_stage
         self.compute_dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
@@ -1892,6 +1893,15 @@ class DeepSpeedEngine:
             "global_samples": self.global_samples,
             "zero_stage": self.zero_stage,
             "dtype": self.config.precision_dtype,
+            # elastic-resume record (docs/elasticity.md): the mesh this
+            # state was partitioned on + the global batch it was trained
+            # at, so a resume on a DIFFERENT mesh can verify the resize is
+            # a pure re-partition (global batch preserved) and log the
+            # re-layout instead of silently changing training semantics
+            "mesh": {k: int(v) for k, v in dict(self.mesh.shape).items()},
+            "dp_world_size": self.mesh_ctx.dp_world_size,
+            "train_batch_size": self.train_batch_size(),
+            "elasticity": self.config.elastic_record,
             "client_state": client_state or {},
             "lr_scheduler": (self.lr_scheduler.state_dict()
                              if self.lr_scheduler is not None and
@@ -2070,6 +2080,76 @@ class DeepSpeedEngine:
             "fallback_tag": fallback}))
         return fallback
 
+    def _check_mesh_transition(self, meta):
+        """Elastic resume-on-resize gate (docs/elasticity.md): compare the
+        checkpoint's recorded mesh with the current one.
+
+        - identical mesh: nothing to do (the common restart).
+        - no record: pre-elastic checkpoint — reshard anyway (the on-disk
+          form is full arrays), but warn that global-batch preservation
+          cannot be verified.
+        - different mesh: a reshard-on-resize event.  The resize is a pure
+          re-partition only when the GLOBAL batch is preserved (ZeRO shard
+          layout is a function of world size — arXiv 1910.02054 — but the
+          optimizer trajectory is a function of the batch): with
+          elasticity enabled a changed global batch means the elasticity
+          block itself changed (it is a pure function of that block), so
+          raise; without elasticity, warn loudly and continue.  The
+          re-layout of the ZeRO placements is logged as one structured
+          event (``relayout_report``).
+        """
+        cur_mesh = {k: int(v) for k, v in dict(self.mesh.shape).items()}
+        saved_mesh = meta.get("mesh")
+        if saved_mesh is None:
+            logger.warning(
+                "pre-elastic checkpoint: no mesh/batch record in the "
+                f"checkpoint meta; resharding onto mesh {cur_mesh} "
+                "proceeds, but global-batch preservation cannot be "
+                "verified — if the device count changed, loss-curve "
+                "continuity is not guaranteed (enable `elasticity` and "
+                "re-save to make checkpoints resize-aware)")
+            return
+        saved_mesh = {k: int(v) for k, v in saved_mesh.items()}
+        if saved_mesh == cur_mesh:
+            return
+        saved_tb = meta.get("train_batch_size")
+        cur_tb = self.train_batch_size()
+        event = {"event": "elastic_resume",
+                 "from_mesh": saved_mesh, "to_mesh": cur_mesh,
+                 "from_dp_world": meta.get("dp_world_size"),
+                 "to_dp_world": self.mesh_ctx.dp_world_size,
+                 "global_batch": {"from": saved_tb, "to": cur_tb,
+                                  "preserved": saved_tb == cur_tb},
+                 "elastic": bool(self.config.elasticity_enabled)}
+        if saved_tb is not None and saved_tb != cur_tb:
+            if self.config.elasticity_enabled:
+                # the elastic final batch is a pure function of the
+                # elasticity block — a mismatch means the block changed
+                # between save and resume, which silently changes the
+                # optimizer trajectory; refuse rather than drift
+                from ..elasticity import ElasticityConfigError
+                raise ElasticityConfigError(
+                    f"elastic resume would change the global batch "
+                    f"{saved_tb} -> {cur_tb}: the `elasticity` block does "
+                    f"not match the one the checkpoint was trained with "
+                    f"(saved record: {meta.get('elasticity')})")
+            logger.warning(
+                "resuming on a different mesh WITHOUT elasticity: the "
+                f"global batch changes {saved_tb} -> {cur_tb}, which "
+                "changes training semantics (lr schedule, convergence). "
+                "Enable `elasticity` (or `deepspeed --elastic`) to pick a "
+                "(micro_batch, gas) pair that preserves it.")
+        old_fsdp = int(saved_mesh.get("fsdp", 1))
+        new_fsdp = self.mesh_ctx.fsdp_size
+        if self.state is not None and self.state.params is not None \
+                and old_fsdp != new_fsdp:
+            event["relayout"] = zpart.relayout_report(
+                self.state.params, self.zero_stage, old_fsdp, new_fsdp,
+                persistence_threshold=(self.config.zero_config
+                                       .param_persistence_threshold),
+                tp_specs=self._tp_specs)
+        log_dist("elastic resume: " + json.dumps(event), ranks=[0])
+
     def load_checkpoint(self, load_dir, tag=None, load_module_only=False,
                         load_optimizer_states=True, load_lr_scheduler_states=True):
         """Parity: reference ``engine.py:2467``. Returns (path, client_state).
@@ -2086,28 +2166,28 @@ class DeepSpeedEngine:
         self.loaded_checkpoint_tag = tag
         retry = self.config.io_retry_config.policy()
 
-        from ..checkpoint.serialization import restore_like
+        from ..checkpoint.serialization import reshard_put, restore_like
         model_tree, meta = load_tree(os.path.join(path, MODEL_FILE),
                                      with_meta=True, retry=retry)
+        # elastic resume (docs/elasticity.md): validate a mesh change
+        # BEFORE restoring anything — the checkpoint stores full (gathered)
+        # arrays, so re-partitioning onto this mesh is the reshard_put
+        # below, but the resize is only training-equivalent when the
+        # global batch is preserved
+        self._check_mesh_transition(meta)
         state = self.state
         if self._offload is None:
             # (offload path uploads once from the restored host master below)
-            params = restore_like(self.state.params, model_tree["params"])
-            params = jax.device_put(
-                jax.tree_util.tree_map(lambda x, p: np.asarray(x).astype(p.dtype),
-                                       params, self.state.params),
-                self._param_sh)
-            state = state._replace(params=params)
+            state = state._replace(params=reshard_put(
+                model_tree["params"], self.state.params, self._param_sh))
         if state.master is not None:
             # keep the fp32 master coherent with the loaded params NOW; if
             # optimizer states are loaded below this is overwritten with the
             # checkpointed master, otherwise (load_module_only) the train step
             # would silently resume from the stale master.
-            loaded_master = restore_like(state.master, model_tree["params"])
-            state = state._replace(master=jax.device_put(
-                jax.tree_util.tree_map(
-                    lambda x: np.asarray(x).astype(np.float32), loaded_master),
-                self._master_sh))
+            state = state._replace(master=reshard_put(
+                model_tree["params"], state.master, self._master_sh,
+                cast=np.float32))
 
         loaded_ef = None
         if self._offload is not None:
@@ -2143,13 +2223,13 @@ class DeepSpeedEngine:
         elif load_optimizer_states and not load_module_only:
             optim_tree, _ = load_tree(os.path.join(path, OPTIM_FILE),
                                       with_meta=True, retry=retry)
-            opt_state = jax.device_put(
-                restore_like(self.state.opt_state, optim_tree["opt_state"]),
-                self._opt_shardings(self.state.opt_state))
+            opt_state = reshard_put(optim_tree["opt_state"],
+                                    self.state.opt_state,
+                                    self._opt_shardings(self.state.opt_state))
             master = state.master
             if "master" in optim_tree and master is not None:
-                master = jax.device_put(
-                    restore_like(master, optim_tree["master"]), self._master_sh)
+                master = reshard_put(optim_tree["master"], master,
+                                     self._master_sh)
             scale = state.scale
             if "scale" in optim_tree and scale is not None:
                 scale = jax.device_put(
@@ -2206,11 +2286,17 @@ class DeepSpeedEngine:
         if (data_state.get("loader") is not None
                 and self.training_dataloader is not None
                 and hasattr(self.training_dataloader, "load_state_dict")):
-            self.training_dataloader.load_state_dict(data_state["loader"])
+            exact = self.training_dataloader.load_state_dict(
+                data_state["loader"])
             # rebuild the engine-owned iterator over the restored position
             self._data_iterator = iter(
                 RepeatingLoader(self.training_dataloader))
-            self._stream_pos_known = True
+            # a mesh resize changes the loader's global micro-batch; the
+            # position converts through rows (loader state carries its
+            # batch_size) and stays EXACT at optimizer-step boundaries —
+            # only an off-boundary conversion (floored, rows replay)
+            # degrades the stream position to unknown for fast-forward
+            self._stream_pos_known = exact is not False
         else:
             # pre-guardian checkpoint (or no engine-owned loader): the live
             # iterator's position no longer matches _stream_step, so a

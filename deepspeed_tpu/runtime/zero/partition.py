@@ -123,6 +123,54 @@ def grad_specs(params, stage: int, fsdp_size: int, *, tp_specs=None):
     return param_specs(params, min(stage, 2), fsdp_size, tp_specs=tp_specs)
 
 
+def _has_fsdp(spec: P) -> bool:
+    for entry in spec:
+        axes = (entry,) if isinstance(entry, str) else (entry or ())
+        if "fsdp" in axes:
+            return True
+    return False
+
+
+def relayout_report(params, stage: int, old_fsdp: int, new_fsdp: int, *,
+                    persistence_threshold: int = 0, tp_specs=None) -> dict:
+    """Summarize how ZeRO placements change across an fsdp-extent change
+    (the elastic reshard-on-resize path, docs/elasticity.md).
+
+    The placement rules are pure functions of (shape, stage, fsdp extent)
+    — arXiv 1910.02054's observation that a ZeRO shard layout is derivable
+    from the world size alone — so a resize is a deterministic
+    re-partition: recompute the specs at the new extent and ``device_put``
+    the full (gathered) checkpoint arrays under them.  This report names
+    what that re-partition does: how many leaves change their spec, and
+    how many lose their fsdp sharding entirely because no axis divides the
+    new extent (they fall back to replicated — still correct, but
+    memory-relevant, so the resume path logs it).
+    """
+    def counts(old_specs, new_specs):
+        olds = jax.tree_util.tree_leaves(
+            old_specs, is_leaf=lambda x: isinstance(x, P))
+        news = jax.tree_util.tree_leaves(
+            new_specs, is_leaf=lambda x: isinstance(x, P))
+        changed = sum(1 for o, n in zip(olds, news) if tuple(o) != tuple(n))
+        fallback = sum(1 for o, n in zip(olds, news)
+                       if _has_fsdp(o) and not _has_fsdp(n))
+        return {"leaves": len(news), "respec": changed,
+                "replicated_fallback": fallback}
+
+    report = {"old_fsdp": old_fsdp, "new_fsdp": new_fsdp}
+    report["params"] = counts(
+        param_specs(params, stage, old_fsdp,
+                    persistence_threshold=persistence_threshold,
+                    tp_specs=tp_specs),
+        param_specs(params, stage, new_fsdp,
+                    persistence_threshold=persistence_threshold,
+                    tp_specs=tp_specs))
+    report["master"] = counts(
+        master_specs(params, stage, old_fsdp, tp_specs=tp_specs),
+        master_specs(params, stage, new_fsdp, tp_specs=tp_specs))
+    return report
+
+
 def to_named(specs, mesh: Mesh):
     return jax.tree_util.tree_map(lambda sp: NamedSharding(mesh, sp), specs,
                                   is_leaf=lambda x: isinstance(x, P))

