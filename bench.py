@@ -559,9 +559,102 @@ def measure_wire_compression(steps=8, micro=64):
     return out
 
 
-def wire_probe_subprocess(timeout_s=600):
-    """Run :func:`measure_wire_compression` in a CPU child with 8 virtual
-    devices (the in-process backend is already bound to the real chip)."""
+def measure_moe_wire_compression(steps=8, micro=64):
+    """Quantized expert-dispatch rung (docs/comms-compression.md, moe
+    route): trains 16 experts on an ``expert=8`` mesh full-width and
+    int8-dispatched, reports per-step wire bytes from the compiled
+    step's collective census, the loss delta, and the step audit —
+    including budget TIGHTNESS (the full-width census must violate the
+    compressed budget, ``--audit-step moe`` semantics).  Needs an
+    8-device mesh — the driver runs it in a CPU subprocess."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    from deepspeed_tpu.analysis.fixtures import MoEProbeModel
+    from deepspeed_tpu.analysis.jaxpr_audit import audit_engine
+    from deepspeed_tpu.analysis.comms import wire_report, check_budget
+
+    n_dev = jax.device_count()
+    if n_dev != 8:
+        return {"skipped": f"needs an expert=8 mesh (got {n_dev} devices)"}
+    mesh = make_mesh({"expert": 8})
+    rng = np.random.default_rng(0)
+
+    # io stays well under the MoE width so the dense-grad all-reduce is
+    # noise next to the dispatch/combine payload: on the pure expert=8
+    # mesh the expert params are EP-sharded (their grads never cross the
+    # wire), so the exchange IS the wire being measured — the way
+    # rows >> width does for qwZ above
+    io = 32
+
+    def probe():
+        return MoEProbeModel(dim=128, num_experts=16, io=io, expert_mult=2)
+
+    data = [(rng.normal(size=(io,)).astype(np.float32),
+             rng.normal(size=(io,)).astype(np.float32))
+            for _ in range(1024)]
+
+    def run(policy):
+        cfg = {"train_micro_batch_size_per_gpu": micro,
+               "gradient_accumulation_steps": 1,
+               "steps_per_print": 10 ** 9,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": 1}}
+        if policy is not None:
+            cfg["comms_compression"] = policy
+        engine, _, _, _ = ds.initialize(config=cfg, model=probe(),
+                                        training_data=data, mesh=mesh)
+        loss = float(engine.train_batch())   # cold trace: records the
+        # moe wire's census expectation, so comms_budget() sees it
+        budget = engine.comms_budget()
+        report = audit_engine(engine, comms_budget=budget)
+        hlo = [c for c in report.census if c.level == "hlo"]
+        wr = wire_report(hlo)
+        for _ in range(steps - 1):
+            loss = float(engine.train_batch())
+        rec = {
+            "final_loss": round(loss, 5),
+            "moe_active": bool(engine._router.moe_active),
+            "wire_bytes_per_step": wr["wire_bytes"],
+            "quantized_wire_bytes": wr["quantized_wire_bytes"],
+            "logical_bytes": wr["logical_bytes"],
+            "by_kind": {k: v["bytes"] for k, v in wr["by_kind"].items()},
+            "audit": {
+                "host_callbacks": len(report.host_callbacks),
+                "donation_unhonored":
+                    len(report.donation.get("unhonored_args", [])),
+                "budget_declared": budget is not None,
+                "budget_ok": not [f for f in report.findings
+                                  if f.rule == "DSTPU203"],
+            },
+        }
+        engine.close()
+        return rec, hlo, budget
+
+    full, full_hlo, _ = run(None)
+    comp, _, comp_budget = run({
+        "enabled": True, "min_tensor_bytes": 0, "routes": ["moe"],
+        "moe": {"bits": 8, "block_size": 128}})
+    comp["reduction_x"] = round(
+        full["wire_bytes_per_step"]
+        / max(comp["wire_bytes_per_step"], 1), 2)
+    comp["loss_rel_delta"] = round(
+        abs(comp["final_loss"] - full["final_loss"])
+        / max(abs(full["final_loss"]), 1e-9), 4)
+    # tightness: the full-width census must NOT fit the compressed
+    # budget (check_budget returns the overrun findings)
+    comp["audit"]["budget_tight"] = (comp_budget is not None
+                                     and bool(check_budget(full_hlo,
+                                                           comp_budget)))
+    return {"mesh": dict(mesh.shape), "steps": steps,
+            "experts": 16, "full": full, "int8": comp}
+
+
+def wire_probe_subprocess(timeout_s=600, flag="--wire-probe"):
+    """Run :func:`measure_wire_compression` (or, with
+    ``flag="--moe-wire-probe"``, :func:`measure_moe_wire_compression`)
+    in a CPU child with 8 virtual devices (the in-process backend is
+    already bound to the real chip)."""
     import subprocess
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -576,7 +669,7 @@ def wire_probe_subprocess(timeout_s=600):
     # would silently compress the baseline or veto the compressed rungs
     env.pop("DSTPU_COMMS_COMPRESSION", None)
     out = subprocess.run([sys.executable, os.path.abspath(__file__),
-                          "--wire-probe"], capture_output=True, text=True,
+                          flag], capture_output=True, text=True,
                          timeout=timeout_s, env=env)
     lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
     if out.returncode != 0 or not lines:
@@ -642,6 +735,9 @@ def main():
         # child mode (wire_probe_subprocess): one JSON line on stdout
         print(json.dumps(measure_wire_compression()), flush=True)
         return
+    if "--moe-wire-probe" in sys.argv:
+        print(json.dumps(measure_moe_wire_compression()), flush=True)
+        return
     t_start = time.time()
     left = lambda: TIME_BUDGET_S - (time.time() - t_start)
     cache_dir = bench_cache_dir()
@@ -701,6 +797,20 @@ def main():
             extra["zero3_wire_compression_cpu8"] = {"error": str(e)[:160]}
     else:
         extra["zero3_wire_compression_cpu8"] = {"skipped": "time budget"}
+
+    # ---- quantized expert-dispatch rung (CPU-mesh subprocess) ----------
+    # 16 experts on expert=8, full-width vs int8 dispatch/combine — the
+    # moe-route headline evidence (docs/comms-compression.md): >=3x
+    # wire_bytes_per_step apart at matched loss, audit clean
+    if left() > 4 * 60:
+        try:
+            extra["moe_wire_compression_cpu8"] = wire_probe_subprocess(
+                timeout_s=min(600, max(int(left() - 120), 60)),
+                flag="--moe-wire-probe")
+        except Exception as e:
+            extra["moe_wire_compression_cpu8"] = {"error": str(e)[:160]}
+    else:
+        extra["moe_wire_compression_cpu8"] = {"skipped": "time budget"}
 
     # graded config #3: GPT-2 1.3B ZeRO-3 + host-offload optimizer.  A full
     # cycle of that point takes ~25 tunnel-bound minutes (measured; see
@@ -842,6 +952,16 @@ def main():
             "int8_reduction_x": (wirec.get("int8") or {}).get("reduction_x"),
             "int4w_reduction_x": (wirec.get("int4_weights")
                                   or {}).get("reduction_x"),
+        }
+    moew = extra.get("moe_wire_compression_cpu8") or {}
+    if "full" in moew:
+        mi = moew.get("int8") or {}
+        headline["extra"]["moe_wire_bytes_per_step"] = {
+            "full": moew["full"]["wire_bytes_per_step"],
+            "int8": mi.get("wire_bytes_per_step"),
+            "reduction_x": mi.get("reduction_x"),
+            "loss_rel_delta": mi.get("loss_rel_delta"),
+            "audit": mi.get("audit"),
         }
     serving = extra.get("serving_125m_b8") or {}
     if "tokens_per_sec" in serving:

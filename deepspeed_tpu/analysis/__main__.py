@@ -71,6 +71,9 @@ def _audit_builtin_steps(stages):
             if str(spec) == "elastic":
                 findings.extend(_audit_elastic_resume())
                 continue
+            if str(spec) == "moe":
+                findings.extend(_audit_moe_step())
+                continue
             compressed = str(spec).endswith("q")
             stage = int(str(spec).rstrip("q"))
             cfg = {"train_micro_batch_size_per_gpu": 4,
@@ -207,6 +210,99 @@ def _audit_decode_step():
     return findings
 
 
+def _audit_moe_step():
+    """--audit-step moe: jaxpr-audit the quantized expert-parallel
+    dispatch (docs/comms-compression.md, moe route) on a data×expert
+    mesh: the compiled step must run zero host callbacks (DSTPU201)
+    with every donation honored (DSTPU204), its census must move the
+    dispatch/combine payload as int8 with replica groups > 1 on the
+    expert phase (the two-level split), fit the engine's declared
+    CommsBudget — and that budget must be TIGHT: the full-width twin's
+    census has to violate it."""
+    import numpy as np
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel.mesh import make_mesh
+    from .findings import Finding
+    from .fixtures import MoEProbeModel
+    from .jaxpr_audit import audit_engine
+    from .comms import wire_report, check_budget
+
+    n = jax.device_count()
+    if n < 4 or n % 2:
+        return [Finding(
+            "DSTPU200", "warning",
+            f"--audit-step moe needs an even device count >= 4 for the "
+            f"data×expert mesh (got {n}); skipped", eqn_path="moe-dispatch")]
+    mesh = make_mesh({"data": 2, "expert": n // 2})
+    rng = np.random.default_rng(0)
+    # big enough that the expert exchange dominates the budget floors:
+    # the tightness check below needs the full-width dispatch's 4x-wider
+    # payload to clear the int8 ceiling by a margin, not a whisker
+    dim = 128
+    data = [(rng.normal(size=(dim,)).astype(np.float32),
+             rng.normal(size=(dim,)).astype(np.float32)) for _ in range(512)]
+    base = {"train_micro_batch_size_per_gpu": 64,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 10 ** 9,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1}}
+
+    def build(comp):
+        cfg = dict(base)
+        if comp:
+            cfg["comms_compression"] = {
+                "enabled": True, "min_tensor_bytes": 0,
+                "routes": ["moe"], "moe": {"bits": 8, "block_size": 64}}
+        e, _, _, _ = ds.initialize(config=cfg, model=MoEProbeModel(dim, n),
+                                   training_data=data, mesh=mesh)
+        e.train_batch()      # cold trace records the moe wire expectation
+        return e
+
+    findings = []
+    full = build(False)
+    full_census = [c for c in audit_engine(full).census if c.level == "hlo"]
+    full.close()
+
+    engine = build(True)
+    if not engine._router.moe_active:
+        engine.close()
+        return [Finding("DSTPU200", "warning",
+                        "--audit-step moe: the moe route did not activate "
+                        "on this mesh", eqn_path="moe-dispatch",
+                        extra={"policy": engine._router.describe()})]
+    budget = engine.comms_budget()
+    report = audit_engine(engine, comms_budget=budget)
+    hlo = [c for c in report.census if c.level == "hlo"]
+    wr = wire_report(hlo)
+    quant = [c for c in hlo if c.quantized]
+    if not quant:
+        findings.append(Finding(
+            "DSTPU200", "warning",
+            "--audit-step moe: expert dispatch moved no int8 payload",
+            eqn_path="moe-dispatch",
+            extra={"by_kind": wr["by_kind"]}))
+    if quant and not any(c.groups > 1 for c in quant):
+        findings.append(Finding(
+            "DSTPU200", "warning",
+            "--audit-step moe: no quantized collective ran with replica "
+            "groups > 1 (two-level phase missing on the data×expert mesh)",
+            eqn_path="moe-dispatch",
+            extra={"groups": [c.groups for c in quant]}))
+    if budget is None or not check_budget(full_census, budget):
+        findings.append(Finding(
+            "DSTPU200", "warning",
+            "--audit-step moe: the declared budget is loose — the "
+            "full-width twin's census fits it",
+            eqn_path="moe-dispatch",
+            extra={"budget_declared": budget is not None}))
+    for f in report.findings:
+        f.extra = dict(f.extra, audit="moe-dispatch")
+    findings.extend(report.findings)
+    engine.close()
+    return findings
+
+
 def _audit_elastic_resume():
     """--audit-step elastic: audit the FIRST compiled step after an elastic
     reshard-on-resize (docs/elasticity.md) — a ZeRO-2 elastic engine saves
@@ -299,7 +395,10 @@ def main(argv=None):
                          "decode step + generate()'s fused token scan; "
                          "'elastic' audits the first resharded step after "
                          "an elastic resume on half the devices "
-                         "(docs/elasticity.md)")
+                         "(docs/elasticity.md); 'moe' audits the quantized "
+                         "expert-parallel dispatch on a data×expert mesh "
+                         "(int8 on the wire, two-level replica groups, "
+                         "tight budget)")
     args = ap.parse_args(argv)
 
     # findings are the stdout payload (the tier-1 gate parses --json);

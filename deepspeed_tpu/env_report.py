@@ -161,6 +161,10 @@ def comms_compression_report():
     print(f"grads ................. int{pol['grads_bits']} qgZ "
           "reduce (error-fed)" if pol["grads_bits"] else
           "grads ................. full width")
+    moe = pol.get("moe") or {}
+    print(f"moe dispatch .......... int{moe['bits']} expert all_to_all "
+          f"(block {moe['block_size']})" if moe.get("bits") else
+          "moe dispatch .......... full width")
     print(f"block_size ............ {pol['block_size']}")
     print(f"hierarchical .......... {pol['hierarchical']}")
     print(f"min_tensor_bytes ...... {pol['min_tensor_bytes']}")

@@ -22,6 +22,7 @@ capacity-drop count); the internal ``MOELayer.apply`` always returns the
 4-tuple.
 """
 
+import itertools
 from typing import Optional
 
 import jax
@@ -49,16 +50,37 @@ class MOELayer:
       the numerics oracle and for comparison (examples/bench_moe.py).
     """
 
+    # per-instance wire site ids: distinct layers (even same-shaped ones
+    # in one model) must each contribute their own exchange to the wire's
+    # census expectation, while retraces of the SAME layer dedup
+    # (moe_wire.MoEWire._record)
+    _wire_sites = itertools.count()
+
     def __init__(self, gate: TopKGate, experts: Experts,
                  dispatch_impl: str = "scatter"):
         assert dispatch_impl in ("scatter", "einsum"), dispatch_impl
         self.gate = gate
         self.experts = experts
         self.dispatch_impl = dispatch_impl
+        self._wire_site = next(MOELayer._wire_sites)
 
     def init(self, rng):
         g, e = jax.random.split(rng)
         return {"gate": self.gate.init(g), "experts": self.experts.init(e)}
+
+    def _active_wire(self, E: int, C: int, d_model: int):
+        """The engine-installed quantized expert wire, iff it applies
+        here: scatter dispatch only, shape supported, and the trace is
+        actually running under the wire's mesh (a leaked wire from
+        another engine's mesh must fall back, never mis-shard)."""
+        from ..runtime.comm import moe_wire as mw
+        wire = mw.get_active()
+        if wire is None or not wire.supports(E, C, d_model):
+            return None
+        am = jax.sharding.get_abstract_mesh()
+        if am.empty or dict(am.shape) != dict(wire.mesh.shape):
+            return None
+        return wire
 
     def apply(self, params, x, rng=None, used_token=None, train: bool = True):
         d_model = x.shape[-1]
@@ -69,6 +91,7 @@ class MOELayer:
         else:
             gate_rng = expert_rng = None
 
+        wire = None
         if self.dispatch_impl == "scatter":
             l_aux, routes, exp_counts, C = self.gate.apply_routes(
                 params["gate"], reshaped, rng=gate_rng,
@@ -76,13 +99,23 @@ class MOELayer:
             E = self.gate.num_experts
             # dispatch: scatter each kept token to its (expert, slot) row;
             # dropped routes (weight 0) address the OOB row and vanish
-            flat = jnp.zeros((E * C, d_model), x.dtype)
             positions = []
             for idx, loc, w in routes:
                 pos = jnp.where(w > 0, idx * C + loc, E * C)
-                flat = flat.at[pos].set(reshaped, mode="drop")
                 positions.append((pos, w))
-            dispatched = flat.reshape(E, C, d_model)
+            wire = self._active_wire(E, C, d_model)
+            if wire is not None:
+                # quantized expert exchange (runtime/comm/moe_wire.py):
+                # int8 + per-block scales on every all_to_all hop, the
+                # gate/capacity math above untouched
+                pos_stack = jnp.stack([pos for pos, _ in positions])
+                dispatched = wire.dispatch(reshaped, pos_stack, E, C,
+                                           site=self._wire_site)
+            else:
+                flat = jnp.zeros((E * C, d_model), x.dtype)
+                for pos, _ in positions:
+                    flat = flat.at[pos].set(reshaped, mode="drop")
+                dispatched = flat.reshape(E, C, d_model)
         else:
             l_aux, combine_weights, dispatch_mask, exp_counts = \
                 self.gate.apply(params["gate"], reshaped, rng=gate_rng,
@@ -93,18 +126,27 @@ class MOELayer:
                                     dispatch_mask.astype(x.dtype), reshaped)
 
         # constraining the expert axis makes XLA emit the forward
-        # all-to-all (reference :525)
+        # all-to-all (reference :525); the quantized wire already landed
+        # the buffer expert-sharded
         dispatched = maybe_constrain(dispatched, P("expert", None, None))
         expert_output = self.experts.apply(params["experts"], dispatched,
                                            rng=expert_rng)
         expert_output = maybe_constrain(expert_output, P("expert", None, None))
 
         if self.dispatch_impl == "scatter":
-            flat_out = expert_output.reshape(-1, d_model)
-            combined = 0.0
-            for pos, w in positions:
-                row = flat_out[jnp.clip(pos, 0, flat_out.shape[0] - 1)]
-                combined = combined + row * w[:, None].astype(x.dtype)
+            if wire is not None:
+                rows = wire.combine(expert_output, pos_stack,
+                                    site=self._wire_site)       # (k, S, M)
+                combined = 0.0
+                for r, (_, w) in enumerate(positions):
+                    combined = combined + rows[r] * \
+                        w[:, None].astype(x.dtype)
+            else:
+                flat_out = expert_output.reshape(-1, d_model)
+                combined = 0.0
+                for pos, w in positions:
+                    row = flat_out[jnp.clip(pos, 0, flat_out.shape[0] - 1)]
+                    combined = combined + row * w[:, None].astype(x.dtype)
         else:
             # combine: (S,E,C) × (E,C,M) → (S,M); the contraction back to
             # token-sharded output is the reverse all-to-all (reference :542)

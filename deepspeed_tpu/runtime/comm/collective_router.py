@@ -35,8 +35,9 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import quantized as Q
+from .moe_wire import MoEWire
 from ...parallel import mesh as M
-from ...utils.logging import logger
+from ...utils.logging import logger, warning_once
 
 EF_DTYPE = jnp.bfloat16      # error-feedback storage (docs/comms-compression.md)
 
@@ -77,16 +78,28 @@ class CollectiveRouter:
         route = f"z{min(max(zero_stage, 0), 3)}"
         self._zero_route_on = (enabled and supports_zero_routes
                                and route in policy.routes)
-        if enabled and not supports_zero_routes and zero_stage > 0:
-            logger.warning(
-                "comms_compression: this engine's ZeRO wire does not "
-                "support compression (pipeline schedules its own "
-                "collectives); gradients/params stay full-width")
+        if enabled and not supports_zero_routes:
+            # fires ONCE per process, at ANY stage: an engine that
+            # schedules its own collectives opts every compressed route
+            # out, and the operator who enabled the policy must hear it
+            # even at zero_stage 0 (where the old stage-gated warning
+            # stayed silent)
+            warning_once(
+                "comms_compression: this engine's wire does not support "
+                "compression (pipeline schedules its own collectives); "
+                "gradients/params/expert dispatch stay full-width")
         self.weights_active = (self._zero_route_on and zero_stage >= 3
                                and self.fsdp > 1
                                and policy.weights_bits is not None)
         self.grads_active = (self._zero_route_on and self.dp_world > 1
                              and policy.grads_bits is not None)
+        # moe route: the quantized expert-parallel dispatch/combine wire
+        # (moe_wire.py) — active only when there IS an expert wire to
+        # compress (expert axis extent > 1)
+        self.moe_active = (enabled and supports_zero_routes
+                           and "moe" in (policy.routes if policy else ())
+                           and getattr(policy, "moe_bits", None) is not None
+                           and mesh_ctx.expert_size > 1)
         # batch axes actually present on the mesh; fsdp-major ordering so
         # the two-level regather (mid -> out) is a pure outer-axis move
         self.batch_axes = tuple(M.BATCH_AXES)
@@ -254,6 +267,18 @@ class CollectiveRouter:
         new_ef = treedef.unflatten([o[1] for o in outs])
         return grads, new_ef
 
+    # ----------------------------------------------------- moe dispatch
+    def moe_wire(self) -> Optional[MoEWire]:
+        """The quantized expert-parallel dispatch wire for this policy,
+        or None (full-width constraint dispatch).  The engine installs
+        the returned wire via ``moe_wire.set_active`` so ``moe/layer.py``
+        finds it at trace time (docs/comms-compression.md)."""
+        if not self.moe_active:
+            return None
+        return MoEWire(self.mesh, bits=int(self.policy.moe_bits),
+                       block_size=int(self.policy.moe_block_size),
+                       hierarchical=bool(self.policy.hierarchical))
+
     # ------------------------------------------------ budget + reporting
     def describe(self) -> dict:
         """Stable policy fingerprint (compile-cache key, ds_report)."""
@@ -262,8 +287,11 @@ class CollectiveRouter:
             "enabled": bool(pol is not None and pol.enabled),
             "weights_active": self.weights_active,
             "grads_active": self.grads_active,
+            "moe_active": self.moe_active,
             "weights_bits": getattr(pol, "weights_bits", None),
             "grads_bits": getattr(pol, "grads_bits", None),
+            "moe_bits": getattr(pol, "moe_bits", None),
+            "moe_block_size": getattr(pol, "moe_block_size", None),
             "block_size": getattr(pol, "block_size", None),
             "hierarchical": getattr(pol, "hierarchical", None),
             "min_tensor_bytes": getattr(pol, "min_tensor_bytes", None),
@@ -279,9 +307,13 @@ class CollectiveRouter:
 
         - all_gather: quantized param payloads + full-width leaves +
           scale/mask side-channels + the level-2 grad regathers;
-        - all_to_all: the level-1 quantized partial-grad exchange.
+        - all_to_all: the level-1 quantized partial-grad exchange;
+        - all_reduce: leaves whose gradients stay full-width (excluded /
+          unplannable) — the partitioner reduces those as f32 all-reduce
+          (this bucket also hosts the MoE wire's outer int8 psums, added
+          by :meth:`comms_budget`).
         """
-        ag = ata = 0
+        ag = ata = ar = 0
         leaves = jax.tree_util.tree_flatten_with_path(params)[0]
         p_specs = jax.tree_util.tree_leaves(
             param_specs, is_leaf=lambda x: isinstance(x, P))
@@ -302,7 +334,7 @@ class CollectiveRouter:
             gplan = self._grad_plan(ps, shape, gsp)
             if gplan is None:
                 # full-width reduction: all-reduce/reduce-scatter of f32
-                ag += 4 * n
+                ar += 4 * n
             else:
                 bits, chunk_dim, lvl2, B = gplan
                 nb = n // max(B, 1)
@@ -315,26 +347,38 @@ class CollectiveRouter:
                     ata += n * bits // 8 + 4 * nb        # q + scales
                     ag += ((n * bits // 8) * O // self.dp_world
                            + 4 * nb * O // self.dp_world + 4 * nb)
-        return {"all_gather": ag, "all_to_all": ata}
+        return {"all_gather": ag, "all_to_all": ata, "all_reduce": ar}
 
     def comms_budget(self, params, param_specs, grad_specs,
                      compute_itemsize: int, *, slack: float = 1.6,
-                     floor: int = 1 << 16):
+                     floor: int = 1 << 16, moe_wire=None):
         """A :class:`analysis.comms.CommsBudget` for the compressed step:
         per-kind ceilings at ``slack`` over the expected quantized wire
         (+ a small floor for loss/norm reductions).  Declared tight
         enough that the FULL-WIDTH step violates it — the budget is an
-        accounting statement, not a formality."""
+        accounting statement, not a formality.
+
+        ``moe_wire``: the engine's active :class:`MoEWire`; its
+        trace-recorded expert-route expectation (int8 all_to_all +
+        outer psum + combine all_gather, both directions) joins the
+        ceilings — available after the first cold trace."""
         from ...analysis.comms import CommsBudget
         exp = self.expected_wire_bytes(params, param_specs, grad_specs,
                                        compute_itemsize)
+        if moe_wire is not None:
+            for kind, b in moe_wire.expected_wire_bytes().items():
+                exp[kind] = exp.get(kind, 0) + b
         per_kind = {
             "all_gather": {"max_bytes": int(exp["all_gather"] * slack)
                            + floor},
             "all_to_all": {"max_bytes": int(exp["all_to_all"] * slack)
                            + floor},
+            # full-width fallback reductions + the moe wire's outer int8
+            # psums; the 4x floor also absorbs loss/norm scalar psums
+            "all_reduce": {"max_bytes": int(exp["all_reduce"] * slack)
+                           + 4 * floor},
         }
-        total = int(sum(exp.values()) * slack) + 4 * floor
+        total = int(sum(exp.values()) * slack) + 8 * floor
         return CommsBudget(per_kind=per_kind, total_max_bytes=total)
 
     # -------------------------------------------------- 1-bit transport

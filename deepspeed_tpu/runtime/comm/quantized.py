@@ -65,7 +65,8 @@ def _sanitize(x):
     return jnp.where(jnp.isfinite(x), x, jnp.zeros_like(x))
 
 
-def quantize_blockwise(x, *, block_size: int = 1024, bits: int = 8):
+def quantize_blockwise(x, *, block_size: int = 1024, bits: int = 8,
+                       zero_scale: float = 1.0):
     """Symmetric per-block quantization along the LAST axis.
 
     Returns ``(q, scales)``:
@@ -74,8 +75,10 @@ def quantize_blockwise(x, *, block_size: int = 1024, bits: int = 8):
                packed within blocks so shard alignment is preserved).
     ``scales`` is fp32 of shape ``(..., K//B)`` with ``B`` the largest
     divisor of K <= block_size.  Guards: all-zero blocks quantize with
-    scale 1 (no 0/0), non-finite inputs are zeroed (see ``_sanitize``),
-    zero-size tensors round-trip as empty.
+    scale ``zero_scale`` (no 0/0; the default 1 keeps dequant exact,
+    the MoE wire passes 0 so disjoint-row partial buffers SUM exactly
+    across devices — ``moe_wire.py``), non-finite inputs are zeroed
+    (see ``_sanitize``), zero-size tensors round-trip as empty.
     """
     assert bits in (4, 8), f"bits must be 4 or 8, got {bits}"
     assert np.ndim(x) >= 1, "quantize_blockwise needs ndim >= 1"
@@ -94,8 +97,12 @@ def quantize_blockwise(x, *, block_size: int = 1024, bits: int = 8):
     xb = _sanitize(x.astype(jnp.float32)).reshape(x.shape[:-1] + (nb, B))
     amax = jnp.max(jnp.abs(xb), axis=-1)
     qmax = 127.0 if bits == 8 else 7.0
-    scales = jnp.where(amax > 0, amax / qmax, jnp.ones_like(amax))
-    q = jnp.clip(jnp.round(xb / scales[..., None]), -qmax, qmax)
+    scales = jnp.where(amax > 0, amax / qmax,
+                       jnp.full_like(amax, jnp.float32(zero_scale)))
+    # divide by a safe scale: an all-zero block (scale possibly 0) must
+    # yield q=0, not 0/0 NaNs cast to int
+    safe = jnp.where(scales > 0, scales, jnp.ones_like(scales))
+    q = jnp.clip(jnp.round(xb / safe[..., None]), -qmax, qmax)
     if bits == 8:
         return q.astype(jnp.int8).reshape(x.shape), scales
     # int4: pack value pairs into one byte, pairs never cross a block

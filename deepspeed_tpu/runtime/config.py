@@ -444,6 +444,27 @@ class DeepSpeedCommsCompressionConfig:
             raise DeepSpeedConfigError(
                 f"comms_compression.routes {bad} unknown; valid: "
                 f"{C.COMMS_COMPRESSION_ROUTES_VALID}")
+        # per-route knobs: the MoE expert-dispatch wire (moe route)
+        moe = get_dict_param(cc, C.COMMS_COMPRESSION_MOE, {}) or {}
+        self.moe_bits = get_scalar_param(
+            moe, C.COMMS_COMPRESSION_MOE_BITS,
+            C.COMMS_COMPRESSION_MOE_BITS_DEFAULT)
+        if self.moe_bits is not None and int(self.moe_bits) != 8:
+            raise DeepSpeedConfigError(
+                "comms_compression.moe.bits must be 8 or null (the "
+                "int8-activation dispatch is the supported MoE scheme; "
+                "null = the expert all_to_all stays full-width)")
+        self.moe_bits = None if self.moe_bits is None else int(self.moe_bits)
+        self.moe_block_size = get_scalar_param(
+            moe, C.COMMS_COMPRESSION_MOE_BLOCK_SIZE,
+            C.COMMS_COMPRESSION_MOE_BLOCK_SIZE_DEFAULT)
+        if self.moe_block_size is None:
+            self.moe_block_size = self.block_size
+        else:
+            self.moe_block_size = int(self.moe_block_size)
+            if self.moe_block_size < 2:
+                raise DeepSpeedConfigError(
+                    "comms_compression.moe.block_size must be >= 2")
 
     def describe(self) -> dict:
         return {"enabled": self.enabled, "weights_bits": self.weights_bits,
@@ -451,7 +472,9 @@ class DeepSpeedCommsCompressionConfig:
                 "hierarchical": self.hierarchical,
                 "min_tensor_bytes": self.min_tensor_bytes,
                 "excluded": list(self.excluded),
-                "routes": list(self.routes)}
+                "routes": list(self.routes),
+                "moe": {"bits": self.moe_bits,
+                        "block_size": self.moe_block_size}}
 
 
 class DeepSpeedMeshConfig:

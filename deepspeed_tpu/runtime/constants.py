@@ -351,8 +351,18 @@ COMMS_COMPRESSION_EXCLUDED = "excluded"
 COMMS_COMPRESSION_EXCLUDED_DEFAULT = ["bias", "norm", "ln_", "layernorm",
                                       "/b"]
 COMMS_COMPRESSION_ROUTES = "routes"
-COMMS_COMPRESSION_ROUTES_DEFAULT = ["z1", "z2", "z3", "param_stream"]
-COMMS_COMPRESSION_ROUTES_VALID = ["z1", "z2", "z3", "param_stream", "pipe"]
+COMMS_COMPRESSION_ROUTES_DEFAULT = ["z1", "z2", "z3", "param_stream", "moe"]
+COMMS_COMPRESSION_ROUTES_VALID = ["z1", "z2", "z3", "param_stream", "pipe",
+                                  "moe"]
+# per-route knobs for the expert-parallel dispatch wire (moe route):
+# activations tolerate coarser blocks than weights, so the block size is
+# independently tunable; bits=None keeps the route full-width even when
+# listed in routes
+COMMS_COMPRESSION_MOE = "moe"
+COMMS_COMPRESSION_MOE_BITS = "bits"
+COMMS_COMPRESSION_MOE_BITS_DEFAULT = 8      # int8 dispatch/combine payload
+COMMS_COMPRESSION_MOE_BLOCK_SIZE = "block_size"
+COMMS_COMPRESSION_MOE_BLOCK_SIZE_DEFAULT = None   # None -> global block_size
 
 #############################################
 # Dataloader

@@ -269,7 +269,14 @@ class DeepSpeedEngine:
             self.zero_stage,
             supports_zero_routes=self._supports_comms_compression)
         self._onebit_transport = None
-        if self._router.weights_active or self._router.grads_active:
+        # quantized expert-parallel dispatch (moe route): the wire is
+        # process-global so moe/layer.py finds it at trace time; install
+        # it now AND before every step dispatch (_install_moe_wire) so a
+        # retrace under THIS engine never sees another engine's policy
+        self._moe_wire = self._router.moe_wire()
+        self._install_moe_wire()
+        if self._router.weights_active or self._router.grads_active \
+                or self._router.moe_active:
             log_dist("comms_compression active: "
                      f"{self._router.describe()}", ranks=[0])
 
@@ -691,21 +698,33 @@ class DeepSpeedEngine:
         from . import compile_cache as ccache
         return ccache.report(self.compile_cache)
 
+    def _install_moe_wire(self):
+        """Make THIS engine's quantized expert wire (or its absence) the
+        process-global one ``moe/layer.py`` reads at trace time — called
+        at init and before every step dispatch, so interleaved engines
+        with different policies each retrace under their own."""
+        from .comm import moe_wire as mw
+        mw.set_active(self._moe_wire)
+
     def comms_budget(self):
         """Declared per-step wire ceiling for the compressed step's
         collective census (``analysis/comms.py CommsBudget``), computed
         from the compression policy — tight enough that the FULL-WIDTH
         step violates it.  None when no compression route is active or
-        the engine streams params."""
+        the engine streams params.  The moe route's component is
+        trace-recorded, so budget-gated flows run one cold step first
+        (docs/comms-compression.md)."""
         if self._param_stream is not None or self.state is None:
             return None
-        if not (self._router.weights_active or self._router.grads_active):
+        if not (self._router.weights_active or self._router.grads_active
+                or self._router.moe_active):
             return None
         base = (self.state.master if self.state.master is not None
                 else self.state.params)
         return self._router.comms_budget(
             base, self._param_specs, self._grad_specs,
-            np.dtype(self.compute_dtype).itemsize)
+            np.dtype(self.compute_dtype).itemsize,
+            moe_wire=self._moe_wire)
 
     def preflight_memory(self, batch, rng=None):
         """Peak-HBM preflight of the compiled step via the executable's
@@ -741,6 +760,11 @@ class DeepSpeedEngine:
         self._pending_offload = None
         self._pending_row_drop_checks = []
         self._data_iterator = None
+        # release the global expert-wire slot iff this engine owns it
+        from .comm import moe_wire as mw
+        if mw.get_active() is not None and mw.get_active() is self._moe_wire:
+            mw.set_active(None)
+        self._moe_wire = None
         for wrapper in (self._jit_train_step, self._jit_grad_step,
                         self._jit_eval, self._jit_scatter_params):
             if hasattr(wrapper, "clear"):
@@ -1299,6 +1323,7 @@ class DeepSpeedEngine:
         """
         from .. import fault
         fault.site("engine.step")    # host-side only; never traced
+        self._install_moe_wire()
         it = data_iter if data_iter is not None else self._data_iterator
         assert it is not None, "train_batch needs training_data or a data_iter"
         if it is not self._data_iterator:
@@ -1656,6 +1681,7 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch, rng=None):
         """Loss without gradient/update (jitted separately)."""
+        self._install_moe_wire()
         self._flush_offload()
         if self._param_stream is not None:
             rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -1702,6 +1728,9 @@ class DeepSpeedEngine:
         over the queued microbatches."""
         if not self.is_gradient_accumulation_boundary():
             return None
+        # a retrace here must see THIS engine's expert-wire policy, not
+        # whichever engine dispatched last (same rule as train_batch)
+        self._install_moe_wire()
         micro_batches, self._pending_microbatches = \
             self._pending_microbatches, []
         if self._param_stream is not None:

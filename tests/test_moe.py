@@ -428,3 +428,322 @@ def test_moe_16e_ep8_dispatch_matches_single(devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
                                rtol=2e-4, atol=1e-5)
     np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
+
+
+# =================================================== quantized expert wire
+# int8 dispatch/combine all_to_all (runtime/comm/moe_wire.py, ISSUE 8 /
+# docs/comms-compression.md `moe` route).  Oracle strategy mirrors the
+# EP tests above: the wire must be a LAYOUT+PRECISION change only — same
+# gate decisions, same aux loss, outputs within the block-scale bound.
+
+from deepspeed_tpu.runtime.comm import moe_wire as mw  # noqa: E402
+
+
+def _wire_setup(devices, k=1, dim=16, tokens=64, capacity_factor=4.0,
+                num_experts=4, block_size=16, hierarchical=True,
+                data_axis=2, seed=8):
+    """Sharded MoE wire fixture: (moe, mesh, wire, p_sh, x_sh, rng).
+
+    Callers build distinct function objects per variant — the process-global wire is
+    read at TRACE time, so reusing one jitted callable across a policy
+    flip would silently reuse the stale executable (exactly why the
+    ENGINE keys its compile cache on the policy)."""
+    moe = MoE(dim, ExpertMLP(dim), num_experts=num_experts, k=k,
+              capacity_factor=capacity_factor, min_capacity=0, use_rts=False)
+    rng = jax.random.PRNGKey(seed)
+    params = moe.init(rng)
+    x = jax.random.normal(jax.random.PRNGKey(seed + 1), (tokens, dim),
+                          jnp.float32)
+    mesh = make_mesh({"data": data_axis, "expert": 8 // data_axis})
+    wire = mw.MoEWire(mesh, bits=8, block_size=block_size,
+                      hierarchical=hierarchical)
+    specs = moe.partition_specs(params)
+    p_sh = jax.device_put(params, jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh, sp), specs,
+        is_leaf=lambda v: isinstance(v, P)))
+    x_sh = jax.device_put(x, NamedSharding(mesh, P(("data", "expert"))))
+    return moe, mesh, wire, p_sh, x_sh, rng
+
+
+@pytest.mark.parametrize("k,hierarchical", [(1, True), (2, True), (1, False)])
+def test_moe_wire_matches_fullwidth(devices, k, hierarchical):
+    """Quantized dispatch/combine vs the full-width constraint path:
+    outputs within a tolerance TIED TO THE BLOCK SCALE (two int8 hops,
+    each bounded by scale/2 = amax/254 per element), gate decisions and
+    aux loss untouched (top-1 AND top-2)."""
+    moe, mesh, wire, p_sh, x_sh, rng = _wire_setup(
+        devices, k=k, hierarchical=hierarchical)
+
+    with jax.set_mesh(mesh):
+        def full_fn(p, xx):
+            out, aux, _ = moe.apply(p, xx, rng=rng)
+            return out, aux
+
+        def quant_fn(p, xx):
+            out, aux, _ = moe.apply(p, xx, rng=rng)
+            return out, aux
+
+        mw.set_active(None)
+        out_f, aux_f = jax.jit(full_fn)(p_sh, x_sh)
+        try:
+            mw.set_active(wire)
+            out_q, aux_q = jax.jit(quant_fn)(p_sh, x_sh)
+        finally:
+            mw.set_active(None)
+
+    assert wire.trace_log, "the quantized wire never traced"
+    out_f, out_q = np.asarray(out_f), np.asarray(out_q)
+    # block-scale bound: dispatch quantizes the activations (amax_in),
+    # combine quantizes the expert outputs; k routes sum.  scale/2 per
+    # element per hop, with slack 2 for the f32 accumulation order.
+    amax_in = np.max(np.abs(np.asarray(x_sh)))
+    amax_out = np.max(np.abs(out_f))
+    bound = 2 * k * (amax_in + amax_out) / 254 + 1e-5
+    err = np.max(np.abs(out_q - out_f))
+    assert err <= bound, (err, bound)
+    assert err > 0                      # it IS a lossy wire (int8 moved)
+    np.testing.assert_allclose(float(aux_q), float(aux_f), rtol=1e-6)
+
+
+def test_moe_wire_gradient_flows_ste(devices):
+    """No silent zero grads through the int8 cast (the qwZ custom_vjp
+    lesson): gradients w.r.t. the dispatched activations AND the expert
+    weights must flow through both quantized exchanges and track the
+    full-width gradients."""
+    moe, mesh, wire, p_sh, x_sh, rng = _wire_setup(devices, k=1)
+
+    with jax.set_mesh(mesh):
+        def mk_loss():
+            def loss_fn(p, xx):
+                # proj on the input makes the dispatch payload depend on
+                # differentiated params -> the dispatch BACKWARD (gather
+                # direction) is exercised too
+                h = xx @ p["proj"]
+                out, aux, _ = moe.apply(p["moe"], h, rng=rng)
+                return jnp.mean(jnp.square(out)) + 0.01 * aux
+            return loss_fn
+
+        proj = jnp.eye(x_sh.shape[-1], dtype=jnp.float32)
+        args = ({"proj": proj, "moe": p_sh}, x_sh)
+        mw.set_active(None)
+        g_f = jax.jit(jax.grad(mk_loss()))(*args)
+        try:
+            mw.set_active(wire)
+            g_q = jax.jit(jax.grad(mk_loss()))(*args)
+        finally:
+            mw.set_active(None)
+
+    tags = [ev["tag"] for ev in wire.trace_log]
+    assert "dispatch_bwd" in tags and "combine_bwd" in tags, tags
+    for path in (("moe", "moe", "experts", "w1"),
+                 ("moe", "moe", "experts", "w2"), ("proj",)):
+        lf, lq = g_f, g_q
+        for kpath in path:
+            lf, lq = lf[kpath], lq[kpath]
+        lf, lq = np.asarray(lf), np.asarray(lq)
+        assert np.linalg.norm(lq) > 1e-6, path   # not silently zeroed
+        rel = np.linalg.norm(lq - lf) / max(np.linalg.norm(lf), 1e-12)
+        assert rel < 0.1, (path, rel)
+
+
+def test_moe_wire_zero_token_expert(devices):
+    """An expert that receives ZERO tokens must contribute exact zeros
+    through the int8 wire (zero-scale blocks sum exactly — the
+    disjointness invariant) and the step stays finite."""
+    # 8 tokens onto 8 experts top-1: several experts get no token
+    moe, mesh, wire, p_sh, x_sh, rng = _wire_setup(
+        devices, k=1, tokens=8, num_experts=8, capacity_factor=8.0)
+
+    with jax.set_mesh(mesh):
+        def full_fn(p, xx):
+            return moe.apply(p, xx, rng=rng)[0]
+
+        def quant_fn(p, xx):
+            return moe.apply(p, xx, rng=rng)[0]
+
+        mw.set_active(None)
+        out_f = jax.jit(full_fn)(p_sh, x_sh)
+        try:
+            mw.set_active(wire)
+            out_q = jax.jit(quant_fn)(p_sh, x_sh)
+        finally:
+            mw.set_active(None)
+
+    out_f, out_q = np.asarray(out_f), np.asarray(out_q)
+    assert np.isfinite(out_q).all()
+    amax = max(np.max(np.abs(out_f)), np.max(np.abs(np.asarray(x_sh))))
+    assert np.max(np.abs(out_q - out_f)) <= 4 * amax / 254 + 1e-5
+
+
+def test_moe_wire_capacity_overflow(devices):
+    """Capacity-dropped routes (weight 0, OOB slot address) must vanish
+    identically on the quantized wire — the drop mask is the gate's,
+    never the quantizer's."""
+    # tiny capacity forces drops: 64 tokens, 4 experts, cf such that
+    # C < per-expert demand
+    moe, mesh, wire, p_sh, x_sh, rng = _wire_setup(
+        devices, k=1, tokens=64, num_experts=4, capacity_factor=0.5)
+
+    with jax.set_mesh(mesh):
+        def full_fn(p, xx):
+            out, _, _, ovf = moe.moe_layer.apply(p["moe"], xx, rng=rng)
+            return out, ovf
+
+        def quant_fn(p, xx):
+            out, _, _, ovf = moe.moe_layer.apply(p["moe"], xx, rng=rng)
+            return out, ovf
+
+        mw.set_active(None)
+        out_f, ovf_f = jax.jit(full_fn)(p_sh, x_sh)
+        try:
+            mw.set_active(wire)
+            out_q, ovf_q = jax.jit(quant_fn)(p_sh, x_sh)
+        finally:
+            mw.set_active(None)
+
+    assert int(ovf_f) > 0, "fixture must actually overflow capacity"
+    assert int(ovf_q) == int(ovf_f)
+    out_f, out_q = np.asarray(out_f), np.asarray(out_q)
+    amax = max(np.max(np.abs(out_f)), np.max(np.abs(np.asarray(x_sh))))
+    assert np.max(np.abs(out_q - out_f)) <= 4 * amax / 254 + 1e-5
+
+
+def test_moe_wire_engine_loss_tracks_full(devices):
+    """EP loss tracking, compressed vs full width, >=8 steps on a
+    data×expert mesh through the ENGINE (the moe route of
+    comms_compression) — plus the wire census: int8 on the all_to_all,
+    replica groups > 1 (two-level phase).  The >=3x reduction acceptance
+    runs at a payload-dominated scale in bench.py's
+    ``moe_wire_compression_cpu8`` rung and ``--audit-step moe``."""
+    from deepspeed_tpu.analysis.jaxpr_audit import audit_engine
+    from deepspeed_tpu.analysis.comms import wire_report
+
+    data = random_dataset(n=256)
+    mesh = make_mesh({"data": 2, "expert": 4})
+
+    def build(comp):
+        cfg = base_config(micro=4, over={})
+        if comp:
+            cfg["comms_compression"] = {
+                "enabled": True, "routes": ["moe"],
+                "moe": {"bits": 8, "block_size": 8}}
+        model = SimpleMoEModel(dim=8, num_experts=4)
+        e, _, _, _ = ds.initialize(config=cfg, model=model,
+                                   training_data=data, mesh=mesh)
+        return e
+
+    e_full = build(False)
+    ref = [float(e_full.train_batch()) for _ in range(8)]
+    e_full.close()
+
+    e = build(True)
+    assert e._router.moe_active and e._moe_wire is not None
+    got = [float(e.train_batch()) for _ in range(8)]
+    rep = audit_engine(e)
+    hlo = [c for c in rep.census if c.level == "hlo"]
+    e.close()
+
+    assert all(np.isfinite(got))
+    assert got[-1] < got[0]                      # it still learns
+    assert abs(got[-1] - ref[-1]) / max(abs(ref[-1]), 1e-6) < 0.1, (ref, got)
+    # the wire truly moved int8, in a grouped (two-level) phase
+    quant = [c for c in hlo if c.quantized]
+    assert any(c.kind == "all_to_all" for c in quant), [c.kind for c in quant]
+    assert any(c.groups > 1 for c in quant)
+    wr = wire_report(hlo)
+    assert wr["quantized_wire_bytes"] > 0
+
+
+def test_moe_wire_census_counts_each_layer_site(devices):
+    """Two same-shaped MoE layers in one model must EACH contribute
+    their exchanges to the wire's census expectation (distinct per-layer
+    sites — otherwise ``comms_budget()`` under-declares and the
+    compressed step's own census violates it), while a RETRACE of the
+    same layers (eval twin, warm re-specialization) must not inflate
+    it."""
+    dim, E = 16, 4
+    mesh = make_mesh({"data": 2, "expert": 4})
+    rng = jax.random.PRNGKey(11)
+    ka, kb = jax.random.split(rng)
+    mk = lambda: MoE(dim, ExpertMLP(dim), num_experts=E, k=1,
+                     capacity_factor=4.0, min_capacity=0, use_rts=False)
+    moe_a, moe_b = mk(), mk()
+    params = {"a": moe_a.init(ka), "b": moe_b.init(kb)}
+    specs = {"a": moe_a.partition_specs(params["a"]),
+             "b": moe_b.partition_specs(params["b"])}
+    p_sh = jax.device_put(params, jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh, sp), specs,
+        is_leaf=lambda v: isinstance(v, P)))
+    x = jax.random.normal(jax.random.PRNGKey(12), (64, dim), jnp.float32)
+    x_sh = jax.device_put(x, NamedSharding(mesh, P(("data", "expert"))))
+
+    def single_fn(p, xx):
+        return moe_a.apply(p["a"], xx, rng=rng)[0]
+
+    def stacked_fn(p, xx):
+        h = moe_a.apply(p["a"], xx, rng=rng)[0]
+        return moe_b.apply(p["b"], h, rng=rng)[0]
+
+    def trace(fn, wire):
+        mw.set_active(wire)
+        try:
+            with jax.set_mesh(mesh):
+                jax.jit(fn)(p_sh, x_sh)
+        finally:
+            mw.set_active(None)
+        return wire.expected_wire_bytes()
+
+    w1 = mw.MoEWire(mesh, bits=8, block_size=16)
+    one = trace(single_fn, w1)
+    w2 = mw.MoEWire(mesh, bits=8, block_size=16)
+    two = trace(stacked_fn, w2)
+    assert one and set(two) == set(one)
+    for kind, b in one.items():
+        assert two[kind] == 2 * b, (kind, one, two)
+    # a retrace of the SAME layers stays deduped
+    assert trace(stacked_fn, w2) == two
+    # a re-specialization at a SMALLER batch shape (eval twin) keeps the
+    # largest variant per (tag, site) — it must not inflate the per-step
+    # expectation by summing two programs
+    x_small = jax.device_put(x[:32], NamedSharding(mesh,
+                                                   P(("data", "expert"))))
+
+    def small_fn(p, _):
+        return stacked_fn(p, x_small)
+
+    assert trace(small_fn, w2) == two
+
+
+@pytest.mark.slow   # three engine builds (conftest budget policy); the
+# key mechanism itself stays tier-1-covered by test_compile_cache.py and
+# test_quantized_comm.py::test_compile_cache_key_covers_compression_policy
+def test_compile_cache_key_covers_moe_policy(devices):
+    """Flipping the moe route (or its knobs) must change the compile
+    cache key: the wire is read at TRACE time, so a stale executable
+    under a different policy would silently move full-width bytes."""
+    mesh = make_mesh({"data": 2, "expert": 4})
+    data = random_dataset(n=64)
+
+    def build(moe_policy):
+        cfg = base_config(micro=4, over={})
+        if moe_policy is not None:
+            cfg["comms_compression"] = {"enabled": True,
+                                        "routes": ["moe"],
+                                        "moe": moe_policy}
+        e, _, _, _ = ds.initialize(config=cfg,
+                                   model=SimpleMoEModel(dim=8,
+                                                        num_experts=4),
+                                   training_data=data, mesh=mesh)
+        return e
+
+    e_off = build(None)
+    e_on = build({"bits": 8, "block_size": 8})
+    e_blk = build({"bits": 8, "block_size": 4})
+    keys = [e._cc_key_slice["comms_compression"]
+            for e in (e_off, e_on, e_blk)]
+    for e in (e_off, e_on, e_blk):
+        e.close()
+    assert keys[0] != keys[1] and keys[1] != keys[2], keys
+    assert keys[1]["enabled"] and keys[1]["moe"] == {"bits": 8,
+                                                     "block_size": 8}
+    assert keys[2]["moe"]["block_size"] == 4

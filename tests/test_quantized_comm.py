@@ -224,6 +224,60 @@ def _mk_router(mesh, policy_overrides=None, stage=3):
     return CollectiveRouter(cfg, mesh, MeshContext(mesh), stage)
 
 
+def test_quantize_zero_scale_blocks():
+    """All-zero blocks carry the caller's ``zero_scale`` (the MoE wire
+    passes 0 so row-disjoint partial buffers SUM exactly on the int8
+    wire); the default 1 keeps the round trip exact, and a zero scale
+    must never turn into 0/0 codes."""
+    x = jnp.zeros((2, 32), jnp.float32)
+    q, s = Q.quantize_blockwise(x, block_size=16, bits=8, zero_scale=0.0)
+    assert np.all(np.asarray(s) == 0) and np.all(np.asarray(q) == 0)
+    out = Q.dequantize_blockwise(q, s, bits=8, out_dtype=jnp.float32)
+    assert np.all(np.asarray(out) == 0)
+    _, s1 = Q.quantize_blockwise(x, block_size=16, bits=8)
+    assert np.all(np.asarray(s1) == 1.0)          # default unchanged
+    # mixed tensor: only the all-zero block gets the zero scale
+    x2 = jnp.concatenate([jnp.zeros((1, 16)), jnp.ones((1, 16))], axis=1)
+    q2, s2 = Q.quantize_blockwise(x2, block_size=16, bits=8,
+                                  zero_scale=0.0)
+    assert np.asarray(s2)[0, 0] == 0 and np.asarray(s2)[0, 1] > 0
+    out2 = Q.dequantize_blockwise(q2, s2, bits=8, out_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(x2))
+
+
+def test_router_unsupported_wire_warns_any_stage(mesh_2x4, monkeypatch):
+    """An engine whose wire cannot compress (pipeline schedules its own
+    collectives) must tell the operator who enabled the policy — at ANY
+    zero stage (the old warning was gated on stage > 0 and stayed
+    silent at stage 0), and exactly once per process."""
+    from deepspeed_tpu.utils import logging as ds_logging
+    from deepspeed_tpu.runtime.config import DeepSpeedCommsCompressionConfig
+    from deepspeed_tpu.parallel.mesh import MeshContext
+    frag = "does not support compression"
+    seen = ds_logging.warning_once.__defaults__[0]
+    for m in [m for m in seen if frag in m]:      # order-independence
+        seen.discard(m)
+    calls = []
+    monkeypatch.setattr(ds_logging.logger, "warning",
+                        lambda msg, *a, **k: calls.append(str(msg)))
+    cfg = DeepSpeedCommsCompressionConfig(
+        {"comms_compression": {"enabled": True}})
+    CollectiveRouter(cfg, mesh_2x4, MeshContext(mesh_2x4), 0,
+                     supports_zero_routes=False)          # stage 0
+    assert len([m for m in calls if frag in m]) == 1, calls
+    CollectiveRouter(cfg, mesh_2x4, MeshContext(mesh_2x4), 3,
+                     supports_zero_routes=False)          # once only
+    assert len([m for m in calls if frag in m]) == 1, calls
+    # a disabled policy stays silent
+    for m in [m for m in seen if frag in m]:
+        seen.discard(m)
+    calls.clear()
+    off = DeepSpeedCommsCompressionConfig({})
+    CollectiveRouter(off, mesh_2x4, MeshContext(mesh_2x4), 2,
+                     supports_zero_routes=False)
+    assert not [m for m in calls if frag in m], calls
+
+
 def test_router_leaf_policy(mesh_2x4):
     r = _mk_router(mesh_2x4)
     assert r.weights_active and r.grads_active
