@@ -43,17 +43,11 @@ import numpy as np
 
 
 def peak_flops_per_chip():
-    """bf16 peak per chip by TPU generation (fallback: v5e)."""
-    import jax
-    kind = jax.devices()[0].device_kind.lower()
-    table = {
-        "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
-        "v4": 275e12, "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12
+    """bf16 peak per chip by TPU generation (fallback: v5e) — the ONE
+    peak table, shared with the engine monitor's live MFU gauge so the
+    headline and ds_top price compute identically."""
+    from deepspeed_tpu.monitor.gauges import peak_flops_per_chip as peak
+    return peak()
 
 
 def hbm_budget_bytes():
@@ -132,7 +126,7 @@ def _cache_stats(engine):
 
 def measure(preset, seq, micro, zero_stage, *, steps=10, warmup=3,
             unroll=True, remat=False, remat_policy=None, loss_chunk=0,
-            cache_dir=None, hbm_budget=None):
+            cache_dir=None, hbm_budget=None, monitor_dir=None):
     """Train `steps` steps; returns the rung record dict.
 
     Keys: ``mfu``, ``tokens_per_sec``, ``samples_per_sec_per_chip``,
@@ -163,6 +157,11 @@ def measure(preset, seq, micro, zero_stage, *, steps=10, warmup=3,
         }
         if cache_dir:
             config["compile_cache"] = {"dir": cache_dir}
+        if monitor_dir:
+            # armed-telemetry rung: the trajectory catches observability
+            # regressions (overhead, dead sinks) alongside perf ones
+            config["monitor"] = {"enabled": True, "dir": monitor_dir,
+                                 "sinks": ["jsonl", "ring"]}
         rng = np.random.default_rng(0)
         tokens = rng.integers(0, model.config.vocab_size,
                               size=(mb * 8, seq + 1)).astype(np.int32)
@@ -711,7 +710,8 @@ def emit_headline(headline: dict, stream=None):
     line = format_headline(headline)
     sys.stderr.flush()
     stream.flush()
-    print(line, file=stream, flush=True)
+    # the CONTRACTUAL final stdout line the driver json-parses
+    print(line, file=stream, flush=True)  # dstpu: disable=DSTPU104
     return line
 
 
@@ -732,11 +732,14 @@ def main():
     # from the start so nothing can trail the final line
     route_logs_to_stderr()
     if "--wire-probe" in sys.argv:
-        # child mode (wire_probe_subprocess): one JSON line on stdout
-        print(json.dumps(measure_wire_compression()), flush=True)
+        # child mode (wire_probe_subprocess): one JSON line on stdout is
+        # the parent's parse contract
+        print(json.dumps(measure_wire_compression()),  # dstpu: disable=DSTPU104
+              flush=True)
         return
     if "--moe-wire-probe" in sys.argv:
-        print(json.dumps(measure_moe_wire_compression()), flush=True)
+        print(json.dumps(measure_moe_wire_compression()),  # dstpu: disable=DSTPU104
+              flush=True)
         return
     t_start = time.time()
     left = lambda: TIME_BUDGET_S - (time.time() - t_start)
@@ -896,6 +899,37 @@ def main():
         except Exception as e:  # one failed point must not kill the bench
             extra[name] = {"error": str(e)[:120]}
 
+    # ---- armed-monitor rung (docs/monitoring.md): the 125M/T512 point
+    # re-runs with the telemetry bus on (warm cache — same executable),
+    # so the trajectory catches observability regressions and the
+    # headline carries measured monitor overhead + events/step
+    base125 = extra.get("gpt2_125m_T512_z1") or {}
+    if left() > 2 * 60 and "tokens_per_sec" in base125:
+        try:
+            with tempfile.TemporaryDirectory(prefix="dstpu-bench-mon-") \
+                    as mon_dir:
+                steps_mon, warmup_mon = 10, 3
+                rec = measure("gpt2-125m", 512, 24, 1, steps=steps_mon,
+                              warmup=warmup_mon, cache_dir=cache_dir,
+                              monitor_dir=mon_dir)
+                stream = os.path.join(mon_dir, "events.jsonl")
+                n_events = (sum(1 for ln in open(stream) if ln.strip())
+                            if os.path.exists(stream) else 0)
+                # measure() executes first-step + (warmup-1) + timed steps
+                total_steps = steps_mon + warmup_mon
+                rec = dict(
+                    rec,
+                    events_per_step=round(n_events / total_steps, 1),
+                    overhead_pct_vs_unarmed=round(
+                        (base125["tokens_per_sec"]
+                         / max(rec["tokens_per_sec"], 1) - 1.0) * 100, 2))
+                extra["gpt2_125m_T512_z1_monitored"] = rec
+        except Exception as e:
+            extra["gpt2_125m_T512_z1_monitored"] = {"error": str(e)[:160]}
+    else:
+        extra["gpt2_125m_T512_z1_monitored"] = {
+            "skipped": "time budget or unarmed baseline missing"}
+
     # The driver captures only the TAIL of stdout and parses the last line as
     # JSON — r4/r5 lost the flagship number because the extras ballooned the
     # single line past the capture window (`parsed: null`, VERDICT.md).  So:
@@ -906,7 +940,9 @@ def main():
     details_error = None
     try:
         with open(details_path, "w") as f:
-            json.dump({"headline_mfu": round(flagship_mfu, 4),
+            # the committed BENCH_DETAILS.json artifact the headline's
+            # details_file field points at (driver protocol)
+            json.dump({"headline_mfu": round(flagship_mfu, 4),  # dstpu: disable=DSTPU104
                        "extra": extra}, f, indent=2)
     except OSError as e:
         details_path, details_error = None, str(e)[:120]
@@ -963,6 +999,11 @@ def main():
             "loss_rel_delta": mi.get("loss_rel_delta"),
             "audit": mi.get("audit"),
         }
+    monrec = extra.get("gpt2_125m_T512_z1_monitored") or {}
+    if "overhead_pct_vs_unarmed" in monrec:
+        headline["extra"]["monitor"] = {
+            "overhead_pct": monrec["overhead_pct_vs_unarmed"],
+            "events_per_step": monrec["events_per_step"]}
     serving = extra.get("serving_125m_b8") or {}
     if "tokens_per_sec" in serving:
         headline["extra"]["serving"] = {

@@ -22,7 +22,8 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                training_data=None, lr_scheduler=None, mpu=None,
                dist_init_required=None, collate_fn=None, config=None,
                config_params=None, mesh=None, loss_fn=None, params=None,
-               apply_fn=None, rng_seed=0, auto_resume=None, elastic=None):
+               apply_fn=None, rng_seed=0, auto_resume=None, elastic=None,
+               monitor=None):
     """Initialize the engine. Returns ``(engine, optimizer, dataloader, lr_scheduler)``.
 
     Parity: reference ``deepspeed/__init__.py:51-151``.  ``args.deepspeed_config``
@@ -45,6 +46,13 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
     re-partition the checkpoint onto the new mesh (docs/elasticity.md).
     Combined, ``--elastic --auto-resume`` is the full
     preemption-survival path.
+
+    ``monitor=True`` (or env ``DSTPU_MONITOR=1`` as set by ``deepspeed
+    --monitor``, or config ``monitor.enabled``) arms the unified runtime
+    telemetry bus (``deepspeed_tpu/monitor``; docs/monitoring.md):
+    per-step spans, MFU/memory gauges, wire-byte counters and trace
+    capture streamed as JSONL for ``python -m deepspeed_tpu.monitor``
+    (``ds_top``) to tail.  ``monitor=False`` forces it off against both.
     """
     if config is None and config_params is not None:
         config = config_params
@@ -65,7 +73,7 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                                 training_data=training_data,
                                 lr_scheduler=lr_scheduler, mesh=mesh,
                                 collate_fn=collate_fn, rng_seed=rng_seed,
-                                elastic=elastic)
+                                elastic=elastic, monitor=monitor)
     else:
         engine = DeepSpeedEngine(model=model, optimizer=optimizer, config=config,
                                  training_data=training_data,
@@ -74,7 +82,7 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                                  params=params, apply_fn=apply_fn,
                                  rng_seed=rng_seed, mpu=mpu,
                                  dist_init_required=dist_init_required,
-                                 elastic=elastic)
+                                 elastic=elastic, monitor=monitor)
     _maybe_auto_resume(engine, auto_resume)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 

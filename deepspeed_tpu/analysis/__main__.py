@@ -74,6 +74,9 @@ def _audit_builtin_steps(stages):
             if str(spec) == "moe":
                 findings.extend(_audit_moe_step())
                 continue
+            if str(spec) == "monitor":
+                findings.extend(_audit_monitor_step(cache_dir))
+                continue
             compressed = str(spec).endswith("q")
             stage = int(str(spec).rstrip("q"))
             cfg = {"train_micro_batch_size_per_gpu": 4,
@@ -303,6 +306,87 @@ def _audit_moe_step():
     return findings
 
 
+def _audit_monitor_step(cache_dir):
+    """--audit-step monitor: prove that an ARMED monitor leaves the
+    compiled train step clean (docs/monitoring.md).  Twin tiny engines
+    — monitor off and monitor on (jsonl+ring sinks into a tmp dir) —
+    must produce byte-identical ``_train_step`` jaxprs (the PR-3
+    equality gate), the armed engine's compiled step must show zero
+    DSTPU201 host callbacks, and the stream it wrote must parse line by
+    line under the versioned schema."""
+    import shutil
+    import tempfile
+    import numpy as np
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.monitor import parse_line
+    from deepspeed_tpu.monitor.sinks import EVENTS_FILE
+    from .findings import Finding
+    from .jaxpr_audit import audit_engine, train_step_jaxpr_text
+
+    data = (np.ones((8, 16), np.float32), np.ones((8, 16), np.float32))
+    dataset = [(data[0][i], data[1][i]) for i in range(8)]
+    mon_dir = tempfile.mkdtemp(prefix="dstpu-audit-mon-")
+    findings = []
+
+    def build(mon_cfg):
+        cfg = {"train_micro_batch_size_per_gpu": 4,
+               "gradient_accumulation_steps": 1,
+               "steps_per_print": 10 ** 9,
+               "bf16": {"enabled": True},
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": 2},
+               "compile_cache": {"dir": cache_dir}}
+        if mon_cfg:
+            cfg["monitor"] = mon_cfg
+        return ds.initialize(config=cfg, model=_MLP(),
+                             training_data=dataset)[0]
+
+    try:
+        off = build(None)
+        armed = build({"enabled": True, "dir": mon_dir,
+                       "sinks": ["jsonl", "ring"], "interval": 1})
+
+        if train_step_jaxpr_text(off) != train_step_jaxpr_text(armed):
+            findings.append(Finding(
+                "DSTPU201", "error",
+                "--audit-step monitor: the armed monitor CHANGED the "
+                "traced train step (jaxpr monitor-on != monitor-off) — "
+                "instrumentation leaked into the compiled program",
+                eqn_path="monitor/jaxpr-equality"))
+        off.close()
+
+        armed.train_batch()
+        armed.train_batch()
+        report = audit_engine(armed)
+        for f in report.findings:
+            f.extra = dict(f.extra, audit="monitor-armed")
+        findings.extend(report.findings)
+        armed.monitor.flush()
+        stream = os.path.join(mon_dir, EVENTS_FILE)
+        try:
+            events = [parse_line(ln) for ln in open(stream)
+                      if ln.strip()]
+        except Exception as e:
+            events = None
+            findings.append(Finding(
+                "DSTPU200", "warning",
+                f"--audit-step monitor: event stream did not parse ({e})",
+                eqn_path="monitor/stream"))
+        if events is not None:
+            kinds = {e.kind for e in events}
+            missing = {"step", "span"} - kinds
+            if missing:
+                findings.append(Finding(
+                    "DSTPU200", "warning",
+                    f"--audit-step monitor: armed run emitted no "
+                    f"{sorted(missing)} events (got {sorted(kinds)})",
+                    eqn_path="monitor/stream"))
+        armed.close()
+    finally:
+        shutil.rmtree(mon_dir, ignore_errors=True)
+    return findings
+
+
 def _audit_elastic_resume():
     """--audit-step elastic: audit the FIRST compiled step after an elastic
     reshard-on-resize (docs/elasticity.md) — a ZeRO-2 elastic engine saves
@@ -398,7 +482,10 @@ def main(argv=None):
                          "(docs/elasticity.md); 'moe' audits the quantized "
                          "expert-parallel dispatch on a data×expert mesh "
                          "(int8 on the wire, two-level replica groups, "
-                         "tight budget)")
+                         "tight budget); 'monitor' proves an ARMED "
+                         "telemetry monitor leaves the compiled step "
+                         "byte-identical and host-callback-free while "
+                         "its JSONL stream parses (docs/monitoring.md)")
     args = ap.parse_args(argv)
 
     # findings are the stdout payload (the tier-1 gate parses --json);

@@ -433,6 +433,25 @@ def _audit_hlo_collectives(compiled, report):
 
 
 # ------------------------------------------------------------- public API
+def train_step_jaxpr_text(engine, batch=None, rng=None) -> str:
+    """Normalized jaxpr text of an engine's traced train step — the
+    byte-identity term of the monitor purity gate (``--audit-step
+    monitor`` and the tier-1 twin test compare armed vs unarmed engines
+    through this ONE helper so the normalization cannot drift).  Object
+    addresses (``0x...`` inside partial/function reprs) are scrubbed:
+    instance noise, not program content."""
+    import jax
+
+    if batch is None:
+        batch = engine._stack_microbatches([next(engine._data_iterator)])
+    if rng is None:
+        rng = jax.random.fold_in(engine._base_rng, 0)
+    with jax.set_mesh(engine.mesh):
+        text = str(jax.make_jaxpr(engine._train_step)(engine.state, batch,
+                                                      rng))
+    return re.sub(r"0x[0-9a-f]+", "0x", text)
+
+
 def audit_fn(fn, *example_args, donate_argnums=(), compute_dtype=None,
              comms_budget: Optional[CommsBudget] = None, mesh=None,
              compile: bool = True, **example_kwargs) -> AuditReport:

@@ -15,9 +15,15 @@ Rule catalog (see ``docs/static-analysis.md``):
             function: ``float()``, ``np.asarray()``/``np.array()``,
             ``jax.device_get()``, ``.item()`` — a host sync (or a
             tracer error) in the hot path                           (error)
+  DSTPU104  ad-hoc metric emission (``print``/direct ``json.dump``)
+            in runtime/inference code — metrics go through the
+            monitor bus (one schema) or the logger; deliberate
+            contractual outputs (the bench headline stdout line)
+            carry per-site suppressions                             (error)
 """
 
 import ast
+import os
 
 from . import Rule, register
 
@@ -207,6 +213,51 @@ class RawCollective(Rule):
                     f"raw collective `{base}.{node.attr}` outside "
                     "parallel/collectives.py (use the "
                     "parallel.collectives wrapper)")
+
+
+@register
+class AdhocMetricEmission(Rule):
+    id = "DSTPU104"
+    name = "adhoc-metric-emission"
+    severity = "error"
+    description = ("runtime/inference code must emit metrics through the "
+                   "monitor bus (deepspeed_tpu/monitor) or the logger; "
+                   "bare print()/json.dump() invents a one-off format "
+                   "ds_top and the schema tests cannot see")
+
+    # scope: the runtime + inference trees (where the monitor bus is the
+    # one sanctioned metric path) and the bench driver (whose contractual
+    # stdout headline carries explicit per-site suppressions)
+    SCOPE_DIRS = ("runtime/", "inference/")
+    SCOPE_FILES = ("bench.py",)
+
+    def _in_scope(self, relpath):
+        norm = relpath.replace("\\", "/")
+        if "/monitor/" in norm or norm.startswith("monitor/"):
+            return False              # the bus itself (and ds_top's table)
+        return any(d in norm for d in self.SCOPE_DIRS) or \
+            os.path.basename(norm) in self.SCOPE_FILES
+
+    def check(self, tree, src, relpath):
+        if not self._in_scope(relpath):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted == "print":
+                yield self.finding(
+                    relpath, node.lineno,
+                    "`print(...)` in runtime/inference code — emit "
+                    "metrics via the monitor bus or logger (suppress "
+                    "per-site for contractual stdout protocols)")
+            elif dotted == "json.dump":
+                yield self.finding(
+                    relpath, node.lineno,
+                    "direct `json.dump(...)` of a metrics/artifact dict "
+                    "— route it through the monitor bus (artifact "
+                    "events), or suppress per-site with the reviewed "
+                    "reason")
 
 
 @register

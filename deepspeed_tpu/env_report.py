@@ -172,10 +172,37 @@ def comms_compression_report():
     print(f"routes ................ {', '.join(pol['routes'])}")
 
 
+def monitor_report():
+    """Resolved runtime-telemetry policy (docs/monitoring.md): config
+    defaults + the DSTPU_MONITOR / DSTPU_MONITOR_DIR env overrides,
+    exactly as an engine built in this environment would resolve them."""
+    import os as _os
+    from .runtime.config import DeepSpeedMonitorConfig
+    from .monitor.core import resolve_run_dir
+
+    print("-" * 64)
+    print("Monitor (DSTPU_MONITOR / config `monitor`):")
+    print("-" * 64)
+    pol = _safe(lambda: DeepSpeedMonitorConfig({}).describe())
+    if not isinstance(pol, dict):
+        print(f"policy ................ {pol}")
+        return
+    env = _os.environ.get("DSTPU_MONITOR")
+    src = f"env DSTPU_MONITOR={env}" if env else "config default (off)"
+    print(f"enabled ............... {pol['enabled']} ({src})")
+    print(f"sinks ................. {', '.join(pol['sinks'])}")
+    print(f"dir ................... {_safe(lambda: resolve_run_dir(pol['dir']))}")
+    print(f"interval .............. every {pol['interval']} step(s)")
+    print(f"ring_size ............. {pol['ring_size']} events")
+    print(f"trace_steps ........... {pol['trace_steps'] or 'disabled'}")
+    print("tail with ............. python -m deepspeed_tpu.monitor <dir>")
+
+
 def main():
     op_report()
     compile_cache_report()
     comms_compression_report()
+    monitor_report()
     debug_report()
 
 

@@ -20,6 +20,7 @@ TPU re-design:
   "kernels" are the jitted/pallas paths those models already use.
 """
 
+import os
 from typing import Any, Optional
 
 import numpy as np
@@ -328,8 +329,29 @@ class InferenceEngine:
         tree, _ = load_tree(path, with_meta=True)
         return tree["params"]
 
-    def profile_model_time(self, *a, **k):
-        logger.warning("profile_model_time: use jax.profiler traces on TPU")
+    def profile_model_time(self, tokens=None, trace_dir=None):
+        """Capture a ``jax.profiler`` device trace of one forward pass and
+        return the xplane artifact path (None when the profiler is
+        unavailable).  This used to be a warning telling the user to do
+        it themselves; the monitor layer (``monitor/trace.py``,
+        docs/monitoring.md) now owns the capture — training gets the
+        same thing config-driven via ``monitor.trace_steps``."""
+        from ..monitor import core as moncore
+        from ..monitor import trace as mtrace
+        if tokens is None:
+            tokens = np.zeros((1, 8), np.int32)
+        trace_dir = trace_dir or os.path.join(moncore.resolve_run_dir(),
+                                              "traces")
+        # synchronize via a VALUE READ, not block_until_ready — on the
+        # axon TPU platform block_until_ready returns while work is still
+        # queued (the bench.py lesson), which would close the trace
+        # window before the device executed anything
+        path = mtrace.capture(
+            trace_dir, lambda: np.asarray(self.forward(tokens)[:1, :1]))
+        if path is not None:
+            log_dist(f"profile_model_time: trace captured at {path}",
+                     ranks=[0])
+        return path
 
     def close(self):
         """Release live compiled executables and the param tree.

@@ -39,6 +39,7 @@ slot assignment, or what else shares the batch (tested:
 """
 
 import dataclasses
+import os
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -124,7 +125,8 @@ class ServingEngine:
     """
 
     def __init__(self, model=None, params=None, engine=None, config=None,
-                 mesh=None, compile_cache=None, **engine_kwargs):
+                 mesh=None, compile_cache=None, monitor=None,
+                 **engine_kwargs):
         from .engine import InferenceEngine
         self._owns_engine = engine is None
         if engine is None:
@@ -132,6 +134,17 @@ class ServingEngine:
                                      compile_cache=compile_cache,
                                      **engine_kwargs)
         self.engine = engine
+        # unified telemetry (docs/monitoring.md): pass a Monitor, True
+        # (env-default run dir), or None -> env DSTPU_MONITOR decides.
+        # The serving stats export rides the same bus/schema as training.
+        from ..monitor import core as moncore
+        if monitor is None:
+            monitor = bool(moncore.env_enabled(False))
+        self._owns_monitor = not hasattr(monitor, "armed")
+        if monitor is True:
+            monitor = moncore.Monitor(run_dir=moncore.resolve_run_dir(),
+                                      role="serving")
+        self.monitor = monitor if monitor else moncore.NullMonitor()
         if config is None:
             config = ServingConfig()
         elif isinstance(config, dict):
@@ -439,10 +452,11 @@ class ServingEngine:
         blk = jnp.asarray(np.asarray(blocks[:nb_pre], np.int32))
         fn = self._prefill_fn(bucket)
         with jax.set_mesh(self.engine.mesh):
-            first, self.pool = fn(
-                self.engine.params, jnp.asarray(toks), self.pool, blk,
-                jnp.int32(T), jnp.int32(req.seed),
-                jnp.float32(req.temperature), jnp.asarray(req.do_sample))
+            with self.monitor.span("prefill"):
+                first, self.pool = fn(
+                    self.engine.params, jnp.asarray(toks), self.pool, blk,
+                    jnp.int32(T), jnp.int32(req.seed),
+                    jnp.float32(req.temperature), jnp.asarray(req.do_sample))
         first = int(np.asarray(first))
 
         s = _Slot(req, blocks, T, new)
@@ -487,26 +501,65 @@ class ServingEngine:
         Returns False when there is nothing left to do."""
         if not self._preflight_done:
             self._preflight_gate()
-        self._admit()
+        mon = self.monitor
+        mon.begin_step()
+        with mon.span("admit"):
+            self._admit()
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
+            # idle poll: nothing decoded — discard the bracket instead of
+            # emitting spans under a reused step number
+            mon.abort_step()
             return bool(self.queue)
         self._build_decode()
         with jax.set_mesh(self.engine.mesh):
-            nxt, self.pool = self._decode(*self._decode_args())
-        nxt = np.asarray(nxt)
-        self._steps += 1
-        c = self.config
-        for i in active:
-            s = self._slots[i]
-            tok = int(nxt[i])
-            s.out_tokens.append(tok)
-            self._lengths[i] += 1
-            self._toks[i] = tok
-            self._ngen[i] += 1
-            if len(s.out_tokens) >= s.max_new or tok == c.eos_token_id:
-                self._finish(i)
+            with mon.span("dispatch"):
+                nxt, self.pool = self._decode(*self._decode_args())
+        with mon.span("sample_join"):
+            nxt = np.asarray(nxt)
+            self._steps += 1
+            c = self.config
+            for i in active:
+                s = self._slots[i]
+                tok = int(nxt[i])
+                s.out_tokens.append(tok)
+                self._lengths[i] += 1
+                self._toks[i] = tok
+                self._ngen[i] += 1
+                if len(s.out_tokens) >= s.max_new or tok == c.eos_token_id:
+                    self._finish(i)
+        self._monitor_finish(len(active))
         return True
+
+    # decode steps between latency-percentile emissions: stats() sorts two
+    # <=4096-entry windows, which must not run per generated token
+    _PERCENTILES_EVERY = 16
+
+    def _monitor_finish(self, active_slots):
+        """Per-decode-step telemetry: the serving stats (previously an
+        export-only dict) re-routed through the bus in the one schema.
+        Cheap counters ride every emitted step; the percentile gauges
+        (a sort over the completion windows) ride a coarser cadence."""
+        mon = self.monitor
+        if not mon.armed or not mon.should_emit(self._steps):
+            mon.end_step(self._steps, name="serving_step")
+            return
+        scalars = {"active_slots": active_slots,
+                   "queued": len(self.queue),
+                   "completed_total": self._completed_total,
+                   "generated_total": self._generated_total,
+                   "free_blocks": self.allocator.free_blocks}
+        gauges = {}
+        if self._steps % self._PERCENTILES_EVERY == 0:
+            st = self.stats()
+            if "latency_ms" in st:
+                gauges["latency_p50_ms"] = st["latency_ms"]["p50"]
+                gauges["latency_p99_ms"] = st["latency_ms"]["p99"]
+            if "ttft_ms" in st:
+                gauges["ttft_p50_ms"] = st["ttft_ms"]["p50"]
+        mon.set_rates(tokens_per_step=active_slots)
+        mon.end_step(self._steps, scalars=scalars, gauges=gauges,
+                     name="serving_step")
 
     def run(self, requests=None, max_steps: int = 10 ** 6) -> Dict[int, dict]:
         """Submit ``requests`` (if given) and drive :meth:`step` until
@@ -584,5 +637,7 @@ class ServingEngine:
         self._decode = None
         self._prefills.clear()
         self.pool = None
+        if self._owns_monitor:
+            self.monitor.close()
         if self._owns_engine:
             self.engine.close()

@@ -91,6 +91,23 @@ def parse_args(args=None):
                         help="Force the health guardian OFF (sets "
                              "DSTPU_HEALTH_CHECK=0) — e.g. for numerics "
                              "debugging where NaN steps must be applied")
+    parser.add_argument("--monitor", default=None, action="store_true",
+                        dest="monitor",
+                        help="Arm the unified runtime telemetry bus (sets "
+                             "DSTPU_MONITOR=1, overriding a config that "
+                             "disables it): per-step spans, MFU/memory "
+                             "gauges, wire-byte counters streamed as JSONL "
+                             "for `python -m deepspeed_tpu.monitor` to "
+                             "tail; see docs/monitoring.md")
+    parser.add_argument("--no-monitor", dest="monitor",
+                        action="store_false",
+                        help="Force the monitor OFF (sets DSTPU_MONITOR=0) "
+                             "even when the config enables it")
+    parser.add_argument("--monitor-dir", type=str, default="",
+                        dest="monitor_dir",
+                        help="Telemetry output directory (sets "
+                             "DSTPU_MONITOR_DIR; default ./ds_monitor). "
+                             "The same path is what ds_top tails.")
     parser.add_argument("--comms-compression", default=None,
                         action="store_true", dest="comms_compression",
                         help="Force quantized ZeRO collectives ON (sets "
@@ -229,6 +246,10 @@ def main(args=None):
         env["DSTPU_COMPILE_CACHE"] = args.compile_cache_dir
     if args.health_check is not None:
         env["DSTPU_HEALTH_CHECK"] = "1" if args.health_check else "0"
+    if args.monitor is not None:
+        env["DSTPU_MONITOR"] = "1" if args.monitor else "0"
+    if args.monitor_dir:
+        env["DSTPU_MONITOR_DIR"] = args.monitor_dir
     if args.comms_compression is not None:
         env["DSTPU_COMMS_COMPRESSION"] = \
             "1" if args.comms_compression else "0"

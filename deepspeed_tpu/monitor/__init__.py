@@ -1,0 +1,49 @@
+"""Unified runtime telemetry: one event bus, one schema, many consumers.
+
+The reference ships a monitoring stack (``deepspeed/monitor/``, the
+``wall_clock_breakdown`` timers of ``utils/timer.py``, the FLOPS profiler,
+a tensorboard writer); this reproduction's equivalents were scattered
+one-off emitters — a torch-importing tensorboard path that could never
+run here, ``wall_clock_breakdown`` parsed but driving nothing, and health
+forensics / serving stats / wire census each inventing a format.  This
+package replaces them with a process-local **event bus** over a typed,
+versioned event schema (``events.Event``: ``step`` | ``span`` | ``gauge``
+| ``counter`` | ``artifact``) and pluggable sinks:
+
+- :class:`sinks.JSONLSink` — the default stream (rank-0, one event per
+  line, O_APPEND-atomic writes through the PR-1 retry IO);
+- :class:`sinks.CSVSink` — the same events as a flat table;
+- :class:`sinks.RingBufferSink` — bounded in-memory history (the class
+  behind the health guardian's forensic ring);
+- :class:`sinks.TensorboardSink` — scalar export through a NON-torch
+  writer when one is importable (degrades to a one-line warning).
+
+Instrumentation is **monitor-side only**: spans are host wall-clock
+brackets around the dispatch path, gauges/counters are host reads of
+already-computed values — nothing here is traced into a jitted step, so
+an armed monitor leaves the compiled program byte-identical (gated by
+the jaxpr-equality test and the ``--audit-step monitor`` stage).
+
+Consumption: ``python -m deepspeed_tpu.monitor <run_dir>`` (``ds_top``)
+tails the JSONL stream into a refreshing terminal table.
+
+See docs/monitoring.md for the schema, span taxonomy, configuration
+(config ``monitor`` block > env ``DSTPU_MONITOR`` > ``deepspeed
+--monitor``), and the overhead guarantees.
+"""
+
+from .events import SCHEMA_VERSION, EVENT_KINDS, Event, parse_line
+from .ring import RingBuffer
+from .bus import MonitorBus
+from .spans import SpanRecorder
+from .sinks import (Sink, JSONLSink, CSVSink, RingBufferSink,
+                    TensorboardSink, SinkUnavailable, EVENTS_FILE)
+from .core import Monitor, NullMonitor, from_config
+
+__all__ = [
+    "SCHEMA_VERSION", "EVENT_KINDS", "Event", "parse_line",
+    "RingBuffer", "MonitorBus", "SpanRecorder",
+    "Sink", "JSONLSink", "CSVSink", "RingBufferSink", "TensorboardSink",
+    "SinkUnavailable", "EVENTS_FILE",
+    "Monitor", "NullMonitor", "from_config",
+]

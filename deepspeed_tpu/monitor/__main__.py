@@ -1,0 +1,208 @@
+"""``ds_top``: tail a monitor run's JSONL stream into a live terminal table.
+
+Usage::
+
+    python -m deepspeed_tpu.monitor <run_dir | events.jsonl> \
+        [--interval 2] [--once] [--tail N]
+
+Reads ``events.jsonl`` incrementally (only bytes appended since the last
+poll), folds the events into one aggregate view (latest step scalars,
+latest gauges/counters by name, the last step's span breakdown, artifact
+announcements), and redraws the table every ``--interval`` seconds.
+``--once`` renders a single frame and exits (scripting/tests).
+
+Malformed or future-schema lines are counted and skipped — a live tail
+must survive a writer mid-line or a newer producer.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+from .events import parse_line
+from .sinks import EVENTS_FILE
+
+
+class StreamFollower:
+    """Incremental JSONL reader: remembers the byte offset, returns only
+    complete new lines each poll (a partial trailing line is carried)."""
+
+    def __init__(self, path):
+        self.path = path
+        self.offset = 0
+        self._carry = ""
+        self.bad_lines = 0
+
+    def poll(self):
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self.offset:        # truncated/rotated: restart
+            self.offset, self._carry = 0, ""
+        if size == self.offset:
+            return []
+        with open(self.path, "r", encoding="utf-8") as f:
+            f.seek(self.offset)
+            chunk = f.read()
+            self.offset = f.tell()
+        data = self._carry + chunk
+        lines = data.split("\n")
+        self._carry = lines.pop()     # "" on a complete final line
+        events = []
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                events.append(parse_line(line))
+            except Exception:
+                self.bad_lines += 1
+        return events
+
+
+class Aggregate:
+    """Folds the event stream into the state one table frame renders."""
+
+    def __init__(self):
+        self.step = None              # latest step event
+        self.gauges = {}              # name -> (step, value)
+        self.counters = {}
+        self.spans = {}               # spans of the newest span-step
+        self._span_step = None
+        self.artifacts = []           # newest-last (path, name)
+        self.events = 0
+        self.skips_total = 0
+        self.last_t = None
+
+    def feed(self, events):
+        for e in events:
+            self.events += 1
+            self.last_t = e.t
+            if e.kind == "step":
+                self.step = e
+                if e.fields.get("skip"):
+                    self.skips_total += 1
+            elif e.kind == "gauge":
+                self.gauges[e.name] = (e.step, e.value)
+            elif e.kind == "counter":
+                self.counters[e.name] = (e.step, e.value)
+            elif e.kind == "span":
+                if e.step != self._span_step:
+                    self._span_step = e.step
+                    self.spans = {}
+                self.spans[e.name] = e
+            elif e.kind == "artifact":
+                self.artifacts.append((e.name, e.path))
+                del self.artifacts[:-4]
+
+
+def _fmt(v, unit=""):
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return str(v)
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    if unit == "B":
+        for u in ("B", "KB", "MB", "GB", "TB"):
+            if abs(v) < 1024 or u == "TB":
+                return f"{v:.1f}{u}" if u != "B" else f"{v:.0f}B"
+            v /= 1024
+    if abs(v) >= 1e5 or 0 < abs(v) < 1e-3:
+        return f"{v:.3e}"
+    if abs(v) >= 10:
+        return f"{v:.1f}"
+    return f"{v:.4f}"
+
+
+def render(agg: Aggregate, source: str, clock=time.time) -> str:
+    """One table frame as a string (pure: unit-testable)."""
+    g = lambda name: agg.gauges.get(name, (None, None))[1]
+    c = lambda name: agg.counters.get(name, (None, None))[1]
+    step = agg.step
+    fields = step.fields if step is not None else {}
+    age = (f"{clock() - agg.last_t:5.1f}s ago" if agg.last_t is not None
+           else "never")
+    lines = [
+        f"ds_top — {source}",
+        f"events: {agg.events}   last event: {age}",
+        "-" * 78,
+        f"{'step':>8} {'loss':>10} {'lr':>10} {'tokens/s':>10} "
+        f"{'MFU':>7} {'HBM':>9} {'wire/step':>10} {'skips':>6}",
+        f"{_fmt(step.step if step else None):>8} "
+        f"{_fmt(fields.get('loss')):>10} "
+        f"{_fmt(fields.get('lr')):>10} "
+        f"{_fmt(g('tokens_per_sec') or g('samples_per_sec')):>10} "
+        f"{_fmt(g('mfu')):>7} "
+        f"{_fmt(g('device_mem_in_use') or g('hbm_peak_projected'), 'B'):>9} "
+        f"{_fmt(c('wire_bytes_per_step'), 'B'):>10} "
+        f"{_fmt(fields.get('skipped_steps', agg.skips_total)):>6}",
+    ]
+    if agg.spans:
+        root = agg.spans.get("step")
+        parts = [f"step {root.dur_s * 1e3:.1f}ms"] if root is not None \
+            else []
+        parts += [f"{n} {e.dur_s * 1e3:.1f}" for n, e in
+                  sorted(((n, e) for n, e in agg.spans.items()
+                          if n != "step"), key=lambda kv: -kv[1].dur_s)]
+        lines += ["-" * 78, "spans (ms): " + " | ".join(parts)]
+    extra = {k: v for k, (_, v) in sorted(agg.gauges.items())
+             if k not in ("tokens_per_sec", "samples_per_sec", "mfu",
+                          "device_mem_in_use", "hbm_peak_projected")}
+    if extra:
+        lines.append("gauges: " + "  ".join(
+            f"{k}={_fmt(v)}" for k, v in extra.items()))
+    if agg.artifacts:
+        lines += ["artifacts:"] + [f"  [{n}] {p}" for n, p in
+                                   agg.artifacts]
+    return "\n".join(lines)
+
+
+def resolve_stream(path: str) -> str:
+    return (path if path.endswith(".jsonl")
+            else os.path.join(path, EVENTS_FILE))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.monitor",
+        description="ds_top: live terminal view of a monitor event stream")
+    ap.add_argument("run", help="monitor run dir (or an events.jsonl path)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--tail", type=int, default=0,
+                    help="with --once: also print the last N raw events")
+    args = ap.parse_args(argv)
+
+    stream = resolve_stream(args.run)
+    follower = StreamFollower(stream)
+    agg = Aggregate()
+    if not os.path.exists(stream) and args.once:
+        print(f"ds_top: no event stream at {stream}")
+        return 1
+    try:
+        while True:
+            events = follower.poll()
+            agg.feed(events)
+            frame = render(agg, stream)
+            if args.once:
+                print(frame)
+                if args.tail:
+                    for e in (events or [])[-args.tail:]:
+                        print(e.to_json())
+                return 0
+            # full-screen redraw (clear + home); plain prints would scroll
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

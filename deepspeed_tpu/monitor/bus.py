@@ -1,0 +1,84 @@
+"""The process-local event bus: emit once, deliver to every sink.
+
+Failure isolation is the bus's one hard guarantee: a sink that raises is
+detached after ONE logged warning and never consulted again — telemetry
+must never kill (or even retry inside) a train step.  Detached sinks are
+recorded in :attr:`MonitorBus.dead_sinks` so ``ds_report``/tests can see
+what was lost and why.
+"""
+
+import time
+
+from ..utils.logging import logger
+from .events import Event
+
+
+class MonitorBus:
+    def __init__(self, sinks=(), clock=time.time):
+        self._sinks = list(sinks)
+        self._clock = clock
+        self.dead_sinks = {}          # sink name -> repr(exception)
+        self.emitted = 0
+
+    @property
+    def sinks(self):
+        return tuple(self._sinks)
+
+    def attach(self, sink):
+        self._sinks.append(sink)
+
+    def emit(self, event: Event):
+        self.emitted += 1
+        for sink in tuple(self._sinks):
+            try:
+                sink.write(event)
+            except Exception as e:
+                self._detach(sink, e)
+
+    def _detach(self, sink, exc):
+        name = getattr(sink, "name", type(sink).__name__)
+        try:
+            self._sinks.remove(sink)
+        except ValueError:  # raced with another detach path
+            pass
+        self.dead_sinks[name] = repr(exc)
+        logger.warning(
+            f"monitor: sink {name!r} raised {exc!r}; detached — telemetry "
+            "to this sink stops, training continues")
+
+    # ------------------------------------------------------------ emit sugar
+    def step(self, name, step, value=None, **fields):
+        self.emit(Event(kind="step", name=name, t=self._clock(), step=step,
+                        value=value, fields=fields))
+
+    def span(self, name, dur_s, step=None, parent=None, **fields):
+        self.emit(Event(kind="span", name=name, t=self._clock(), step=step,
+                        dur_s=dur_s, parent=parent, fields=fields))
+
+    def gauge(self, name, value, step=None, **fields):
+        self.emit(Event(kind="gauge", name=name, t=self._clock(), step=step,
+                        value=value, fields=fields))
+
+    def counter(self, name, value, step=None, **fields):
+        self.emit(Event(kind="counter", name=name, t=self._clock(),
+                        step=step, value=value, fields=fields))
+
+    def artifact(self, name, path, step=None, **fields):
+        self.emit(Event(kind="artifact", name=name, t=self._clock(),
+                        step=step, path=path, fields=fields))
+
+    # -------------------------------------------------------------- lifecycle
+    def flush(self):
+        for sink in tuple(self._sinks):
+            try:
+                sink.flush()
+            except Exception as e:
+                self._detach(sink, e)
+
+    def close(self):
+        for sink in tuple(self._sinks):
+            try:
+                sink.close()
+            except Exception as e:
+                self._detach(sink, e)
+        self._sinks = []

@@ -1,0 +1,355 @@
+"""The :class:`Monitor`: spans + gauges + counters + trace windows over
+one bus, with the engine-facing lifecycle.
+
+Hot-path discipline (the <2% overhead guarantee, docs/monitoring.md):
+
+- **No forced syncs.**  Device scalars (loss, grad norm...) are queued as
+  references and synced ONE STEP LATE — the same lag trick the health
+  guardian uses (``runtime/health.py``): by the time step *t*'s scalars
+  are read, step *t+1* has already been dispatched, so the read blocks
+  only on work the device has finished.
+- **Nothing in the traced program.**  Spans are host brackets; gauges
+  read host state.  ``--audit-step monitor`` asserts zero DSTPU201 host
+  callbacks and the jaxpr-equality test pins monitor-on == monitor-off.
+- **Interval thinning.**  ``monitor.interval`` emits every Nth step;
+  off-interval steps pay only the span bracket cost (two clock reads per
+  span).
+
+Disabled monitoring is a :class:`NullMonitor` — shared no-op context
+managers, no bus, nothing allocated per step.
+"""
+
+import os
+import time
+from contextlib import contextmanager
+
+from ..utils.logging import logger
+from .bus import MonitorBus
+from .events import _scalar
+from .sinks import RingBufferSink, SinkUnavailable, make_sink
+from .spans import SpanRecorder
+from .trace import TraceWindow
+
+DEFAULT_RUN_DIR = "ds_monitor"
+ENV_ENABLED = "DSTPU_MONITOR"
+ENV_DIR = "DSTPU_MONITOR_DIR"
+
+# scalar-sync lag in steps (mirrors health_check.check_interval's default):
+# reading step t's device scalars after step t+1 dispatched blocks only on
+# already-finished work, preserving the engine's async-dispatch overlap
+_SCALAR_LAG = 1
+
+
+def _is_rank0() -> bool:
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+class _NullCtx:
+    """Reusable nothing-context (cheaper than contextlib.nullcontext()
+    per call — one shared instance, no allocation on the hot path)."""
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+class NullMonitor:
+    """API-compatible disabled monitor: every method is a no-op."""
+
+    armed = False
+    bus = None
+    ring = None
+    run_dir = None
+
+    def span(self, name):
+        return _NULL_CTX
+
+    def standalone_span(self, name):
+        return _NULL_CTX
+
+    def begin_step(self):
+        pass
+
+    def abort_step(self):
+        pass
+
+    def end_step(self, step_no, scalars=None, gauges=None, counters=None,
+                 name="train_step"):
+        return []
+
+    def should_emit(self, step_no) -> bool:
+        return False
+
+    def set_rates(self, **kw):
+        pass
+
+    def gauge(self, *a, **kw):
+        pass
+
+    def counter(self, *a, **kw):
+        pass
+
+    def artifact(self, *a, **kw):
+        pass
+
+    def trace_before_step(self, step_no):
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+    def report(self) -> dict:
+        return {"enabled": False}
+
+
+class Monitor:
+    """Armed runtime telemetry for one process (see module docstring)."""
+
+    armed = True
+
+    def __init__(self, *, run_dir=None, sinks=("jsonl", "ring"),
+                 interval=1, trace_steps=None, ring_size=1024, retry=None,
+                 role="train", clock=time.time):
+        self.run_dir = run_dir
+        self.role = role
+        self.interval = max(1, int(interval))
+        self.spans = SpanRecorder()
+        self.ring = None
+        built = []
+        rank0 = _is_rank0()
+        for kind in sinks:
+            if kind != "ring" and not rank0:
+                continue              # file/export sinks are rank-0 only
+            if kind != "ring" and not run_dir:
+                logger.warning(f"monitor: sink {kind!r} needs a run dir; "
+                               "skipped")
+                continue
+            try:
+                sink = make_sink(kind, run_dir, retry=retry,
+                                 ring_size=ring_size)
+            except SinkUnavailable as e:
+                logger.warning(f"monitor: sink {kind!r} unavailable ({e}); "
+                               "continuing without it")
+                continue
+            if isinstance(sink, RingBufferSink):
+                self.ring = sink.ring
+            built.append(sink)
+        self.bus = MonitorBus(built, clock=clock)
+        self._trace = None
+        if trace_steps:
+            start, stop = trace_steps
+            self._trace = TraceWindow(
+                os.path.join(run_dir or DEFAULT_RUN_DIR, "traces"),
+                start, stop)
+        self._rates = {}              # tokens_per_step/flops_per_step/peak
+        self._root = None
+        self._pending = []            # lagged step-event queue
+        self._last_step = None
+        self.steps_seen = 0
+
+    # ---------------------------------------------------------------- spans
+    def span(self, name):
+        """Nested span context; records only inside an open step (so
+        preflight/audit calls through instrumented helpers stay silent)."""
+        if self._root is None:
+            return _NULL_CTX
+        return self.spans.span(name)
+
+    @contextmanager
+    def standalone_span(self, name):
+        """Span outside any step (checkpoint commit, eval): timed here,
+        emitted immediately."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.bus.span(name, time.perf_counter() - t0,
+                          step=self._last_step)
+
+    # ---------------------------------------------------------------- steps
+    def begin_step(self):
+        if self._root is not None:
+            # a step aborted mid-flight (exception between begin and
+            # end): drop its partial spans instead of folding its clock
+            # into this step
+            self.spans.reset()
+            self._root = None
+        self.spans.drain()            # drop strays from aborted steps
+        self._root = self.spans.open("step")
+
+    def abort_step(self):
+        """Close an open root span and DISCARD its spans — for idle or
+        aborted iterations that must not emit (a serving scheduler poll
+        with no active slots would otherwise overwrite the last real
+        step's breakdown under a reused step number)."""
+        if self._root is not None:
+            self.spans.close(self._root)
+            self._root = None
+            self.spans.drain()
+
+    def should_emit(self, step_no) -> bool:
+        """True when this step's events would actually land somewhere:
+        on the interval AND with at least one live sink.  The bus-less
+        monitor `wall_clock_breakdown` arms (and a run whose sinks all
+        died) then skips gauge computation, the lagged scalar sync, and
+        — engine-side — the one-time executable pricing entirely; spans
+        are still measured for the breakdown log."""
+        return step_no % self.interval == 0 and bool(self.bus.sinks)
+
+    def set_rates(self, **kw):
+        """Per-step denominators for the rate gauges: ``tokens_per_step``,
+        ``samples_per_step``, ``flops_per_step``, ``peak_flops`` (set
+        lazily by the engine once each is known)."""
+        for k, v in kw.items():
+            if v is not None:
+                self._rates[k] = v
+
+    def end_step(self, step_no, scalars=None, gauges=None, counters=None,
+                 name="train_step"):
+        """Close the step's root span and emit (span events + rate gauges
+        now; the scalar ``step`` event one step late).  Returns the
+        step's completed spans (the ``wall_clock_breakdown`` feed)."""
+        if self._root is None:
+            return []
+        wall = self.spans.close(self._root)
+        self._root = None
+        done = self.spans.drain()
+        self._last_step = step_no
+        self.steps_seen += 1
+        if not self.should_emit(step_no):
+            if self._trace is not None:
+                self._trace_after(step_no)
+            return done
+        for s in done:
+            self.bus.span(s["name"], s["dur_s"], step=step_no,
+                          parent=s["parent"])
+        self._emit_rate_gauges(step_no, wall)
+        for gname, gval in (gauges or {}).items():
+            self.bus.gauge(gname, gval, step=step_no)
+        for cname, cval in (counters or {}).items():
+            self.bus.counter(cname, cval, step=step_no)
+        self._pending.append((step_no, name, dict(scalars or {}),
+                              wall))
+        while len(self._pending) > _SCALAR_LAG:
+            self._emit_step(self._pending.pop(0))
+        # one buffered write per emitted step: ds_top's tail stays at
+        # most `interval` steps behind while the hot path pays a single
+        # append syscall
+        self.bus.flush()
+        if self._trace is not None:
+            self._trace_after(step_no)
+        return done
+
+    def _emit_rate_gauges(self, step_no, wall_s):
+        if wall_s <= 0:
+            return
+        r = self._rates
+        if r.get("tokens_per_step"):
+            self.bus.gauge("tokens_per_sec", r["tokens_per_step"] / wall_s,
+                           step=step_no)
+        if r.get("samples_per_step"):
+            self.bus.gauge("samples_per_sec",
+                           r["samples_per_step"] / wall_s, step=step_no)
+        if r.get("flops_per_step") and r.get("peak_flops"):
+            self.bus.gauge(
+                "mfu", r["flops_per_step"] / wall_s / r["peak_flops"],
+                step=step_no)
+
+    def _emit_step(self, entry):
+        step_no, name, scalars, wall = entry
+        fields = {}
+        for k, v in scalars.items():
+            try:
+                fields[k] = _scalar(v)    # device ref -> host (lagged sync)
+            except Exception:
+                continue
+        fields["wall_s"] = wall
+        self.bus.step(name, step_no, value=fields.get("loss"), **fields)
+
+    # ---------------------------------------------------- one-off emissions
+    def gauge(self, name, value, step=None, **fields):
+        self.bus.gauge(name, value, step=step if step is not None
+                       else self._last_step, **fields)
+
+    def counter(self, name, value, step=None, **fields):
+        self.bus.counter(name, value, step=step if step is not None
+                         else self._last_step, **fields)
+
+    def artifact(self, name, path, step=None, **fields):
+        self.bus.artifact(name, path, step=step if step is not None
+                          else self._last_step, **fields)
+
+    # ----------------------------------------------------------------- trace
+    def trace_before_step(self, step_no):
+        if self._trace is not None:
+            self._trace.before_step(step_no)
+
+    def _trace_after(self, step_no):
+        path = self._trace.after_step(step_no)
+        if path is not None:
+            self.bus.artifact("profiler_trace", path, step=step_no,
+                              start_step=self._trace.start_step,
+                              stop_step=self._trace.stop_step)
+            self.bus.flush()
+
+    # ------------------------------------------------------------- lifecycle
+    def flush(self):
+        while self._pending:
+            self._emit_step(self._pending.pop(0))
+        self.bus.flush()
+
+    def close(self):
+        if self._trace is not None:
+            self._trace.abort()
+        self.flush()
+        self.bus.close()
+
+    def report(self) -> dict:
+        return {"enabled": True, "dir": self.run_dir, "role": self.role,
+                "interval": self.interval,
+                "sinks": [getattr(s, "name", "?") for s in self.bus.sinks],
+                "dead_sinks": dict(self.bus.dead_sinks),
+                "events_emitted": self.bus.emitted,
+                "steps_seen": self.steps_seen}
+
+
+def env_enabled(default=None):
+    """The DSTPU_MONITOR env override, parsed ONCE here for every
+    consumer (config block, serving engine): True/False when the var is
+    set, ``default`` when unset."""
+    v = os.environ.get(ENV_ENABLED, "").strip().lower()
+    if not v:
+        return default
+    return v in ("1", "true", "yes", "on")
+
+
+def resolve_run_dir(cfg_dir=None) -> str:
+    """Monitor output dir: config ``monitor.dir`` > env ``DSTPU_MONITOR_DIR``
+    (set by ``deepspeed --monitor-dir``) > ``./ds_monitor``."""
+    return (cfg_dir or os.environ.get(ENV_DIR, "").strip()
+            or os.path.join(os.getcwd(), DEFAULT_RUN_DIR))
+
+
+def from_config(cfg, *, override_enabled=None, retry=None, role="train"):
+    """Build the engine's monitor from its parsed ``monitor`` config
+    block, honoring the kwarg > env > config precedence (the env is
+    already folded into ``cfg.enabled`` at parse time; the kwarg arrives
+    here as ``override_enabled``)."""
+    enabled = cfg.enabled if override_enabled is None else override_enabled
+    if not enabled:
+        return NullMonitor()
+    return Monitor(run_dir=resolve_run_dir(cfg.dir), sinks=cfg.sinks,
+                   interval=cfg.interval, trace_steps=cfg.trace_steps,
+                   ring_size=cfg.ring_size, retry=retry, role=role)

@@ -1,0 +1,116 @@
+"""The monitor's typed, versioned event schema.
+
+One flat record type covers every telemetry emission so sinks, ``ds_top``
+and offline consumers parse exactly one format:
+
+- ``step``     one finished unit of work (train step, serving decode
+               step); scalar payload in ``fields``, headline scalar in
+               ``value`` (loss for training);
+- ``span``     one wall-clock bracket (``dur_s``), nested via ``parent``
+               — the ``wall_clock_breakdown`` data;
+- ``gauge``    a sampled instantaneous value (tokens/s, MFU, HBM bytes);
+- ``counter``  a per-step or cumulative count (wire bytes/step, rewinds);
+- ``artifact`` a file the run produced (profiler trace, forensic dump,
+               committed checkpoint) — ``path`` points at it.
+
+The wire format is one JSON object per line, ``sort_keys`` + compact
+separators, ``None`` fields dropped; non-finite floats are serialized as
+their ``repr`` strings (``'nan'``/``'inf'``) because bare NaN tokens are
+not RFC-8259 JSON (the health forensics lesson).  ``v`` carries
+:data:`SCHEMA_VERSION` so consumers can gate on compatibility.
+"""
+
+import dataclasses
+import json
+import math
+from typing import Any, Dict, Optional
+
+SCHEMA_VERSION = 1
+
+EVENT_KINDS = ("step", "span", "gauge", "counter", "artifact")
+
+
+def _scalar(v):
+    """Host-ify one payload value: numpy/jax scalars become plain Python
+    numbers so the schema never leaks array types into JSON."""
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+        return _scalar(v.item())
+    if hasattr(v, "__float__"):
+        return float(v)
+    return str(v)
+
+
+def _json_safe(v):
+    if isinstance(v, float) and not math.isfinite(v):
+        return repr(v)              # 'nan' | 'inf' | '-inf'
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    return v
+
+
+@dataclasses.dataclass
+class Event:
+    """One telemetry record (see module docstring for the kind taxonomy)."""
+    kind: str
+    name: str
+    t: float                              # unix wall-clock seconds
+    step: Optional[int] = None
+    value: Optional[float] = None         # gauge/counter/step headline scalar
+    dur_s: Optional[float] = None         # span duration
+    parent: Optional[str] = None          # span nesting (parent span name)
+    path: Optional[str] = None            # artifact payload location
+    fields: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    v: int = SCHEMA_VERSION
+
+    def __post_init__(self):
+        if self.kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unknown event kind {self.kind!r}; valid: {EVENT_KINDS}")
+        if not self.name:
+            raise ValueError("event name must be non-empty")
+        self.t = float(self.t)
+        if self.step is not None:
+            self.step = int(self.step)
+        if self.value is not None:
+            self.value = float(_scalar(self.value))
+        if self.dur_s is not None:
+            self.dur_s = float(self.dur_s)
+        self.fields = {str(k): _scalar(val) for k, val in
+                       (self.fields or {}).items()}
+
+    def to_dict(self) -> dict:
+        """Compact dict form: None-valued optionals are dropped."""
+        out = {"v": self.v, "kind": self.kind, "name": self.name,
+               "t": self.t}
+        for key in ("step", "value", "dur_s", "parent", "path"):
+            val = getattr(self, key)
+            if val is not None:
+                out[key] = val
+        if self.fields:
+            out["fields"] = self.fields
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(_json_safe(self.to_dict()), sort_keys=True,
+                          separators=(",", ":"), allow_nan=False)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Event":
+        v = int(d.get("v", 0))
+        if v != SCHEMA_VERSION:
+            raise ValueError(
+                f"event schema version {v} != supported {SCHEMA_VERSION}")
+        return cls(kind=d["kind"], name=d["name"], t=d["t"],
+                   step=d.get("step"), value=d.get("value"),
+                   dur_s=d.get("dur_s"), parent=d.get("parent"),
+                   path=d.get("path"), fields=dict(d.get("fields") or {}))
+
+
+def parse_line(line: str) -> Event:
+    """One JSONL line back into an :class:`Event` (raises on malformed
+    input — a consumer choosing to skip bad lines does so explicitly)."""
+    return Event.from_dict(json.loads(line))

@@ -1,0 +1,142 @@
+"""Shared gauge math: device memory, peak FLOPS, batch token counts.
+
+These helpers read ALREADY-AVAILABLE host state (backend memory stats,
+compiled-executable analyses, host batch shapes) — never device values,
+never anything that forces a sync.  ``bench.py`` imports
+:func:`peak_flops_per_chip` so the MFU gauge and the bench headline price
+compute against the same peak table.
+"""
+
+import numpy as np
+
+_PEAK_BF16_FLOPS = {
+    # TPU generation substring (lowercased device_kind) -> bf16 peak/chip
+    "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+    "v4": 275e12, "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
+}
+_PEAK_DEFAULT = 197e12   # v5e fallback
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak per chip by TPU generation (fallback: v5e).  On non-TPU
+    backends (CPU tests) the returned peak is nominal — MFU is then a
+    relative series, not an absolute fraction."""
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in _PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return _PEAK_DEFAULT
+
+
+def device_memory() -> dict:
+    """Live device-memory gauges from the backend's ``memory_stats()``,
+    or ``{}`` when the backend exposes none (this container's CPU and
+    tunneled TPU runtimes both return None — callers fall back to the
+    executable's ``memory_analysis()`` projection)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+    out = {}
+    if stats.get("bytes_in_use") is not None:
+        out["device_mem_in_use"] = int(stats["bytes_in_use"])
+    if stats.get("peak_bytes_in_use") is not None:
+        out["device_mem_peak"] = int(stats["peak_bytes_in_use"])
+    return out
+
+
+def tokens_in_batch(batch) -> int:
+    """Approximate token count of one step batch: the LARGEST
+    integer-dtype leaf with a sequence axis (``ndim >= 2``).  Largest,
+    not the sum — a batch carrying separate (input_ids, labels) integer
+    leaves of the same shape must count its tokens once, not twice.
+    For LM batches shaped ``(gas, B, T)`` this is ``gas*B*T``; for
+    regression data with no integer leaves it returns 0 and the caller
+    reports samples/s instead of tokens/s."""
+    import jax
+    best = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        dt = getattr(leaf, "dtype", None)
+        shape = getattr(leaf, "shape", None)
+        if dt is None or shape is None or len(shape) < 2:
+            continue
+        if np.issubdtype(np.dtype(dt), np.integer):
+            best = max(best, int(np.prod(shape)))
+    return best
+
+
+def latest_executable(fn):
+    """The MOST RECENTLY acquired live executable of a ``CachedStep``
+    (dict insertion order), or None.  Per-program gauges price exactly
+    one program: summing over every live signature would double-count a
+    shape-polymorphic run (e.g. curriculum cropping) — the most recent
+    signature is the one dispatching."""
+    exes = getattr(fn, "_exes", None)
+    if not exes:
+        return None
+    return next(reversed(list(exes.values())))[0]
+
+
+def live_signature_count(fn) -> int:
+    """How many argument signatures currently hold live executables —
+    the cache-invalidation term for per-program gauge pricing (a new
+    signature means the priced program may no longer be the one
+    dispatching)."""
+    return len(getattr(fn, "_exes", {}) or {})
+
+
+def executable_flops(fn) -> int:
+    """Compiled-step FLOPs from the dispatching executable's XLA cost
+    analysis (the flops-profiler reading, shared here so the live MFU
+    gauge and the profiler price the same program).  0 when no
+    executable is live yet or the backend exposes no analysis."""
+    exe = latest_executable(fn)
+    if exe is None:
+        return 0
+    try:
+        ca = exe.cost_analysis()
+    except Exception:
+        return 0
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    try:
+        return int(ca.get("flops", 0) or 0)
+    except (AttributeError, TypeError, ValueError):
+        return 0
+
+
+def executable_wire_report(fn) -> dict:
+    """Per-executed-step wire accounting from the dispatching
+    executable's HLO collective census (``analysis/comms.py``).  This
+    prices the census once per program — the resulting bytes are
+    constant per step for a fixed executable, which is exactly what
+    makes them cheap to emit as a runtime series.  ``{}`` when no
+    executable/HLO is available."""
+    from ..analysis.comms import wire_report
+    from ..analysis.jaxpr_audit import census_from_hlo_text
+    exe = latest_executable(fn)
+    if exe is None:
+        return {}
+    try:
+        hlo = exe.runtime_executable().hlo_modules()[0].to_string()
+    except Exception:
+        return {}
+    wr = wire_report(census_from_hlo_text(hlo))
+    return {"wire_bytes_per_step": wr["wire_bytes"],
+            "wire_logical_bytes_per_step": wr["logical_bytes"],
+            "wire_quantized_bytes_per_step": wr["quantized_wire_bytes"]}
+
+
+def executable_peak_bytes(fn) -> int:
+    """Projected peak bytes of the dispatching executable's
+    ``memory_analysis()`` — the preflight fallback HBM gauge for
+    backends whose ``memory_stats()`` is unavailable.  0 when no
+    analysis is exposed."""
+    from ..runtime.compile_cache import executable_memory_analysis
+    exe = latest_executable(fn)
+    if exe is None:
+        return 0
+    ma = executable_memory_analysis(exe)
+    return int(ma.get("peak_bytes", 0)) if ma else 0

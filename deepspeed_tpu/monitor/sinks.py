@@ -1,0 +1,231 @@
+"""Event sinks: where the bus delivers telemetry.
+
+Every sink implements ``write(event)`` / ``flush()`` / ``close()``.  A
+sink that raises is detached by the bus after one logged warning
+(``bus.MonitorBus``) — telemetry failures must never kill a train step.
+
+File sinks append through the PR-1 retry IO (``utils/retry.py``): each
+flush is ONE ``O_APPEND`` write of whole lines, so a concurrent reader
+(``ds_top``) never observes a torn record, and a transient filesystem
+hiccup is retried with bounded backoff instead of losing the stream.
+"""
+
+import csv
+import io
+import json
+import os
+
+from ..utils.logging import logger
+from ..utils.retry import RetryPolicy, retry_call
+from .events import Event, _json_safe
+from .ring import RingBuffer
+
+EVENTS_FILE = "events.jsonl"
+EVENTS_CSV_FILE = "events.csv"
+
+CSV_COLUMNS = ("v", "kind", "name", "t", "step", "value", "dur_s",
+               "parent", "path", "fields")
+
+
+class SinkUnavailable(RuntimeError):
+    """A sink's backend is not importable/usable in this environment
+    (e.g. no non-torch tensorboard writer installed)."""
+
+
+class Sink:
+    """Interface; subclasses override :meth:`write` (required) and the
+    lifecycle methods (optional)."""
+
+    name = "sink"
+
+    def write(self, event: Event):
+        raise NotImplementedError
+
+    def flush(self):
+        pass
+
+    def close(self):
+        self.flush()
+
+
+class RingBufferSink(Sink):
+    """Bounded in-memory event history (newest ``maxlen`` events)."""
+
+    name = "ring"
+
+    def __init__(self, maxlen: int = 1024):
+        self.ring = RingBuffer(maxlen)
+
+    def write(self, event: Event):
+        self.ring.append(event)
+
+
+class _AppendFileSink(Sink):
+    """Shared buffered-append machinery for the JSONL/CSV sinks.
+
+    Events buffer in memory and land as ONE append per flush (the bus
+    flushes once per emitted step) on a persistently-open ``O_APPEND``
+    handle — per-event ``open()`` calls were the measured overhead tax.
+    A failed append retries with bounded backoff through a REOPENED
+    handle (the PR-1 retry IO), so a transient filesystem hiccup costs
+    events nothing."""
+
+    def __init__(self, path, retry=None, flush_every: int = 64):
+        self.path = path
+        self._retry = retry or RetryPolicy()
+        self._flush_every = max(1, int(flush_every))
+        self._buf = []
+        self._fh = None
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def _format(self, event: Event) -> str:
+        raise NotImplementedError
+
+    def write(self, event: Event):
+        self._buf.append(self._format(event))
+        if len(self._buf) >= self._flush_every:
+            self.flush()
+
+    def flush(self):
+        if not self._buf:
+            return
+        data = "".join(self._buf)
+        # one append-mode write of complete lines per flush: atomic with
+        # respect to concurrent readers (ds_top never sees a torn line)
+        retry_call(self._append, data, policy=self._retry,
+                   describe=f"append {os.path.basename(self.path)}",
+                   on_retry=lambda a, e: self._close_fh())
+        self._buf = []
+
+    def _append(self, data: str):
+        if self._fh is None:
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self._fh.write(data)
+        self._fh.flush()
+
+    def _close_fh(self):
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError as e:
+                logger.debug(f"monitor sink: close failed: {e}")
+            self._fh = None
+
+    def close(self):
+        self.flush()
+        self._close_fh()
+
+
+class JSONLSink(_AppendFileSink):
+    """The default stream: one compact JSON event per line."""
+
+    name = "jsonl"
+
+    def _format(self, event: Event) -> str:
+        return event.to_json() + "\n"
+
+
+class CSVSink(_AppendFileSink):
+    """Flat-table twin of the JSONL stream (``fields`` as one JSON cell).
+    The header row is written when the file is created."""
+
+    name = "csv"
+
+    def __init__(self, path, retry=None, flush_every: int = 1):
+        super().__init__(path, retry=retry, flush_every=flush_every)
+        if not os.path.exists(self.path) or \
+                os.path.getsize(self.path) == 0:
+            self._buf.append(self._row(CSV_COLUMNS))
+            self.flush()
+
+    @staticmethod
+    def _row(cells) -> str:
+        out = io.StringIO()
+        csv.writer(out).writerow(cells)
+        return out.getvalue()
+
+    def _format(self, event: Event) -> str:
+        d = event.to_dict()
+        cells = [d.get(c, "") for c in CSV_COLUMNS[:-1]]
+        fields = d.get("fields")
+        cells.append(json.dumps(_json_safe(fields), sort_keys=True,
+                                separators=(",", ":"), allow_nan=False)
+                     if fields else "")
+        return self._row(cells)
+
+
+class TensorboardSink(Sink):
+    """Scalar export through a NON-torch tensorboard writer.
+
+    The engine's old path imported ``torch.utils.tensorboard`` — a wrong
+    (and absent) dependency for a JAX framework, silently dead in this
+    container.  This sink resolves ``tensorboardX`` or
+    ``flax.metrics.tensorboard`` instead; when neither is importable it
+    raises :class:`SinkUnavailable` at construction and the caller
+    degrades with one warning (JSONL/CSV always work)."""
+
+    name = "tensorboard"
+
+    def __init__(self, log_dir):
+        self._writer = self._resolve_writer(log_dir)
+
+    @staticmethod
+    def _resolve_writer(log_dir):
+        try:
+            from tensorboardX import SummaryWriter
+            return SummaryWriter(log_dir=log_dir)
+        except ImportError:
+            pass
+        try:
+            from flax.metrics.tensorboard import SummaryWriter
+            return SummaryWriter(log_dir=log_dir)
+        except ImportError:
+            pass
+        raise SinkUnavailable(
+            "no non-torch tensorboard writer importable (tried "
+            "tensorboardX, flax.metrics.tensorboard); use the jsonl/csv "
+            "sinks, or install one of those writers")
+
+    def write(self, event: Event):
+        step = event.step if event.step is not None else 0
+        if event.kind in ("gauge", "counter"):
+            if event.value is not None:
+                self._writer.add_scalar(f"Train/{event.name}",
+                                        float(event.value), step)
+        elif event.kind == "step":
+            for k, v in event.fields.items():
+                if isinstance(v, bool):
+                    v = int(v)
+                if isinstance(v, (int, float)):
+                    self._writer.add_scalar(f"Train/{k}", float(v), step)
+        elif event.kind == "span" and event.dur_s is not None:
+            self._writer.add_scalar(f"Spans/{event.name}_ms",
+                                    event.dur_s * 1e3, step)
+
+    def flush(self):
+        self._writer.flush()
+
+    def close(self):
+        self.flush()
+        close = getattr(self._writer, "close", None)
+        if close is not None:
+            close()
+
+
+def make_sink(kind, run_dir, *, retry=None, ring_size=1024,
+              flush_every=64):
+    """Build one sink by config name (``monitor.sinks`` entries).  File
+    sinks need ``run_dir``; raises :class:`SinkUnavailable` when the
+    backend cannot serve (caller logs once and drops the sink)."""
+    if kind == "ring":
+        return RingBufferSink(maxlen=ring_size)
+    if kind == "jsonl":
+        return JSONLSink(os.path.join(run_dir, EVENTS_FILE), retry=retry,
+                         flush_every=flush_every)
+    if kind == "csv":
+        return CSVSink(os.path.join(run_dir, EVENTS_CSV_FILE), retry=retry,
+                       flush_every=flush_every)
+    if kind == "tensorboard":
+        return TensorboardSink(os.path.join(run_dir, "tensorboard"))
+    raise ValueError(f"unknown monitor sink {kind!r}")

@@ -1,0 +1,72 @@
+"""Nested wall-clock spans, measured monitor-side.
+
+A span is a host ``perf_counter`` bracket around a region of the dispatch
+path (data fetch, H2D upload, compiled-step dispatch, host Adam sweep...).
+Nothing here touches jax: spans never enter a traced function, so an
+armed monitor leaves the compiled step byte-identical (the jaxpr-equality
+test + ``--audit-step monitor`` prove it).
+
+Nesting is tracked with an explicit stack; each completed span records
+its parent's name, so the consumer can rebuild the tree (``ds_top``'s
+breakdown line, the ``wall_clock_breakdown`` log).
+"""
+
+import time
+from contextlib import contextmanager
+
+
+class _Open:
+    __slots__ = ("name", "parent", "t0")
+
+    def __init__(self, name, parent, t0):
+        self.name = name
+        self.parent = parent
+        self.t0 = t0
+
+
+class SpanRecorder:
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._stack = []
+        self._done = []          # [{"name", "parent", "dur_s"}]
+
+    @property
+    def depth(self) -> int:
+        return len(self._stack)
+
+    def open(self, name) -> _Open:
+        """Explicit open (for brackets that span method boundaries, e.g.
+        the per-step root); pair with :meth:`close`."""
+        rec = _Open(name, self._stack[-1].name if self._stack else None,
+                    self._clock())
+        self._stack.append(rec)
+        return rec
+
+    def close(self, rec: _Open) -> float:
+        """Close ``rec`` (and anything left open inside it — an exception
+        may have skipped inner closes).  Returns the span's duration."""
+        now = self._clock()
+        while self._stack:
+            top = self._stack.pop()
+            self._done.append({"name": top.name, "parent": top.parent,
+                               "dur_s": now - top.t0})
+            if top is rec:
+                return now - rec.t0
+        return now - rec.t0
+
+    @contextmanager
+    def span(self, name):
+        rec = self.open(name)
+        try:
+            yield rec
+        finally:
+            self.close(rec)
+
+    def drain(self) -> list:
+        """Completed spans since the last drain, oldest-first."""
+        done, self._done = self._done, []
+        return done
+
+    def reset(self):
+        self._stack = []
+        self._done = []

@@ -1,0 +1,114 @@
+"""Config-driven ``jax.profiler`` trace capture windows.
+
+Real per-op device timing on TPU comes from profiler traces, not host
+timers (``utils/timer.py`` docstring; ``inference/engine.py`` said "use
+jax.profiler traces" for years without doing it).  This module makes the
+capture a config knob: ``monitor.trace_steps: [start, stop]`` brackets
+``jax.profiler.start_trace``/``stop_trace`` around that inclusive step
+range, and the resulting xplane artifact is announced on the bus as an
+``artifact`` event — so the trace's existence and location live in the
+same stream as everything else.
+"""
+
+import glob
+import os
+
+from ..utils.logging import logger
+
+
+def newest_trace_artifact(trace_dir):
+    """The newest profiler payload under ``trace_dir`` (prefers the
+    ``.xplane.pb`` protobuf; falls back to any file), or None."""
+    hits = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                     recursive=True)
+    if not hits:
+        hits = [p for p in glob.glob(os.path.join(trace_dir, "**", "*"),
+                                     recursive=True) if os.path.isfile(p)]
+    if not hits:
+        return None
+    return max(hits, key=os.path.getmtime)
+
+
+def capture(trace_dir, fn):
+    """One-shot convenience: run ``fn()`` under a profiler trace written
+    to ``trace_dir``; returns the captured artifact path (or None when
+    the profiler is unavailable — the capture is best-effort, never a
+    training failure)."""
+    import jax
+    os.makedirs(trace_dir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(trace_dir)
+    except Exception as e:
+        logger.warning(f"monitor: jax.profiler unavailable ({e}); "
+                       "trace capture skipped")
+        fn()
+        return None
+    try:
+        fn()
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            logger.warning(f"monitor: stop_trace failed ({e})")
+            return None
+    return newest_trace_artifact(trace_dir)
+
+
+class TraceWindow:
+    """One inclusive ``[start_step, stop_step]`` capture window.  The
+    engine calls :meth:`before_step` ahead of each dispatch and
+    :meth:`after_step` once the step finished; the window fires once per
+    process (a rewind replaying the range does not re-trace)."""
+
+    def __init__(self, trace_dir, start_step, stop_step):
+        assert 1 <= int(start_step) <= int(stop_step), \
+            f"trace window needs 1 <= start <= stop, got " \
+            f"[{start_step}, {stop_step}]"
+        self.trace_dir = trace_dir
+        self.start_step = int(start_step)
+        self.stop_step = int(stop_step)
+        self._active = False
+        self._spent = False
+
+    def before_step(self, step_no: int):
+        if self._spent or self._active or step_no != self.start_step:
+            return
+        import jax
+        os.makedirs(self.trace_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception as e:
+            logger.warning(f"monitor: trace window [{self.start_step}, "
+                           f"{self.stop_step}] could not start ({e})")
+            self._spent = True
+            return
+        self._active = True
+        logger.info(f"monitor: profiler trace started (steps "
+                    f"{self.start_step}-{self.stop_step}) -> "
+                    f"{self.trace_dir}")
+
+    def after_step(self, step_no: int):
+        """Returns the artifact path when this step closed the window."""
+        if not self._active or step_no < self.stop_step:
+            return None
+        import jax
+        self._active = False
+        self._spent = True
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            logger.warning(f"monitor: stop_trace failed ({e})")
+            return None
+        return newest_trace_artifact(self.trace_dir)
+
+    def abort(self):
+        """Stop an in-flight capture (process teardown)."""
+        if not self._active:
+            return
+        import jax
+        self._active = False
+        self._spent = True
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # teardown is best-effort
+            logger.debug(f"monitor: abort stop_trace failed ({e})")

@@ -42,7 +42,8 @@ class DeepSpeedConfigWriter:
     def write_config(self, filename):
         import json
         with open(filename, "w") as f:
-            json.dump(self.data, f, indent=4)
+            # autotuner experiment CONFIG, not a metric stream
+            json.dump(self.data, f, indent=4)  # dstpu: disable=DSTPU104
 
 
 class DeepSpeedFP16Config:
@@ -124,6 +125,60 @@ class DeepSpeedTensorboardConfig:
                                             C.TENSORBOARD_OUTPUT_PATH_DEFAULT)
         self.job_name = get_scalar_param(tb_dict, C.TENSORBOARD_JOB_NAME,
                                          C.TENSORBOARD_JOB_NAME_DEFAULT)
+
+
+class DeepSpeedMonitorConfig:
+    """Unified runtime telemetry knobs (``deepspeed_tpu/monitor``;
+    docs/monitoring.md): the event bus with its sinks, the gauge/step
+    emission interval, and the profiler trace-capture window.
+
+    Env ``DSTPU_MONITOR`` (set by ``deepspeed --monitor`` /
+    ``--no-monitor``) overrides ``enabled`` in either direction, matching
+    the health-guardian/comms-compression pattern; the ``monitor=``
+    kwarg of ``deepspeed_tpu.initialize`` outranks both.
+    """
+
+    def __init__(self, param_dict):
+        from ..monitor.core import env_enabled
+        m = get_dict_param(param_dict, C.MONITOR, {}) or {}
+        self.enabled = bool(env_enabled(
+            get_scalar_param(m, C.MONITOR_ENABLED,
+                             C.MONITOR_ENABLED_DEFAULT)))
+        sinks = get_scalar_param(m, C.MONITOR_SINKS, None)
+        self.sinks = tuple(sinks if sinks is not None
+                           else C.MONITOR_SINKS_DEFAULT)
+        bad = [s for s in self.sinks if s not in C.MONITOR_SINKS_VALID]
+        if bad:
+            raise DeepSpeedConfigError(
+                f"monitor.sinks {bad} unknown; valid: "
+                f"{list(C.MONITOR_SINKS_VALID)}")
+        self.dir = get_scalar_param(m, C.MONITOR_DIR, C.MONITOR_DIR_DEFAULT)
+        self.interval = int(get_scalar_param(m, C.MONITOR_INTERVAL,
+                                             C.MONITOR_INTERVAL_DEFAULT))
+        if self.interval < 1:
+            raise DeepSpeedConfigError("monitor.interval must be >= 1")
+        self.ring_size = int(get_scalar_param(m, C.MONITOR_RING_SIZE,
+                                              C.MONITOR_RING_SIZE_DEFAULT))
+        if self.ring_size < 1:
+            raise DeepSpeedConfigError("monitor.ring_size must be >= 1")
+        trace = get_scalar_param(m, C.MONITOR_TRACE_STEPS,
+                                 C.MONITOR_TRACE_STEPS_DEFAULT)
+        if trace is not None:
+            if (not isinstance(trace, (list, tuple)) or len(trace) != 2
+                    or not all(isinstance(x, int) for x in trace)
+                    or not 1 <= trace[0] <= trace[1]):
+                raise DeepSpeedConfigError(
+                    "monitor.trace_steps must be [start, stop] with "
+                    f"1 <= start <= stop (got {trace!r})")
+            trace = (int(trace[0]), int(trace[1]))
+        self.trace_steps = trace
+
+    def describe(self) -> dict:
+        return {"enabled": self.enabled, "sinks": list(self.sinks),
+                "dir": self.dir, "interval": self.interval,
+                "ring_size": self.ring_size,
+                "trace_steps": (list(self.trace_steps)
+                                if self.trace_steps else None)}
 
 
 class DeepSpeedPipelineConfig:
@@ -746,6 +801,7 @@ class DeepSpeedConfig:
         self.activation_checkpointing = DeepSpeedActivationCheckpointingConfig(pd)
         self.flops_profiler = DeepSpeedFlopsProfilerConfig(pd)
         self.tensorboard = DeepSpeedTensorboardConfig(pd)
+        self.monitor_config = DeepSpeedMonitorConfig(pd)
         self.pipeline = DeepSpeedPipelineConfig(pd)
         self.curriculum = DeepSpeedCurriculumConfig(pd)
         self.pld = DeepSpeedPLDConfig(pd)

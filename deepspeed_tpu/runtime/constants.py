@@ -135,6 +135,25 @@ TENSORBOARD_OUTPUT_PATH_DEFAULT = ""
 TENSORBOARD_JOB_NAME = "job_name"
 TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
 
+# unified runtime telemetry (deepspeed_tpu/monitor; docs/monitoring.md).
+# Env DSTPU_MONITOR (set by `deepspeed --monitor`) overrides `enabled` in
+# either direction; DSTPU_MONITOR_DIR (`--monitor-dir`) supplies the run
+# dir when the config gives none.
+MONITOR = "monitor"
+MONITOR_ENABLED = "enabled"
+MONITOR_ENABLED_DEFAULT = False
+MONITOR_SINKS = "sinks"
+MONITOR_SINKS_DEFAULT = ["jsonl", "ring"]
+MONITOR_SINKS_VALID = ("jsonl", "csv", "ring", "tensorboard")
+MONITOR_DIR = "dir"
+MONITOR_DIR_DEFAULT = None             # None -> DSTPU_MONITOR_DIR or ./ds_monitor
+MONITOR_INTERVAL = "interval"
+MONITOR_INTERVAL_DEFAULT = 1           # emit every Nth step
+MONITOR_TRACE_STEPS = "trace_steps"
+MONITOR_TRACE_STEPS_DEFAULT = None     # [start, stop] -> jax.profiler window
+MONITOR_RING_SIZE = "ring_size"
+MONITOR_RING_SIZE_DEFAULT = 1024       # in-memory event ring length
+
 #############################################
 # Profiling
 #############################################

@@ -131,7 +131,8 @@ class DeepSpeedEngine:
     def __init__(self, model=None, optimizer=None, config=None, config_params=None,
                  training_data=None, lr_scheduler=None, mesh=None, collate_fn=None,
                  loss_fn=None, params=None, apply_fn=None, rng_seed=0, mpu=None,
-                 dist_init_required=None, dont_change_device=False, elastic=None):
+                 dist_init_required=None, dont_change_device=False, elastic=None,
+                 monitor=None):
         config = config if config is not None else config_params
         assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
 
@@ -145,6 +146,25 @@ class DeepSpeedEngine:
         self.mesh_ctx = M.MeshContext(mesh)
         self.config = DeepSpeedConfig(config, world_size=self.mesh_ctx.dp_world_size,
                                       elastic=elastic)
+
+        # ---- unified runtime telemetry (monitor/; docs/monitoring.md) -----
+        # Event bus + monitor-side spans/gauges/counters.  The `monitor`
+        # kwarg outranks env DSTPU_MONITOR outranks the config block
+        # (the --elastic/--health-check precedence pattern).  All
+        # instrumentation is host-side: an armed monitor leaves the
+        # compiled step byte-identical (--audit-step monitor).
+        from ..monitor import core as moncore
+        self.monitor = moncore.from_config(
+            self.config.monitor_config, override_enabled=monitor,
+            retry=self.config.io_retry_config.policy(), role="train")
+        if not self.monitor.armed and self.config.wall_clock_breakdown:
+            # wall_clock_breakdown alone still needs measured spans: arm a
+            # bus-less monitor (no sinks, nothing written) so the span
+            # recorder feeds the named-timer breakdown log
+            self.monitor = moncore.Monitor(run_dir=None, sinks=())
+        self._mon_tokens_per_step = None   # lazy: first stacked batch
+        self._mon_step_stats = None        # lazy: per-program flops/wire
+        self._mon_example = None           # (batch, rng) for one-time pricing
 
         self.zero_stage = self.config.zero_optimization_stage
         self.compute_dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
@@ -160,8 +180,11 @@ class DeepSpeedEngine:
         # skip -> rewind -> abort escalation ladder.
         self._health_cfg = self.config.health_check
         self._health_enabled = self._health_cfg.enabled
-        self.health_monitor = (hmod.HealthMonitor(self._health_cfg)
-                               if self._health_enabled else None)
+        self.health_monitor = (
+            hmod.HealthMonitor(self._health_cfg,
+                               bus=(self.monitor.bus if self.monitor.armed
+                                    else None))
+            if self._health_enabled else None)
         self._stream_step = 0        # monotonic data-stream batch index
         self._last_batch_index = None  # stream index of the running step
         # True while _stream_step and the live iterator agree (fresh engine,
@@ -450,13 +473,13 @@ class DeepSpeedEngine:
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
-            steps_per_output=self.config.steps_per_print)
+            steps_per_output=self.config.steps_per_print,
+            bus=self.monitor.bus if self.monitor.armed else None)
         self.micro_steps = 0
         self._global_steps_host = 0
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._pending_microbatches = []   # forward/backward/step shim buffer
         self._last_metrics = {}
-        self._tb_writer = None
         self.loaded_checkpoint_tag = None
         self.global_samples = 0
         if self.config.tensorboard.enabled:
@@ -782,6 +805,7 @@ class DeepSpeedEngine:
         if ps is not None:
             ps.close()
         self._offload = None
+        self.monitor.close()
         import gc
         gc.collect()
 
@@ -1324,6 +1348,7 @@ class DeepSpeedEngine:
         from .. import fault
         fault.site("engine.step")    # host-side only; never traced
         self._install_moe_wire()
+        self.monitor.begin_step()    # root "step" span (host wall-clock)
         it = data_iter if data_iter is not None else self._data_iterator
         assert it is not None, "train_batch needs training_data or a data_iter"
         if it is not self._data_iterator:
@@ -1332,7 +1357,8 @@ class DeepSpeedEngine:
             # not "fast-forward" it (the warning path in rewind())
             self._stream_pos_known = False
         gas = self.gradient_accumulation_steps()
-        micro_batches = [next(it) for _ in range(gas)]
+        with self.monitor.span("data_fetch"):
+            micro_batches = [next(it) for _ in range(gas)]
         # data-stream position of THIS step (monotonic; checkpointed with
         # the data-pipeline state, advanced by rewind's fast-forward) —
         # also the index the value-corruption fault sites key on, so an
@@ -1369,10 +1395,18 @@ class DeepSpeedEngine:
         return self.curriculum_scheduler.get_current_difficulty()
 
     def _stack_microbatches(self, micro_batches):
-        batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro_batches)
-        sh = jax.tree_util.tree_map(
-            lambda x: NamedSharding(self.mesh, P(None, M.BATCH_AXES)), batch)
-        return jax.device_put(batch, sh)
+        # spanned as one phase: host collation + the H2D placement (the
+        # device_put dispatch; the DMA itself overlaps the step)
+        with self.monitor.span("h2d_upload"):
+            batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
+                                           *micro_batches)
+            if self.monitor.armed and self._mon_tokens_per_step is None:
+                from ..monitor import gauges as mg
+                self._mon_tokens_per_step = mg.tokens_in_batch(batch)
+            sh = jax.tree_util.tree_map(
+                lambda x: NamedSharding(self.mesh, P(None, M.BATCH_AXES)),
+                batch)
+            return jax.device_put(batch, sh)
 
     def _run_fused_step(self, batch):
         self.tput_timer.start()
@@ -1384,10 +1418,15 @@ class DeepSpeedEngine:
             self._profile_train_step(batch, rng)
         # trace with the mesh in context so bare-PartitionSpec sharding
         # constraints inside models (MoE expert axis, SP) bind to it
+        if self.monitor.armed and self.monitor.bus.sinks \
+                and self._mon_step_stats is None:
+            self._mon_example = (batch, rng)   # freed once stats price
+        self.monitor.trace_before_step(self._global_steps_host + 1)
         with jax.set_mesh(self.mesh):
             if self._offload is not None:
-                grads, metrics, new_scale, new_health, new_ef = \
-                    self._jit_grad_step(self.state, batch, rng)
+                with self.monitor.span("dispatch"):
+                    grads, metrics, new_scale, new_health, new_ef = \
+                        self._jit_grad_step(self.state, batch, rng)
                 # loss scale + health EMA + qgZ error feedback advance
                 # eagerly (device-graph dependency): the NEXT dispatch
                 # sees a post-overflow halving / updated loss baseline /
@@ -1402,7 +1441,8 @@ class DeepSpeedEngine:
                 # original flat array's buffer is then freed as soon as
                 # the chunk slices are computed, instead of being pinned
                 # through the DPU delay window.
-                grads = self._offload.start_d2h(grads)
+                with self.monitor.span("grad_d2h"):
+                    grads = self._offload.start_d2h(grads)
                 if self._dpu and self._global_steps_host >= self._dpu_warmup:
                     # DPU steady state: while the device computes THIS
                     # step's grads, the host applies the PREVIOUS step's —
@@ -1410,12 +1450,16 @@ class DeepSpeedEngine:
                     # the reference's overlap-centric design,
                     # docs/_posts/2021-03-08-zero3-offload.md:72)
                     if self._pending_offload is not None:
-                        self._host_offload_update(*self._pending_offload)
+                        with self.monitor.span("host_adam"):
+                            self._host_offload_update(*self._pending_offload)
                     self._pending_offload = (grads, metrics)
                 else:
-                    self._host_offload_update(grads, metrics)
+                    with self.monitor.span("host_adam"):
+                        self._host_offload_update(grads, metrics)
             else:
-                self.state, metrics = self._jit_train_step(self.state, batch, rng)
+                with self.monitor.span("dispatch"):
+                    self.state, metrics = self._jit_train_step(
+                        self.state, batch, rng)
         return self._finish_step(metrics)
 
     def _run_stream_step(self, micro_batches):
@@ -1425,10 +1469,18 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         rng = jax.random.fold_in(self._base_rng, self.micro_steps)
         lr = float(self._lr_at(self.state.global_steps))
+        if self.monitor.armed and self._mon_tokens_per_step is None:
+            from ..monitor import gauges as mg
+            self._mon_tokens_per_step = mg.tokens_in_batch(micro_batches)
+        self.monitor.trace_before_step(self._global_steps_host + 1)
         with jax.set_mesh(self.mesh):
-            metrics = self._param_stream.train_step(
-                micro_batches, rng, lr=lr,
-                step_no=int(self.state.optimizer_steps) + 1)
+            # the runner's layer loop (streamed gathers, NVMe swaps, host
+            # Adam) runs inside this bracket; its own phase timings land
+            # as child spans in _monitor_finish when it reports them
+            with self.monitor.span("dispatch"):
+                metrics = self._param_stream.train_step(
+                    micro_batches, rng, lr=lr,
+                    step_no=int(self.state.optimizer_steps) + 1)
         # the runner's skip-step (non-finite loss/grad-norm -> host Adam
         # not applied) reports through metrics["skip"]; counters mirror
         # the fused path's skipped-step accounting
@@ -1463,13 +1515,123 @@ class DeepSpeedEngine:
             self._report_progress(step_no, metrics)
         self.tput_timer.stop(global_step=True,
                              sync_obj=metrics["loss"] if reporting else None)
-        self._write_tensorboard(step_no, metrics)
+        self._monitor_finish(step_no, metrics, reporting)
         if self.health_monitor is not None:
             # trails the device by health_check.check_interval steps (the
             # sentinel read then blocks only on already-finished work) and
             # may rewind (in-process) or abort (with forensics)
             self._health_observe(step_no, metrics)
         return metrics["loss"]
+
+    # ------------------------------------------------------------- telemetry
+    _MON_SCALAR_KEYS = ("loss", "lr", "grad_norm", "loss_scale", "skip",
+                        "moe_aux_loss", "moe_tokens_dropped")
+
+    def _monitor_finish(self, step_no, metrics, reporting):
+        """Per-step telemetry emission (monitor/; docs/monitoring.md).
+
+        Closes the step's root span and hands the monitor (a) the span
+        tree measured around this step's dispatch path, (b) the step's
+        scalar metrics as DEVICE REFERENCES — synced one step late by
+        the monitor, never here — and (c) host-side gauges/counters
+        (memory, compile-cache, health counters, per-step wire bytes).
+        With ``wall_clock_breakdown`` the same spans feed the named-timer
+        registry and its log line on reporting steps."""
+        mon = self.monitor
+        if not mon.armed:
+            return
+        scalars = gauges = counters = None
+        if mon.should_emit(step_no):
+            scalars = {k: metrics[k] for k in self._MON_SCALAR_KEYS
+                       if k in metrics}
+            gauges, counters = self._monitor_gauges_counters()
+        spans = mon.end_step(step_no, scalars=scalars, gauges=gauges,
+                             counters=counters)
+        if self.config.wall_clock_breakdown and spans:
+            for s in spans:
+                self.timers.record_span(s["name"], s["dur_s"])
+            if reporting:
+                self.timers.log(
+                    sorted({s["name"] for s in spans}),
+                    memory_breakdown=self.config.memory_breakdown)
+
+    def _monitor_gauges_counters(self):
+        """Host-side gauge/counter payload for one emitted step: rate
+        denominators (tokens, flops — set once, the monitor divides by
+        measured wall), device memory (live stats, or the executable's
+        ``memory_analysis()`` projection where the backend exposes
+        none), compile-cache hit/miss, and health skip/rewind state."""
+        from ..monitor import gauges as mg
+        stats = self._monitor_step_stats()
+        self.monitor.set_rates(
+            tokens_per_step=self._mon_tokens_per_step or None,
+            samples_per_step=self.train_batch_size(),
+            flops_per_step=stats.get("flops"),
+            peak_flops=stats.get("peak_flops"))
+        gauges = {}
+        mem = mg.device_memory()
+        if mem:
+            gauges.update(mem)
+        elif stats.get("hbm_projected"):
+            gauges["hbm_peak_projected"] = stats["hbm_projected"]
+        if self.compile_cache is not None:
+            gauges["compile_cache_hits"] = self.compile_cache.stats["hits"]
+            gauges["compile_cache_misses"] = \
+                self.compile_cache.stats["misses"]
+        if self.health_monitor is not None:
+            hc = self.health_monitor.counters()
+            gauges["health_skipped_total"] = hc["total_skips"]
+            gauges["health_rewinds"] = hc["rewinds"]
+        return gauges, dict(stats.get("wire") or {})
+
+    def _monitor_step_stats(self):
+        """Per-program telemetry constants, priced from the DISPATCHING
+        compiled step (no extra lowering/compile): XLA cost-analysis
+        FLOPs (the flops-profiler reading — live MFU divides them by
+        measured wall), the HLO collective census priced as wire
+        bytes/step (``analysis/comms.py``), and the projected peak bytes.
+        Cached per live-signature count: a retrace under a new batch
+        shape (curriculum cropping) re-prices, so the gauges follow the
+        program that is actually executing."""
+        from ..monitor import gauges as mg
+        fn = (self._jit_grad_step if self._offload is not None
+              else self._jit_train_step)
+        n_sigs = mg.live_signature_count(fn)
+        if self._mon_step_stats is not None:
+            cached_n, out = self._mon_step_stats
+            if cached_n == n_sigs:
+                return out
+            self._mon_step_stats = None    # new program: re-price
+        if not getattr(fn, "_exes", None) and self._mon_example is not None:
+            # no live executable recorded (compile cache off -> CachedStep
+            # passthrough): acquire one, once, so the per-program gauges
+            # exist anyway.  One extra compile on monitored no-cache
+            # engines — enabling the compile cache avoids it.
+            example, self._mon_example = self._mon_example, None
+            try:
+                with jax.set_mesh(self.mesh):
+                    fn.executable(self.state, *example)
+            except Exception as e:
+                logger.warning(f"monitor: could not price the compiled "
+                               f"step ({e}); MFU/wire gauges unavailable")
+        self._mon_example = None
+        out = {}
+        flops = mg.executable_flops(fn)
+        if flops:
+            out["flops"] = flops
+            out["peak_flops"] = mg.peak_flops_per_chip() * len(jax.devices())
+        wire = mg.executable_wire_report(fn)
+        if wire:
+            out["wire"] = wire
+        peak = mg.executable_peak_bytes(fn)
+        if peak:
+            out["hbm_projected"] = peak
+        n_sigs = mg.live_signature_count(fn)
+        if n_sigs:
+            # cache against the signature count: stable program = priced
+            # once; a retrace invalidates (see the check above)
+            self._mon_step_stats = (n_sigs, out)
+        return out
 
     # ------------------------------------------------- health guardian (host)
     def _health_observe(self, step_no, metrics):
@@ -1634,8 +1796,9 @@ class DeepSpeedEngine:
                 self._tree_stage_idx = 1 - idx
                 tree = stages[idx]
             return jax.device_put(tree, self._param_sh)
-        payload = self._offload.payload_flat()
-        chunks = self._h2d.upload_flat(payload, stage=self._dpu)
+        with self.monitor.span("param_h2d"):
+            payload = self._offload.payload_flat()
+            chunks = self._h2d.upload_flat(payload, stage=self._dpu)
         if self._jit_scatter_params is None or \
                 self._scatter_nchunks != len(chunks):
             from .zero.wire import make_chunk_scatter
@@ -1731,6 +1894,7 @@ class DeepSpeedEngine:
         # a retrace here must see THIS engine's expert-wire policy, not
         # whichever engine dispatched last (same rule as train_batch)
         self._install_moe_wire()
+        self.monitor.begin_step()
         micro_batches, self._pending_microbatches = \
             self._pending_microbatches, []
         if self._param_stream is not None:
@@ -1769,8 +1933,7 @@ class DeepSpeedEngine:
             prof._flops = int(ca.get("flops", 0) or 0)
             prof._macs = prof._flops // 2
             prof._bytes = ca.get("bytes accessed")
-            prof._duration = self.tput_timer.avg_step_time() if hasattr(
-                self.tput_timer, "avg_step_time") else 0.0
+            prof._duration = self.tput_timer.avg_step_time()
             if self.config.flops_profiler.detailed:
                 # per-module tree via named_scope attribution (the model's
                 # scopes; optimizer/infra ops stay at the root)
@@ -1813,26 +1976,39 @@ class DeepSpeedEngine:
                      "(drop_tokens=False)", ranks=[0])
 
     def _setup_tensorboard(self):
-        try:
-            from torch.utils.tensorboard import SummaryWriter
-            path = os.path.join(self.config.tensorboard.output_path,
-                                self.config.tensorboard.job_name)
-            self._tb_writer = SummaryWriter(log_dir=path)
-        except Exception as e:
-            logger.warning(f"tensorboard unavailable: {e}")
+        """Tensorboard as a monitor-bus sink.
 
-    def _write_tensorboard(self, step, metrics):
-        if self._tb_writer is None:
+        The old path imported ``torch.utils.tensorboard`` — a torch
+        dependency a JAX framework must not carry, and dead in any
+        torch-less container.  Now ``tensorboard.enabled`` attaches a
+        :class:`monitor.sinks.TensorboardSink` (tensorboardX / flax
+        writer) to the engine's event bus, so the scalars it exports are
+        the SAME step/gauge events every other sink sees; when no
+        non-torch writer is importable it degrades to one warning
+        (JSONL/CSV always work)."""
+        from ..monitor import core as moncore
+        from ..monitor.sinks import TensorboardSink, SinkUnavailable
+        if not moncore._is_rank0():
+            # same rank-0 gate Monitor.__init__ applies to export sinks:
+            # every process writing the same tfevents dir would conflict
             return
-        self._tb_writer.add_scalar("Train/loss", float(metrics["loss"]), step)
-        self._tb_writer.add_scalar("Train/lr", float(metrics["lr"]), step)
-        if self.fp16_enabled:
-            self._tb_writer.add_scalar("Train/loss_scale",
-                                       float(metrics["loss_scale"]), step)
-        for k in metrics:
-            if k.startswith("moe_"):
-                self._tb_writer.add_scalar(f"Train/{k}",
-                                           float(metrics[k]), step)
+        path = os.path.join(self.config.tensorboard.output_path or ".",
+                            self.config.tensorboard.job_name)
+        try:
+            sink = TensorboardSink(path)
+        except (SinkUnavailable, OSError) as e:
+            logger.warning(f"tensorboard unavailable: {e}")
+            return
+        if not self.monitor.armed:
+            # arm a bus-only monitor so the tensorboard sink has events
+            # to consume; no file sinks, nothing else changes
+            self.monitor = moncore.Monitor(run_dir=None, sinks=())
+        self.monitor.bus.attach(sink)
+        # a late-armed (tensorboard-only) monitor must reach the other
+        # bus consumers built before it
+        self.tput_timer.bus = self.monitor.bus
+        if self.health_monitor is not None:
+            self.health_monitor.bus = self.monitor.bus
 
     # ------------------------------------------------------------ properties
     @property
@@ -1995,7 +2171,10 @@ class DeepSpeedEngine:
             "format_version": 1,
         })
         fault.site("ckpt.before_commit")
-        final = atomic.commit_staged(save_dir, tag, fsync=fsync)
+        with self.monitor.standalone_span("checkpoint_commit"):
+            final = atomic.commit_staged(save_dir, tag, fsync=fsync)
+        self.monitor.artifact("checkpoint", final, tag=tag,
+                              global_steps=self.global_steps)
         fault.site("ckpt.after_commit")
         if save_latest:
             atomic.write_latest(save_dir, tag)
@@ -2178,6 +2357,13 @@ class DeepSpeedEngine:
                                        .param_persistence_threshold),
                 tp_specs=self._tp_specs)
         log_dist("elastic resume: " + json.dumps(event), ranks=[0])
+        if self.monitor.armed:
+            # the same record on the telemetry stream (one schema)
+            self.monitor.counter(
+                "elastic_resume", 1,
+                from_mesh=json.dumps(saved_mesh),
+                to_mesh=json.dumps(cur_mesh),
+                global_batch_preserved=bool(saved_tb == cur_tb))
 
     def load_checkpoint(self, load_dir, tag=None, load_module_only=False,
                         load_optimizer_states=True, load_lr_scheduler_states=True):

@@ -35,13 +35,13 @@ engine traces into its step.
 import json
 import os
 import time
-from collections import deque
 from typing import Any, NamedTuple, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..monitor.ring import RingBuffer
 from ..utils.logging import logger, log_dist
 
 
@@ -204,11 +204,19 @@ class HealthMonitor:
     host-side already); the monitor then maintains a :class:`HostEma`
     twin so the z-score telemetry and spike accounting exist on every
     path.
+
+    The forensic step history is a ``monitor.ring.RingBuffer`` (the same
+    bounded-ring class behind the telemetry bus's in-memory sink), and
+    when the engine runs with an armed monitor the guardian's events —
+    rewinds, forensic dumps — are ALSO announced on the bus (``bus=``),
+    so the escalation record shows up in the one telemetry stream
+    instead of only in scattered log lines.
     """
 
-    def __init__(self, cfg):
+    def __init__(self, cfg, bus=None):
         self.cfg = cfg
-        self.history = deque(maxlen=int(cfg.history))
+        self.bus = bus
+        self.history = RingBuffer(int(cfg.history))
         self.consecutive_skips = 0
         self.total_skips = 0
         self.total_spikes = 0
@@ -332,6 +340,12 @@ class HealthMonitor:
             "limit": int(self.cfg.rewind_limit), "restored_tag": tag,
             "replayed_past_stream_step": self.last_bad_stream_step}),
             ranks=[0])
+        if self.bus is not None:
+            self.bus.counter(
+                "health_rewind", self.rewinds, step=self.last_step,
+                episode_rewind=self.episode_rewinds,
+                restored_tag=tag,
+                replayed_past_stream_step=self.last_bad_stream_step)
 
     def on_checkpoint_load(self):
         """A checkpoint load supersedes the observed run: the consecutive
@@ -394,8 +408,10 @@ class HealthMonitor:
             os.makedirs(dirpath, exist_ok=True)
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump(self._json_safe(payload), f, indent=2,
-                          allow_nan=False)
+                # the forensic ARTIFACT itself; its existence is announced
+                # on the monitor bus as an `artifact` event below
+                json.dump(self._json_safe(payload),  # dstpu: disable=DSTPU104
+                          f, indent=2, allow_nan=False)
             os.replace(tmp, path)
         except OSError as e:
             logger.warning(f"health: could not write forensic dump to "
@@ -404,4 +420,8 @@ class HealthMonitor:
         logger.warning("health forensics written: " + json.dumps({
             "event": "health_forensics_written", "path": path,
             "reason": reason}))
+        if self.bus is not None:
+            self.bus.artifact("health_forensics", path,
+                              step=self.last_step, reason=reason)
+            self.bus.flush()
         return path

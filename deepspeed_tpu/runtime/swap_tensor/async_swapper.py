@@ -14,10 +14,12 @@ from ...utils.logging import logger
 
 
 class AsyncTensorSwapper:
-    def __init__(self, aio_handle, numel_alignment=None, timers=None,
+    def __init__(self, aio_handle, numel_alignment=None,
                  buffer_count=2, buffer_numel=None, retry=None):
+        # (a `timers=` parameter used to be accepted and silently ignored
+        # — a dead started-but-never-read path; swap timing now comes
+        # from the monitor spans around the offload host half)
         self.aio_handle = aio_handle
-        self.timers = timers
         from ...utils.retry import RetryPolicy
         self.retry = retry or RetryPolicy()
         self.buffer_count = max(2, buffer_count)

@@ -4,7 +4,14 @@ Parity: reference ``deepspeed/utils/timer.py:34`` (``SynchronizedWallClockTimer`
 and ``:134`` (``ThroughputTimer``).  On TPU there are no CUDA events; accurate
 device timing means blocking on output buffers (``jax.block_until_ready``)
 before reading the host clock — real per-op breakdowns come from
-``jax.profiler`` traces instead (see ``deepspeed_tpu/profiling``).
+``jax.profiler`` traces instead (``monitor.trace``, ``monitor.trace_steps``).
+
+Both timers are consumers of the monitor layer now (docs/monitoring.md):
+the engine's per-step spans feed the named-timer registry through
+:meth:`SynchronizedWallClockTimer.record_span` (so ``wall_clock_breakdown``
+prints measured phase times instead of registering timers nobody starts),
+and :class:`ThroughputTimer` mirrors its periodic samples/sec reading onto
+the monitor bus when one is attached.
 """
 
 import time
@@ -15,13 +22,19 @@ from .logging import logger
 class SynchronizedWallClockTimer:
     """Named timer registry, device-synchronized at stop when requested."""
 
+    # per-timer sample window behind mean()/get_mean(): bounded, or a
+    # long wall_clock_breakdown run leaks one float per span per step
+    RECORD_WINDOW = 512
+
     class Timer:
         def __init__(self, name):
             self.name_ = name
             self.elapsed_ = 0.0
             self.started_ = False
             self.start_time = time.time()
-            self.records = []
+            from collections import deque
+            self.records = deque(
+                maxlen=SynchronizedWallClockTimer.RECORD_WINDOW)
 
         def start(self):
             assert not self.started_, f"{self.name_} timer has already been started"
@@ -71,6 +84,16 @@ class SynchronizedWallClockTimer:
             self.timers[name] = self.Timer(name)
         return self.timers[name]
 
+    def record_span(self, name, dur_s):
+        """Feed one externally-measured duration (an engine monitor span)
+        into the named-timer registry: ``elapsed`` accumulates for
+        :meth:`log`, ``records`` feeds :meth:`get_mean` — the timer is
+        never ``start()``ed, so there is no dead started-but-unread
+        state."""
+        t = self(name)
+        t.elapsed_ += float(dur_s)
+        t.records.append(float(dur_s))
+
     def has_timer(self, name):
         return name in self.timers
 
@@ -113,7 +136,7 @@ class ThroughputTimer:
     """Samples/sec tracking. Parity: reference ``utils/timer.py:134``."""
 
     def __init__(self, batch_size, start_step=2, steps_per_output=50,
-                 monitor_memory=False, logging_fn=None):
+                 monitor_memory=False, logging_fn=None, bus=None):
         self.start_time = 0
         self.end_time = 0
         self.started = False
@@ -127,6 +150,9 @@ class ThroughputTimer:
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or logger.info
+        self.bus = bus            # optional monitor bus: the periodic
+        # samples/sec reading ALSO lands on the telemetry stream, so the
+        # log line and ds_top show the same number (one schema)
         self.initialized = False
 
     def update_epoch_count(self):
@@ -159,18 +185,29 @@ class ThroughputTimer:
             self.step_elapsed_time += duration
             if global_step:
                 if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    curr = self.batch_size / self.step_elapsed_time
                     self.logging(
                         "epoch={}/micro_step={}/global_step={}, RunningAvgSamplesPerSec={}, "
                         "CurrSamplesPerSec={}".format(
                             self.epoch_count, self.micro_step_count, self.global_step_count,
-                            self.avg_samples_per_sec(),
-                            self.batch_size / self.step_elapsed_time))
+                            self.avg_samples_per_sec(), curr))
+                    if self.bus is not None:
+                        self.bus.gauge("throughput_samples_per_sec", curr,
+                                       step=self.global_step_count)
                 self.step_elapsed_time = 0
 
     def avg_samples_per_sec(self):
         if self.global_step_count > self.start_step:
             samples_per_step = self.batch_size
-            total_step_offset = self.global_step_count - self.start_step
-            avg_time_per_step = self.total_elapsed_time / total_step_offset
+            avg_time_per_step = self.avg_step_time()
             return samples_per_step / avg_time_per_step
         return float("-inf")
+
+    def avg_step_time(self):
+        """Mean wall-clock per counted optimizer step (post-warmup), in
+        seconds; 0.0 before any step has been counted.  Consumed by the
+        flops profiler's duration term (``runtime/engine.py``)."""
+        if self.global_step_count > self.start_step:
+            return self.total_elapsed_time / (self.global_step_count
+                                              - self.start_step)
+        return 0.0

@@ -76,6 +76,7 @@ def test_engine_loss_curve_matches_torch_adamw(devices):
     np.testing.assert_allclose(ours, ref_losses, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_engine_loss_curve_matches_torch_zero2(devices):
     """Same oracle with the step sharded over an 8-way fsdp mesh (ZeRO-2):
     sharding must not change the math."""

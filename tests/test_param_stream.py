@@ -58,6 +58,7 @@ def _train(config, dropout=0.0, steps=3, model=None):
     return engine, losses
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_stream_loss_matches_nonstream(devices):
     _, ref = _train(_config(4))
     eng, got = _train(_config(4, offload_param={"device": "cpu"}))

@@ -207,6 +207,7 @@ def test_kernel_masks_match_dense_oracle(kp_mode, am_mode):
                                atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_kernel_masked_backward_matches_oracle():
     """Gradients through the masked kernel path match the dense oracle —
     BERT trains with real padding through the kernel."""
@@ -329,6 +330,7 @@ def test_flash_attention_with_padding_bias():
                                atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow   # compile-heavy; fast tier stays inside the driver budget (conftest)
 def test_block_q_merge_exact():
     """block_q_merge=2 (two layout rows share one kernel row with
     per-half-row gating) must match the unmerged path — forward AND
