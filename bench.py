@@ -442,6 +442,99 @@ def measure_serving(preset="gpt2-125m", *, streams=8, batch_slots=8,
         srv.close()
 
 
+def measure_serving_chaos(preset="gpt2-125m", *, streams=8, batch_slots=8,
+                          prompt_len=64, new_tokens=64, block_size=32,
+                          kv_bits=16, int8_weights=False,
+                          io_delay_ms=2.0, deadline_ms=None,
+                          cache_dir=None):
+    """Chaos twin of :func:`measure_serving` (docs/serving.md#resilience):
+    the SAME serving rung re-run with the fault harness ARMED — an
+    ``io_delay_ms`` on every journal append plus ONE ``logit_nan``-
+    poisoned request — under the shed_oldest overload policy with the
+    request journal live.  Reports p50/p99 alongside the typed
+    shed/deadline/poisoned counts and the journal flush count, proving
+    latency stays bounded and accounting stays honest under injected
+    faults (the serving side of the fault-tolerance story)."""
+    import shutil
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu import fault
+    from deepspeed_tpu.models import build
+    from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
+                                         ServingConfig, Request, POISONED)
+
+    model = build(preset, dtype=jnp.bfloat16, max_seq=prompt_len + new_tokens,
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+    poisoned_uid = 10 ** 6 + 1
+    eng = srv = journal_dir = None
+    try:
+        # everything that needs cleanup is built INSIDE the try: a
+        # construction failure (e.g. the memory-preflight gate) must not
+        # leak the journal dir or a live engine into later rungs
+        eng = InferenceEngine(
+            model=model, quantization_setting=1 if int8_weights else None,
+            compile_cache=cache_dir)
+        journal_dir = tempfile.mkdtemp(prefix="serving-chaos-journal-")
+        srv = ServingEngine(engine=eng, config=ServingConfig(
+            batch_slots=batch_slots, block_size=block_size, kv_bits=kv_bits,
+            max_new_tokens=new_tokens, overload="shed_oldest",
+            deadline_ms=deadline_ms, journal_dir=journal_dir,
+            poison_budget=batch_slots))  # one poisoned request must not trip
+        rng = np.random.default_rng(0)
+        V = model.config.vocab_size
+        reqs = [Request(tokens=rng.integers(0, V, (prompt_len,)),
+                        max_new_tokens=new_tokens, seed=i)
+                for i in range(streams)]
+        reqs.append(Request(tokens=rng.integers(0, V, (prompt_len,)),
+                            max_new_tokens=new_tokens, uid=poisoned_uid))
+        # warm executables outside the chaos window, then ARM
+        srv.run([Request(tokens=rng.integers(0, V, (prompt_len,)),
+                         max_new_tokens=2, seed=10 ** 6)])
+        srv.reset_stats()
+        fault.configure(io_delay_ms=io_delay_ms, logit_nan=poisoned_uid)
+        t0 = time.time()
+        srv.run(reqs)
+        dt = time.time() - t0
+        st = srv.stats()
+        gen = sum(len(srv.results[r.uid]["tokens"] or ()) for r in reqs)
+        plan = fault.plan()
+        return {
+            "streams": streams + 1,       # incl. the poisoned request
+            "batch_slots": batch_slots,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "kv_bits": kv_bits,
+            "int8_weights": int8_weights,
+            "fault_spec": {"io_delay_ms": io_delay_ms,
+                           "logit_nan_uids": 1},
+            "tokens_per_sec": round(gen / dt, 1),
+            "p50_ms": st["latency_ms"]["p50"],
+            "p99_ms": st["latency_ms"]["p99"],
+            "outcomes": st["outcomes"],
+            "requeued": st["requeued"],
+            "breaker_open": st["breaker_open"],
+            "poisoned_result_typed": (
+                srv.results[poisoned_uid]["outcome"] == POISONED),
+            "journal_flushes": srv.journal.flushes,
+            "io_site_hits": plan.hits.get("io.write", 0),
+            "decode_steps": st["decode_steps"],
+        }
+    finally:
+        # nested so a failing close cannot skip the rest of the cleanup
+        fault.reset()
+        try:
+            if srv is not None:
+                srv.close()
+        finally:
+            try:
+                if eng is not None:
+                    eng.close()   # serving never owns a passed-in engine
+            finally:
+                if journal_dir is not None:
+                    shutil.rmtree(journal_dir, ignore_errors=True)
+
+
 class _WireProbeMLP:
     """Self-contained MLP for the wire probe: rows >> width, so the SPMD
     partitioner's cheapest baseline schedule moves WEIGHTS (the ZeRO-3
@@ -861,6 +954,19 @@ def main():
     else:
         extra["serving_125m_b8"] = {"skipped": "time budget"}
 
+    # chaos twin: the same serving rung with armed fault injection
+    # (journal io delay + one poisoned request) — p50/p99 must stay
+    # bounded and the shed/poisoned accounting typed (docs/serving.md)
+    if left() > 5 * 60:
+        try:
+            extra["serving_125m_b8_chaos"] = measure_serving_chaos(
+                "gpt2-125m", streams=8, batch_slots=8, prompt_len=64,
+                new_tokens=64, cache_dir=cache_dir)
+        except Exception as e:
+            extra["serving_125m_b8_chaos"] = {"error": str(e)[:160]}
+    else:
+        extra["serving_125m_b8_chaos"] = {"skipped": "time budget"}
+
     # 760M remat: the largest on-chip model (Adam states + remat'd
     # activations fill the 16GB HBM) — the VERDICT r2 MFU target (>=0.45)
     if left() > 4 * 60:
@@ -1010,6 +1116,14 @@ def main():
             "tok_s": serving["tokens_per_sec"],
             "p50_ms": serving["p50_ms"], "p99_ms": serving["p99_ms"],
             "streams": serving["streams"]}
+    chaos = extra.get("serving_125m_b8_chaos") or {}
+    if "tokens_per_sec" in chaos:
+        headline["extra"]["serving_chaos"] = {
+            "p50_ms": chaos["p50_ms"], "p99_ms": chaos["p99_ms"],
+            "shed": chaos["outcomes"]["shed"],
+            "poisoned": chaos["outcomes"]["poisoned"],
+            "deadline": chaos["outcomes"]["deadline"],
+            "breaker_open": chaos["breaker_open"]}
     backoffs = _backoff_summary()
     if backoffs:
         headline["extra"]["backoff"] = backoffs

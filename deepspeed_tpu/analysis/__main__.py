@@ -68,6 +68,9 @@ def _audit_builtin_steps(stages):
             if str(spec) == "decode":
                 findings.extend(_audit_decode_step())
                 continue
+            if str(spec) == "serving-resilience":
+                findings.extend(_audit_serving_resilience())
+                continue
             if str(spec) == "elastic":
                 findings.extend(_audit_elastic_resume())
                 continue
@@ -210,6 +213,78 @@ def _audit_decode_step():
         f.extra = dict(f.extra, audit="generate-decode-loop")
         findings.append(f)
     eng.close()
+    return findings
+
+
+def _audit_serving_resilience():
+    """--audit-step serving-resilience: the quarantine-sentinel-armed
+    serving decode step (docs/serving.md#resilience) must stay one clean
+    executable — zero host callbacks (DSTPU201) with the pool donation
+    honored (DSTPU204) — and the ``logit_nan`` chaos fault must leave
+    the TRACED program byte-identical (the poison rides the pool data;
+    the PR-3 jaxpr-equality discipline applied to the serving step).
+    Functionally, a poisoned request must come back quarantined while
+    its neighbor completes."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from .findings import Finding
+    from .jaxpr_audit import audit_fn
+    from deepspeed_tpu import fault
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
+                                         Request, POISONED, OK)
+
+    cfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0))
+    scfg = dict(batch_slots=2, block_size=8, max_new_tokens=4,
+                preflight=False)
+    findings = []
+
+    def jaxpr_text(srv):
+        srv._build_decode()
+        return str(jax.make_jaxpr(srv._decode)(*srv._decode_args()))
+
+    fault.reset()
+    try:
+        clean = ServingEngine(model=model, params=params,
+                              config=ServingConfig(**scfg))
+        clean_jaxpr = jaxpr_text(clean)
+        # audit the sentinel-armed step itself: no host callbacks, pool
+        # donation honored through the quarantine sentinel's extra output
+        clean.run([Request(tokens=np.arange(5), max_new_tokens=2)])
+        report = audit_fn(clean._decode, *clean._decode_args(),
+                          donate_argnums=(1,), mesh=clean.engine.mesh)
+        for f in report.findings:
+            f.extra = dict(f.extra, audit="serving-resilience")
+        findings.extend(report.findings)
+        clean.close()
+
+        fault.configure(logit_nan=7)
+        armed = ServingEngine(model=model, params=params,
+                              config=ServingConfig(**scfg))
+        if jaxpr_text(armed) != clean_jaxpr:
+            findings.append(Finding(
+                "DSTPU201", "error",
+                "--audit-step serving-resilience: arming the logit_nan "
+                "fault CHANGED the traced decode step (jaxpr armed != "
+                "disarmed) — the poison must ride the pool data, never "
+                "the program", eqn_path="serving/jaxpr-equality"))
+        res = armed.run([Request(tokens=np.arange(5), uid=7),
+                         Request(tokens=np.arange(6), uid=8)])
+        if res[7]["outcome"] != POISONED or res[8]["outcome"] != OK:
+            findings.append(Finding(
+                "DSTPU200", "warning",
+                "--audit-step serving-resilience: the poisoned request "
+                f"was not quarantined (outcomes: uid7="
+                f"{res[7]['outcome']}, uid8={res[8]['outcome']})",
+                eqn_path="serving/quarantine"))
+        armed.close()
+    finally:
+        fault.reset()
     return findings
 
 
@@ -477,6 +552,10 @@ def main(argv=None):
                          "census against the engine's declared CommsBudget; "
                          "'decode' audits the serving layer's fused paged "
                          "decode step + generate()'s fused token scan; "
+                         "'serving-resilience' audits the quarantine-"
+                         "sentinel-armed serving step (zero host "
+                         "callbacks, donation honored, logit_nan fault "
+                         "jaxpr-identical; docs/serving.md#resilience); "
                          "'elastic' audits the first resharded step after "
                          "an elastic resume on half the devices "
                          "(docs/elasticity.md); 'moe' audits the quantized "

@@ -39,11 +39,20 @@ tokens:
                                    by ``spike_factor`` over the window —
                                    finite but wildly out-of-distribution
 - ``spike_factor=<float>``         loss_spike multiplier (default 1e4)
+- ``logit_nan=<uid>``              VALUE corruption for SERVING: poison
+                                   request ``uid``'s KV blocks right after
+                                   its prefill (host-side pool edit — the
+                                   compiled decode step is unchanged) so
+                                   its decode logits go non-finite; drives
+                                   the quarantine ladder
+                                   (docs/serving.md#resilience).  Repeat
+                                   the token to poison several uids.
 
 Known sites (kept in ``SITES`` so tests and docs can't drift): checkpoint
 commit protocol (``ckpt.*``), tree serialization (``io.read``/``io.write``),
-AIO submits (``aio.submit``), and the engine's host-side step boundary
-(``engine.step``).
+AIO submits (``aio.submit``), the engine's host-side step boundary
+(``engine.step``), and the serving scheduler's host boundaries
+(``serving.step``/``serving.admit``/``serving.prefill``).
 
 Value-corruption faults (``grad_nan``/``loss_spike``) are NOT call sites:
 the engine passes each drawn batch through :func:`corrupt_batch` with its
@@ -69,6 +78,9 @@ SITES = (
     "io.read",                 # serialization reads (load_tree)
     "aio.submit",              # NVMe swap read/write submission
     "engine.step",             # host-side train_batch boundary
+    "serving.step",            # serving scheduler iteration (host boundary)
+    "serving.admit",           # serving admission (queue -> slot) boundary
+    "serving.prefill",         # before a request's prefill dispatch
 )
 
 _IO_PREFIXES = ("io.", "aio.")
@@ -103,7 +115,7 @@ def _parse_window(val):
 class FaultPlan:
     def __init__(self, crash_sites=(), io_error_p=0.0, io_delay_ms=0.0,
                  max_faults=None, seed=0, grad_nan=None, loss_spike=None,
-                 spike_factor=1e4):
+                 spike_factor=1e4, logit_nan=()):
         unknown = set(crash_sites) - set(SITES)
         assert not unknown, f"unknown fault sites {sorted(unknown)}; " \
                             f"valid: {SITES}"
@@ -115,6 +127,9 @@ class FaultPlan:
         self.loss_spike = (tuple(loss_spike) if loss_spike is not None
                            else None)
         self.spike_factor = float(spike_factor)
+        if isinstance(logit_nan, int):
+            logit_nan = (logit_nan,)
+        self.logit_nan = frozenset(int(u) for u in logit_nan)
         self.rng = random.Random(seed)
         self.injected_io_errors = 0
         self.hits = {}            # site -> visit count (test observability)
@@ -137,6 +152,9 @@ class FaultPlan:
                     kw[key] = int(val)
                 elif key in ("grad_nan", "loss_spike"):
                     kw[key] = _parse_window(val)
+                elif key == "logit_nan":
+                    # may repeat: each token adds one poisoned uid
+                    kw.setdefault("logit_nan", []).append(int(val))
                 else:
                     raise ValueError(f"unknown fault spec key {key!r}")
             elif "_crash_" in token:
@@ -244,6 +262,25 @@ def corrupt_batch(batch, index):
         p.hits["fault.loss_spike"] = p.hits.get("fault.loss_spike", 0) + 1
         return _map_float_leaves(batch, lambda a: a * p.spike_factor)
     return batch
+
+
+def poison_uid(uid):
+    """True when the armed plan marks serving request ``uid`` as a
+    ``logit_nan`` target (the serving quarantine's value fault).
+
+    Like :func:`corrupt_batch`, this is NOT a call site: the serving
+    scheduler consults it host-side after the request's prefill and
+    NaN-fills the request's OWN KV pool blocks — the poison rides the
+    data (slot-local by the paged layout's construction), and the
+    compiled decode step stays byte-identical armed or not (asserted by
+    the serving jaxpr-equality test)."""
+    if _PLAN is None or not _PLAN.logit_nan:
+        return False
+    if int(uid) in _PLAN.logit_nan:
+        _PLAN.hits["fault.logit_nan"] = \
+            _PLAN.hits.get("fault.logit_nan", 0) + 1
+        return True
+    return False
 
 
 # env wiring: a preemption-test job (or `deepspeed --fault=...` launch) arms

@@ -1,9 +1,16 @@
 """Inference engine + serving layer. Parity: reference
 ``deepspeed/inference/`` (engine); the continuous-batching serving layer
-(``serving.py``) is this repo's production-traffic addition
-(docs/serving.md)."""
+(``serving.py``) with its resilience machinery (deadlines, load
+shedding, quarantine, crash-recoverable journal — ``journal.py``) is
+this repo's production-traffic addition (docs/serving.md)."""
 
 from .engine import InferenceEngine
-from .serving import ServingConfig, ServingEngine, Request
+from .serving import (ServingConfig, ServingEngine, Request,
+                      ServingError, QueueFullError, ServingStalledError,
+                      CircuitOpenError,
+                      OK, SHED, DEADLINE, POISONED, OUTCOMES)
 
-__all__ = ["InferenceEngine", "ServingEngine", "ServingConfig", "Request"]
+__all__ = ["InferenceEngine", "ServingEngine", "ServingConfig", "Request",
+           "ServingError", "QueueFullError", "ServingStalledError",
+           "CircuitOpenError", "OK", "SHED", "DEADLINE", "POISONED",
+           "OUTCOMES"]

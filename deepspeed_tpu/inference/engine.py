@@ -202,9 +202,22 @@ class InferenceEngine:
         from ..runtime import compile_cache as ccache
         return ccache.report(self.compile_cache)
 
+    def _check_open(self):
+        """A closed engine's params are gone; using it would surface as a
+        bare ``NoneType`` TypeError deep inside a jitted call.  The
+        serving layer's drain/close path tears engines down while callers
+        may still hold handles — fail with the actual contract instead."""
+        if self.params is None:
+            raise RuntimeError(
+                "InferenceEngine is closed (close() released its params "
+                "and executables); build a new engine — a ServingEngine "
+                "tears down an engine it BUILT, never one passed in via "
+                "engine= (docs/serving.md)")
+
     # ---------------------------------------------------------------- forward
     def forward(self, tokens, **kwargs):
         """Full-context forward → logits (parity: reference ``forward`` :389)."""
+        self._check_open()
         if self._jit_forward is None:
             def fwd(params, toks):
                 return self.module.apply(params, toks)
@@ -232,6 +245,7 @@ class InferenceEngine:
         """
         assert hasattr(self.module, "apply_with_cache"), \
             f"{type(self.module).__name__} does not support cached decoding"
+        self._check_open()
         tokens = jnp.asarray(tokens, jnp.int32)
         B, T = tokens.shape
         total = T + max_new_tokens

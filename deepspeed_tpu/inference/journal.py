@@ -1,0 +1,224 @@
+"""Crash-recoverable serving state: the append-only request journal.
+
+The training side survives a kill at any instant (PR-1 atomic
+checkpoints); this gives the serving side the same property for its only
+mutable state that matters — *which requests were accepted and not yet
+answered*.  Everything else regenerates: sampling streams are pure
+functions of ``(seed, token_index)`` (docs/serving.md), so a restarted
+``ServingEngine`` that re-queues the journal's unfinished requests
+produces token-identical results to the uninterrupted run.
+
+Discipline (the same one the monitor's JSONL sink and the checkpoint
+protocol established):
+
+- **rank-0, append-only JSONL** — one complete record per line, flushed
+  as ONE ``os.write`` on a persistent ``O_APPEND`` handle per scheduler
+  step (submits flush eagerly: an accepted request must be durable
+  before it is served).  A kill mid-write leaves at most one torn
+  trailing line, which :func:`replay` tolerates by construction.
+- **retry-IO**: each flush goes through ``utils/retry.py`` (transient
+  write hiccups are retried with backoff; structural errors raise) and
+  visits the fault harness's ``io.write`` site, so chaos tests can delay
+  or fail the journal path deterministically.
+- **bounded hot-path cost**: per-token records (finishes) buffer in
+  memory and land in the per-step flush — journal IO is O(steps +
+  submits), never O(tokens).
+
+Record kinds: ``submit`` (full request spec — enough to reconstruct the
+``Request``), ``admit``, ``finish`` (outcome + generated tokens),
+``requeue`` (a recovered engine re-queued this uid), ``shutdown``
+(clean drain marker).
+"""
+
+import json
+import os
+import time
+
+from .. import fault
+from ..utils.logging import logger
+from ..utils.retry import RetryPolicy, retry_call
+
+JOURNAL_FILE = "requests.jsonl"
+
+
+class RequestJournal:
+    """Rank-0 append-only journal for one serving deployment (see module
+    docstring).  Not thread-safe — the scheduler is single-threaded."""
+
+    def __init__(self, dirpath, retry=None, clock=time.time):
+        self.dir = dirpath
+        self.path = os.path.join(dirpath, JOURNAL_FILE)
+        os.makedirs(dirpath, exist_ok=True)
+        self._retry = retry or RetryPolicy()
+        self._clock = clock
+        self._buf = []
+        self._fd = None
+        self.flushes = 0
+
+    # ------------------------------------------------------------- records
+    def record(self, kind, **fields):
+        """Buffer one record; it lands on disk at the next :meth:`flush`."""
+        rec = {"kind": kind, "t": self._clock()}
+        rec.update(fields)
+        self._buf.append(json.dumps(rec, sort_keys=True,
+                                    separators=(",", ":")))
+
+    def submit(self, req, deadline_ms=None):
+        """A request was accepted: journal everything needed to re-run it
+        bit-identically, and flush NOW — acceptance must survive a crash
+        (durability is the submit contract; everything later regenerates)."""
+        if deadline_ms is not None and deadline_ms == float("inf"):
+            deadline_ms = "inf"    # bare Infinity is not RFC-8259 JSON
+        self.record("submit", uid=int(req.uid),
+                    tokens=[int(t) for t in req.tokens],
+                    max_new_tokens=int(req.max_new_tokens),
+                    temperature=float(req.temperature),
+                    do_sample=bool(req.do_sample), seed=int(req.seed),
+                    deadline_ms=deadline_ms)
+        try:
+            self.flush()
+        except Exception:
+            # the engine is about to tell its caller acceptance FAILED,
+            # but the failed flush's partial write may ALREADY have made
+            # the submit line durable (a newline-less final line still
+            # parses).  Popping the in-memory record cannot un-write
+            # disk, so instead buffer a cancelling finish: whenever IO
+            # recovers, submit+finish land together and replay sees the
+            # uid as finished, never pending.  Only a process that dies
+            # with IO still broken can leave the phantom submit — the
+            # irreducible window of a cancel that cannot be journaled.
+            self.finish(req.uid, "shed", None)
+            raise
+
+    def admit(self, uid):
+        self.record("admit", uid=int(uid))
+
+    def finish(self, uid, outcome, tokens):
+        self.record("finish", uid=int(uid), outcome=str(outcome),
+                    tokens=None if tokens is None
+                    else [int(t) for t in tokens])
+
+    def requeue(self, uid):
+        self.record("requeue", uid=int(uid))
+
+    def shutdown(self, clean=True, pending=0):
+        self.record("shutdown", clean=bool(clean), pending=int(pending))
+        self.flush()
+
+    # --------------------------------------------------------------- flush
+    def _ensure_fd(self):
+        if self._fd is None:
+            self._fd = os.open(self.path,
+                               os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                               0o644)
+        return self._fd
+
+    def flush(self):
+        """One buffered ``O_APPEND`` write of every pending record (the
+        per-step syscall), through the retry policy and the ``io.write``
+        fault site.  The buffer is cleared only AFTER the write lands —
+        a failed flush keeps the records for the next attempt instead of
+        silently dropping them (replay tolerates the resulting
+        duplicates: submit/finish records are idempotent per uid).
+        Short writes are completed in-attempt; an attempt that failed
+        after partial bytes prepends a newline on retry so the torn
+        fragment terminates instead of corrupting the NEXT record."""
+        if not self._buf:
+            return
+        payload = ("\n".join(self._buf) + "\n").encode("utf-8")
+        state = {"tore": False}
+
+        def _write():
+            fault.site("io.write", path=self.path)
+            fd = self._ensure_fd()
+            view = memoryview(b"\n" + payload if state["tore"]
+                              else payload)
+            while view:
+                state["tore"] = True    # bytes may land before a raise
+                view = view[os.write(fd, view):]
+            state["tore"] = False
+
+        retry_call(_write, policy=self._retry,
+                   describe=f"journal append ({self.path})")
+        self._buf = []
+        self.flushes += 1
+
+    def rotate(self):
+        """Truncate the journal.  Called by a recovering engine when the
+        previous generation shut down CLEAN with nothing pending: every
+        journaled uid reached a terminal outcome and was handed to its
+        caller, so the history is dead weight — without rotation each
+        restart would replay (and re-materialize) every request ever
+        served."""
+        self.flush()
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+        with open(self.path, "w"):
+            pass
+
+    def close(self):
+        try:
+            self.flush()
+        finally:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+
+def replay(dirpath):
+    """Fold a journal back into recovery state.
+
+    Returns ``{"pending": [submit-record dicts, journal order],
+    "finished": {uid: finish-record}, "max_uid": int,
+    "clean_shutdown": bool}``.  ``pending`` holds every submitted uid
+    without a finish record — submitted-but-queued and in-flight alike
+    (a crash loses the distinction, and both re-run identically).
+
+    Torn trailing lines (a kill mid-append) and unparseable lines are
+    skipped with a warning count — replay of a crashed journal must
+    never itself crash."""
+    path = os.path.join(dirpath, JOURNAL_FILE)
+    state = {"pending": [], "finished": {}, "max_uid": -1,
+             "clean_shutdown": False}
+    if not os.path.isfile(path):
+        return state
+    submitted = {}          # uid -> submit record (insertion-ordered)
+    bad = 0
+
+    def _read():
+        fault.site("io.read", path=path)
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+
+    data = retry_call(_read, policy=RetryPolicy(),
+                      describe=f"journal replay ({path})")
+    for line in data.split("\n"):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+            kind = rec["kind"]
+        except (ValueError, KeyError, TypeError):
+            bad += 1        # torn tail or foreign line: skip, keep going
+            continue
+        if kind == "submit":
+            uid = int(rec["uid"])
+            submitted[uid] = rec
+            state["max_uid"] = max(state["max_uid"], uid)
+        elif kind == "finish":
+            uid = int(rec.get("uid", -1))
+            submitted.pop(uid, None)
+            state["finished"][uid] = rec
+        elif kind == "shutdown":
+            state["clean_shutdown"] = bool(rec.get("clean", False))
+            continue
+        # admit/requeue records are informational for replay
+        if kind != "shutdown":
+            state["clean_shutdown"] = False
+    state["pending"] = list(submitted.values())
+    if bad:
+        logger.warning(f"journal replay: skipped {bad} unparseable "
+                       f"line(s) in {path} (torn tail from a kill is "
+                       "expected)")
+    return state

@@ -29,6 +29,33 @@ MII/externals.  This module is that missing layer, built TPU-first:
   (``stats()``); long-running servers drain finished records with
   ``pop_result(uid)`` so ``results`` never grows unbounded.
 
+Resilience (docs/serving.md#resilience — the serving twin of the
+training fault ladder, PR 1/3/7 composed):
+
+- **deadlines + overload policy** — per-request ``deadline_ms``
+  enforced at admit (predictively, against the measured decode-step
+  EMA) and per decode step; queue admission follows
+  ``ServingConfig.overload`` (``reject`` | ``shed_oldest`` | ``block``)
+  with hysteresis watermarks, so sustained overload degrades to
+  bounded-latency shedding instead of unbounded queueing;
+- **poisoned-request quarantine** — an in-graph per-slot non-finite
+  sentinel on the decode logits (``runtime/health.rows_nonfinite``; no
+  host callbacks, sampling branchlessly forced to a sentinel token)
+  with host-side eviction, block scrubbing + return, and a circuit
+  breaker that trips to reject-all with a forensic ring dump when the
+  poison rate exceeds ``poison_budget``;
+- **crash-recoverable in-flight state** — a rank-0 append-only request
+  journal (``inference/journal.py``); a restarted engine re-queues lost
+  in-flight requests and regenerates token-identical answers;
+- **graceful drain** — ``drain(timeout_s)`` stops admission, finishes
+  the active slots and journals a clean shutdown; ``close()`` drains.
+
+Every terminal outcome is typed (``OK``/``SHED``/``DEADLINE``/
+``POISONED`` in the result record's ``outcome``; ``QueueFullError``/
+``ServingStalledError``/``CircuitOpenError`` raised), and the
+shed/deadline/poisoned/requeued totals ride the monitor bus as counters
+(rendered by ``ds_top``).
+
 Determinism: each request's sampling stream is
 ``fold_in(PRNGKey(request.seed), token_index)`` — a function of the
 request alone, never of batch composition — and slots compute
@@ -49,7 +76,49 @@ import jax
 import jax.numpy as jnp
 
 from . import paged_kv as pk
+from .. import fault
+from ..monitor.ring import RingBuffer
+from ..runtime.health import rows_nonfinite, write_forensics
 from ..utils.logging import logger, log_dist
+
+
+# ------------------------------------------------------------ typed results
+# terminal outcomes, carried in every result record's "outcome" field
+OK = "ok"                 # completed normally (length or eos)
+SHED = "shed"             # dropped by the overload policy before serving
+DEADLINE = "deadline"     # could not finish by its deadline (at admit or
+#                           mid-decode; mid-decode keeps the partial tokens)
+POISONED = "poisoned"     # quarantined: drove the decode logits non-finite
+
+OUTCOMES = (OK, SHED, DEADLINE, POISONED)
+
+# token the in-graph sentinel forces into a poisoned slot's sample (the
+# value is irrelevant — the scheduler evicts the slot the same step and
+# never appends it — it only has to be a valid vocab id)
+POISON_SENTINEL_TOKEN = 0
+
+
+class ServingError(RuntimeError):
+    """Base of the serving layer's typed errors."""
+
+
+class QueueFullError(ServingError):
+    """``submit()`` refused: the queue is at its high watermark under
+    ``overload: reject`` (callers can distinguish load shedding from a
+    malformed request, which raises ``ValueError``)."""
+
+
+class ServingStalledError(ServingError):
+    """The scheduler cannot make progress: requests are queued, zero
+    slots are active, and admission seated nothing — or ``run()``
+    overran its step bound.  The message carries the blocking request's
+    block math."""
+
+
+class CircuitOpenError(ServingError):
+    """The poison circuit breaker tripped: new submissions are rejected
+    until the operator investigates (the forensic dump path is in the
+    message and on the monitor bus)."""
 
 
 @dataclasses.dataclass
@@ -71,6 +140,17 @@ class ServingConfig:
     hbm_budget_bytes: Optional[int] = None   # None → backend memory_stats
     preflight_safety: float = 0.92  # allocator headroom (bench.py's margin)
     max_queue: int = 4096
+    # ---- resilience block (docs/serving.md#resilience) ----
+    deadline_ms: Optional[float] = None   # per-request default; None = none
+    overload: str = "reject"        # reject | shed_oldest | block
+    queue_high_watermark: int = 0   # 0 → max_queue
+    queue_low_watermark: int = 0    # 0 → 3/4 of the high watermark
+    poison_budget: int = 4          # breaker trips when poisoned count in
+    poison_window: int = 64         # the last `poison_window` outcomes
+    #                                 EXCEEDS the budget
+    journal_dir: Optional[str] = None     # None = journaling off
+    forensic_dir: Optional[str] = None    # None → journal_dir or cwd
+    drain_timeout_s: float = 60.0   # close()'s drain bound
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServingConfig":
@@ -93,6 +173,12 @@ class Request:
     do_sample: bool = False
     seed: int = 0
     uid: Optional[int] = None
+    # latency budget from submit time (None → serving.deadline_ms;
+    # float("inf") opts OUT of a config default).  A relative budget,
+    # not a wall-clock instant: a recovered engine re-arms it at requeue
+    # time (monotonic clocks don't survive a restart, and a re-run
+    # request deserves a fresh budget).
+    deadline_ms: Optional[float] = None
 
 
 def _mem_analysis(exe) -> Optional[dict]:
@@ -152,6 +238,9 @@ class ServingEngine:
         self.config = config
         assert config.kv_bits in (8, 16)
         assert config.batch_slots >= 1 and config.block_size >= 1
+        assert config.overload in ("reject", "shed_oldest", "block"), \
+            f"serving.overload must be reject|shed_oldest|block, " \
+            f"got {config.overload!r}"
 
         # quantized-weight routing: the SAME helper InferenceEngine
         # .generate uses (models whose decode consumes int8 leaves
@@ -201,12 +290,101 @@ class ServingEngine:
         self._steps = 0
         self._decode = None
         self._prefills = {}       # bucket length → CachedStep
+        self._blockset = None     # jitted poison/scrub scatter (lazy)
         self._preflight_done = False
+
+        # ---- resilience state (docs/serving.md#resilience) ----
+        self._outcomes = {k: 0 for k in OUTCOMES}
+        self._requeued_total = 0
+        self._breaker_open = False
+        self._forensic_path = None
+        self._draining = False
+        self._closed = False
+        self._step_ema_s = None   # measured decode-step wall EMA (the
+        self._step_last_s = None  # predictive-deadline denominator; see
+        #                           _step_estimate_s for the fast-bias)
+        # bounded ring of recent terminal outcomes: the poison-rate
+        # window AND the breaker's forensic payload (PR-9 RingBuffer)
+        self._recent = RingBuffer(max(1, int(config.poison_window)))
+        self.journal = None
+        if config.journal_dir:
+            from . import journal as jr
+            recovered = jr.replay(config.journal_dir)
+            self.journal = jr.RequestJournal(config.journal_dir)
+            self._recover(recovered)
         log_dist(
             f"ServingEngine ready: slots={S} block_size={config.block_size} "
             f"blocks={self.num_blocks} (nb_max={self.nb_max}) "
             f"kv_bits={config.kv_bits} "
             f"pool={pk.pool_bytes(self.pool) / 1e6:.1f} MB", ranks=[0])
+
+    # ------------------------------------------------------------- recovery
+    def _recover(self, state):
+        """Fold a replayed journal into this engine: finished records are
+        restored into ``results`` (tokens + outcome — a caller polling a
+        pre-crash uid still gets its answer), pending requests are
+        RE-QUEUED in journal order, and ``_next_uid`` resumes past every
+        journaled uid so fresh traffic cannot collide."""
+        if state["max_uid"] >= 0:
+            self._next_uid = state["max_uid"] + 1
+        if state["clean_shutdown"] and not state["pending"]:
+            # the previous generation drained clean with nothing left:
+            # every journaled uid was answered and handed over, so the
+            # history is dead weight — rotate instead of re-materializing
+            # every request ever served into results on each restart
+            self.journal.rotate()
+            log_dist(
+                f"serving journal: clean shutdown with nothing pending — "
+                f"rotated {self.config.journal_dir}", ranks=[0])
+            return
+        for uid, rec in state["finished"].items():
+            self.results[uid] = {
+                "tokens": rec.get("tokens"), "outcome": rec.get("outcome"),
+                "t_submit": None, "t_first": None,
+                "t_done": rec.get("t", 0.0), "prompt_len": None,
+                "deadline": None, "recovered": True}
+        for spec in state["pending"]:
+            dl_ms = spec.get("deadline_ms")
+            if dl_ms == "inf":     # journal spelling of float("inf")
+                dl_ms = float("inf")
+            req = Request(tokens=np.asarray(spec["tokens"], np.int32),
+                          max_new_tokens=spec["max_new_tokens"],
+                          temperature=spec.get("temperature", 1.0),
+                          do_sample=spec.get("do_sample", False),
+                          seed=spec.get("seed", 0), uid=spec["uid"],
+                          deadline_ms=dl_ms)
+            try:
+                self.submit(req, _requeue=True)
+            except ValueError as e:
+                # the restart may run a SMALLER serving configuration
+                # (fewer blocks, shorter max_seq — the elastic-resize
+                # workflows): a pending request that no longer fits gets
+                # a typed terminal outcome and a journal finish record
+                # instead of wedging every restart in __init__ (degrade,
+                # never die — recovery must recover the rest)
+                logger.warning(
+                    f"journal recovery: pending request {req.uid} no "
+                    f"longer fits this serving configuration ({e}); "
+                    f"finalized as '{SHED}'")
+                self.results[req.uid] = {
+                    "tokens": None, "outcome": None, "t_submit": None,
+                    "t_first": None, "t_done": None,
+                    "prompt_len": None, "deadline": None,
+                    "recovered": True}
+                self._finalize_unseated(
+                    req, SHED, "recovery: no longer fits this "
+                    "configuration")
+                continue
+            self.journal.requeue(req.uid)
+            self._requeued_total += 1
+        if state["pending"]:
+            self.journal.flush()
+            log_dist(
+                f"serving journal recovery: re-queued "
+                f"{len(state['pending'])} in-flight request(s), restored "
+                f"{len(state['finished'])} finished record(s) "
+                f"(clean_shutdown={state['clean_shutdown']}) from "
+                f"{self.config.journal_dir}", ranks=[0])
 
     # ------------------------------------------------------------- capacity
     def capacity(self) -> dict:
@@ -299,9 +477,61 @@ class ServingEngine:
         self._preflight_done = True
 
     # ------------------------------------------------------------ submission
-    def submit(self, req: Request) -> int:
+    def _breaker_gate(self):
+        if self._breaker_open:
+            raise CircuitOpenError(
+                "serving circuit breaker is OPEN (poison rate exceeded "
+                f"budget {self.config.poison_budget}); forensics: "
+                f"{self._forensic_path}")
+
+    def _watermarks(self):
+        # clamped to max_queue: a high watermark beyond it must not
+        # silently disable the queue's absolute bound
+        high = min(self.config.queue_high_watermark
+                   or self.config.max_queue, self.config.max_queue)
+        low = self.config.queue_low_watermark or max(1, (high * 3) // 4)
+        return high, min(low, high)
+
+    def _apply_overload_policy(self):
+        """The queue-admission gate at the high watermark: ``reject``
+        raises typed, ``shed_oldest`` sheds queue-head requests down past
+        the LOW watermark (hysteresis: one burst of shedding absorbs a
+        sustained overload wave instead of per-submit churn), ``block``
+        drives the scheduler until the queue drains below the mark."""
+        high, low = self._watermarks()
+        if len(self.queue) < high:
+            return
+        pol = self.config.overload
+        if pol == "reject":
+            raise QueueFullError(
+                f"queue full ({len(self.queue)} >= high watermark {high}; "
+                "overload=reject) — retry later, raise the watermark, or "
+                "use overload=shed_oldest/block (docs/serving.md)")
+        if pol == "shed_oldest":
+            shed = 0
+            while self.queue and len(self.queue) >= low:
+                self._finalize_unseated(self.queue.popleft(), SHED,
+                                      "overload: shed_oldest watermark")
+                shed += 1
+            logger.warning(
+                f"serving overload: shed {shed} oldest queued request(s) "
+                f"(queue hit {high}, drained below {low})")
+            return
+        # pol == "block": serve until the backlog clears the mark — the
+        # scheduler makes progress or raises ServingStalledError itself
+        while len(self.queue) >= high:
+            self.step()
+
+    def submit(self, req: Request, _requeue: bool = False) -> int:
         """Queue a request; returns its uid.  Rejects prompts whose
-        worst-case length cannot fit ``max_seq`` or the pool."""
+        worst-case length cannot fit ``max_seq`` or the pool (ValueError),
+        refuses new work while the poison breaker is open
+        (:class:`CircuitOpenError`) or a drain is in progress, and applies
+        the configured overload policy at the queue's high watermark."""
+        self._breaker_gate()
+        if self._draining:
+            raise ServingError("serving engine is draining: admission "
+                               "is stopped")
         toks = np.asarray(req.tokens, np.int32).reshape(-1)
         if toks.size == 0:
             raise ValueError("empty prompt")
@@ -319,24 +549,61 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {nb} blocks; the pool only has "
                 f"{self.num_blocks - 1} allocatable")
-        if len(self.queue) >= self.config.max_queue:
-            raise RuntimeError(f"queue full ({self.config.max_queue})")
+        if req.uid is not None and req.uid in self.results:
+            # validated BEFORE the overload gate: an inadmissible
+            # (duplicate-uid) submission must not shed legitimate queued
+            # work on its way to a ValueError
+            raise ValueError(
+                f"uid {req.uid} already submitted — a duplicate would "
+                "corrupt that request's result record")
+        if not _requeue:
+            # recovered requests were admitted once already; only fresh
+            # traffic passes the overload gate
+            self._apply_overload_policy()
+            # overload='block' drove the scheduler, which may have
+            # quarantined poison and TRIPPED the breaker mid-call —
+            # reject-all must hold for this submission too
+            self._breaker_gate()
         # mutate in place: the caller's handle keeps the uid submit
         # assigns and the resolved generation length
         req.tokens = toks
         req.max_new_tokens = new
         if req.uid is None:
             req.uid = self._next_uid
-        elif req.uid in self.results:
-            raise ValueError(
-                f"uid {req.uid} already submitted — a duplicate would "
-                "corrupt that request's result record")
         self._next_uid = max(self._next_uid, req.uid) + 1
-        self.results[req.uid] = {"tokens": None, "t_submit": time.monotonic(),
+        dl_ms = (req.deadline_ms if req.deadline_ms is not None
+                 else self.config.deadline_ms)
+        if self.journal is not None and not _requeue:
+            # durability contract: an ACCEPTED request survives a crash —
+            # the submit record (plus any buffered shed finishes from the
+            # overload gate above) flushes now, not at the next step, and
+            # BEFORE the request enters the queue: if the flush fails
+            # (retry exhausted), submit raises with nothing enqueued,
+            # so the caller's view ("acceptance failed") stays true
+            self.journal.submit(req, deadline_ms=dl_ms)
+        now = time.monotonic()
+        self.results[req.uid] = {"tokens": None, "outcome": None,
+                                 "t_submit": now,
                                  "t_first": None, "t_done": None,
-                                 "prompt_len": int(toks.size)}
+                                 "prompt_len": int(toks.size),
+                                 "deadline": (now + dl_ms / 1e3
+                                              if dl_ms is not None else None)}
         self.queue.append(req)
         return req.uid
+
+    def _finalize_unseated(self, req: Request, outcome: str, why: str):
+        """Terminal result for a request that never held a slot (overload
+        shed / deadline-at-admit / prefill quarantine): typed outcome, no
+        tokens."""
+        rec = self.results[req.uid]
+        rec["tokens"] = None
+        rec["outcome"] = outcome
+        rec["t_done"] = time.monotonic()
+        self._outcomes[outcome] += 1
+        self._recent.append({"uid": req.uid, "outcome": outcome,
+                             "why": why, "t": time.time()})
+        if self.journal is not None:
+            self.journal.finish(req.uid, outcome, None)
 
     # ---------------------------------------------------------- jitted steps
     def _decode_args(self):
@@ -368,8 +635,16 @@ class ServingEngine:
                  flags):
             logits, pool = self.model.decode_step_paged(
                 deq(params), toks, pool, tables, lengths)
+            # quarantine sentinel (docs/serving.md#resilience): per-slot
+            # non-finite flag computed IN-GRAPH (no host callback — the
+            # PR-3 discipline, audited by --audit-step serving-resilience)
+            # and the poisoned slot's sample branchlessly forced to a
+            # sentinel.  Slots are row-independent, so neighbors' tokens
+            # are bit-identical to a run without the poisoned request.
+            poisoned = rows_nonfinite(logits)
             nxt = self._sample_tokens(logits, seeds, ngen, temps, flags)
-            return nxt, pool
+            nxt = jnp.where(poisoned, jnp.int32(POISON_SENTINEL_TOKEN), nxt)
+            return nxt, poisoned, pool
 
         c = self.config
         self._decode = self.engine._wrap_step(
@@ -412,10 +687,18 @@ class ServingEngine:
                 pad = ((0, 0), (0, bucket - fwd_len), (0, 0), (0, 0))
                 k, v = jnp.pad(k, pad), jnp.pad(v, pad)
             pool = pk.write_prefill(pool, blocks, k, v)
+            row = logits[0, t_real - 1][None]
+            # prefill half of the quarantine sentinel: without it, a
+            # request whose PREFILL logits are already non-finite would
+            # sample a garbage first token — and at max_new_tokens == 1
+            # complete typed OK, invisibly to the circuit breaker
+            bad = rows_nonfinite(row)[0]
             first = self._sample_tokens(
-                logits[0, t_real - 1][None], seed[None],
+                row, seed[None],
                 jnp.zeros((1,), jnp.int32), temp[None], flag[None])
-            return first[0], pool
+            first = jnp.where(bad, jnp.int32(POISON_SENTINEL_TOKEN),
+                              first[0])
+            return first, bad, pool
 
         fn = self.engine._wrap_step(
             f"serving.prefill[{bucket},kv{self.config.kv_bits}]", prefill,
@@ -427,22 +710,56 @@ class ServingEngine:
     def _admit(self):
         """Move queue-head requests into free slots while capacity lasts
         (strict FIFO: a blocked head waits for blocks rather than being
-        overtaken — no starvation)."""
+        overtaken — no starvation).  Deadline enforcement's admit half
+        lives here: a head whose deadline already passed, or provably
+        cannot be met (remaining budget < max_new · measured step EMA),
+        is shed with a typed ``DEADLINE`` result instead of occupying a
+        slot it cannot use."""
+        if self._draining:
+            return
+        fault.site("serving.admit")
         c = self.config
         while self.queue:
+            req: Request = self.queue[0]
+            dl = self.results[req.uid]["deadline"]
+            if dl is not None:
+                now = time.monotonic()
+                est = self._step_estimate_s()
+                eta = now + (req.max_new_tokens * est if est else 0.0)
+                if now >= dl or eta > dl:
+                    self.queue.popleft()
+                    self._finalize_unseated(req, DEADLINE,
+                                          "deadline unmeetable at admit")
+                    continue
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
                 return
-            req: Request = self.queue[0]
             new = req.max_new_tokens       # resolved >= 1 by submit()
             nb = pk.blocks_needed(len(req.tokens) + new, c.block_size)
             blocks = self.allocator.alloc(nb)
             if blocks is None:
                 return
             self.queue.popleft()
+            if self.journal is not None:
+                self.journal.admit(req.uid)
             self._start(free[0], req, blocks, new)
 
+    def _step_estimate_s(self) -> Optional[float]:
+        """Decode-step wall estimate for predictive deadline shedding:
+        the EMA, clamped to the LAST measured step when that was faster.
+        Fast-biased on purpose — a compile/deserialize-laden first step
+        must not convince the gate that every deadline is hopeless; an
+        underestimate only admits a request the per-step deadline check
+        will still evict on time, while an overestimate sheds work the
+        server could have finished."""
+        if self._step_ema_s is None:
+            return None
+        if self._step_last_s is not None:
+            return min(self._step_ema_s, self._step_last_s)
+        return self._step_ema_s
+
     def _start(self, slot: int, req: Request, blocks: List[int], new: int):
+        fault.site("serving.prefill")
         c = self.config
         T = int(len(req.tokens))
         bucket = pk.blocks_needed(T, c.block_size) * c.block_size
@@ -453,11 +770,26 @@ class ServingEngine:
         fn = self._prefill_fn(bucket)
         with jax.set_mesh(self.engine.mesh):
             with self.monitor.span("prefill"):
-                first, self.pool = fn(
+                first, bad, self.pool = fn(
                     self.engine.params, jnp.asarray(toks), self.pool, blk,
                     jnp.int32(T), jnp.int32(req.seed),
                     jnp.float32(req.temperature), jnp.asarray(req.do_sample))
         first = int(np.asarray(first))
+        if bool(np.asarray(bad)):
+            # quarantined AT prefill: the slot is never seated, the
+            # sentinel token is never surfaced, and the blocks go back
+            # scrubbed (prompt K/V of a poisoned forward may be
+            # non-finite too)
+            self._set_blocks(blocks, poison=False)
+            self.allocator.free(blocks)
+            logger.warning(
+                f"serving: request {req.uid} QUARANTINED at prefill — "
+                f"non-finite logits; typed '{POISONED}' result "
+                f"(docs/serving.md#resilience)")
+            self._finalize_unseated(req, POISONED,
+                                  "non-finite prefill logits")
+            self._check_breaker()
+            return
 
         s = _Slot(req, blocks, T, new)
         s.out_tokens.append(first)
@@ -474,18 +806,82 @@ class ServingEngine:
         rec["t_first"] = time.monotonic()
         if new == 1 or first == c.eos_token_id:
             self._finish(slot)
+        elif fault.poison_uid(req.uid):
+            # logit_nan chaos fault: NaN this request's OWN blocks (an
+            # eager host-side pool edit — the compiled step is untouched;
+            # the poison rides the data, exactly like real KV corruption).
+            # Only a slot that will actually decode is poisoned: a
+            # request finishing at prefill frees its blocks above, and
+            # they must go back clean.
+            self._set_blocks(blocks, poison=True)
 
-    def _finish(self, slot: int):
+    def _set_blocks(self, blocks: List[int], poison: bool):
+        """Pool edit over a block list, outside the decode step:
+        ``poison=True`` NaN-fills the payload (int8 pools NaN the fp32
+        scales — the int8 lanes cannot hold a NaN), ``poison=False``
+        scrubs back to zeros/unit scales.  Scrubbing matters on eviction:
+        a stale non-finite row would leak NaN into the block's NEXT
+        tenant through the masked attention tail (0 · NaN = NaN), where
+        stale *finite* garbage is harmless.
+
+        Runs as ONE small jitted scatter with the pool donated (the
+        decode step's in-place discipline — an eager ``.at[].set`` would
+        materialize a full pool copy per quarantine event, transiently
+        doubling a production pool's bytes).  The block list pads to
+        ``nb_max`` by repeating its first id (duplicate scatter indices
+        write the same value), so every request shape shares one
+        executable."""
+        if self._blockset is None:
+            quant = pk.is_quantized_pool(self.pool)
+
+            def setter(pool, blk, val):
+                if quant:
+                    return dict(pool,
+                                k_scale=pool["k_scale"].at[:, blk].set(val),
+                                v_scale=pool["v_scale"].at[:, blk].set(val))
+                v = val.astype(pool["k"].dtype)
+                return dict(pool, k=pool["k"].at[:, blk].set(v),
+                            v=pool["v"].at[:, blk].set(v))
+
+            # cpu backend: donation would only warn (PR-4's copy-on-
+            # donate note); device backends get the in-place update
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._blockset = jax.jit(setter, donate_argnums=donate)
+        padded = np.full((self.nb_max,), blocks[0], np.int32)
+        padded[:len(blocks)] = blocks
+        val = jnp.float32(jnp.nan if poison else (1.0 if
+                          pk.is_quantized_pool(self.pool) else 0.0))
+        with jax.set_mesh(self.engine.mesh):
+            self.pool = self._blockset(self.pool, jnp.asarray(padded), val)
+
+    def _finish(self, slot: int, outcome: str = OK):
         s = self._slots[slot]
+        if outcome == POISONED:
+            # quarantine eviction: scrub the non-finite rows out of the
+            # blocks BEFORE they return to the free list
+            self._set_blocks(s.blocks, poison=False)
         self.allocator.free(s.blocks)
         rec = self.results[s.req.uid]
         rec["tokens"] = list(s.out_tokens)
+        rec["outcome"] = outcome
         rec["t_done"] = time.monotonic()
-        self._completed_total += 1
+        self._outcomes[outcome] += 1
+        self._recent.append({"uid": s.req.uid, "outcome": outcome,
+                             "generated": len(s.out_tokens),
+                             "t": time.time()})
+        if outcome == OK:
+            self._completed_total += 1
         self._generated_total += len(s.out_tokens)
-        self._lat_ms.append((rec["t_done"] - rec["t_submit"]) * 1e3)
-        if rec["t_first"] is not None:
-            self._ttft_ms.append((rec["t_first"] - rec["t_submit"]) * 1e3)
+        if outcome in (OK, DEADLINE):
+            # admitted-request latency window: completions AND
+            # deadline evictions (their latency ≈ the deadline — the
+            # bound the overload tests assert); queue sheds never ran
+            self._lat_ms.append((rec["t_done"] - rec["t_submit"]) * 1e3)
+            if rec["t_first"] is not None:
+                self._ttft_ms.append(
+                    (rec["t_first"] - rec["t_submit"]) * 1e3)
+        if self.journal is not None:
+            self.journal.finish(s.req.uid, outcome, rec["tokens"])
         self._slots[slot] = None
         self._tables[slot] = 0
         self._lengths[slot] = 0
@@ -495,32 +891,117 @@ class ServingEngine:
         self._temps[slot] = 1.0
         self._flags[slot] = False
 
+    def _evict_poisoned(self, slot: int):
+        s = self._slots[slot]
+        logger.warning(
+            f"serving: request {s.req.uid} QUARANTINED — its decode "
+            f"logits went non-finite; evicted with a typed '{POISONED}' "
+            f"result, blocks scrubbed and returned "
+            f"(docs/serving.md#resilience)")
+        self._finish(slot, outcome=POISONED)
+        self._check_breaker()
+
+    def _check_breaker(self):
+        """Trip to reject-all when the poison count in the recent-outcome
+        window EXCEEDS ``poison_budget`` — one bad input is an eviction,
+        a stream of them is an attack or a broken model, and the server
+        must say so loudly instead of grinding through it."""
+        if self._breaker_open:
+            return
+        recent = list(self._recent)
+        poisoned = sum(1 for r in recent if r["outcome"] == POISONED)
+        if poisoned <= self.config.poison_budget:
+            return
+        self._breaker_open = True
+        dirpath = (self.config.forensic_dir or self.config.journal_dir
+                   or os.getcwd())
+        payload = {
+            "event": "serving_forensics",
+            "reason": f"poison rate: {poisoned} poisoned of "
+                      f"{len(recent)} recent outcomes exceeds budget "
+                      f"{self.config.poison_budget}",
+            "time_unix": time.time(),
+            "decode_steps": self._steps,
+            "counters": dict(self._outcomes,
+                             requeued=self._requeued_total),
+            "policy": {"poison_budget": self.config.poison_budget,
+                       "poison_window": self.config.poison_window,
+                       "overload": self.config.overload,
+                       "deadline_ms": self.config.deadline_ms},
+            "recent": recent,
+        }
+        self._forensic_path = write_forensics(
+            dirpath, f"serving_forensics_step{self._steps}.json", payload)
+        logger.error(
+            "serving circuit breaker TRIPPED: rejecting all new "
+            f"submissions ({payload['reason']}); forensics: "
+            f"{self._forensic_path}")
+        mon = self.monitor
+        if mon.armed:
+            mon.counter("breaker_open", 1, step=self._steps)
+            if self._forensic_path is not None:
+                mon.artifact("serving_forensics", self._forensic_path,
+                             step=self._steps,
+                             reason=payload["reason"])
+            mon.flush()
+
     def step(self) -> bool:
         """One scheduler iteration: admit from the queue, ONE fused
-        decode dispatch for the whole batch, sample, join/evict.
+        decode dispatch for the whole batch, sample, join/evict (with
+        quarantine + deadline enforcement), flush the journal.
         Returns False when there is nothing left to do."""
         if not self._preflight_done:
             self._preflight_gate()
+        fault.site("serving.step")
         mon = self.monitor
         mon.begin_step()
         with mon.span("admit"):
             self._admit()
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
+            if self.queue and not self._draining:
+                # livelock guard: requests are waiting, EVERY slot is
+                # free, and admission still seated nothing — spinning a
+                # hot no-op step() forever would hide the bug; raise
+                # with the head's block math instead
+                self._raise_stalled()
             # idle poll: nothing decoded — discard the bracket instead of
             # emitting spans under a reused step number
             mon.abort_step()
+            if self.journal is not None:
+                self.journal.flush()
             return bool(self.queue)
         self._build_decode()
+        t0 = time.perf_counter()
         with jax.set_mesh(self.engine.mesh):
             with mon.span("dispatch"):
-                nxt, self.pool = self._decode(*self._decode_args())
+                nxt, poisoned, self.pool = self._decode(*self._decode_args())
         with mon.span("sample_join"):
             nxt = np.asarray(nxt)
+            poisoned = np.asarray(poisoned)
+            # the value read above synced the dispatch: this wall time is
+            # a true decode-step cost, the predictive-deadline EMA's input
+            dt = time.perf_counter() - t0
+            self._step_last_s = dt
+            if self._step_ema_s is None:
+                self._step_ema_s = dt
+            elif dt < self._step_ema_s:
+                # adapt DOWN fast: one compile-heavy outlier step decays
+                # in a few iterations instead of poisoning the
+                # predictive-deadline gate for a long tail
+                self._step_ema_s = 0.5 * self._step_ema_s + 0.5 * dt
+            else:
+                self._step_ema_s = 0.7 * self._step_ema_s + 0.3 * dt
             self._steps += 1
             c = self.config
+            now = time.monotonic()
             for i in active:
                 s = self._slots[i]
+                if poisoned[i]:
+                    # the sentinel token is NOT appended: the request's
+                    # record keeps only its pre-poison tokens
+                    self._evict_poisoned(i)
+                    continue
                 tok = int(nxt[i])
                 s.out_tokens.append(tok)
                 self._lengths[i] += 1
@@ -528,8 +1009,35 @@ class ServingEngine:
                 self._ngen[i] += 1
                 if len(s.out_tokens) >= s.max_new or tok == c.eos_token_id:
                     self._finish(i)
+                    continue
+                dl = self.results[s.req.uid]["deadline"]
+                if dl is not None and now >= dl:
+                    # mid-decode deadline: evict with the partial tokens
+                    # — the slot goes back to work that can still meet
+                    # its budget
+                    self._finish(i, outcome=DEADLINE)
+        if self.journal is not None:
+            with mon.span("journal"):
+                # ONE buffered append per scheduler step (admits +
+                # finishes); submits flushed eagerly at submit()
+                self.journal.flush()
         self._monitor_finish(len(active))
         return True
+
+    def _raise_stalled(self):
+        c = self.config
+        req: Request = self.queue[0]
+        nb = pk.blocks_needed(len(req.tokens) + req.max_new_tokens,
+                              c.block_size)
+        raise ServingStalledError(
+            f"serving stalled: {len(self.queue)} request(s) queued, zero "
+            f"slots active, and admission made no progress — head uid "
+            f"{req.uid} needs {nb} block(s) "
+            f"(= ceil(({len(req.tokens)} prompt + {req.max_new_tokens} "
+            f"new) / block_size {c.block_size})) but the allocator has "
+            f"{self.allocator.free_blocks} free of "
+            f"{self.num_blocks - 1} allocatable "
+            f"({self.allocator.used_blocks} leaked or still held)")
 
     # decode steps between latency-percentile emissions: stats() sorts two
     # <=4096-entry windows, which must not run per generated token
@@ -549,6 +1057,14 @@ class ServingEngine:
                    "completed_total": self._completed_total,
                    "generated_total": self._generated_total,
                    "free_blocks": self.allocator.free_blocks}
+        # resilience outcomes as counters: the ds_top serving line and
+        # any alerting pipeline read shed/deadline/poison pressure from
+        # the one event stream (docs/monitoring.md)
+        counters = {"shed_total": self._outcomes[SHED],
+                    "deadline_total": self._outcomes[DEADLINE],
+                    "poisoned_total": self._outcomes[POISONED],
+                    "requeued_total": self._requeued_total,
+                    "breaker_open": int(self._breaker_open)}
         gauges = {}
         if self._steps % self._PERCENTILES_EVERY == 0:
             st = self.stats()
@@ -559,20 +1075,73 @@ class ServingEngine:
                 gauges["ttft_p50_ms"] = st["ttft_ms"]["p50"]
         mon.set_rates(tokens_per_step=active_slots)
         mon.end_step(self._steps, scalars=scalars, gauges=gauges,
-                     name="serving_step")
+                     counters=counters, name="serving_step")
 
     def run(self, requests=None, max_steps: int = 10 ** 6) -> Dict[int, dict]:
         """Submit ``requests`` (if given) and drive :meth:`step` until
         the queue drains and every slot completes.  Returns
-        ``self.results`` (uid → tokens + stamps)."""
+        ``self.results`` (uid → tokens + stamps + outcome)."""
         for r in requests or ():
             self.submit(r)
         steps = 0
         while self.step():
             steps += 1
             if steps > max_steps:
-                raise RuntimeError(f"serving run exceeded {max_steps} steps")
+                raise ServingStalledError(
+                    f"serving run exceeded {max_steps} steps with work "
+                    f"still pending ({len(self.queue)} queued, "
+                    f"{sum(s is not None for s in self._slots)} active)")
         return self.results
+
+    # ----------------------------------------------------------------- drain
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful shutdown: stop admission, let the ACTIVE slots finish
+        (bounded by ``timeout_s``, default ``serving.drain_timeout_s``),
+        and journal a clean-shutdown marker.  Queued-but-unseated
+        requests are left journaled as pending — a restarted engine
+        re-queues and serves them (:meth:`_recover`); WITHOUT a journal
+        no restart will ever serve them, so they finalize as typed
+        ``SHED`` results instead of staying in-flight forever.
+        Idempotent; :meth:`close` drains first.  Returns a summary
+        dict."""
+        if timeout_s is None:
+            timeout_s = self.config.drain_timeout_s
+        self._draining = True
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        timed_out = False
+        while any(s is not None for s in self._slots):
+            if time.monotonic() >= deadline:
+                timed_out = True
+                break
+            self.step()
+        active = sum(s is not None for s in self._slots)
+        summary = {"clean": not timed_out, "active": active,
+                   "queued": len(self.queue)}
+        if timed_out:
+            logger.warning(
+                f"serving drain timed out after {timeout_s}s with "
+                f"{active} slot(s) still active — "
+                + ("their requests stay journaled as in-flight (a "
+                   "restart re-queues them)" if self.journal is not None
+                   else "their requests finalize as typed 'shed' "
+                        "results (no journal, no restart)"))
+        if self.journal is not None:
+            self.journal.shutdown(clean=not timed_out,
+                                  pending=active + len(self.queue))
+        else:
+            # no journal = no restart will ever serve the leftovers:
+            # give each — queued AND timed-out active — a typed terminal
+            # outcome instead of an eternally in-flight record ("every
+            # terminal outcome is typed" must hold on the default
+            # configuration too; close() frees the pool right after)
+            while self.queue:
+                self._finalize_unseated(self.queue.popleft(), SHED,
+                                        "drain without a journal")
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    self._finish(i, outcome=SHED)
+        log_dist(f"serving drained: {summary}", ranks=[0])
+        return summary
 
     # ------------------------------------------------------------- reporting
     def pop_result(self, uid: int) -> dict:
@@ -588,9 +1157,10 @@ class ServingEngine:
         return self.results.pop(uid)
 
     def reset_stats(self):
-        """Zero the latency/throughput aggregates and drop completed
-        records; in-flight requests are untouched (bench warmup
-        hygiene)."""
+        """Zero the latency/throughput aggregates, the outcome counters
+        and the recent-outcome ring, and drop completed records;
+        in-flight requests and the breaker state are untouched (bench
+        warmup hygiene — an OPEN breaker must survive a stats reset)."""
         for uid in [u for u, r in self.results.items()
                     if r["t_done"] is not None]:
             del self.results[uid]
@@ -599,6 +1169,9 @@ class ServingEngine:
         self._completed_total = 0
         self._generated_total = 0
         self._steps = 0
+        self._outcomes = {k: 0 for k in OUTCOMES}
+        self._requeued_total = 0
+        self._recent = RingBuffer(max(1, int(self.config.poison_window)))
 
     def stats(self) -> dict:
         """Latency/throughput summary over completed requests: p50/p99
@@ -609,7 +1182,10 @@ class ServingEngine:
                "pending": len(self.queue) + sum(
                    s is not None for s in self._slots),
                "decode_steps": self._steps,
-               "generated_tokens": self._generated_total}
+               "generated_tokens": self._generated_total,
+               "outcomes": dict(self._outcomes),
+               "requeued": self._requeued_total,
+               "breaker_open": self._breaker_open}
         if self._lat_ms:
             lat = np.asarray(self._lat_ms)
             out["latency_ms"] = {
@@ -627,17 +1203,33 @@ class ServingEngine:
         return self.engine.compile_report()
 
     def close(self):
-        """Drop live executables and the pool (bench hygiene — the same
-        contract as ``DeepSpeedEngine.close``).  An engine the CALLER
-        passed in (``engine=``) stays usable — only an internally built
-        one is torn down."""
-        for fn in [self._decode] + list(self._prefills.values()):
-            if fn is not None and hasattr(fn, "clear"):
-                fn.clear()
-        self._decode = None
-        self._prefills.clear()
-        self.pool = None
-        if self._owns_monitor:
-            self.monitor.close()
-        if self._owns_engine:
-            self.engine.close()
+        """Graceful shutdown: :meth:`drain` (finish active slots, journal
+        a clean shutdown), then drop live executables and the pool (bench
+        hygiene — the same contract as ``DeepSpeedEngine.close``).  An
+        engine the CALLER passed in (``engine=``) stays usable — only an
+        internally built one is torn down.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            # a drain failure (wedged backend, armed crash site) must not
+            # leak the pool/executables/journal fd: teardown runs anyway
+            self.drain()
+        finally:
+            try:
+                if self.journal is not None:
+                    self.journal.close()
+            except OSError as e:
+                logger.warning(f"serving: journal close failed ({e}); "
+                               "continuing teardown")
+            for fn in [self._decode] + list(self._prefills.values()):
+                if fn is not None and hasattr(fn, "clear"):
+                    fn.clear()
+            self._decode = None
+            self._prefills.clear()
+            self._blockset = None
+            self.pool = None
+            if self._owns_monitor:
+                self.monitor.close()
+            if self._owns_engine:
+                self.engine.close()

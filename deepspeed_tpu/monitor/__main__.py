@@ -141,6 +141,22 @@ def render(agg: Aggregate, source: str, clock=time.time) -> str:
         f"{_fmt(c('wire_bytes_per_step'), 'B'):>10} "
         f"{_fmt(fields.get('skipped_steps', agg.skips_total)):>6}",
     ]
+    # serving resilience line (docs/serving.md#resilience): rendered when
+    # the stream carries serving decode steps or any resilience counter
+    srv = {k: c(k) for k in ("shed_total", "deadline_total",
+                             "poisoned_total", "requeued_total",
+                             "breaker_open")}
+    if (any(v is not None for v in srv.values())
+            or (step is not None and step.name == "serving_step")):
+        lines += [
+            "-" * 78,
+            f"serving: active {_fmt(fields.get('active_slots'))}  "
+            f"queued {_fmt(fields.get('queued'))}  "
+            f"shed {_fmt(srv['shed_total'] or 0)}  "
+            f"deadline {_fmt(srv['deadline_total'] or 0)}  "
+            f"poisoned {_fmt(srv['poisoned_total'] or 0)}  "
+            f"requeued {_fmt(srv['requeued_total'] or 0)}  "
+            f"breaker {'OPEN' if srv['breaker_open'] else 'closed'}"]
     if agg.spans:
         root = agg.spans.get("step")
         parts = [f"step {root.dur_s * 1e3:.1f}ms"] if root is not None \

@@ -92,6 +92,18 @@ def tree_nonfinite(tree) -> jnp.ndarray:
     return out
 
 
+def rows_nonfinite(x, axis=-1) -> jnp.ndarray:
+    """Per-row any-non-finite flag: the batched sibling of
+    :func:`tree_nonfinite`, reduced over ``axis`` only.
+
+    The serving quarantine uses it on the decode logits — one bool per
+    batch slot, computed INSIDE the compiled step (pure ``jnp``, no host
+    callback), so a poisoned request is detected in-graph and its
+    sampling branchlessly forced to a sentinel while neighbors' rows are
+    untouched (docs/serving.md#resilience)."""
+    return jnp.logical_not(jnp.all(jnp.isfinite(x), axis=axis))
+
+
 def update_ema(state: HealthState, loss, *, window: int,
                zmax: float = 0.0, warmup: Optional[int] = None):
     """One EMA tick + loss-spike z-score, branchless.
@@ -176,6 +188,48 @@ class HostEma:
                 self._sq += alpha * (loss * loss - self._sq)
             self._count += 1
         return z, spike
+
+
+# ---------------------------------------------------------------------------
+# forensic-dump plumbing shared with the serving circuit breaker
+# ---------------------------------------------------------------------------
+
+def json_safe(obj):
+    """Non-finite floats -> strings: the whole point of a forensic dump is
+    the NaN/Inf values, and bare ``NaN``/``Infinity`` tokens (Python's
+    default) are not RFC-8259 JSON — jq / JSON.parse / monitoring
+    pipelines would reject the artifact.  Shared by the training
+    guardian's dump and the serving circuit breaker's."""
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return repr(obj)              # 'nan' | 'inf' | '-inf'
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
+def write_forensics(dirpath, filename, payload):
+    """Atomically write a forensic JSON artifact (write-temp + replace);
+    best-effort — returns the path, or None on failure (a dump failure
+    must never mask the abort/trip it accompanies)."""
+    path = os.path.join(dirpath, filename)
+    try:
+        os.makedirs(dirpath, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            # the forensic ARTIFACT itself; announced on the monitor bus
+            # by the caller as an `artifact` event
+            json.dump(json_safe(payload),  # dstpu: disable=DSTPU104
+                      f, indent=2, allow_nan=False)
+        os.replace(tmp, path)
+    except (OSError, TypeError, ValueError) as e:
+        # TypeError/ValueError: a payload value json couldn't serialize
+        # (e.g. a numpy scalar a caller smuggled in as a uid) — a dump
+        # failure must never mask the abort/trip it accompanies
+        logger.warning(f"could not write forensic dump to {path}: {e}")
+        return None
+    return path
 
 
 # ---------------------------------------------------------------------------
@@ -364,19 +418,9 @@ class HealthMonitor:
                 "episode_rewinds": self.episode_rewinds,
                 "last_bad_stream_step": self.last_bad_stream_step}
 
-    @staticmethod
-    def _json_safe(obj):
-        """Non-finite floats -> strings: the whole point of the dump is the
-        NaN/Inf values, and bare ``NaN``/``Infinity`` tokens (Python's
-        default) are not RFC-8259 JSON — jq / JSON.parse / monitoring
-        pipelines would reject the artifact."""
-        if isinstance(obj, float) and not np.isfinite(obj):
-            return repr(obj)              # 'nan' | 'inf' | '-inf'
-        if isinstance(obj, dict):
-            return {k: HealthMonitor._json_safe(v) for k, v in obj.items()}
-        if isinstance(obj, (list, tuple)):
-            return [HealthMonitor._json_safe(v) for v in obj]
-        return obj
+    # alias kept for existing callers; implementation is the module-level
+    # json_safe (shared with the serving circuit breaker's dump)
+    _json_safe = staticmethod(json_safe)
 
     def forensic_dump(self, dirpath, reason, last_good_tag=None):
         """Write the forensic JSON (ring-buffer history + counters + policy)
@@ -403,19 +447,9 @@ class HealthMonitor:
             "history": list(self.history),
         }
         step = self.last_step if self.last_step is not None else 0
-        path = os.path.join(dirpath, f"health_forensics_step{step}.json")
-        try:
-            os.makedirs(dirpath, exist_ok=True)
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                # the forensic ARTIFACT itself; its existence is announced
-                # on the monitor bus as an `artifact` event below
-                json.dump(self._json_safe(payload),  # dstpu: disable=DSTPU104
-                          f, indent=2, allow_nan=False)
-            os.replace(tmp, path)
-        except OSError as e:
-            logger.warning(f"health: could not write forensic dump to "
-                           f"{path}: {e}")
+        path = write_forensics(dirpath, f"health_forensics_step{step}.json",
+                               payload)
+        if path is None:
             return None
         logger.warning("health forensics written: " + json.dumps({
             "event": "health_forensics_written", "path": path,

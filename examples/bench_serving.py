@@ -7,7 +7,11 @@ recorded so CPU functional runs cannot be mistaken for TPU numbers.
 
 Run:  python examples/bench_serving.py [--preset gpt2-125m] [--streams 8]
       [--slots 8] [--prompt 64] [--new 64] [--block 32] [--kv-bits 16]
-      [--int8]
+      [--int8] [--chaos] [--io-delay-ms 2.0]
+
+``--chaos`` runs the resilience twin instead (docs/serving.md#resilience):
+armed fault injection — io delay on the journal path + one logit_nan-
+poisoned request — reporting p50/p99 with typed shed/poisoned counts.
 """
 
 import argparse
@@ -29,15 +33,28 @@ def main():
     ap.add_argument("--kv-bits", type=int, default=16, choices=[8, 16])
     ap.add_argument("--int8", action="store_true",
                     help="int8 weights (quantize_param_tree)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="armed-fault resilience twin (journal io delay + "
+                         "one poisoned request; docs/serving.md#resilience)")
+    ap.add_argument("--io-delay-ms", type=float, default=2.0,
+                    help="with --chaos: injected delay per journal append")
     args = ap.parse_args()
 
     import jax
-    from bench import measure_serving
+    from bench import measure_serving, measure_serving_chaos
 
-    rec = measure_serving(
-        args.preset, streams=args.streams, batch_slots=args.slots,
-        prompt_len=args.prompt, new_tokens=args.new, block_size=args.block,
-        kv_bits=args.kv_bits, int8_weights=args.int8)
+    if args.chaos:
+        rec = measure_serving_chaos(
+            args.preset, streams=args.streams, batch_slots=args.slots,
+            prompt_len=args.prompt, new_tokens=args.new,
+            block_size=args.block, kv_bits=args.kv_bits,
+            int8_weights=args.int8, io_delay_ms=args.io_delay_ms)
+    else:
+        rec = measure_serving(
+            args.preset, streams=args.streams, batch_slots=args.slots,
+            prompt_len=args.prompt, new_tokens=args.new,
+            block_size=args.block,
+            kv_bits=args.kv_bits, int8_weights=args.int8)
     rec["preset"] = args.preset
     rec["backend"] = jax.default_backend()
     rec["device_kind"] = jax.devices()[0].device_kind

@@ -31,6 +31,11 @@ def _match(hf_model, ids, policy, rtol=2e-2, atol=2e-2, **fwd):
     return model, params, np.asarray(ref)
 
 
+@pytest.mark.slow   # heaviest single test of the fast tier (~36s: HF torch
+                    # model build + full logit match); the injection
+                    # mechanism keeps fast twins (bert/gptneo/gptj/gptneox
+                    # logit matches + the training roundtrip) — conftest
+                    # budget policy
 def test_gpt2_policy_logit_match():
     cfg = transformers.GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
                                   n_layer=2, n_head=4, embd_pdrop=0.0,

@@ -89,7 +89,11 @@ def test_top1_no_drop_tokens():
     assert int(dm.astype(jnp.int32).sum()) == S
 
 
-@pytest.mark.parametrize("k", [1, 2])
+@pytest.mark.parametrize("k", [
+    # the top-1 variant (the heavier compile per the durations report)
+    # rides the slow tier (conftest budget policy); k=2 keeps the
+    # scatter==einsum property fast
+    pytest.param(1, marks=pytest.mark.slow), 2])
 def test_scatter_dispatch_matches_einsum(k):
     """The O(S·M) scatter dispatch computes EXACTLY what the GShard one-hot
     einsum computes — outputs and gradients — including capacity drops
@@ -292,7 +296,10 @@ def test_moe_expert_parallel_matches_single(devices):
 
 
 # ------------------------------------------------------------------------ e2e
-@pytest.mark.parametrize("use_residual", [False, True])
+@pytest.mark.parametrize("use_residual", [
+    # residual-MoE e2e rides the slow tier (conftest budget policy);
+    # residual-mode semantics keep test_moe_residual_mode fast
+    False, pytest.param(True, marks=pytest.mark.slow)])
 def test_moe_e2e_training(devices, use_residual):
     """Train SimpleMoEModel on a data×expert mesh; loss must decrease
     (reference ``test_moe.py`` pattern)."""
@@ -400,6 +407,9 @@ def test_gpt_moe_16e_ep8_converges(devices):
     assert all(np.isfinite(l) for l in losses)
 
 
+@pytest.mark.slow   # compile-heavy 16e/ep8 build (conftest budget policy);
+                    # dispatch math keeps scatter_dispatch_matches_einsum
+                    # + the wire parity tests in the fast tier
 def test_moe_16e_ep8_dispatch_matches_single(devices):
     """16-expert MoE layer on an expert=8 mesh computes the SAME output as
     unsharded — EP with experts-per-rank > 1 is a pure layout change."""
@@ -608,6 +618,10 @@ def test_moe_wire_capacity_overflow(devices):
     assert np.max(np.abs(out_q - out_f)) <= 4 * amax / 254 + 1e-5
 
 
+@pytest.mark.slow   # two engine builds x 8 steps (conftest budget policy);
+                    # the wire numerics keep fast twins (moe_wire_matches_
+                    # fullwidth, STE/zero-token/capacity) and the engine
+                    # integration keeps the census test fast
 def test_moe_wire_engine_loss_tracks_full(devices):
     """EP loss tracking, compressed vs full width, >=8 steps on a
     data×expert mesh through the ENGINE (the moe route of

@@ -530,6 +530,23 @@ def test_ds_top_renders_stream(tmp_path, capsys):
     assert "dispatch 15.0" in out         # span breakdown in ms
 
 
+def test_ds_top_renders_serving_resilience_line(tmp_path, capsys):
+    """A serving stream's resilience counters (docs/serving.md#resilience)
+    render as the dedicated serving line; a training stream shows none."""
+    from deepspeed_tpu.monitor.__main__ import main as ds_top
+    bus = MonitorBus([JSONLSink(str(tmp_path / EVENTS_FILE))])
+    bus.step("serving_step", 9, active_slots=3, queued=7)
+    bus.counter("shed_total", 4, step=9)
+    bus.counter("poisoned_total", 1, step=9)
+    bus.counter("breaker_open", 1, step=9)
+    bus.flush()
+    assert ds_top([str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "serving: active 3" in out and "queued 7" in out
+    assert "shed 4" in out and "poisoned 1" in out
+    assert "breaker OPEN" in out
+
+
 def test_ds_top_follower_incremental(tmp_path):
     from deepspeed_tpu.monitor.__main__ import StreamFollower
     path = tmp_path / EVENTS_FILE

@@ -82,6 +82,10 @@ def test_stream_with_dropout_rng_parity(devices):
     np.testing.assert_allclose(ref, got, rtol=3e-4)
 
 
+@pytest.mark.slow   # compile-heavy twin engine run (conftest budget policy);
+                    # NVMe-tier mechanics keep the prefetch/race tests fast
+                    # and the loss-match family already lives in the slow
+                    # tier beside it
 def test_stream_nvme_param_tier_matches_cpu(tmp_path, devices):
     cpu_cfg = _config(4, offload_param={"device": "cpu"})
     _, ref = _train(cpu_cfg)

@@ -6,6 +6,8 @@ every serving answer must match token-for-token under greedy decoding)
 and the model's contiguous cached decode (logit-level equivalence for
 the paged cache)."""
 
+import time
+
 import numpy as np
 import pytest
 import jax
@@ -13,7 +15,10 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
 from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
-                                     ServingConfig, Request)
+                                     ServingConfig, Request,
+                                     ServingError, QueueFullError,
+                                     ServingStalledError,
+                                     OK, SHED, DEADLINE)
 from deepspeed_tpu.inference import paged_kv as pk
 
 
@@ -321,6 +326,144 @@ def test_serving_int8_weights_runs(tiny, devices):
                                        max_new_tokens=4))
         assert got == out[0, len(r.tokens):].tolist()
     srv.close()
+
+
+# ------------------------------------------------------ serving resilience
+# (overload policy, deadlines, typed errors, drain — docs/serving.md;
+#  the chaos/fault-injection half lives in tests/test_serving_resilience.py)
+
+def test_queue_full_is_typed(tiny, devices):
+    """submit()'s backpressure raises QueueFullError (a RuntimeError
+    subclass — callers can distinguish load shedding from a malformed
+    request, which stays ValueError)."""
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8,
+                                             max_queue=1))
+    srv.submit(Request(tokens=np.arange(4), max_new_tokens=1))
+    with pytest.raises(QueueFullError, match="overload=reject"):
+        srv.submit(Request(tokens=np.arange(4), max_new_tokens=1))
+    assert issubclass(QueueFullError, RuntimeError)  # backcompat contract
+    srv.close()
+
+
+def test_overload_shed_oldest_hysteresis(tiny, devices):
+    """At the high watermark, shed_oldest sheds queue-HEAD requests down
+    past the low watermark (one burst, hysteresis) with typed SHED
+    results; everything admitted completes."""
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=2, block_size=8,
+                                             max_new_tokens=3,
+                                             overload="shed_oldest",
+                                             queue_high_watermark=3,
+                                             queue_low_watermark=2))
+    reqs = [Request(tokens=np.arange(5), seed=i, uid=i) for i in range(5)]
+    for r in reqs[:3]:
+        srv.submit(r)                   # queue: 0,1,2 (at the watermark)
+    srv.submit(reqs[3])                 # sheds uids 0,1; queues 3
+    assert [r.uid for r in srv.queue] == [2, 3]
+    res = srv.run([reqs[4]])
+    st = srv.stats()
+    assert st["outcomes"][SHED] == 2 and st["outcomes"][OK] == 3
+    for uid in (0, 1):
+        assert res[uid]["outcome"] == SHED and res[uid]["tokens"] is None
+    for uid in (2, 3, 4):
+        assert res[uid]["outcome"] == OK and len(res[uid]["tokens"]) == 3
+    srv.close()
+
+
+def test_stalled_scheduler_raises_with_block_math(tiny, devices):
+    """The run() livelock class: queue non-empty, zero active slots,
+    admission made no progress (here: leaked blocks) — the scheduler must
+    raise ServingStalledError carrying the head's block math instead of
+    spinning step() hot forever."""
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8,
+                                             num_blocks=4))
+    leaked = srv.allocator.alloc(3)     # simulate a block leak
+    assert leaked is not None
+    srv.submit(Request(tokens=np.arange(4), max_new_tokens=2))
+    with pytest.raises(ServingStalledError, match=r"needs 1 block.*0 free"):
+        srv.run(max_steps=10)
+    srv.close()
+
+
+def test_run_overrun_is_typed(tiny, devices):
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8,
+                                             max_new_tokens=4))
+    with pytest.raises(ServingStalledError, match="exceeded 1 steps"):
+        srv.run([Request(tokens=np.arange(4), seed=0),
+                 Request(tokens=np.arange(4), seed=1)], max_steps=1)
+    srv.close()
+
+
+def test_deadline_enforced_at_admit_and_mid_decode(tiny, devices):
+    """Both halves of deadline enforcement, one engine.
+
+    Admit half: an expired head, and a head whose remaining budget
+    provably cannot cover max_new tokens at the measured step EMA, shed
+    with typed DEADLINE results WITHOUT occupying a slot.  Per-step
+    half: an ACTIVE slot past its deadline is evicted with its partial
+    tokens, freeing the slot + blocks for work that can still meet its
+    budget."""
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8))
+    u_expired = srv.submit(Request(tokens=np.arange(4), max_new_tokens=2,
+                                   deadline_ms=0.0))
+    u_slow = srv.submit(Request(tokens=np.arange(4), max_new_tokens=8,
+                                deadline_ms=50.0))
+    u_ok = srv.submit(Request(tokens=np.arange(4), max_new_tokens=1))
+    time.sleep(0.001)                   # the 0ms deadline is now past
+    srv._step_ema_s = 1.0               # white-box: 1 s/token measured
+    srv.step()          # admit: sheds both, u_ok completes at prefill
+    res = srv.results
+    assert res[u_expired]["outcome"] == DEADLINE
+    assert res[u_slow]["outcome"] == DEADLINE   # 8 tok · 1 s >> 50 ms
+    assert res[u_ok]["outcome"] == OK           # no-deadline head served
+    assert srv.stats()["outcomes"][DEADLINE] == 2
+
+    # per-step half: seat a no-deadline request, then force expiry
+    uid = srv.submit(Request(tokens=np.arange(4), max_new_tokens=8,
+                             seed=0))
+    srv.step()                          # admit + first decode step
+    assert srv._slots[0] is not None
+    srv.results[uid]["deadline"] = time.monotonic() - 1.0  # force expiry
+    srv.step()
+    rec = srv.results[uid]
+    assert rec["outcome"] == DEADLINE
+    assert 2 <= len(rec["tokens"]) < 8           # partial output kept
+    assert srv.allocator.free_blocks == srv.num_blocks - 1
+    st = srv.stats()
+    assert st["outcomes"][DEADLINE] == 3 and "latency_ms" in st
+    srv.close()
+
+
+def test_drain_finishes_active_stops_admission(tiny, devices):
+    """drain(): active slots run to completion, and admission is
+    refused afterwards; WITHOUT a journal the queued leftover gets a
+    typed SHED result (no restart will ever serve it — an eternally
+    in-flight record would be a lie); close() is idempotent on top."""
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8,
+                                             max_new_tokens=3))
+    u_active = srv.submit(Request(tokens=np.arange(4), seed=0))
+    u_queued = srv.submit(Request(tokens=np.arange(4), seed=1))
+    srv.step()                          # seats u_active only (1 slot)
+    summary = srv.drain(timeout_s=60)
+    assert summary == {"clean": True, "active": 0, "queued": 1}
+    assert srv.results[u_active]["outcome"] == OK
+    assert srv.results[u_queued]["outcome"] == SHED     # typed, poppable
+    assert srv.pop_result(u_queued)["tokens"] is None
+    with pytest.raises(ServingError, match="draining"):
+        srv.submit(Request(tokens=np.arange(4), seed=2))
+    srv.close()
+    srv.close()                         # idempotent
 
 
 def test_capacity_report(tiny, devices):
