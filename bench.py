@@ -428,12 +428,19 @@ def measure_serving(preset="gpt2-125m", *, streams=8, batch_slots=8,
             "tokens_per_sec": round(gen / dt, 1),
             "p50_ms": st["latency_ms"]["p50"],
             "p99_ms": st["latency_ms"]["p99"],
+            "p999_ms": st["latency_ms"]["p999"],
             "ttft_p50_ms": st["ttft_ms"]["p50"],
             "decode_steps": st["decode_steps"],
             "capacity": {k: cap[k] for k in
                          ("num_blocks", "capacity_tokens", "pool_bytes")},
             "preflight": srv.preflight_memory(),
         }
+        # roofline attribution of the live decode executable (ds_explain
+        # without the stream round-trip; analysis/roofline.py) — on CPU
+        # the chip row is the NOMINAL v5e reference, honestly flagged
+        roof = srv.roofline_report()
+        if roof is not None:
+            rec["roofline"] = roof
         cache = _cache_stats(eng)
         if cache is not None:
             rec["cache"] = cache
@@ -533,6 +540,87 @@ def measure_serving_chaos(preset="gpt2-125m", *, streams=8, batch_slots=8,
             finally:
                 if journal_dir is not None:
                     shutil.rmtree(journal_dir, ignore_errors=True)
+
+
+def measure_serving_tracing(preset="gpt2-125m", *, streams=8,
+                            batch_slots=8, prompt_len=64, new_tokens=64,
+                            block_size=32, cache_dir=None):
+    """Armed-tracing twin of :func:`measure_serving`
+    (docs/monitoring.md#request-tracing): the SAME rung run twice, BOTH
+    with a live monitor — ``trace_sample_rate`` 0.0 vs 1.0 — so the
+    reported overhead isolates the TRACING term (the monitor's own cost
+    is priced separately by the armed-monitor training rung,
+    ``extra.monitor``).  The jaxpr-equality test + ``--audit-step
+    tracing`` prove the compiled step is byte-identical; this rung
+    prices the host-side cost (the <3% acceptance bound)."""
+    import shutil
+    import tempfile
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import build
+    from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
+                                         ServingConfig, Request)
+    from deepspeed_tpu.monitor import Monitor
+    from deepspeed_tpu.monitor.trace_export import chrome_trace
+    from deepspeed_tpu.monitor.__main__ import StreamFollower, \
+        resolve_stream
+
+    model = build(preset, dtype=jnp.bfloat16,
+                  max_seq=prompt_len + new_tokens,
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+
+    def one_pass(trace_on, run_dir):
+        eng = InferenceEngine(model=model, compile_cache=cache_dir)
+        srv = ServingEngine(engine=eng, config=ServingConfig(
+            batch_slots=batch_slots, block_size=block_size,
+            max_new_tokens=new_tokens,
+            trace_sample_rate=1.0 if trace_on else 0.0),
+            monitor=Monitor(run_dir=run_dir, role="serving"))
+        reqs = [Request(tokens=rng.integers(0, V, (prompt_len,)),
+                        max_new_tokens=new_tokens, seed=i)
+                for i in range(streams)]
+        try:
+            srv.run([Request(tokens=rng.integers(0, V, (prompt_len,)),
+                             max_new_tokens=2, seed=10 ** 6)])
+            srv.reset_stats()
+            t0 = time.time()
+            srv.run(reqs)
+            dt = time.time() - t0
+            gen = sum(len(srv.results[r.uid]["tokens"]) for r in reqs)
+            traces = srv.stats()["traces_emitted"]
+        finally:
+            srv.close()
+            eng.close()
+        return gen / dt, traces
+
+    base_dir = tempfile.mkdtemp(prefix="serving-tracing-base-")
+    run_dir = tempfile.mkdtemp(prefix="serving-tracing-bench-")
+    try:
+        tps_off, _ = one_pass(False, base_dir)
+        tps_on, traces = one_pass(True, run_dir)
+        doc = chrome_trace(
+            StreamFollower(resolve_stream(run_dir)).poll())
+        return {
+            "streams": streams,
+            "batch_slots": batch_slots,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "trace_sample_rate": 1.0,
+            "tokens_per_sec_off": round(tps_off, 1),
+            "tokens_per_sec_on": round(tps_on, 1),
+            "overhead_pct": round(100.0 * (tps_off - tps_on) / tps_off, 2),
+            # measured-window traces only; the export covers the WHOLE
+            # stream, so its request count also includes the warmup
+            # request (reported separately — the two must not be
+            # cross-checked as equal)
+            "traces_emitted": traces,
+            "chrome_trace_requests": doc["otherData"]["requests"],
+            "chrome_trace_events": len(doc["traceEvents"]),
+        }
+    finally:
+        shutil.rmtree(base_dir, ignore_errors=True)
+        shutil.rmtree(run_dir, ignore_errors=True)
 
 
 class _WireProbeMLP:
@@ -967,6 +1055,19 @@ def main():
     else:
         extra["serving_125m_b8_chaos"] = {"skipped": "time budget"}
 
+    # armed-tracing twin: the serving rung with trace_sample_rate=1.0 +
+    # a live monitor — tokens/s overhead of full request tracing
+    # (<3% acceptance; docs/monitoring.md#request-tracing)
+    if left() > 8 * 60:
+        try:
+            extra["serving_125m_b8_tracing"] = measure_serving_tracing(
+                "gpt2-125m", streams=8, batch_slots=8, prompt_len=64,
+                new_tokens=64, cache_dir=cache_dir)
+        except Exception as e:
+            extra["serving_125m_b8_tracing"] = {"error": str(e)[:160]}
+    else:
+        extra["serving_125m_b8_tracing"] = {"skipped": "time budget"}
+
     # 760M remat: the largest on-chip model (Adam states + remat'd
     # activations fill the 16GB HBM) — the VERDICT r2 MFU target (>=0.45)
     if left() > 4 * 60:
@@ -1116,6 +1217,17 @@ def main():
             "tok_s": serving["tokens_per_sec"],
             "p50_ms": serving["p50_ms"], "p99_ms": serving["p99_ms"],
             "streams": serving["streams"]}
+        roof = serving.get("roofline") or {}
+        if "bound" in roof:
+            headline["extra"]["roofline"] = {
+                "bound": roof["bound"],
+                "achieved_frac": roof["achieved_frac"],
+                "gap_host_pct": roof["gap"]["host_pct"]}
+    tracing = extra.get("serving_125m_b8_tracing") or {}
+    if "overhead_pct" in tracing:
+        headline["extra"]["tracing"] = {
+            "overhead_pct": tracing["overhead_pct"],
+            "traces": tracing["traces_emitted"]}
     chaos = extra.get("serving_125m_b8_chaos") or {}
     if "tokens_per_sec" in chaos:
         headline["extra"]["serving_chaos"] = {

@@ -71,6 +71,9 @@ def _audit_builtin_steps(stages):
             if str(spec) == "serving-resilience":
                 findings.extend(_audit_serving_resilience())
                 continue
+            if str(spec) == "tracing":
+                findings.extend(_audit_tracing())
+                continue
             if str(spec) == "elastic":
                 findings.extend(_audit_elastic_resume())
                 continue
@@ -285,6 +288,104 @@ def _audit_serving_resilience():
         armed.close()
     finally:
         fault.reset()
+    return findings
+
+
+def _audit_tracing():
+    """--audit-step tracing: request-scoped tracing armed at
+    ``trace_sample_rate=1.0`` (docs/monitoring.md#request-tracing) must
+    leave the serving decode step byte-identical — tracing is host-side
+    bookkeeping, never program content.  Gates: armed-vs-disarmed jaxpr
+    equality, zero host callbacks (DSTPU201) and pool donation honored
+    (DSTPU204) on the armed step, and the armed run must emit parseable
+    ``trace`` events with monotone non-overlapping spans."""
+    import shutil
+    import tempfile
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from .findings import Finding
+    from .jaxpr_audit import audit_fn
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
+                                         Request)
+    from deepspeed_tpu.monitor import Monitor, parse_line
+    from deepspeed_tpu.monitor.sinks import EVENTS_FILE
+
+    cfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0))
+    scfg = dict(batch_slots=2, block_size=8, max_new_tokens=4,
+                preflight=False)
+    findings = []
+
+    def jaxpr_text(srv):
+        srv._build_decode()
+        return str(jax.make_jaxpr(srv._decode)(*srv._decode_args()))
+
+    clean = ServingEngine(model=model, params=params,
+                          config=ServingConfig(**scfg))
+    clean_jaxpr = jaxpr_text(clean)
+    clean.close()
+
+    run_dir = tempfile.mkdtemp(prefix="dstpu-audit-tracing-")
+    try:
+        armed = ServingEngine(
+            model=model, params=params,
+            monitor=Monitor(run_dir=run_dir, role="serving"),
+            config=ServingConfig(trace_sample_rate=1.0, **scfg))
+        if jaxpr_text(armed) != clean_jaxpr:
+            findings.append(Finding(
+                "DSTPU201", "error",
+                "--audit-step tracing: arming trace_sample_rate=1.0 "
+                "CHANGED the traced decode step (jaxpr armed != "
+                "disarmed) — tracing must stay host-side bookkeeping",
+                eqn_path="tracing/jaxpr-equality"))
+        armed.run([Request(tokens=np.arange(5), max_new_tokens=3),
+                   Request(tokens=np.arange(6), max_new_tokens=2)])
+        report = audit_fn(armed._decode, *armed._decode_args(),
+                          donate_argnums=(1,), mesh=armed.engine.mesh)
+        for f in report.findings:
+            f.extra = dict(f.extra, audit="tracing")
+        findings.extend(report.findings)
+        armed.close()
+        traces = []
+        stream_ok = True
+        try:
+            with open(os.path.join(run_dir, EVENTS_FILE)) as fh:
+                for line in fh:
+                    if line.strip():
+                        e = parse_line(line)
+                        if e.kind == "trace":
+                            traces.append(e)
+        except (OSError, ValueError) as e:
+            stream_ok = False
+            findings.append(Finding(
+                "DSTPU104", "error",
+                f"--audit-step tracing: armed event stream did not "
+                f"parse ({e})", eqn_path="tracing/stream"))
+        if stream_ok and not traces:
+            findings.append(Finding(
+                "DSTPU104", "error",
+                "--audit-step tracing: the armed run emitted no `trace` "
+                "events at trace_sample_rate=1.0",
+                eqn_path="tracing/stream"))
+        for e in traces:
+            prev = 0.0
+            for s in e.fields.get("spans") or ():
+                if s["start_ms"] < prev - 1e-6:
+                    findings.append(Finding(
+                        "DSTPU104", "error",
+                        f"--audit-step tracing: request "
+                        f"{e.fields.get('uid')} spans overlap/regress "
+                        f"({s['name']} starts {s['start_ms']}ms before "
+                        f"the previous span ended at {prev}ms)",
+                        eqn_path="tracing/spans"))
+                prev = max(prev, s["start_ms"] + s["dur_ms"])
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
     return findings
 
 
@@ -564,7 +665,13 @@ def main(argv=None):
                          "tight budget); 'monitor' proves an ARMED "
                          "telemetry monitor leaves the compiled step "
                          "byte-identical and host-callback-free while "
-                         "its JSONL stream parses (docs/monitoring.md)")
+                         "its JSONL stream parses (docs/monitoring.md); "
+                         "'tracing' proves request-scoped tracing at "
+                         "trace_sample_rate=1.0 leaves the serving "
+                         "decode step jaxpr-identical (zero host "
+                         "callbacks, donation honored) while emitting "
+                         "parseable trace events with monotone spans "
+                         "(docs/monitoring.md#request-tracing)")
     args = ap.parse_args(argv)
 
     # findings are the stdout payload (the tier-1 gate parses --json);

@@ -1,0 +1,189 @@
+"""``ds_bench_diff``: the perf-regression gate over bench artifacts.
+
+Compares two bench JSON documents — live ``bench.py`` headlines
+(``parse_headline_tail`` output), committed ``BENCH_*.json`` /
+``SERVING_BENCH.json`` / ``INFERENCE_BENCH.json`` artifacts, or any mix
+— metric by metric, with per-metric **noise bands**, and exits non-zero
+on a regression beyond the band.  This is the gate the bench trajectory
+lacked: the artifacts were compared by eye across PRs.
+
+Metric classification (by key name, innermost key of the JSON path):
+
+- **higher-better** (throughput family): ``tokens_per_sec``, ``tok_s``,
+  ``mfu`` (and ``projected_mfu*``), ``samples_per_sec``,
+  ``fraction_of_bound``, ``achieved_frac``, ``reduction_x``,
+  ``bound_tokens_per_sec``, ``decode_tokens_per_sec``;
+- **lower-better** (latency/cost family): keys ending in ``_ms``/``_s``
+  (``p50_ms``, ``p99_ms``, ``ttft_*``, ``prefill_ms``, compile times),
+  ``ms_per_token*``, ``*_bytes``/``*_bytes_per_step`` (wire/pool cost),
+  ``host_pct``/``overhead_pct``;
+- everything else numeric is **informational** — reported when it moved,
+  never gated (counts, shapes, config echoes).
+
+Band defaults (docs/monitoring.md#ds_bench_diff): ``--band 0.2`` —
+±20%, this container's measured fast-tier run-to-run swing (CHANGES.md
+PR-6/PR-9 notes); TPU runs are steadier, ``--band 0.05`` is apt there.
+Per-metric overrides: ``--band-for p99_ms=0.5`` (tail latencies are
+noisier than medians).  A metric present on one side only is reported
+as added/removed, never gated; so is one whose baseline is zero (a
+relative band cannot price an infinite delta).
+
+Exit codes: 0 = no regression beyond band, 1 = regression(s), 2 = usage.
+"""
+
+import argparse
+import json
+import sys
+
+DEFAULT_BAND = 0.2         # ±20%: this container's measured CPU-tier noise
+
+HIGHER_BETTER = ("tokens_per_sec", "tok_s", "samples_per_sec", "mfu",
+                 "fraction_of_bound", "achieved_frac", "reduction_x",
+                 "bound_tokens_per_sec", "decode_tokens_per_sec")
+LOWER_BETTER_SUFFIX = ("_ms", "_s")
+LOWER_BETTER = ("ms_per_token", "overhead_pct", "host_pct")
+LOWER_BETTER_BYTES = ("wire_bytes", "bytes_per_step")
+
+
+def classify(key: str):
+    """'higher' | 'lower' | None (informational) for one metric key."""
+    k = key.lower()
+    for name in HIGHER_BETTER:
+        if name in k:
+            return "higher"
+    for name in LOWER_BETTER + LOWER_BETTER_BYTES:
+        if name in k:
+            return "lower"
+    if k.endswith(LOWER_BETTER_SUFFIX):
+        return "lower"
+    return None
+
+
+def _numeric_leaves(doc, prefix=""):
+    """Flatten a bench JSON into {path: float} over its numeric leaves
+    (bools excluded — `breaker_open: false` is a flag, not a metric)."""
+    out = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            out.update(_numeric_leaves(v, f"{prefix}.{k}" if prefix else k))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        out[prefix] = float(doc)
+    return out
+
+
+def compare(base: dict, new: dict, band: float = DEFAULT_BAND,
+            bands: dict = None) -> dict:
+    """Per-metric comparison.  Returns ``{"rows": [...], "regressions":
+    [...], "added": [...], "removed": [...]}`` — a row per shared
+    numeric leaf that moved, each with the applied band and verdict."""
+    bands = bands or {}
+    a, b = _numeric_leaves(base), _numeric_leaves(new)
+    rows, regressions = [], []
+    for path in sorted(set(a) & set(b)):
+        key = path.rsplit(".", 1)[-1]
+        direction = classify(key)
+        va, vb = a[path], b[path]
+        if va == vb:
+            continue
+        if not va:
+            # zero baseline: no relative band can gate this (delta is
+            # infinite for ANY change) — report, never regress.  A
+            # rounded-to-0.0 gap_host_pct moving to 0.3 is noise, not
+            # a perf cliff; absolute gating needs a real baseline.
+            direction = None
+        delta = (vb - va) / abs(va) if va else float("inf")
+        this_band = bands.get(key, bands.get(path, band))
+        verdict = "info"
+        if direction is not None and abs(delta) > this_band:
+            bad = (delta < 0) if direction == "higher" else (delta > 0)
+            verdict = "REGRESSION" if bad else "improved"
+        row = {"path": path, "base": va, "new": vb,
+               "delta_pct": round(100.0 * delta, 2),
+               "direction": direction, "band_pct": round(100 * this_band, 1),
+               "verdict": verdict}
+        rows.append(row)
+        if verdict == "REGRESSION":
+            regressions.append(row)
+    return {"rows": rows, "regressions": regressions,
+            "added": sorted(set(b) - set(a)),
+            "removed": sorted(set(a) - set(b))}
+
+
+def _load(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        # a bench stdout capture: the headline is the strict final line
+        for line in reversed(text.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        raise
+
+
+def render(result: dict, base_path: str, new_path: str) -> str:
+    lines = [f"ds_bench_diff: {base_path} -> {new_path}"]
+    shown = [r for r in result["rows"] if r["verdict"] != "info"] or \
+        result["rows"][:20]
+    for r in shown:
+        arrow = {"higher": "↑ better", "lower": "↓ better",
+                 None: ""}[r["direction"]]
+        lines.append(
+            f"  [{r['verdict']:>10}] {r['path']}: {r['base']:g} -> "
+            f"{r['new']:g} ({r['delta_pct']:+.1f}%, band "
+            f"±{r['band_pct']:.0f}%) {arrow}")
+    if result["added"]:
+        lines.append(f"  added: {len(result['added'])} metric(s) "
+                     f"(e.g. {result['added'][0]})")
+    if result["removed"]:
+        lines.append(f"  removed: {len(result['removed'])} metric(s) "
+                     f"(e.g. {result['removed'][0]})")
+    n = len(result["regressions"])
+    lines.append(f"verdict: {n} regression(s) beyond the noise band"
+                 if n else "verdict: no regression beyond the noise band")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="ds_bench_diff",
+        description="compare two bench JSONs with per-metric noise "
+                    "bands; exit 1 on regression beyond the band "
+                    "(docs/monitoring.md#ds_bench_diff)")
+    ap.add_argument("base", help="baseline JSON (headline or committed "
+                                 "BENCH_*.json artifact)")
+    ap.add_argument("new", help="candidate JSON")
+    ap.add_argument("--band", type=float, default=DEFAULT_BAND,
+                    help=f"relative noise band (default {DEFAULT_BAND} "
+                         "= ±20%%, the measured CPU-tier swing)")
+    ap.add_argument("--band-for", action="append", default=[],
+                    metavar="METRIC=BAND",
+                    help="per-metric override, e.g. p99_ms=0.5 "
+                         "(repeatable; matches the key or the full path)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as JSON")
+    args = ap.parse_args(argv)
+
+    bands = {}
+    for spec in args.band_for:
+        if "=" not in spec:
+            ap.error(f"--band-for wants METRIC=BAND, got {spec!r}")
+        key, val = spec.rsplit("=", 1)
+        bands[key] = float(val)
+    try:
+        base, new = _load(args.base), _load(args.new)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"ds_bench_diff: cannot load inputs: {e}", file=sys.stderr)
+        return 2
+    result = compare(base, new, band=args.band, bands=bands)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(render(result, args.base, args.new))
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,9 +25,11 @@ MII/externals.  This module is that missing layer, built TPU-first:
   so a mis-sized pool refuses to start instead of dying
   RESOURCE_EXHAUSTED mid-traffic;
 - **latency accounting** — per-request submit→first-token and
-  submit→done stamps, p50/p99 over a bounded window of completions
-  (``stats()``); long-running servers drain finished records with
-  ``pop_result(uid)`` so ``results`` never grows unbounded.
+  submit→done stamps; p50/p99/p999 from mergeable log-bucketed
+  histograms over EVERY completion (``stats()``; exact counts, ≤1%
+  value error, bounded memory — ``monitor/histogram.py``); long-running
+  servers drain finished records with ``pop_result(uid)`` so
+  ``results`` never grows unbounded.
 
 Resilience (docs/serving.md#resilience — the serving twin of the
 training fault ladder, PR 1/3/7 composed):
@@ -77,6 +79,7 @@ import jax.numpy as jnp
 
 from . import paged_kv as pk
 from .. import fault
+from ..monitor.histogram import LogHistogram
 from ..monitor.ring import RingBuffer
 from ..runtime.health import rows_nonfinite, write_forensics
 from ..utils.logging import logger, log_dist
@@ -151,6 +154,13 @@ class ServingConfig:
     journal_dir: Optional[str] = None     # None = journaling off
     forensic_dir: Optional[str] = None    # None → journal_dir or cwd
     drain_timeout_s: float = 60.0   # close()'s drain bound
+    # ---- request tracing (docs/monitoring.md#request-tracing) ----
+    # fraction of requests that carry a host-side trace (submit →
+    # queue-wait → prefill → per-decode-step → finish, emitted as a
+    # schema-v2 `trace` event; exportable as Chrome trace-event JSON).
+    # Sampling is a pure function of the uid, so replicas/restarts
+    # sample the same requests.  0.0 = off; needs an armed monitor.
+    trace_sample_rate: float = 0.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServingConfig":
@@ -241,6 +251,9 @@ class ServingEngine:
         assert config.overload in ("reject", "shed_oldest", "block"), \
             f"serving.overload must be reject|shed_oldest|block, " \
             f"got {config.overload!r}"
+        assert 0.0 <= config.trace_sample_rate <= 1.0, \
+            f"serving.trace_sample_rate must be in [0, 1], " \
+            f"got {config.trace_sample_rate!r}"
 
         # quantized-weight routing: the SAME helper InferenceEngine
         # .generate uses (models whose decode consumes int8 leaves
@@ -278,12 +291,16 @@ class ServingEngine:
 
         self.queue: deque = deque()
         # uid → record; completed records stay until the caller
-        # pop_result()s them.  The latency aggregates live in BOUNDED
-        # deques + counters so a long-running server's stats() stays
-        # O(1)-ish even if the caller drains results promptly.
+        # pop_result()s them.  The latency aggregates are mergeable
+        # log-bucketed histograms (monitor/histogram.py): bounded
+        # memory, EXACT counts over the whole run — the bounded deques
+        # they replace silently dropped history under sustained traffic,
+        # so "p99" was really "p99 of the last 4096 completions"
+        # (regression-tested in test_serving.py)
         self.results: Dict[int, dict] = {}
-        self._lat_ms: deque = deque(maxlen=4096)
-        self._ttft_ms: deque = deque(maxlen=4096)
+        self._lat_hist = LogHistogram()
+        self._ttft_hist = LogHistogram()
+        self._step_wall_hist = LogHistogram()   # decode-step wall, ms
         self._completed_total = 0
         self._generated_total = 0
         self._next_uid = 0
@@ -306,6 +323,13 @@ class ServingEngine:
         # bounded ring of recent terminal outcomes: the poison-rate
         # window AND the breaker's forensic payload (PR-9 RingBuffer)
         self._recent = RingBuffer(max(1, int(config.poison_window)))
+        # ---- request tracing (docs/monitoring.md#request-tracing) ----
+        # host-side only: uid -> open trace record; nothing here touches
+        # the compiled step (--audit-step tracing proves jaxpr equality
+        # armed vs disarmed).  Disarmed = one boolean check per call.
+        self._traces: Dict[int, dict] = {}
+        self._traces_emitted = 0
+        self._exe_cost_emitted = False
         self.journal = None
         if config.journal_dir:
             from . import journal as jr
@@ -588,6 +612,8 @@ class ServingEngine:
                                  "prompt_len": int(toks.size),
                                  "deadline": (now + dl_ms / 1e3
                                               if dl_ms is not None else None)}
+        if self._tracing and self._trace_sampled(req.uid):
+            self._trace_open(req.uid, int(toks.size), now)
         self.queue.append(req)
         return req.uid
 
@@ -602,8 +628,73 @@ class ServingEngine:
         self._outcomes[outcome] += 1
         self._recent.append({"uid": req.uid, "outcome": outcome,
                              "why": why, "t": time.time()})
+        self._trace_finish(req.uid, outcome)
         if self.journal is not None:
             self.journal.finish(req.uid, outcome, None)
+
+    # ------------------------------------------------------- request tracing
+    # Host-side only (docs/monitoring.md#request-tracing): every sampled
+    # request accumulates spans relative to its submit instant — queue
+    # wait, prefill, one span per decode step — and emits ONE schema-v2
+    # `trace` event at its terminal outcome.  Nothing here is visible to
+    # jit: the compiled decode step is byte-identical armed vs disarmed
+    # (--audit-step tracing), and a disarmed engine pays one boolean
+    # check per call site.
+
+    @property
+    def _tracing(self) -> bool:
+        return self.config.trace_sample_rate > 0.0 and self.monitor.armed
+
+    def _trace_sampled(self, uid: int) -> bool:
+        """Deterministic sampling: a Knuth multiplicative hash of the
+        uid against the rate — a pure function of the request, so a
+        journal replay (and every replica of an item-3 router) samples
+        the SAME requests, keeping merged trace sets coherent."""
+        if self.config.trace_sample_rate >= 1.0:
+            return True
+        return ((uid * 2654435761) & 0xFFFFFFFF) < (
+            self.config.trace_sample_rate * 4294967296.0)
+
+    def _trace_open(self, uid: int, prompt_len: int, m_now: float):
+        self._traces[uid] = {"uid": uid, "t0_unix": time.time(),
+                             "m0": m_now, "prompt_len": prompt_len,
+                             "spans": []}
+
+    def _trace_span(self, uid: int, name: str, start_m: float,
+                    dur_s: float, step: Optional[int] = None):
+        tr = self._traces.get(uid)
+        if tr is None:
+            return
+        span = {"name": name, "start_ms": (start_m - tr["m0"]) * 1e3,
+                "dur_ms": dur_s * 1e3}
+        if step is not None:
+            span["step"] = step
+        tr["spans"].append(span)
+
+    def _trace_finish(self, uid: int, outcome: str, generated: int = 0):
+        tr = self._traces.pop(uid, None)
+        if tr is None:
+            return
+        m_now = time.monotonic()
+        if not tr["spans"]:
+            # never seated (shed / deadline at admit): its whole life
+            # was queue wait
+            tr["spans"].append({"name": "queue_wait", "start_ms": 0.0,
+                                "dur_ms": (m_now - tr["m0"]) * 1e3})
+        qw = next((s for s in tr["spans"] if s["name"] == "queue_wait"),
+                  None)
+        rec = self.results.get(uid) or {}
+        ttft = None
+        if rec.get("t_first") is not None and rec.get("t_submit") is not None:
+            ttft = (rec["t_first"] - rec["t_submit"]) * 1e3
+        self.monitor.trace(
+            "request", step=self._steps, uid=uid, outcome=outcome,
+            t0_unix=tr["t0_unix"], prompt_len=tr["prompt_len"],
+            generated=generated,
+            queue_wait_ms=(qw["dur_ms"] if qw is not None else None),
+            ttft_ms=ttft, total_ms=(m_now - tr["m0"]) * 1e3,
+            spans=tr["spans"])
+        self._traces_emitted += 1
 
     # ---------------------------------------------------------- jitted steps
     def _decode_args(self):
@@ -760,6 +851,12 @@ class ServingEngine:
 
     def _start(self, slot: int, req: Request, blocks: List[int], new: int):
         fault.site("serving.prefill")
+        tr = self._traces.get(req.uid)
+        m_admit = time.monotonic() if tr is not None else 0.0
+        if tr is not None:
+            # queue wait ends the instant this request is seated
+            self._trace_span(req.uid, "queue_wait", tr["m0"],
+                             m_admit - tr["m0"])
         c = self.config
         T = int(len(req.tokens))
         bucket = pk.blocks_needed(T, c.block_size) * c.block_size
@@ -775,6 +872,11 @@ class ServingEngine:
                     jnp.int32(T), jnp.int32(req.seed),
                     jnp.float32(req.temperature), jnp.asarray(req.do_sample))
         first = int(np.asarray(first))
+        if tr is not None:
+            # the int() above synced the prefill dispatch: this bracket
+            # is a true prefill cost, starting where queue_wait ended
+            self._trace_span(req.uid, "prefill", m_admit,
+                             time.monotonic() - m_admit)
         if bool(np.asarray(bad)):
             # quarantined AT prefill: the slot is never seated, the
             # sentinel token is never surfaced, and the blocks go back
@@ -873,13 +975,15 @@ class ServingEngine:
             self._completed_total += 1
         self._generated_total += len(s.out_tokens)
         if outcome in (OK, DEADLINE):
-            # admitted-request latency window: completions AND
+            # admitted-request latency accounting: completions AND
             # deadline evictions (their latency ≈ the deadline — the
             # bound the overload tests assert); queue sheds never ran
-            self._lat_ms.append((rec["t_done"] - rec["t_submit"]) * 1e3)
+            self._lat_hist.add((rec["t_done"] - rec["t_submit"]) * 1e3)
             if rec["t_first"] is not None:
-                self._ttft_ms.append(
+                self._ttft_hist.add(
                     (rec["t_first"] - rec["t_submit"]) * 1e3)
+        self._trace_finish(s.req.uid, outcome,
+                           generated=len(s.out_tokens))
         if self.journal is not None:
             self.journal.finish(s.req.uid, outcome, rec["tokens"])
         self._slots[slot] = None
@@ -973,6 +1077,7 @@ class ServingEngine:
             return bool(self.queue)
         self._build_decode()
         t0 = time.perf_counter()
+        m_step = time.monotonic()      # decode-step span base (tracing)
         with jax.set_mesh(self.engine.mesh):
             with mon.span("dispatch"):
                 nxt, poisoned, self.pool = self._decode(*self._decode_args())
@@ -982,6 +1087,7 @@ class ServingEngine:
             # the value read above synced the dispatch: this wall time is
             # a true decode-step cost, the predictive-deadline EMA's input
             dt = time.perf_counter() - t0
+            self._step_wall_hist.add(dt * 1e3)
             self._step_last_s = dt
             if self._step_ema_s is None:
                 self._step_ema_s = dt
@@ -997,6 +1103,10 @@ class ServingEngine:
             now = time.monotonic()
             for i in active:
                 s = self._slots[i]
+                if self._traces:
+                    # one span per decode step this request was live in
+                    self._trace_span(s.req.uid, "decode", m_step, dt,
+                                     step=self._steps)
                 if poisoned[i]:
                     # the sentinel token is NOT appended: the request's
                     # record keeps only its pre-poison tokens
@@ -1039,8 +1149,8 @@ class ServingEngine:
             f"{self.num_blocks - 1} allocatable "
             f"({self.allocator.used_blocks} leaked or still held)")
 
-    # decode steps between latency-percentile emissions: stats() sorts two
-    # <=4096-entry windows, which must not run per generated token
+    # decode steps between latency-percentile/hist emissions: quantile
+    # walks are cheap (O(buckets)) but need not run per generated token
     _PERCENTILES_EVERY = 16
 
     def _monitor_finish(self, active_slots):
@@ -1071,11 +1181,98 @@ class ServingEngine:
             if "latency_ms" in st:
                 gauges["latency_p50_ms"] = st["latency_ms"]["p50"]
                 gauges["latency_p99_ms"] = st["latency_ms"]["p99"]
+                gauges["latency_p999_ms"] = st["latency_ms"]["p999"]
             if "ttft_ms" in st:
                 gauges["ttft_p50_ms"] = st["ttft_ms"]["p50"]
+            # the distributions themselves ride the bus as mergeable
+            # schema-v2 hist events: replicas/restarts (and the item-3
+            # router) aggregate them exactly (docs/monitoring.md)
+            for hname, h in (("latency_ms", self._lat_hist),
+                             ("ttft_ms", self._ttft_hist),
+                             ("step_wall_ms", self._step_wall_hist)):
+                if h:
+                    mon.hist(hname, h, step=self._steps, unit="ms")
+        self._emit_exe_cost(mon)
         mon.set_rates(tokens_per_step=active_slots)
         mon.end_step(self._steps, scalars=scalars, gauges=gauges,
                      counters=counters, name="serving_step")
+
+    # --------------------------------------------------- roofline attribution
+    def _exe_cost_fields(self) -> Optional[dict]:
+        """Price the LIVE decode executable for roofline attribution
+        (analysis/roofline.py): XLA cost-analysis FLOPs + bytes
+        accessed, the HLO wire census, the chip identity, and the paged
+        path's gather-materialization bytes (modeled from the serving
+        configuration — the exact traffic the ROADMAP-1 in-place kernel
+        deletes).  None until a decode executable is live."""
+        import jax as _jax
+        from ..analysis.roofline import gather_materialization_bytes
+        from ..monitor import gauges as mg
+        if self._decode is None:
+            return None
+        if not getattr(self._decode, "_exes", None):
+            # no live executable recorded (compile cache off -> CachedStep
+            # passthrough): acquire one, once, exactly like the training
+            # engine's pricing path (runtime/engine._monitor_step_stats)
+            try:
+                with jax.set_mesh(self.engine.mesh):
+                    self._decode.executable(*self._decode_args())
+            except Exception as e:
+                logger.warning(f"serving: could not price the decode step "
+                               f"({e}); roofline attribution unavailable")
+                return None
+        flops = mg.executable_flops(self._decode)
+        hbm = mg.executable_bytes_accessed(self._decode)
+        wire = mg.executable_wire_report(self._decode)
+        mc = self.model.config
+        c = self.config
+        gather = gather_materialization_bytes(
+            n_layer=mc.n_layer, batch_slots=c.batch_slots,
+            nb_max=self.nb_max, block_size=c.block_size,
+            n_head=mc.n_head, head_dim=mc.head_dim,
+            itemsize=(1 if c.kv_bits == 8 else jnp.dtype(
+                getattr(self.model, "dtype", jnp.bfloat16)).itemsize))
+        if not (flops or hbm):
+            return None
+        return {"exe": "serving_step", "flops": flops, "hbm_bytes": hbm,
+                "wire_bytes": wire.get("wire_bytes_per_step", 0),
+                "gather_bytes": gather,
+                "tokens_per_step": c.batch_slots,
+                "device_kind": _jax.devices()[0].device_kind,
+                "n_chips": len(_jax.devices())}
+
+    def _emit_exe_cost(self, mon):
+        """One `exe_cost` gauge per serving configuration — the
+        ds_explain feed; priced once, constant per executable.  The
+        attempt latches once a decode executable exists EVEN on a
+        pricing failure (same executable → same outcome): a backend
+        exposing no cost analysis must not re-run the HLO census — or
+        re-try a failing AOT compile — on every monitored step."""
+        if self._exe_cost_emitted or self._decode is None:
+            return
+        self._exe_cost_emitted = True
+        fields = self._exe_cost_fields()
+        if fields is None:
+            return
+        mon.gauge("exe_cost", float(fields["flops"]), step=self._steps,
+                  **fields)
+
+    def roofline_report(self) -> Optional[dict]:
+        """The live engine's own roofline verdict (`ds_explain` without
+        the stream round-trip — bench rungs embed this as
+        ``extra.roofline``): the decode executable's priced costs
+        against the chip table, with the measured step-wall histogram's
+        p50 as the wall term.  None before any measured decode step."""
+        from ..analysis.roofline import attribute
+        fields = self._exe_cost_fields()
+        if fields is None or not self._step_wall_hist:
+            return None
+        return attribute(
+            wall_s=self._step_wall_hist.quantile(0.5) / 1e3,
+            flops=fields["flops"], hbm_bytes=fields["hbm_bytes"],
+            wire_bytes=fields["wire_bytes"],
+            gather_bytes=fields["gather_bytes"],
+            n_chips=fields["n_chips"])
 
     def run(self, requests=None, max_steps: int = 10 ** 6) -> Dict[int, dict]:
         """Submit ``requests`` (if given) and drive :meth:`step` until
@@ -1125,6 +1322,18 @@ class ServingEngine:
                    "restart re-queues them)" if self.journal is not None
                    else "their requests finalize as typed 'shed' "
                         "results (no journal, no restart)"))
+        mon = self.monitor
+        if mon.armed:
+            # final whole-run distributions: a run shorter than the
+            # periodic cadence still leaves mergeable hist events in its
+            # stream (what ds_explain / a restart merge reads)
+            for hname, h in (("latency_ms", self._lat_hist),
+                             ("ttft_ms", self._ttft_hist),
+                             ("step_wall_ms", self._step_wall_hist)):
+                if h:
+                    mon.hist(hname, h, step=self._steps, unit="ms")
+            self._emit_exe_cost(mon)
+            mon.flush()
         if self.journal is not None:
             self.journal.shutdown(clean=not timed_out,
                                   pending=active + len(self.queue))
@@ -1164,20 +1373,24 @@ class ServingEngine:
         for uid in [u for u, r in self.results.items()
                     if r["t_done"] is not None]:
             del self.results[uid]
-        self._lat_ms.clear()
-        self._ttft_ms.clear()
+        self._lat_hist = LogHistogram()
+        self._ttft_hist = LogHistogram()
+        self._step_wall_hist = LogHistogram()
         self._completed_total = 0
         self._generated_total = 0
         self._steps = 0
         self._outcomes = {k: 0 for k in OUTCOMES}
         self._requeued_total = 0
+        self._traces_emitted = 0
         self._recent = RingBuffer(max(1, int(self.config.poison_window)))
 
     def stats(self) -> dict:
-        """Latency/throughput summary over completed requests: p50/p99
-        submit→done and submit→first-token (ms), generated tokens.
-        Percentiles cover the last ≤4096 completions (bounded window);
-        the counts are totals since the last :meth:`reset_stats`."""
+        """Latency/throughput summary over completed requests: p50/p99/
+        p999 submit→done and submit→first-token (ms), generated tokens.
+        Percentiles come from the mergeable log-bucketed histograms
+        (monitor/histogram.py) and cover EVERY completion since the last
+        :meth:`reset_stats` — exact counts, ≤1% relative value error —
+        not a truncated deque window."""
         out = {"completed": self._completed_total,
                "pending": len(self.queue) + sum(
                    s is not None for s in self._slots),
@@ -1185,18 +1398,18 @@ class ServingEngine:
                "generated_tokens": self._generated_total,
                "outcomes": dict(self._outcomes),
                "requeued": self._requeued_total,
-               "breaker_open": self._breaker_open}
-        if self._lat_ms:
-            lat = np.asarray(self._lat_ms)
+               "breaker_open": self._breaker_open,
+               "traces_emitted": self._traces_emitted}
+        if self._lat_hist:
+            p = self._lat_hist.percentiles()
             out["latency_ms"] = {
-                "p50": round(float(np.percentile(lat, 50)), 2),
-                "p99": round(float(np.percentile(lat, 99)), 2),
-                "max": round(float(lat.max()), 2)}
-        if self._ttft_ms:
-            ttft = np.asarray(self._ttft_ms)
+                "p50": round(p["p50"], 2), "p99": round(p["p99"], 2),
+                "p999": round(p["p999"], 2), "max": round(p["max"], 2)}
+        if self._ttft_hist:
+            p = self._ttft_hist.percentiles()
             out["ttft_ms"] = {
-                "p50": round(float(np.percentile(ttft, 50)), 2),
-                "p99": round(float(np.percentile(ttft, 99)), 2)}
+                "p50": round(p["p50"], 2), "p99": round(p["p99"], 2),
+                "p999": round(p["p999"], 2)}
         return out
 
     def compile_report(self):

@@ -8,7 +8,10 @@ run here, ``wall_clock_breakdown`` parsed but driving nothing, and health
 forensics / serving stats / wire census each inventing a format.  This
 package replaces them with a process-local **event bus** over a typed,
 versioned event schema (``events.Event``: ``step`` | ``span`` | ``gauge``
-| ``counter`` | ``artifact``) and pluggable sinks:
+| ``counter`` | ``artifact``, plus the v2 kinds ``hist`` — mergeable
+log-bucketed histograms, ``histogram.LogHistogram`` — and ``trace`` —
+per-request serving traces, Chrome-trace-exportable) and pluggable
+sinks:
 
 - :class:`sinks.JSONLSink` — the default stream (rank-0, one event per
   line, O_APPEND-atomic writes through the PR-1 retry IO);
@@ -33,6 +36,7 @@ See docs/monitoring.md for the schema, span taxonomy, configuration
 """
 
 from .events import SCHEMA_VERSION, EVENT_KINDS, Event, parse_line
+from .histogram import LogHistogram
 from .ring import RingBuffer
 from .bus import MonitorBus
 from .spans import SpanRecorder
@@ -42,7 +46,7 @@ from .core import Monitor, NullMonitor, from_config
 
 __all__ = [
     "SCHEMA_VERSION", "EVENT_KINDS", "Event", "parse_line",
-    "RingBuffer", "MonitorBus", "SpanRecorder",
+    "LogHistogram", "RingBuffer", "MonitorBus", "SpanRecorder",
     "Sink", "JSONLSink", "CSVSink", "RingBufferSink", "TensorboardSink",
     "SinkUnavailable", "EVENTS_FILE",
     "Monitor", "NullMonitor", "from_config",

@@ -4,6 +4,7 @@ Usage::
 
     python -m deepspeed_tpu.monitor <run_dir | events.jsonl> \
         [--interval 2] [--once] [--tail N]
+    python -m deepspeed_tpu.monitor <run_dir> --export-trace [--out X.json]
 
 Reads ``events.jsonl`` incrementally (only bytes appended since the last
 poll), folds the events into one aggregate view (latest step scalars,
@@ -71,6 +72,9 @@ class Aggregate:
         self.spans = {}               # spans of the newest span-step
         self._span_step = None
         self.artifacts = []           # newest-last (path, name)
+        self.hists = {}               # name -> latest hist event fields
+        self.traces = 0               # request traces seen
+        self.last_trace = None        # newest trace event fields
         self.events = 0
         self.skips_total = 0
         self.last_t = None
@@ -95,6 +99,11 @@ class Aggregate:
             elif e.kind == "artifact":
                 self.artifacts.append((e.name, e.path))
                 del self.artifacts[:-4]
+            elif e.kind == "hist":
+                self.hists[e.name] = e.fields
+            elif e.kind == "trace":
+                self.traces += 1
+                self.last_trace = e.fields
 
 
 def _fmt(v, unit=""):
@@ -157,6 +166,30 @@ def render(agg: Aggregate, source: str, clock=time.time) -> str:
             f"poisoned {_fmt(srv['poisoned_total'] or 0)}  "
             f"requeued {_fmt(srv['requeued_total'] or 0)}  "
             f"breaker {'OPEN' if srv['breaker_open'] else 'closed'}"]
+    if agg.hists:
+        # whole-run latency percentiles from the mergeable histograms
+        # (docs/monitoring.md#histograms) — not a truncated window
+        from .histogram import LogHistogram
+        parts = []
+        for name, payload in sorted(agg.hists.items()):
+            try:
+                h = LogHistogram.from_dict(payload)
+            except (KeyError, TypeError, ValueError):
+                continue
+            p = h.percentiles()
+            if p["p50"] is None:
+                continue
+            parts.append(
+                f"{name} p50 {_fmt(p['p50'])} p99 {_fmt(p['p99'])} "
+                f"p999 {_fmt(p['p999'])} (n={h.count})")
+        if parts:
+            lines += ["-" * 78, "hist: " + "  |  ".join(parts)]
+    if agg.traces:
+        lt = agg.last_trace or {}
+        lines.append(
+            f"traces: {agg.traces} request(s)  last uid "
+            f"{_fmt(lt.get('uid'))} [{lt.get('outcome', '?')}] "
+            f"ttft {_fmt(lt.get('ttft_ms'))}ms  (--export-trace)")
     if agg.spans:
         root = agg.spans.get("step")
         parts = [f"step {root.dur_s * 1e3:.1f}ms"] if root is not None \
@@ -193,9 +226,33 @@ def main(argv=None):
                     help="render one frame and exit")
     ap.add_argument("--tail", type=int, default=0,
                     help="with --once: also print the last N raw events")
+    ap.add_argument("--export-trace", action="store_true",
+                    help="convert the stream's request traces to Chrome "
+                         "trace-event JSON (Perfetto-loadable) and exit")
+    ap.add_argument("--out", default=None,
+                    help="with --export-trace: output path "
+                         "(default <run_dir>/trace.json)")
     args = ap.parse_args(argv)
 
     stream = resolve_stream(args.run)
+    if args.export_trace:
+        from .trace_export import export_chrome_trace
+        if not os.path.exists(stream):
+            print(f"ds_top: no event stream at {stream}")
+            return 1
+        follower = StreamFollower(stream)
+        events = follower.poll()
+        out = args.out or os.path.join(os.path.dirname(stream),
+                                       "trace.json")
+        doc = export_chrome_trace(events, out)
+        n_req = doc["otherData"]["requests"]
+        print(f"exported {n_req} request trace(s) "
+              f"({len(doc['traceEvents'])} trace events) -> {out}")
+        if n_req == 0:
+            print("no `trace` events in the stream — was the run's "
+                  "serving.trace_sample_rate > 0 with the monitor on? "
+                  "(docs/monitoring.md#request-tracing)")
+        return 0
     follower = StreamFollower(stream)
     agg = Aggregate()
     if not os.path.exists(stream) and args.once:

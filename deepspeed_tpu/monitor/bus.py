@@ -67,6 +67,20 @@ class MonitorBus:
         self.emit(Event(kind="artifact", name=name, t=self._clock(),
                         step=step, path=path, fields=fields))
 
+    def hist(self, name, hist, step=None, **fields):
+        """Serialized :class:`monitor.histogram.LogHistogram` (or its
+        ``to_dict()`` form) as a schema-v2 ``hist`` event."""
+        payload = hist.to_dict() if hasattr(hist, "to_dict") else dict(hist)
+        payload.update(fields)
+        self.emit(Event(kind="hist", name=name, t=self._clock(), step=step,
+                        value=payload.get("count"), fields=payload))
+
+    def trace(self, name, step=None, **fields):
+        """One finished request's trace record (schema-v2 ``trace``
+        event; docs/monitoring.md#request-tracing)."""
+        self.emit(Event(kind="trace", name=name, t=self._clock(),
+                        step=step, fields=fields))
+
     # -------------------------------------------------------------- lifecycle
     def flush(self):
         for sink in tuple(self._sinks):

@@ -101,6 +101,12 @@ class NullMonitor:
     def artifact(self, *a, **kw):
         pass
 
+    def hist(self, *a, **kw):
+        pass
+
+    def trace(self, *a, **kw):
+        pass
+
     def trace_before_step(self, step_no):
         pass
 
@@ -290,6 +296,14 @@ class Monitor:
     def artifact(self, name, path, step=None, **fields):
         self.bus.artifact(name, path, step=step if step is not None
                           else self._last_step, **fields)
+
+    def hist(self, name, hist, step=None, **fields):
+        self.bus.hist(name, hist, step=step if step is not None
+                      else self._last_step, **fields)
+
+    def trace(self, name, step=None, **fields):
+        self.bus.trace(name, step=step if step is not None
+                       else self._last_step, **fields)
 
     # ----------------------------------------------------------------- trace
     def trace_before_step(self, step_no):

@@ -11,13 +11,27 @@ and offline consumers parse exactly one format:
 - ``gauge``    a sampled instantaneous value (tokens/s, MFU, HBM bytes);
 - ``counter``  a per-step or cumulative count (wire bytes/step, rewinds);
 - ``artifact`` a file the run produced (profiler trace, forensic dump,
-               committed checkpoint) — ``path`` points at it.
+               committed checkpoint) — ``path`` points at it;
+- ``hist``     a serialized mergeable log-bucketed histogram
+               (``monitor/histogram.py``) — whole-run latency/step-time
+               distributions that replicas/restarts can merge (v2);
+- ``trace``    one finished request's host-side trace: queue-wait /
+               prefill / per-decode-step spans + TTFT + outcome,
+               exportable as Chrome trace-event JSON (v2).
 
 The wire format is one JSON object per line, ``sort_keys`` + compact
 separators, ``None`` fields dropped; non-finite floats are serialized as
 their ``repr`` strings (``'nan'``/``'inf'``) because bare NaN tokens are
-not RFC-8259 JSON (the health forensics lesson).  ``v`` carries
-:data:`SCHEMA_VERSION` so consumers can gate on compatibility.
+not RFC-8259 JSON (the health forensics lesson).
+
+Versioning is **per kind**: the v1 kinds keep stamping ``v: 1``, the
+kinds added later stamp the version that introduced them
+(:data:`KIND_VERSIONS`), and a reader accepts anything ``<=``
+:data:`SCHEMA_VERSION`.  That is the forward-compatibility contract: a
+v1 reader tailing a v2 stream parses every event it knows and rejects
+exactly the ``hist``/``trace`` lines (its ``from_dict`` sees ``v: 2``),
+which stream followers already count-and-skip — old ``ds_top``
+deployments degrade gracefully instead of dying on the first new event.
 """
 
 import dataclasses
@@ -25,16 +39,27 @@ import json
 import math
 from typing import Any, Dict, Optional
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
-EVENT_KINDS = ("step", "span", "gauge", "counter", "artifact")
+EVENT_KINDS = ("step", "span", "gauge", "counter", "artifact", "hist",
+               "trace")
+
+# schema version that introduced each kind (absent -> 1); events stamp
+# this, so v1 consumers keep parsing v1 kinds from a v2 producer
+KIND_VERSIONS = {"hist": 2, "trace": 2}
 
 
 def _scalar(v):
     """Host-ify one payload value: numpy/jax scalars become plain Python
-    numbers so the schema never leaks array types into JSON."""
+    numbers so the schema never leaks array types into JSON.  Containers
+    recurse (v2: ``hist`` bucket maps and ``trace`` span lists are
+    structured payloads, not stringified reprs)."""
     if isinstance(v, (bool, int, float, str)) or v is None:
         return v
+    if isinstance(v, dict):
+        return {str(k): _scalar(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_scalar(x) for x in v]
     if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
         return _scalar(v.item())
     if hasattr(v, "__float__"):
@@ -64,12 +89,14 @@ class Event:
     parent: Optional[str] = None          # span nesting (parent span name)
     path: Optional[str] = None            # artifact payload location
     fields: Dict[str, Any] = dataclasses.field(default_factory=dict)
-    v: int = SCHEMA_VERSION
+    v: Optional[int] = None       # stamped per kind (KIND_VERSIONS)
 
     def __post_init__(self):
         if self.kind not in EVENT_KINDS:
             raise ValueError(
                 f"unknown event kind {self.kind!r}; valid: {EVENT_KINDS}")
+        if self.v is None:
+            self.v = KIND_VERSIONS.get(self.kind, 1)
         if not self.name:
             raise ValueError("event name must be non-empty")
         self.t = float(self.t)
@@ -99,18 +126,25 @@ class Event:
                           separators=(",", ":"), allow_nan=False)
 
     @classmethod
-    def from_dict(cls, d: dict) -> "Event":
+    def from_dict(cls, d: dict, max_version: int = SCHEMA_VERSION) -> "Event":
+        """Parse one event dict.  ``max_version`` is the reader's schema
+        ceiling (default: this build's :data:`SCHEMA_VERSION`); an event
+        stamped newer raises — passing ``max_version=1`` reproduces a v1
+        reader exactly, which is how the forward-compat contract is
+        tested (a stream follower counts-and-skips the raise)."""
         v = int(d.get("v", 0))
-        if v != SCHEMA_VERSION:
+        if not (1 <= v <= max_version):
             raise ValueError(
-                f"event schema version {v} != supported {SCHEMA_VERSION}")
+                f"event schema version {v} not supported "
+                f"(reader accepts 1..{max_version})")
         return cls(kind=d["kind"], name=d["name"], t=d["t"],
                    step=d.get("step"), value=d.get("value"),
                    dur_s=d.get("dur_s"), parent=d.get("parent"),
-                   path=d.get("path"), fields=dict(d.get("fields") or {}))
+                   path=d.get("path"), fields=dict(d.get("fields") or {}),
+                   v=v)
 
 
-def parse_line(line: str) -> Event:
+def parse_line(line: str, max_version: int = SCHEMA_VERSION) -> Event:
     """One JSONL line back into an :class:`Event` (raises on malformed
     input — a consumer choosing to skip bad lines does so explicitly)."""
-    return Event.from_dict(json.loads(line))
+    return Event.from_dict(json.loads(line), max_version=max_version)

@@ -9,24 +9,53 @@ compute against the same peak table.
 
 import numpy as np
 
-_PEAK_BF16_FLOPS = {
-    # TPU generation substring (lowercased device_kind) -> bf16 peak/chip
-    "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
-    "v4": 275e12, "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
+# Per-chip-generation nominal capability table (public datasheet
+# numbers): bf16 peak FLOPS, HBM bandwidth, and aggregate one-direction
+# ICI bandwidth per chip.  ONE table for the MFU gauge, bench.py's
+# headline pricing, AND the roofline attribution (`analysis/roofline.py`
+# / `ds_explain`) — the tool and the hand math cannot drift.  Keyed by a
+# lowercased `device_kind` substring; matched top-down.
+CHIP_TABLE = {
+    "v5 lite":    {"peak_bf16_flops": 197e12, "hbm_gb_s": 819.0,
+                   "ici_gb_s": 200.0},
+    "v5e":        {"peak_bf16_flops": 197e12, "hbm_gb_s": 819.0,
+                   "ici_gb_s": 200.0},
+    "v5litepod":  {"peak_bf16_flops": 197e12, "hbm_gb_s": 819.0,
+                   "ici_gb_s": 200.0},
+    "v4":         {"peak_bf16_flops": 275e12, "hbm_gb_s": 1228.0,
+                   "ici_gb_s": 300.0},
+    "v5p":        {"peak_bf16_flops": 459e12, "hbm_gb_s": 2765.0,
+                   "ici_gb_s": 600.0},
+    "v6e":        {"peak_bf16_flops": 918e12, "hbm_gb_s": 1640.0,
+                   "ici_gb_s": 448.0},
+    "v6 lite":    {"peak_bf16_flops": 918e12, "hbm_gb_s": 1640.0,
+                   "ici_gb_s": 448.0},
 }
-_PEAK_DEFAULT = 197e12   # v5e fallback
+_CHIP_DEFAULT = "v5e"    # fallback generation (CPU tests: nominal only)
+
+
+def chip_specs(device_kind=None) -> dict:
+    """The :data:`CHIP_TABLE` row for ``device_kind`` (default: the
+    local backend's device), plus the matched kind under
+    ``device_kind``.  On non-TPU backends (CPU tests) the v5e row is
+    returned as a NOMINAL reference — MFU/roofline fractions are then a
+    relative series, not an absolute hardware claim."""
+    if device_kind is None:
+        import jax
+        device_kind = jax.devices()[0].device_kind
+    kind = str(device_kind).lower()
+    for key, row in CHIP_TABLE.items():
+        if key in kind:
+            return dict(row, device_kind=device_kind, matched=key)
+    return dict(CHIP_TABLE[_CHIP_DEFAULT], device_kind=device_kind,
+                matched=_CHIP_DEFAULT, nominal=True)
 
 
 def peak_flops_per_chip() -> float:
     """bf16 peak per chip by TPU generation (fallback: v5e).  On non-TPU
     backends (CPU tests) the returned peak is nominal — MFU is then a
     relative series, not an absolute fraction."""
-    import jax
-    kind = jax.devices()[0].device_kind.lower()
-    for key, val in _PEAK_BF16_FLOPS.items():
-        if key in kind:
-            return val
-    return _PEAK_DEFAULT
+    return chip_specs()["peak_bf16_flops"]
 
 
 def device_memory() -> dict:
@@ -87,22 +116,37 @@ def live_signature_count(fn) -> int:
     return len(getattr(fn, "_exes", {}) or {})
 
 
+def _cost_analysis(fn) -> dict:
+    exe = latest_executable(fn)
+    if exe is None:
+        return {}
+    try:
+        ca = exe.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca if isinstance(ca, dict) else {}
+
+
 def executable_flops(fn) -> int:
     """Compiled-step FLOPs from the dispatching executable's XLA cost
     analysis (the flops-profiler reading, shared here so the live MFU
     gauge and the profiler price the same program).  0 when no
     executable is live yet or the backend exposes no analysis."""
-    exe = latest_executable(fn)
-    if exe is None:
-        return 0
     try:
-        ca = exe.cost_analysis()
-    except Exception:
+        return int(_cost_analysis(fn).get("flops", 0) or 0)
+    except (AttributeError, TypeError, ValueError):
         return 0
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0] if ca else {}
+
+
+def executable_bytes_accessed(fn) -> int:
+    """Total memory-traffic bytes of the dispatching executable per XLA
+    cost analysis (the ``"bytes accessed"`` reading) — the numerator of
+    the HBM-roofline term in ``analysis/roofline.py``.  0 when no
+    executable/analysis is available."""
     try:
-        return int(ca.get("flops", 0) or 0)
+        return int(_cost_analysis(fn).get("bytes accessed", 0) or 0)
     except (AttributeError, TypeError, ValueError):
         return 0
 

@@ -1,0 +1,213 @@
+"""Mergeable log-bucketed latency histograms with a proven quantile bound.
+
+The serving stats and ``ThroughputTimer`` used to compute percentiles
+over **bounded deques** — under sustained traffic the window silently
+drops history, so a "whole-run p99" was really "p99 of the last 4096
+completions" (the PR-12 truncated-window bug).  :class:`LogHistogram`
+replaces that math with the DDSketch construction (Masson et al.,
+VLDB'19 — relative-error sketches over geometric buckets):
+
+- **bounded memory, exact counts**: values land in geometric buckets
+  ``(γ^(i-1), γ^i]`` with ``γ = (1+ε)/(1-ε)``; the bucket *counts* are
+  exact integers, only the *positions* within a bucket are quantized.
+  Memory is bounded by the dynamic range (≈ ``ln(hi/lo)/ln γ`` buckets
+  — about 1150 per 10 decades at ε = 1%), with an optional lowest-bucket
+  collapse as a hard cap;
+- **proven quantile error**: a bucket's representative value
+  ``2γ^i/(γ+1)`` is within relative error ε of every value in the
+  bucket, so ``quantile(q)`` is within ``ε·v`` of some sample ``v``
+  whose rank is *exactly* the requested one (counts are exact) —
+  gated by the property test in ``tests/test_histogram.py``;
+- **mergeable**: two histograms with the same ε merge by adding bucket
+  counts — ``merge`` is associative and commutative, and
+  ``merge(h(A), h(B)) == h(A ++ B)`` *exactly* (same buckets, same
+  counts), which is what lets replicas/restarts (and the ROADMAP-3
+  replica router) aggregate latency without a central sample store.
+
+Serialization (:meth:`to_dict`/:meth:`from_dict`) is the payload of the
+schema-v2 ``hist`` event (docs/monitoring.md#histograms): buckets ride
+as a sparse ``{index: count}`` map, so an idle server's histogram is a
+few bytes and a hot one is bounded by the range above.
+"""
+
+import math
+from typing import Dict, Optional
+
+DEFAULT_REL_ERR = 0.01     # 1% relative quantile error (docs/monitoring.md)
+DEFAULT_MAX_BUCKETS = 4096
+
+
+class LogHistogram:
+    """Fixed-γ geometric-bucket histogram (module docstring).
+
+    Values must be finite; values ``<= 0`` are counted in the zero
+    bucket (latencies/durations are non-negative — a 0 is a legitimate
+    "faster than the clock" reading, not an error).
+    """
+
+    __slots__ = ("rel_err", "_gamma", "_log_gamma", "max_buckets",
+                 "buckets", "zero_count", "count", "sum", "min", "max",
+                 "_collapsed")
+
+    def __init__(self, rel_err: float = DEFAULT_REL_ERR, *,
+                 max_buckets: int = DEFAULT_MAX_BUCKETS):
+        if not (0.0 < rel_err < 1.0):
+            raise ValueError(f"rel_err must be in (0, 1), got {rel_err}")
+        if max_buckets < 8:
+            raise ValueError(f"max_buckets must be >= 8, got {max_buckets}")
+        self.rel_err = float(rel_err)
+        self._gamma = (1.0 + rel_err) / (1.0 - rel_err)
+        self._log_gamma = math.log(self._gamma)
+        self.max_buckets = int(max_buckets)
+        self.buckets: Dict[int, int] = {}
+        self.zero_count = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        # True once the lowest buckets were ever collapsed into one: the
+        # ε bound then no longer holds for quantiles that land in the
+        # collapsed tail (reported honestly via `collapsed`)
+        self._collapsed = False
+
+    # ------------------------------------------------------------- recording
+    def _index(self, value: float) -> int:
+        # bucket i covers (γ^(i-1), γ^i]
+        return math.ceil(math.log(value) / self._log_gamma)
+
+    def add(self, value: float, count: int = 1):
+        value = float(value)
+        if not math.isfinite(value):
+            raise ValueError(f"histogram values must be finite, got {value}")
+        if count < 1:
+            raise ValueError(f"count must be >= 1, got {count}")
+        self.count += count
+        self.sum += value * count
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        if value <= 0.0:
+            self.zero_count += count
+            return
+        i = self._index(value)
+        self.buckets[i] = self.buckets.get(i, 0) + count
+        if len(self.buckets) > self.max_buckets:
+            self._collapse()
+
+    def add_many(self, values):
+        for v in values:
+            self.add(v)
+
+    def _collapse(self):
+        """Hard memory cap: fold the LOWEST buckets together until the
+        map fits.  Only the small-value tail loses resolution (DDSketch's
+        choice: p50/p99 live in the high buckets)."""
+        order = sorted(self.buckets)
+        spill = 0
+        while len(order) > self.max_buckets - 1:
+            spill += self.buckets.pop(order.pop(0))
+        if spill:
+            lowest = order[0]
+            self.buckets[lowest] = self.buckets.get(lowest, 0) + spill
+            self._collapsed = True
+
+    # ------------------------------------------------------------- quantiles
+    def _representative(self, i: int) -> float:
+        # 2γ^i/(γ+1): within rel_err of every value in (γ^(i-1), γ^i]
+        return 2.0 * math.exp(i * self._log_gamma) / (self._gamma + 1.0)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Value at quantile ``q`` ∈ [0, 1] (rank ``ceil(q·n)``), within
+        relative error ``rel_err`` of the exact sample at that rank;
+        clamped to the exact [min, max].  None on an empty histogram."""
+        if not (0.0 <= q <= 1.0):
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        if self.count == 0:
+            return None
+        rank = max(1, math.ceil(q * self.count))
+        if rank <= self.zero_count:
+            # zero-bucket values are stored exactly as <= 0; min is exact
+            return min(self.min, 0.0)
+        cum = self.zero_count
+        for i in sorted(self.buckets):
+            cum += self.buckets[i]
+            if cum >= rank:
+                rep = self._representative(i)
+                return min(max(rep, self.min), self.max)
+        return self.max          # float drift fallback; ranks are exact ints
+
+    def percentiles(self) -> dict:
+        """The standard latency readout: p50/p99/p999 (+ exact max)."""
+        return {"p50": self.quantile(0.50), "p99": self.quantile(0.99),
+                "p999": self.quantile(0.999), "max": self.max}
+
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def __len__(self):
+        return self.count
+
+    def __bool__(self):
+        return self.count > 0
+
+    # ---------------------------------------------------------------- merge
+    def merge(self, other: "LogHistogram") -> "LogHistogram":
+        """Fold ``other`` into ``self`` (in place; returns self).  Both
+        must share ``rel_err`` — merged counts are EXACT, so
+        ``h(A).merge(h(B)) == h(A ++ B)`` bucket-for-bucket."""
+        if abs(other.rel_err - self.rel_err) > 1e-12:
+            raise ValueError(
+                f"cannot merge histograms with different rel_err "
+                f"({self.rel_err} vs {other.rel_err}) — bucket grids differ")
+        for i, c in other.buckets.items():
+            self.buckets[i] = self.buckets.get(i, 0) + c
+        self.zero_count += other.zero_count
+        self.count += other.count
+        self.sum += other.sum
+        if other.min is not None:
+            self.min = other.min if self.min is None else min(self.min,
+                                                              other.min)
+        if other.max is not None:
+            self.max = other.max if self.max is None else max(self.max,
+                                                              other.max)
+        self._collapsed = self._collapsed or other._collapsed
+        if len(self.buckets) > self.max_buckets:
+            self._collapse()
+        return self
+
+    # ------------------------------------------------------------ wire form
+    def to_dict(self) -> dict:
+        """JSON-safe sparse form — the ``hist`` event payload.  Bucket
+        keys serialize as strings (JSON object keys)."""
+        return {"rel_err": self.rel_err, "count": self.count,
+                "sum": self.sum, "min": self.min, "max": self.max,
+                "zero": self.zero_count, "collapsed": self._collapsed,
+                "buckets": {str(i): c for i, c in
+                            sorted(self.buckets.items())}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogHistogram":
+        h = cls(rel_err=float(d["rel_err"]))
+        h.buckets = {int(i): int(c) for i, c in
+                     (d.get("buckets") or {}).items()}
+        h.zero_count = int(d.get("zero", 0))
+        h.count = int(d["count"])
+        h.sum = float(d.get("sum", 0.0))
+        h.min = None if d.get("min") is None else float(d["min"])
+        h.max = None if d.get("max") is None else float(d["max"])
+        h._collapsed = bool(d.get("collapsed", False))
+        return h
+
+    def __eq__(self, other):
+        if not isinstance(other, LogHistogram):
+            return NotImplemented
+        return (self.rel_err == other.rel_err
+                and self.buckets == other.buckets
+                and self.zero_count == other.zero_count
+                and self.count == other.count
+                and self.min == other.min and self.max == other.max)
+
+    def __repr__(self):
+        p = self.percentiles() if self.count else {}
+        return (f"LogHistogram(n={self.count}, rel_err={self.rel_err}, "
+                f"buckets={len(self.buckets)}, p50={p.get('p50')}, "
+                f"p99={p.get('p99')})")

@@ -1,0 +1,71 @@
+"""Export request ``trace`` events as Chrome trace-event JSON.
+
+``python -m deepspeed_tpu.monitor <run_dir> --export-trace`` converts
+the schema-v2 ``trace`` events of a monitor stream (one per sampled
+request, emitted by the serving engine — docs/monitoring.md
+#request-tracing) into the Chrome trace-event format
+(``{"traceEvents": [...]}``), loadable in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``.
+
+Mapping: one *thread* per request (``tid`` = uid, with a thread-name
+metadata event ``req <uid> [outcome]``), one complete-duration ``"X"``
+event per span (``queue_wait`` → ``prefill`` → ``decode[n]`` →
+terminal).  Timestamps are microseconds of absolute unix time
+(``t0_unix`` + the span's host-measured relative offset), so traces
+from several replicas merge onto one timeline.  Spans within a request
+are emitted monotone and non-overlapping — the invariant the round-trip
+test gates (a span starting before its predecessor ends would render as
+a lie about a strictly sequential per-request pipeline).
+"""
+
+import json
+
+PID = 1                      # one process row; replicas can re-map later
+
+
+def request_trace_events(event) -> list:
+    """One ``trace`` event -> its Chrome trace-event dicts."""
+    f = event.fields
+    uid = int(f.get("uid", -1))
+    t0_us = float(f.get("t0_unix", event.t)) * 1e6
+    out = [{
+        "ph": "M", "name": "thread_name", "pid": PID, "tid": uid,
+        "args": {"name": f"req {uid} [{f.get('outcome', '?')}]"},
+    }]
+    prev_end = 0.0           # relative µs; enforces the monotone invariant
+    for span in f.get("spans") or ():
+        start = max(float(span["start_ms"]) * 1e3, prev_end)
+        dur = max(0.0, float(span["dur_ms"]) * 1e3)
+        prev_end = start + dur
+        out.append({
+            "ph": "X", "name": str(span["name"]), "cat": "serving",
+            "pid": PID, "tid": uid,
+            "ts": t0_us + start, "dur": dur,
+            "args": {"uid": uid, "outcome": f.get("outcome"),
+                     **({"step": span["step"]} if "step" in span else {})},
+        })
+    return out
+
+
+def chrome_trace(events) -> dict:
+    """Fold a parsed event stream into one Chrome trace document (only
+    the ``trace``-kind events contribute; everything else is ignored)."""
+    trace_events = []
+    n = 0
+    for e in events:
+        if e.kind != "trace":
+            continue
+        n += 1
+        trace_events.extend(request_trace_events(e))
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms",
+            "otherData": {"producer": "deepspeed_tpu.monitor",
+                          "requests": n}}
+
+
+def export_chrome_trace(events, out_path: str) -> dict:
+    """Write :func:`chrome_trace` to ``out_path``; returns the document
+    (callers report ``len(doc['traceEvents'])``)."""
+    doc = chrome_trace(events)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, separators=(",", ":"))
+    return doc

@@ -1626,6 +1626,18 @@ class DeepSpeedEngine:
         peak = mg.executable_peak_bytes(fn)
         if peak:
             out["hbm_projected"] = peak
+        hbm_bytes = mg.executable_bytes_accessed(fn)
+        if flops or hbm_bytes:
+            # one `exe_cost` event per priced program: the ds_explain
+            # (analysis/roofline.py) feed — XLA FLOPs + memory-traffic
+            # bytes + census wire bytes + the producing chip, so an
+            # offline stream carries everything the roofline needs
+            self.monitor.gauge(
+                "exe_cost", float(flops), exe="train_step", flops=flops,
+                hbm_bytes=hbm_bytes,
+                wire_bytes=(wire or {}).get("wire_bytes_per_step", 0),
+                device_kind=jax.devices()[0].device_kind,
+                n_chips=len(jax.devices()))
         n_sigs = mg.live_signature_count(fn)
         if n_sigs:
             # cache against the signature count: stable program = priced

@@ -22,19 +22,22 @@ from .logging import logger
 class SynchronizedWallClockTimer:
     """Named timer registry, device-synchronized at stop when requested."""
 
-    # per-timer sample window behind mean()/get_mean(): bounded, or a
-    # long wall_clock_breakdown run leaks one float per span per step
-    RECORD_WINDOW = 512
-
     class Timer:
+        """Per-name accumulator.  Recorded samples land in a mergeable
+        log-bucketed histogram (``monitor/histogram.py``) instead of the
+        old bounded 512-deque: ``mean()`` is now the EXACT whole-run
+        mean (sum/count — counts and sums are exact in the histogram)
+        and ``percentiles()`` is available, both at bounded memory, so a
+        long ``wall_clock_breakdown`` run neither leaks one float per
+        span per step nor silently truncates its history."""
+
         def __init__(self, name):
             self.name_ = name
             self.elapsed_ = 0.0
             self.started_ = False
             self.start_time = time.time()
-            from collections import deque
-            self.records = deque(
-                maxlen=SynchronizedWallClockTimer.RECORD_WINDOW)
+            from ..monitor.histogram import LogHistogram
+            self.records = LogHistogram()
 
         def start(self):
             assert not self.started_, f"{self.name_} timer has already been started"
@@ -52,7 +55,7 @@ class SynchronizedWallClockTimer:
             else:
                 self.elapsed_ += elapsed
             if record:
-                self.records.append(self.elapsed_)
+                self.records.add(self.elapsed_)
             self.started_ = False
 
         def reset(self):
@@ -73,7 +76,12 @@ class SynchronizedWallClockTimer:
         def mean(self):
             if not self.records:
                 return 0.0
-            return sum(self.records) / len(self.records)
+            return self.records.mean()
+
+        def percentiles(self):
+            """p50/p99/p999 (+ exact max) of the recorded samples, in
+            seconds (histogram-backed; ≤1% relative value error)."""
+            return self.records.percentiles()
 
 
     def __init__(self):
@@ -92,7 +100,7 @@ class SynchronizedWallClockTimer:
         state."""
         t = self(name)
         t.elapsed_ += float(dur_s)
-        t.records.append(float(dur_s))
+        t.records.add(float(dur_s))
 
     def has_timer(self, name):
         return name in self.timers
@@ -154,6 +162,12 @@ class ThroughputTimer:
         # samples/sec reading ALSO lands on the telemetry stream, so the
         # log line and ds_top show the same number (one schema)
         self.initialized = False
+        # whole-run step-time distribution (mergeable histogram — the
+        # same machinery as the serving latency stats): exact counts,
+        # bounded memory, p50/p99 that cover EVERY counted step instead
+        # of a truncated window
+        from ..monitor.histogram import LogHistogram
+        self.step_time_hist = LogHistogram()
 
     def update_epoch_count(self):
         self.epoch_count += 1
@@ -184,6 +198,7 @@ class ThroughputTimer:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
             if global_step:
+                self.step_time_hist.add(self.step_elapsed_time * 1e3)
                 if report_speed and self.global_step_count % self.steps_per_output == 0:
                     curr = self.batch_size / self.step_elapsed_time
                     self.logging(
@@ -194,6 +209,10 @@ class ThroughputTimer:
                     if self.bus is not None:
                         self.bus.gauge("throughput_samples_per_sec", curr,
                                        step=self.global_step_count)
+                        self.bus.hist("train_step_time_ms",
+                                      self.step_time_hist,
+                                      step=self.global_step_count,
+                                      unit="ms")
                 self.step_elapsed_time = 0
 
     def avg_samples_per_sec(self):
@@ -211,3 +230,10 @@ class ThroughputTimer:
             return self.total_elapsed_time / (self.global_step_count
                                               - self.start_step)
         return 0.0
+
+    def step_time_percentiles(self):
+        """p50/p99/p999 (+ exact max) of per-step wall time in ms over
+        EVERY counted step (histogram-backed — not a truncated window);
+        ``{}`` before any step has been counted."""
+        return (self.step_time_hist.percentiles()
+                if self.step_time_hist else {})

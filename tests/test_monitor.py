@@ -57,12 +57,49 @@ def test_event_schema_roundtrip():
         line = e.to_json()
         assert "\n" not in line
         assert parse_line(line) == e
-    # version is on the wire and gates parsing
+    # version is on the wire, stamped PER KIND (v1 kinds stay v1 under a
+    # v2 producer — the forward-compat contract), and gates parsing
     d = samples[0].to_dict()
-    assert d["v"] == SCHEMA_VERSION
+    assert d["v"] == 1                    # "step" is a v1 kind
     d["v"] = SCHEMA_VERSION + 1
     with pytest.raises(ValueError):
         Event.from_dict(d)
+
+
+def test_schema_v1_to_v2_forward_compat():
+    """v2 adds `hist`/`trace` kinds stamped v:2.  A v1 reader
+    (max_version=1) must parse every v1 event from a mixed v2 stream and
+    reject EXACTLY the new kinds — which stream followers count-and-skip
+    — while the v2 reader round-trips everything."""
+    from deepspeed_tpu.monitor.histogram import LogHistogram
+    h = LogHistogram()
+    h.add_many([1.0, 5.0, 250.0])
+    mixed = [
+        Event(kind="step", name="serving_step", t=1.0, step=4,
+              fields={"wall_s": 0.01}),
+        Event(kind="hist", name="latency_ms", t=2.0, step=4,
+              fields=h.to_dict()),
+        Event(kind="trace", name="request", t=3.0, step=4,
+              fields={"uid": 7, "outcome": "ok",
+                      "spans": [{"name": "queue_wait", "start_ms": 0.0,
+                                 "dur_ms": 1.5}]}),
+        Event(kind="gauge", name="mfu", t=4.0, step=4, value=0.4),
+    ]
+    assert [e.v for e in mixed] == [1, 2, 2, 1]
+    lines = [e.to_json() for e in mixed]
+    # v2 reader: full round-trip, nested payloads intact
+    parsed = [parse_line(ln) for ln in lines]
+    assert parsed == mixed
+    assert parsed[2].fields["spans"][0]["name"] == "queue_wait"
+    # v1 reader: the v1 kinds parse, the new kinds raise (skippable)
+    ok, skipped = [], 0
+    for ln in lines:
+        try:
+            ok.append(parse_line(ln, max_version=1))
+        except ValueError:
+            skipped += 1
+    assert [e.kind for e in ok] == ["step", "gauge"]
+    assert skipped == 2
 
 
 def test_event_rejects_unknown_kind_and_sanitizes():
@@ -545,6 +582,27 @@ def test_ds_top_renders_serving_resilience_line(tmp_path, capsys):
     assert "serving: active 3" in out and "queued 7" in out
     assert "shed 4" in out and "poisoned 1" in out
     assert "breaker OPEN" in out
+
+
+def test_ds_top_renders_hist_and_trace_lines(tmp_path, capsys):
+    """Schema-v2 hist events render whole-run p50/p99/p999; trace events
+    render the request-trace summary with the export pointer."""
+    from deepspeed_tpu.monitor.__main__ import main as ds_top
+    from deepspeed_tpu.monitor.histogram import LogHistogram
+    h = LogHistogram()
+    h.add_many([10.0] * 98 + [500.0, 900.0])
+    bus = MonitorBus([JSONLSink(str(tmp_path / EVENTS_FILE))])
+    bus.step("serving_step", 9, active_slots=2, queued=0)
+    bus.hist("latency_ms", h, step=9, unit="ms")
+    bus.trace("request", step=9, uid=42, outcome="ok", ttft_ms=12.5,
+              spans=[{"name": "queue_wait", "start_ms": 0.0,
+                      "dur_ms": 2.0}])
+    bus.flush()
+    assert ds_top([str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "latency_ms p50" in out and "p999" in out and "n=100" in out
+    assert "traces: 1 request(s)" in out and "42" in out
+    assert "--export-trace" in out
 
 
 def test_ds_top_follower_incremental(tmp_path):

@@ -6,6 +6,7 @@ every serving answer must match token-for-token under greedy decoding)
 and the model's contiguous cached decode (logit-level equivalence for
 the paged cache)."""
 
+import os
 import time
 
 import numpy as np
@@ -477,3 +478,157 @@ def test_capacity_report(tiny, devices):
     assert cap["pool_bytes"] == pk.pool_bytes(srv.pool)
     assert cap["kv_bits"] == 8
     srv.close()
+
+
+# -------------------------------------- request tracing + histograms
+# (docs/monitoring.md#request-tracing / #histograms; PR-12 tentpole)
+
+def test_exact_percentiles_vs_truncated_deque_window(tiny, devices):
+    """The truncated-window percentile bug, as a regression test: the
+    old bounded-deque math silently dropped history under sustained
+    traffic — its "p99" diverges from the exact whole-run quantile —
+    while the histogram path stats() now uses stays within its 1% bound.
+
+    Drives the REAL accounting seam (the engine's latency histogram),
+    with a 10k-completion stream whose early phase is slow and late
+    phase fast: a 4096-window deque forgets the slow phase entirely."""
+    from collections import deque
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8))
+    rng = np.random.default_rng(0)
+    lat = np.concatenate([rng.uniform(900.0, 1100.0, 5000),   # slow era
+                          rng.uniform(40.0, 60.0, 5000)])     # fast era
+    old_window = deque(maxlen=4096)                  # the replaced math
+    for v in lat:
+        srv._lat_hist.add(v)
+        old_window.append(v)
+    exact_p99 = float(np.percentile(np.asarray(lat), 99))
+    new_p99 = srv.stats()["latency_ms"]["p99"]
+    old_p99 = float(np.percentile(np.asarray(old_window), 99))
+    # the deque forgot the 900-1100ms era: its p99 sits in the fast band
+    assert abs(old_p99 - exact_p99) / exact_p99 > 0.5
+    # the histogram covers the whole run within its documented bound
+    # (1% value error + quantile-definition slack on 10k samples)
+    assert abs(new_p99 - exact_p99) / exact_p99 < 0.02
+    assert srv._lat_hist.count == 10000              # exact count
+    srv.close()
+
+
+def test_tracing_emits_spans_and_chrome_export(tiny, devices, tmp_path):
+    """trace_sample_rate=1.0 + armed monitor: every request emits a
+    schema-v2 `trace` event with monotone non-overlapping queue_wait /
+    prefill / decode spans and a TTFT, and --export-trace converts the
+    stream to valid Chrome trace-event JSON (one thread per request)."""
+    import json as _json
+    from deepspeed_tpu.monitor import Monitor, parse_line, EVENTS_FILE
+    from deepspeed_tpu.monitor.__main__ import main as ds_top_main
+    model, params = tiny
+    run_dir = str(tmp_path / "mon")
+    srv = ServingEngine(
+        model=model, params=params,
+        monitor=Monitor(run_dir=run_dir, role="serving"),
+        config=ServingConfig(batch_slots=2, block_size=8,
+                             trace_sample_rate=1.0))
+    reqs = [Request(tokens=np.arange(5), max_new_tokens=4, seed=0),
+            Request(tokens=np.arange(9), max_new_tokens=3, seed=1,
+                    do_sample=True),
+            Request(tokens=np.arange(4), max_new_tokens=2, seed=2)]
+    res = srv.run(reqs)
+    assert srv.stats()["traces_emitted"] == 3
+    srv.close()
+
+    events = []
+    with open(os.path.join(run_dir, EVENTS_FILE)) as fh:
+        for line in fh:
+            if line.strip():
+                events.append(parse_line(line))
+    traces = {e.fields["uid"]: e for e in events if e.kind == "trace"}
+    assert set(traces) == {r.uid for r in reqs}
+    for r in reqs:
+        f = traces[r.uid].fields
+        assert f["outcome"] == OK
+        assert f["generated"] == len(res[r.uid]["tokens"])
+        assert f["ttft_ms"] and f["ttft_ms"] > 0
+        names = [s["name"] for s in f["spans"]]
+        assert names[0] == "queue_wait" and names[1] == "prefill"
+        # one decode span per post-first token, stamped with its step
+        decodes = [s for s in f["spans"] if s["name"] == "decode"]
+        assert len(decodes) == f["generated"] - 1
+        assert all("step" in s for s in decodes)
+        prev_end = 0.0
+        for s in f["spans"]:          # monotone, non-overlapping
+            assert s["start_ms"] >= prev_end - 1e-6
+            assert s["dur_ms"] >= 0.0
+            prev_end = max(prev_end, s["start_ms"] + s["dur_ms"])
+    # the whole-run histograms rode the same stream (drain-time flush)
+    hist_names = {e.name for e in events if e.kind == "hist"}
+    assert {"latency_ms", "ttft_ms", "step_wall_ms"} <= hist_names
+    # exe_cost pricing for ds_explain rode it too
+    assert any(e.kind == "gauge" and e.name == "exe_cost"
+               for e in events)
+
+    # --export-trace: valid Chrome trace-event JSON, loadable schema
+    out = str(tmp_path / "trace.json")
+    rc = ds_top_main([run_dir, "--export-trace", "--out", out])
+    assert rc == 0
+    with open(out) as fh:
+        doc = _json.load(fh)
+    assert doc["otherData"]["requests"] == 3
+    xs = [ev for ev in doc["traceEvents"] if ev["ph"] == "X"]
+    assert xs and all({"name", "ts", "dur", "pid", "tid"} <= set(ev)
+                      for ev in xs)
+    # per-thread (= per-request) events are monotone non-overlapping
+    by_tid = {}
+    for ev in xs:
+        by_tid.setdefault(ev["tid"], []).append(ev)
+    for tid, evs in by_tid.items():
+        end = 0.0
+        for ev in sorted(evs, key=lambda e: e["ts"]):
+            assert ev["ts"] >= end - 1.0      # µs slack
+            end = ev["ts"] + ev["dur"]
+
+
+def test_tracing_disarmed_and_sampling_deterministic(tiny, devices):
+    """Rate 0 (default) or a bus-less monitor records nothing; the
+    sampling decision is a pure function of the uid."""
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8,
+                                             trace_sample_rate=1.0))
+    # armed rate but NullMonitor (no monitor passed, env off): no traces
+    srv.run([Request(tokens=np.arange(4), max_new_tokens=2)])
+    assert srv.stats()["traces_emitted"] == 0 and not srv._traces
+    # deterministic sampling at a partial rate
+    srv.config.trace_sample_rate = 0.25
+    picks = [srv._trace_sampled(uid) for uid in range(1000)]
+    assert picks == [srv._trace_sampled(uid) for uid in range(1000)]
+    assert 0.15 < np.mean(picks) < 0.35
+    srv.close()
+    with pytest.raises(AssertionError, match="trace_sample_rate"):
+        ServingEngine(model=model, params=params,
+                      config=ServingConfig(trace_sample_rate=1.5))
+
+
+def test_tracing_armed_step_jaxpr_identical(tiny, devices):
+    """The PR-9/PR-10 equality discipline applied to tracing: arming
+    trace_sample_rate=1.0 (with a live monitor) must leave the TRACED
+    decode step byte-identical — tracing is host bookkeeping, never
+    program content (--audit-step tracing gates the same invariant)."""
+    from deepspeed_tpu.monitor import Monitor
+    model, params = tiny
+
+    def jaxpr_text(srv):
+        srv._build_decode()
+        return str(jax.make_jaxpr(srv._decode)(*srv._decode_args()))
+
+    off = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=2, block_size=8))
+    off_jaxpr = jaxpr_text(off)
+    off.close()
+    ring_mon = Monitor(run_dir=None, sinks=("ring",))
+    on = ServingEngine(model=model, params=params, monitor=ring_mon,
+                       config=ServingConfig(batch_slots=2, block_size=8,
+                                            trace_sample_rate=1.0))
+    assert jaxpr_text(on) == off_jaxpr
+    on.close()
