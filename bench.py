@@ -78,21 +78,59 @@ def hbm_budget_bytes():
 PREFLIGHT_SAFETY = 0.92
 
 
-def plan_micro_backoff(micro, peak_fn, budget, safety=PREFLIGHT_SAFETY):
+def plan_micro_backoff(micro, peak_fn, budget, safety=PREFLIGHT_SAFETY,
+                       forensic_dir=None, ledger_fn=None, context=None):
     """Pure halving planner behind the rung preflight (unit-tested).
 
     ``peak_fn(micro) -> bytes|None`` is the projected peak at that
     micro-batch.  Halves until the projection fits ``budget * safety``
     (or the projection/budget is unavailable, or micro hits 1).  Returns
-    ``(micro, attempts)`` where attempts records every probe."""
+    ``(micro, attempts)`` where attempts records every probe.
+
+    When a backoff actually happens and ``forensic_dir`` is given, the
+    probe trail — plus the memory ledger from ``ledger_fn()`` and the
+    capacity model's verdict, when available — is dumped through the
+    ``write_forensics`` path (docs/monitoring.md#memory-explainability):
+    the rung's memory post-mortem exists even though the rung survived."""
     attempts = []
     while True:
         peak = peak_fn(micro)
         attempts.append({"micro": micro, "peak_bytes": peak})
         if peak is None or budget is None or peak <= budget * safety \
                 or micro <= 1:
+            if len(attempts) > 1 and forensic_dir:
+                _dump_backoff_forensics(forensic_dir, attempts, budget,
+                                        safety, ledger_fn, context)
             return micro, attempts
         micro //= 2
+
+
+def _dump_backoff_forensics(forensic_dir, attempts, budget, safety,
+                            ledger_fn, context):
+    """Best-effort ledger + verdict dump for a preflight backoff (never
+    raises into the planner)."""
+    from deepspeed_tpu.monitor.memory_ledger import oom_forensics
+    snap = {}
+    if ledger_fn is not None:
+        try:
+            snap = ledger_fn()
+        except Exception:
+            snap = {}
+    try:
+        oom_forensics(
+            forensic_dir, snap,
+            reason=f"bench preflight backoff: projected peak "
+                   f"{attempts[0]['peak_bytes']} B exceeds "
+                   f"{safety:.0%} of the {budget} B budget; micro "
+                   f"{attempts[0]['micro']} -> {attempts[-1]['micro']}",
+            budget_bytes=budget,
+            filename=f"bench_backoff_micro{attempts[-1]['micro']}.json",
+            extra={"attempts": attempts, "context": context,
+                   "advice_applied": "micro backoff "
+                                     "(bench.plan_micro_backoff)"})
+    except Exception as e:
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(f"bench: backoff forensics unavailable ({e})")
 
 
 def bench_cache_dir():
@@ -186,7 +224,12 @@ def measure(preset, seq, micro, zero_stage, *, steps=10, warmup=3,
         return pre.get("peak_bytes") if pre else None
 
     try:
-        micro, attempts = plan_micro_backoff(micro, peak_at, budget)
+        micro, attempts = plan_micro_backoff(
+            micro, peak_at, budget,
+            forensic_dir=os.path.join(os.getcwd(), "ds_forensics"),
+            ledger_fn=lambda: live["engine"].memory_ledger(),
+            context={"preset": preset, "seq": seq,
+                     "zero_stage": zero_stage})
         backoff_events.extend(dict(a, reason="memory_preflight")
                               for a in attempts[:-1])
         engine, model = live["engine"], live["model"]

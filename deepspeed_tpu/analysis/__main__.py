@@ -83,6 +83,9 @@ def _audit_builtin_steps(stages):
             if str(spec) == "monitor":
                 findings.extend(_audit_monitor_step(cache_dir))
                 continue
+            if str(spec) == "mem":
+                findings.extend(_audit_mem_step(cache_dir))
+                continue
             compressed = str(spec).endswith("q")
             stage = int(str(spec).rstrip("q"))
             cfg = {"train_micro_batch_size_per_gpu": 4,
@@ -563,6 +566,164 @@ def _audit_monitor_step(cache_dir):
     return findings
 
 
+def _audit_mem_step(cache_dir):
+    """--audit-step mem: the memory ledger must stay host-side
+    bookkeeping (docs/monitoring.md#memory-explainability).  Gates:
+
+    - twin tiny TRAIN engines — ledger armed (``monitor.memory_interval
+      = 1``) vs monitor off — produce byte-identical ``_train_step``
+      jaxprs, and the armed engine's compiled step shows zero DSTPU201
+      host callbacks;
+    - twin SERVING engines — armed vs disarmed — produce byte-identical
+      decode-step jaxprs;
+    - both armed streams carry parseable schema-v3 ``mem`` events whose
+      attribution names the expected subsystems (params / master /
+      moments on the train side, the paged-KV pool on the serving side)
+      and whose residual fields are present."""
+    import shutil
+    import tempfile
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.monitor import Monitor, parse_line
+    from deepspeed_tpu.monitor.sinks import EVENTS_FILE
+    from .findings import Finding
+    from .jaxpr_audit import audit_engine, train_step_jaxpr_text
+
+    findings = []
+
+    def read_mems(run_dir, what):
+        mems = []
+        try:
+            with open(os.path.join(run_dir, EVENTS_FILE)) as fh:
+                for line in fh:
+                    if line.strip():
+                        e = parse_line(line)
+                        if e.kind == "mem":
+                            mems.append(e)
+        except (OSError, ValueError) as e:
+            findings.append(Finding(
+                "DSTPU104", "error",
+                f"--audit-step mem: {what} event stream did not parse "
+                f"({e})", eqn_path="mem/stream"))
+            return None
+        if not mems:
+            findings.append(Finding(
+                "DSTPU104", "error",
+                f"--audit-step mem: the armed {what} run emitted no "
+                "`mem` events", eqn_path="mem/stream"))
+        return mems
+
+    # ---- train twin --------------------------------------------------
+    data = (np.ones((8, 16), np.float32), np.ones((8, 16), np.float32))
+    dataset = [(data[0][i], data[1][i]) for i in range(8)]
+    mon_dir = tempfile.mkdtemp(prefix="dstpu-audit-mem-")
+
+    def build(mon_cfg):
+        cfg = {"train_micro_batch_size_per_gpu": 4,
+               "gradient_accumulation_steps": 1,
+               "steps_per_print": 10 ** 9,
+               "bf16": {"enabled": True},
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": 2},
+               "compile_cache": {"dir": cache_dir}}
+        if mon_cfg:
+            cfg["monitor"] = mon_cfg
+        return ds.initialize(config=cfg, model=_MLP(),
+                             training_data=dataset)[0]
+
+    try:
+        off = build(None)
+        armed = build({"enabled": True, "dir": mon_dir,
+                       "sinks": ["jsonl"], "interval": 1,
+                       "memory_interval": 1})
+        if train_step_jaxpr_text(off) != train_step_jaxpr_text(armed):
+            findings.append(Finding(
+                "DSTPU201", "error",
+                "--audit-step mem: arming the memory ledger CHANGED the "
+                "traced train step (jaxpr ledger-on != ledger-off) — "
+                "attribution leaked into the compiled program",
+                eqn_path="mem/jaxpr-equality"))
+        off.close()
+        armed.train_batch()
+        armed.train_batch()
+        report = audit_engine(armed)
+        for f in report.findings:
+            f.extra = dict(f.extra, audit="mem-armed")
+        findings.extend(report.findings)
+        armed.monitor.flush()
+        mems = read_mems(mon_dir, "train")
+        if mems:
+            fields = mems[-1].fields
+            hbm = fields.get("hbm") or {}
+            missing = {"params", "master_fp32", "opt_moments"} - set(hbm)
+            if missing:
+                findings.append(Finding(
+                    "DSTPU104", "error",
+                    f"--audit-step mem: train ledger attribution is "
+                    f"missing {sorted(missing)} (got {sorted(hbm)})",
+                    eqn_path="mem/attribution"))
+            if "host_residual_bytes" not in fields:
+                findings.append(Finding(
+                    "DSTPU104", "warning",
+                    "--audit-step mem: no host residual in the train "
+                    "ledger (host RSS unreadable?)",
+                    eqn_path="mem/residual"))
+        armed.close()
+    finally:
+        shutil.rmtree(mon_dir, ignore_errors=True)
+
+    # ---- serving twin ------------------------------------------------
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
+                                         Request)
+    cfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0))
+    scfg = dict(batch_slots=2, block_size=8, max_new_tokens=4,
+                preflight=False)
+
+    def decode_jaxpr(srv):
+        srv._build_decode()
+        return str(jax.make_jaxpr(srv._decode)(*srv._decode_args()))
+
+    clean = ServingEngine(model=model, params=params,
+                          config=ServingConfig(**scfg))
+    clean_jaxpr = decode_jaxpr(clean)
+    clean.close()
+    run_dir = tempfile.mkdtemp(prefix="dstpu-audit-mem-srv-")
+    try:
+        armed = ServingEngine(
+            model=model, params=params,
+            monitor=Monitor(run_dir=run_dir, role="serving"),
+            config=ServingConfig(**scfg))
+        if decode_jaxpr(armed) != clean_jaxpr:
+            findings.append(Finding(
+                "DSTPU201", "error",
+                "--audit-step mem: arming the monitor+ledger CHANGED "
+                "the traced decode step (jaxpr armed != disarmed)",
+                eqn_path="mem/jaxpr-equality"))
+        # enough decode steps to cross the serving ledger cadence
+        armed.run([Request(tokens=np.arange(4), max_new_tokens=18,
+                           uid=u) for u in range(2)])
+        armed.close()
+        mems = read_mems(run_dir, "serving")
+        if mems:
+            hbm = mems[-1].fields.get("hbm") or {}
+            if "paged_kv_pool" not in hbm:
+                findings.append(Finding(
+                    "DSTPU104", "error",
+                    f"--audit-step mem: serving ledger attribution is "
+                    f"missing the paged_kv_pool (got {sorted(hbm)})",
+                    eqn_path="mem/attribution"))
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+    return findings
+
+
 def _audit_elastic_resume():
     """--audit-step elastic: audit the FIRST compiled step after an elastic
     reshard-on-resize (docs/elasticity.md) — a ZeRO-2 elastic engine saves
@@ -671,7 +832,13 @@ def main(argv=None):
                          "decode step jaxpr-identical (zero host "
                          "callbacks, donation honored) while emitting "
                          "parseable trace events with monotone spans "
-                         "(docs/monitoring.md#request-tracing)")
+                         "(docs/monitoring.md#request-tracing); 'mem' "
+                         "proves the memory ledger leaves BOTH the "
+                         "compiled train step and the serving decode "
+                         "step byte-identical ledger-on vs off while "
+                         "its schema-v3 `mem` events parse and name "
+                         "the expected subsystems "
+                         "(docs/monitoring.md#memory-explainability)")
     args = ap.parse_args(argv)
 
     # findings are the stdout payload (the tier-1 gate parses --json);

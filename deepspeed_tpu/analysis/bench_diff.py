@@ -16,7 +16,8 @@ Metric classification (by key name, innermost key of the JSON path):
 - **lower-better** (latency/cost family): keys ending in ``_ms``/``_s``
   (``p50_ms``, ``p99_ms``, ``ttft_*``, ``prefill_ms``, compile times),
   ``ms_per_token*``, ``*_bytes``/``*_bytes_per_step`` (wire/pool cost),
-  ``host_pct``/``overhead_pct``;
+  ``host_pct``/``overhead_pct``, and the memory family
+  (``rss_hwm_gb``, ``pool_bytes``, ``peak_bytes`` — capacity costs);
 - everything else numeric is **informational** — reported when it moved,
   never gated (counts, shapes, config echoes).
 
@@ -43,6 +44,10 @@ HIGHER_BETTER = ("tokens_per_sec", "tok_s", "samples_per_sec", "mfu",
 LOWER_BETTER_SUFFIX = ("_ms", "_s")
 LOWER_BETTER = ("ms_per_token", "overhead_pct", "host_pct")
 LOWER_BETTER_BYTES = ("wire_bytes", "bytes_per_step")
+# memory family (docs/monitoring.md#memory-explainability): host-RSS
+# high-water marks, KV-pool residency and projected/measured peaks are
+# capacity costs — growth beyond band is a regression
+LOWER_BETTER_MEM = ("rss_hwm_gb", "pool_bytes", "peak_bytes")
 
 
 def classify(key: str):
@@ -51,7 +56,7 @@ def classify(key: str):
     for name in HIGHER_BETTER:
         if name in k:
             return "higher"
-    for name in LOWER_BETTER + LOWER_BETTER_BYTES:
+    for name in LOWER_BETTER + LOWER_BETTER_BYTES + LOWER_BETTER_MEM:
         if name in k:
             return "lower"
     if k.endswith(LOWER_BETTER_SUFFIX):

@@ -49,13 +49,12 @@ def model_state_bytes_per_chip(num_params: int, zero_stage: int,
 
 
 def get_hbm_bytes() -> int:
-    try:
-        stats = jax.devices()[0].memory_stats()
-        if stats and "bytes_limit" in stats:
-            return int(stats["bytes_limit"])
-    except Exception:
-        pass
-    return DEFAULT_HBM_BYTES
+    """Per-chip HBM budget through the shared ``monitor/gauges``
+    helper — which also carries the CPU-backend fallback this site
+    previously lacked (a bare ``memory_stats()`` on the CPU backend
+    returns None; the sweep then planned against garbage)."""
+    from ..monitor.gauges import hbm_limit_bytes
+    return hbm_limit_bytes(default=DEFAULT_HBM_BYTES)
 
 
 # ------------------------------------------------------------------- tuners

@@ -464,13 +464,8 @@ class ServingEngine:
     def _budget_bytes(self) -> Optional[int]:
         if self.config.hbm_budget_bytes is not None:
             return int(self.config.hbm_budget_bytes)
-        try:
-            stats = jax.devices()[0].memory_stats() or {}
-            if stats.get("bytes_limit"):
-                return int(stats["bytes_limit"])
-        except Exception:
-            pass
-        return None
+        from ..monitor.gauges import hbm_limit_bytes
+        return hbm_limit_bytes()
 
     def _preflight_gate(self):
         """Refuse to serve a configuration whose decode step cannot fit
@@ -491,13 +486,21 @@ class ServingEngine:
             self._preflight_done = True
             return
         if pre["peak_bytes"] > budget * self.config.preflight_safety:
+            # pre-written post-mortem: the ledger + capacity verdict name
+            # which subsystem blew the budget and which knob buys
+            # headroom (docs/monitoring.md#memory-explainability)
+            path = self._memory_forensics(
+                f"serving preflight: peak {pre['peak_bytes']} B over "
+                f"budget {budget} B", budget_bytes=budget,
+                extra={"preflight": pre})
             raise MemoryError(
                 f"serving preflight: decode step peak "
                 f"{pre['peak_bytes'] / 1e9:.2f} GB exceeds "
                 f"{self.config.preflight_safety:.0%} of the "
                 f"{budget / 1e9:.2f} GB budget — shrink num_blocks/"
                 "batch_slots, use kv_bits=8, or quantize the weights "
-                "(docs/serving.md capacity math)")
+                "(docs/serving.md capacity math)"
+                + (f"; memory forensics: {path}" if path else ""))
         self._preflight_done = True
 
     # ------------------------------------------------------------ submission
@@ -1139,6 +1142,11 @@ class ServingEngine:
         req: Request = self.queue[0]
         nb = pk.blocks_needed(len(req.tokens) + req.max_new_tokens,
                               c.block_size)
+        # admission failure: the ledger dump makes the block math a
+        # forensic artifact, not just an exception message
+        path = self._memory_forensics(
+            f"serving admission stalled: head uid {req.uid} needs {nb} "
+            f"block(s), allocator has {self.allocator.free_blocks} free")
         raise ServingStalledError(
             f"serving stalled: {len(self.queue)} request(s) queued, zero "
             f"slots active, and admission made no progress — head uid "
@@ -1147,7 +1155,8 @@ class ServingEngine:
             f"new) / block_size {c.block_size})) but the allocator has "
             f"{self.allocator.free_blocks} free of "
             f"{self.num_blocks - 1} allocatable "
-            f"({self.allocator.used_blocks} leaked or still held)")
+            f"({self.allocator.used_blocks} leaked or still held)"
+            + (f"; memory forensics: {path}" if path else ""))
 
     # decode steps between latency-percentile/hist emissions: quantile
     # walks are cheap (O(buckets)) but need not run per generated token
@@ -1159,6 +1168,18 @@ class ServingEngine:
         Cheap counters ride every emitted step; the percentile gauges
         (a sort over the completion windows) ride a coarser cadence."""
         mon = self.monitor
+        # memory-ledger cadence: the monitor's `memory_interval` when it
+        # carries one (config-built monitors; 0 = the documented off
+        # switch), else the serving role default.  Independent of
+        # monitor.interval thinning: the cadence is the documented one,
+        # not the lcm.  Static terms latched — memory_ledger._static_terms.
+        mem_every = mon.memory_interval
+        if mem_every is None:
+            mem_every = self._PERCENTILES_EVERY
+        if (mon.armed and mon.bus is not None and mon.bus.sinks
+                and mem_every and self._steps % mem_every == 0):
+            from ..monitor import memory_ledger as mled
+            mled.attribute_serving(self).emit(mon, step=self._steps)
         if not mon.armed or not mon.should_emit(self._steps):
             mon.end_step(self._steps, name="serving_step")
             return
@@ -1273,6 +1294,45 @@ class ServingEngine:
             wire_bytes=fields["wire_bytes"],
             gather_bytes=fields["gather_bytes"],
             n_chips=fields["n_chips"])
+
+    # ------------------------------------------------------------ memory ledger
+    def memory_ledger(self) -> dict:
+        """One memory-ledger snapshot (``monitor/memory_ledger.py``):
+        weights, the paged-KV pool with its in-use block split, decode +
+        per-bucket prefill executables, compile-cache disk, measured
+        gauges, and the explicit residual.  Host-side reads only — the
+        compiled decode step is byte-identical ledger-on vs off
+        (``--audit-step mem``)."""
+        from ..monitor import memory_ledger as mled
+        return mled.attribute_serving(self).snapshot()
+
+    def _memory_forensics(self, reason, budget_bytes=None, extra=None):
+        """Ledger + capacity-verdict dump for a memory-shaped failure
+        (preflight over budget, admission stall).  Best-effort; returns
+        the path or None and never masks the raise it accompanies.
+        Needs an explicitly configured ``forensic_dir``/``journal_dir``
+        — unlike the breaker (whose dump IS the event record), a
+        memory dump must not litter the launch cwd of every
+        mis-submitted request."""
+        from ..monitor import memory_ledger as mled
+        dirpath = self.config.forensic_dir or self.config.journal_dir
+        if not dirpath:
+            return None
+        try:
+            path = mled.oom_forensics(
+                dirpath, self.memory_ledger(), reason=reason,
+                budget_bytes=budget_bytes,
+                filename=f"serving_memory_forensics_step"
+                         f"{self._steps}.json", extra=extra)
+        except Exception as e:
+            logger.warning(f"serving memory forensics unavailable ({e})")
+            return None
+        mon = self.monitor
+        if path and mon.armed:
+            mon.artifact("memory_forensics", path, step=self._steps,
+                         reason=str(reason)[:200])
+            mon.flush()
+        return path
 
     def run(self, requests=None, max_steps: int = 10 ** 6) -> Dict[int, dict]:
         """Submit ``requests`` (if given) and drive :meth:`step` until

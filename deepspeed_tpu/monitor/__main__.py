@@ -75,6 +75,7 @@ class Aggregate:
         self.hists = {}               # name -> latest hist event fields
         self.traces = 0               # request traces seen
         self.last_trace = None        # newest trace event fields
+        self.mem = None               # newest memory-ledger event fields
         self.events = 0
         self.skips_total = 0
         self.last_t = None
@@ -104,6 +105,8 @@ class Aggregate:
             elif e.kind == "trace":
                 self.traces += 1
                 self.last_trace = e.fields
+            elif e.kind == "mem":
+                self.mem = e.fields
 
 
 def _fmt(v, unit=""):
@@ -184,6 +187,24 @@ def render(agg: Aggregate, source: str, clock=time.time) -> str:
                 f"p999 {_fmt(p['p999'])} (n={h.count})")
         if parts:
             lines += ["-" * 78, "hist: " + "  |  ".join(parts)]
+    if agg.mem:
+        # memory-ledger line (docs/monitoring.md#memory-explainability):
+        # top attributed subsystems per space + the explicit residual
+        m = agg.mem
+        parts = []
+        for space in ("hbm", "host"):
+            entries = m.get(space) or {}
+            if not entries:
+                continue
+            top = sorted(entries.items(), key=lambda kv: -kv[1])[:3]
+            inner = " ".join(f"{k}={_fmt(v, 'B')}" for k, v in top)
+            parts.append(f"{space} {_fmt(sum(entries.values()), 'B')} "
+                         f"({inner})")
+        resid = m.get("host_residual_bytes")
+        if resid is not None:
+            parts.append(f"residual {_fmt(resid, 'B')}")
+        parts.append(f"rss hwm {_fmt(m.get('rss_hwm_gb'))}GB")
+        lines += ["-" * 78, "mem: " + "  |  ".join(parts)]
     if agg.traces:
         lt = agg.last_trace or {}
         lines.append(

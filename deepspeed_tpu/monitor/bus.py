@@ -81,6 +81,13 @@ class MonitorBus:
         self.emit(Event(kind="trace", name=name, t=self._clock(),
                         step=step, fields=fields))
 
+    def mem(self, name, step=None, **fields):
+        """One memory-ledger snapshot (schema-v3 ``mem`` event;
+        docs/monitoring.md#memory-explainability) — per-subsystem
+        attributed bytes + measured gauges + the residual."""
+        self.emit(Event(kind="mem", name=name, t=self._clock(),
+                        step=step, fields=fields))
+
     # -------------------------------------------------------------- lifecycle
     def flush(self):
         for sink in tuple(self._sinks):

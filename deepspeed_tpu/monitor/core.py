@@ -69,6 +69,7 @@ class NullMonitor:
     bus = None
     ring = None
     run_dir = None
+    memory_interval = None
 
     def span(self, name):
         return _NULL_CTX
@@ -107,6 +108,9 @@ class NullMonitor:
     def trace(self, *a, **kw):
         pass
 
+    def mem(self, *a, **kw):
+        pass
+
     def trace_before_step(self, step_no):
         pass
 
@@ -127,10 +131,16 @@ class Monitor:
 
     def __init__(self, *, run_dir=None, sinks=("jsonl", "ring"),
                  interval=1, trace_steps=None, ring_size=1024, retry=None,
-                 role="train", clock=time.time):
+                 role="train", clock=time.time, memory_interval=None):
         self.run_dir = run_dir
         self.role = role
         self.interval = max(1, int(interval))
+        # memory-ledger cadence carried WITH the monitor so consumers
+        # that never see the config block (ServingEngine takes a Monitor
+        # object) still honor `monitor.memory_interval` — None means
+        # "use the consumer's role default", 0 disables the ledger
+        self.memory_interval = (None if memory_interval is None
+                                else int(memory_interval))
         self.spans = SpanRecorder()
         self.ring = None
         built = []
@@ -305,6 +315,10 @@ class Monitor:
         self.bus.trace(name, step=step if step is not None
                        else self._last_step, **fields)
 
+    def mem(self, name, step=None, **fields):
+        self.bus.mem(name, step=step if step is not None
+                     else self._last_step, **fields)
+
     # ----------------------------------------------------------------- trace
     def trace_before_step(self, step_no):
         if self._trace is not None:
@@ -366,4 +380,5 @@ def from_config(cfg, *, override_enabled=None, retry=None, role="train"):
         return NullMonitor()
     return Monitor(run_dir=resolve_run_dir(cfg.dir), sinks=cfg.sinks,
                    interval=cfg.interval, trace_steps=cfg.trace_steps,
-                   ring_size=cfg.ring_size, retry=retry, role=role)
+                   ring_size=cfg.ring_size, retry=retry, role=role,
+                   memory_interval=getattr(cfg, "memory_interval", None))

@@ -17,7 +17,12 @@ and offline consumers parse exactly one format:
                distributions that replicas/restarts can merge (v2);
 - ``trace``    one finished request's host-side trace: queue-wait /
                prefill / per-decode-step spans + TTFT + outcome,
-               exportable as Chrome trace-event JSON (v2).
+               exportable as Chrome trace-event JSON (v2);
+- ``mem``      one memory-ledger snapshot (``monitor/memory_ledger.py``):
+               device HBM and host RSS attributed to named subsystems,
+               with the measured-minus-attributed *residual* and the
+               per-phase host RSS high-water marks — what ``ds_mem``
+               and the ``ds_top`` memory line read (v3).
 
 The wire format is one JSON object per line, ``sort_keys`` + compact
 separators, ``None`` fields dropped; non-finite floats are serialized as
@@ -39,14 +44,15 @@ import json
 import math
 from typing import Any, Dict, Optional
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 EVENT_KINDS = ("step", "span", "gauge", "counter", "artifact", "hist",
-               "trace")
+               "trace", "mem")
 
 # schema version that introduced each kind (absent -> 1); events stamp
-# this, so v1 consumers keep parsing v1 kinds from a v2 producer
-KIND_VERSIONS = {"hist": 2, "trace": 2}
+# this, so a v1/v2 consumer keeps parsing the kinds it knows from a v3
+# producer and count-and-skips exactly the newer ones
+KIND_VERSIONS = {"hist": 2, "trace": 2, "mem": 3}
 
 
 def _scalar(v):

@@ -7,6 +7,8 @@ never anything that forces a sync.  ``bench.py`` imports
 compute against the same peak table.
 """
 
+import os
+
 import numpy as np
 
 # Per-chip-generation nominal capability table (public datasheet
@@ -58,16 +60,69 @@ def peak_flops_per_chip() -> float:
     return chip_specs()["peak_bf16_flops"]
 
 
+def memory_stats() -> dict:
+    """THE shared ``memory_stats()`` read site (raw backend dict, or
+    ``{}``).
+
+    Every consumer — :func:`device_memory`, the serving HBM budget,
+    ``runtime/utils.see_memory_usage``, ``utils/timer.memory_usage``,
+    the autotuner's HBM probe — reads through here instead of each
+    calling ``jax.devices()[0].memory_stats()`` with its own (or no)
+    error handling.  This container's CPU and tunneled TPU runtimes both
+    return None from the backend: callers needing a *peak* fall back to
+    the compiled executable's ``memory_analysis()`` projection
+    (:func:`executable_peak_bytes` / ``engine.preflight_memory`` — the
+    documented preflight fallback), callers needing a *budget* fall back
+    to a generation table or their own default."""
+    try:
+        import jax
+        return jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def hbm_limit_bytes(default=None):
+    """The backend's per-device memory budget (``bytes_limit``), or
+    ``default`` when the backend exposes no stats (CPU, tunneled TPU
+    runtimes) — the shared denominator of every HBM preflight gate."""
+    limit = memory_stats().get("bytes_limit")
+    return int(limit) if limit else default
+
+
+def host_rss_bytes() -> int:
+    """Current host resident-set bytes of this process (Linux
+    ``/proc/self/statm``; 0 where unavailable) — the live host-memory
+    gauge the memory ledger reconciles its attributions against."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def host_rss_hwm_bytes() -> int:
+    """Host RSS high-water mark of this process via ``ru_maxrss``.
+
+    Unit note (so the conversion stops being re-derived per call site):
+    on **Linux** ``ru_maxrss`` is in **kilobytes** (KiB), on macOS it is
+    in bytes — this helper returns BYTES on both.  The MAXPARAMS rungs'
+    ``rss_hwm_gb`` figures are this reading divided by 2**30."""
+    try:
+        import resource
+        import sys
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(rss) if sys.platform == "darwin" else int(rss) * 1024
+    except Exception:
+        return 0
+
+
 def device_memory() -> dict:
     """Live device-memory gauges from the backend's ``memory_stats()``,
     or ``{}`` when the backend exposes none (this container's CPU and
     tunneled TPU runtimes both return None — callers fall back to the
     executable's ``memory_analysis()`` projection)."""
-    try:
-        import jax
-        stats = jax.local_devices()[0].memory_stats() or {}
-    except Exception:
-        return {}
+    stats = memory_stats()
     out = {}
     if stats.get("bytes_in_use") is not None:
         out["device_mem_in_use"] = int(stats["bytes_in_use"])

@@ -1,0 +1,394 @@
+"""Memory ledger: attribute device HBM and host RSS to named subsystems.
+
+PR 12's ``ds_explain`` made *time* explainable; this module is its memory
+sibling (docs/monitoring.md#memory-explainability).  Memory was
+discovered by OOM: the MAXPARAMS campaign burned four multi-hour 6.7B
+attempts learning that the host budget was blown by a term nobody had a
+name for.  The ledger gives every byte a name:
+
+- **device HBM** — params / fp32 master / optimizer moments / qgZ
+  error-feedback state, read from the live ``TrainState`` leaves (their
+  avals + shardings make the per-subsystem bytes exact, per the ZeRO
+  layout rules of arXiv 1910.02054); the paged-KV pool + per-request
+  blocks (``inference/paged_kv.py``); compiled-program bytes of the live
+  executables (train step, decode step, every prefill bucket);
+- **host RSS** — the offload tier's fp32 master, fp32 gradient landing
+  buffer, 16-bit payload image and Adam moments
+  (``zero/offload_engine.py``), H2D staging pairs (``zero/wire.py``),
+  NVMe swap buffer pools (``runtime/swap_tensor/``);
+- **disk** — compile-cache entries and NVMe swap files (named so a full
+  scratch volume is attributable too);
+- **residual** — measured − attributed, per space: the *unexplained*
+  term.  On the host this is exactly the "~23 GB client term" of the
+  6.7B post-mortem (MAXPARAMS.json) — the ledger does not hide it, it
+  names it, and ``analysis/capacity.py`` *fits* it from the committed
+  rungs so the capacity model predicts it.
+
+Discipline (the PR-9 contract): everything here is a HOST-SIDE read of
+already-materialized state — array metadata (``nbytes``, shardings),
+``memory_stats()``, ``/proc`` — never a device sync, never anything
+traced into a step.  Compiled train + decode steps are byte-identical
+ledger-on vs off (``--audit-step mem``).
+
+Snapshots ride the bus as schema-v3 ``mem`` events, render as the
+``ds_top`` memory line, feed ``bin/ds_mem``, and are dumped through
+``runtime/health.write_forensics`` on RESOURCE_EXHAUSTED / preflight /
+admission failures — the OOM post-mortem arrives pre-written.
+"""
+
+import time
+
+from ..utils.logging import logger
+from . import gauges
+
+# canonical subsystem names (the taxonomy docs/monitoring.md documents;
+# analysis/capacity.py keys its closed-form formulas and knob advice on
+# the same strings)
+PARAMS = "params"
+MASTER = "master_fp32"
+OPT_MOMENTS = "opt_moments"
+EF_STATE = "ef_state"
+COMPILED_PROGRAMS = "compiled_programs"
+PAGED_KV_POOL = "paged_kv_pool"
+HOST_MASTER = "host_master_fp32"
+HOST_GRAD_LANDING = "host_grad_landing_fp32"
+HOST_PAYLOAD_IMAGE = "host_payload_image_16bit"
+HOST_MOMENTS = "host_adam_moments"
+H2D_STAGING = "h2d_staging"
+NVME_SWAP_BUFFERS = "nvme_swap_buffers"
+COMPILE_CACHE = "compile_cache"
+RESIDUAL = "residual"
+
+SPACES = ("hbm", "host", "disk")
+
+# host RSS high-water-mark bracket phases (module docstring;
+# RssPhases.mark is called by the engine at each boundary)
+PHASE_INIT = "init"
+PHASE_FIRST_COMPILE = "first_compile"
+PHASE_STEADY = "steady_step"
+
+
+def tree_device_bytes(tree) -> int:
+    """Total bytes a pytree's leaves occupy across this process's
+    addressable devices.  Replicated leaves count once per local device
+    (that is what they cost); a plain numpy leaf counts its ``nbytes``."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is not None:
+            try:
+                total += sum(int(s.data.nbytes) for s in shards)
+                continue
+            except Exception:
+                pass
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def _np_bytes(*arrays) -> int:
+    return sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays
+               if a is not None)
+
+
+def _swapper_pool_bytes(*swappers) -> int:
+    """Host bytes of the NVMe swappers' buffer pools (best-effort duck
+    walk: ``SwapBufferPool.buffers`` each wrap one numpy array)."""
+    total = 0
+    for sw in swappers:
+        if sw is None:
+            continue
+        for holder in (sw, getattr(sw, "async_swapper", None),
+                       getattr(sw, "swapper", None)):
+            pool = getattr(holder, "_pool", None)
+            for buf in getattr(pool, "buffers", ()) or ():
+                total += _np_bytes(getattr(buf, "data", None))
+    return total
+
+
+def _uploader_bytes(uploader) -> int:
+    """Host bytes held by an ``H2DUploader``: the reusable staging pool
+    plus pairs still parked/fresh (their buffers are referenced until
+    the recycling barrier proves the DMA landed)."""
+    if uploader is None:
+        return 0
+    total = _np_bytes(*getattr(uploader, "_staging", ()))
+    for pairs in (getattr(uploader, "_fresh", ()),
+                  getattr(uploader, "_settled", ())):
+        for _, buf, _ in pairs:
+            total += _np_bytes(buf)
+    return total
+
+
+def _exe_code_bytes(*wrapped) -> int:
+    """Generated-code bytes of the live executables behind CachedStep
+    wrappers (every signature counts: each holds its program in HBM)."""
+    from ..runtime.compile_cache import executable_memory_analysis
+    total = 0
+    for fn in wrapped:
+        for entry in (getattr(fn, "_exes", {}) or {}).values():
+            ma = executable_memory_analysis(entry[0])
+            if ma:
+                total += int(ma.get("generated_code_bytes", 0) or 0)
+    return total
+
+
+def _live_signatures(*wrapped) -> int:
+    return sum(len(getattr(fn, "_exes", {}) or {}) for fn in wrapped)
+
+
+def _static_terms(holder, key, compute):
+    """Memoize the near-constant ledger terms (executable program bytes,
+    compile-cache disk scan) on the attributed object, keyed by the live
+    program population.  The periodic ledger pass runs on the serving
+    hot loop: re-pricing every executable's ``memory_analysis()`` and
+    re-walking the cache directory per emission would inflate exactly
+    the host-gap term ``ds_explain`` measures (and re-log the
+    no-analysis warning per pass on backends without one).  A new
+    compile — the only event that changes these terms — changes the
+    signature key and invalidates."""
+    cached = getattr(holder, "_mled_static", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    val = compute()
+    try:
+        holder._mled_static = (key, val)
+    except AttributeError:
+        pass
+    return val
+
+
+class RssPhases:
+    """Host RSS high-water marks bracketed per wall-clock phase.
+
+    ``mark(phase)`` records the HWM at a phase boundary; ``deltas()``
+    reports, per phase, the HWM at its end and the growth since the
+    previous mark.  Because ``ru_maxrss`` is monotone, a delta is the
+    growth *observed by* that bracket — growth inside a later phase
+    never back-dates into an earlier one."""
+
+    def __init__(self):
+        self.marks = []               # (phase, hwm_bytes, unix time)
+
+    def mark(self, phase: str):
+        self.marks.append((phase, gauges.host_rss_hwm_bytes(), time.time()))
+
+    def mark_latest(self, phase: str):
+        """Advance (or create) the NEWEST mark for ``phase``: the
+        steady-step bracket re-marks on every ledger emission, so its
+        delta tracks the current HWM against the last pre-steady
+        boundary instead of freezing at the first steady step."""
+        if self.marks and self.marks[-1][0] == phase:
+            self.marks.pop()
+        self.mark(phase)
+
+    def deltas(self):
+        out = []
+        prev = 0
+        for phase, hwm, t in self.marks:
+            out.append({"phase": phase, "rss_hwm_bytes": hwm,
+                        "delta_bytes": max(0, hwm - prev), "t": t})
+            prev = max(prev, hwm)
+        return out
+
+
+class MemoryLedger:
+    """One attribution pass: named subsystems per space, measured
+    gauges, and the explicit residual."""
+
+    def __init__(self, role="train"):
+        self.role = role
+        self.entries = {s: {} for s in SPACES}
+
+    def add(self, space: str, subsystem: str, nbytes, **detail):
+        if not nbytes:
+            return
+        ent = self.entries[space].setdefault(
+            subsystem, {"bytes": 0, **detail})
+        ent["bytes"] += int(nbytes)
+        ent.update(detail)
+
+    def attributed(self, space: str) -> int:
+        return sum(e["bytes"] for e in self.entries[space].values())
+
+    def snapshot(self, phases=None) -> dict:
+        """The emission payload: per-space subsystem bytes, measured
+        gauges, residuals (measured − attributed; None where the backend
+        exposes no measurement), and the RSS phase brackets."""
+        dev = gauges.device_memory()
+        rss = gauges.host_rss_bytes()
+        hwm = gauges.host_rss_hwm_bytes()
+        out = {
+            "role": self.role,
+            "hbm": {k: v["bytes"] for k, v in self.entries["hbm"].items()},
+            "host": {k: v["bytes"] for k, v in self.entries["host"].items()},
+            "disk": {k: v["bytes"] for k, v in self.entries["disk"].items()},
+            # per-subsystem detail kwargs (the paged pool's in-use block
+            # split, prefill bucket count, cache entry count, moments
+            # tier): the forensic dump and ds_mem read these — the byte
+            # maps above stay flat ints for verdicts/rendering
+            "detail": {
+                space: {k: {dk: dv for dk, dv in v.items()
+                            if dk != "bytes"}
+                        for k, v in self.entries[space].items()
+                        if len(v) > 1}
+                for space in SPACES
+                if any(len(v) > 1 for v in self.entries[space].values())},
+            "hbm_attributed_bytes": self.attributed("hbm"),
+            "host_attributed_bytes": self.attributed("host"),
+            "host_rss_bytes": rss,
+            "rss_hwm_bytes": hwm,
+            "rss_hwm_gb": round(hwm / 2**30, 2),
+        }
+        if not out["detail"]:
+            del out["detail"]
+        if dev.get("device_mem_in_use") is not None:
+            out["hbm_measured_bytes"] = dev["device_mem_in_use"]
+            out["hbm_residual_bytes"] = (dev["device_mem_in_use"]
+                                         - out["hbm_attributed_bytes"])
+        if rss:
+            # the honest term: what the process holds that no subsystem
+            # claims (allocator slack, runtime client buffers, Python) —
+            # capacity.py fits its params-scaling from MAXPARAMS rungs
+            out["host_residual_bytes"] = rss - out["host_attributed_bytes"]
+        if phases is not None:
+            out["phases"] = phases.deltas()
+        return out
+
+    def emit(self, monitor, step=None, phases=None, name="memory"):
+        """One schema-v3 ``mem`` event on the bus (host-side only — the
+        compiled step never sees this)."""
+        if not getattr(monitor, "armed", False):
+            return None
+        snap = self.snapshot(phases=phases)
+        monitor.mem(name, step=step, **snap)
+        return snap
+
+
+# --------------------------------------------------------- attribution passes
+
+def attribute_engine(engine) -> MemoryLedger:
+    """Ledger pass over a live :class:`DeepSpeedEngine`: TrainState
+    subsystems from the actual leaves (exact — avals + shardings),
+    offload-tier host buffers, H2D staging, NVMe swap pools, compiled
+    programs, compile-cache disk."""
+    led = MemoryLedger(role="train")
+    state = getattr(engine, "state", None)
+    if state is not None:
+        led.add("hbm", PARAMS, tree_device_bytes(state.params))
+        if state.master is not None:
+            led.add("hbm", MASTER, tree_device_bytes(state.master))
+        if state.opt_state is not None:
+            led.add("hbm", OPT_MOMENTS, tree_device_bytes(state.opt_state))
+        if state.comm_error is not None:
+            led.add("hbm", EF_STATE, tree_device_bytes(state.comm_error))
+    steps = (getattr(engine, "_jit_train_step", None),
+             getattr(engine, "_jit_grad_step", None),
+             getattr(engine, "_jit_eval", None))
+    code, cache_term = _static_terms(
+        engine, _live_signatures(*steps),
+        lambda: (_exe_code_bytes(*steps),
+                 _cache_bytes(getattr(engine, "compile_cache", None))))
+    led.add("hbm", COMPILED_PROGRAMS, code)
+    if cache_term:
+        led.add("disk", COMPILE_CACHE, cache_term[0],
+                entries=cache_term[1])
+
+    off = getattr(engine, "_offload", None)
+    if off is not None:
+        led.add("host", HOST_MASTER, _np_bytes(off.master),
+                numel=int(off.numel))
+        led.add("host", HOST_GRAD_LANDING, _np_bytes(off._flat32))
+        led.add("host", HOST_PAYLOAD_IMAGE, _np_bytes(off._out16))
+        led.add("host", HOST_MOMENTS, _np_bytes(off.m, off.v),
+                tier="nvme" if off.nvme else "cpu")
+        led.add("host", NVME_SWAP_BUFFERS,
+                _swapper_pool_bytes(getattr(off, "swapper", None)))
+    staging = _uploader_bytes(getattr(engine, "_h2d", None))
+    ps = getattr(engine, "_param_stream", None)
+    if ps is not None:
+        staging += _uploader_bytes(getattr(ps, "_h2d", None))
+        led.add("host", NVME_SWAP_BUFFERS,
+                _swapper_pool_bytes(getattr(ps, "swapper", None)))
+    led.add("host", H2D_STAGING, staging)
+    return led
+
+
+def _cache_bytes(cache):
+    """``(total_bytes, entries)`` of a compile cache's on-disk store, or
+    None — computed under :func:`_static_terms`' latch (the directory
+    walk must not run per ledger emission)."""
+    if cache is None:
+        return None
+    try:
+        rep = cache.report()
+        return (rep.get("total_bytes", 0), rep.get("entries", 0))
+    except OSError as e:
+        logger.warning(f"memory ledger: compile-cache scan failed ({e})")
+        return None
+
+
+def attribute_serving(srv) -> MemoryLedger:
+    """Ledger pass over a live :class:`ServingEngine`: weights, the
+    paged-KV pool (with the in-use block split — the per-request term),
+    decode + per-bucket prefill executables, compile-cache disk."""
+    from ..inference import paged_kv as pk
+    led = MemoryLedger(role="serving")
+    pool = getattr(srv, "pool", None)
+    if pool is not None:
+        total = pk.pool_bytes(pool)
+        per_block = total // max(1, srv.num_blocks)
+        used = srv.allocator.used_blocks
+        led.add("hbm", PAGED_KV_POOL, total,
+                blocks=srv.num_blocks, used_blocks=used,
+                request_blocks_bytes=used * per_block,
+                free_blocks=srv.allocator.free_blocks)
+    fns = (srv._decode, *srv._prefills.values())
+    # weights are immutable for a serving engine's lifetime: latched
+    # with the other static terms so the periodic hot-loop pass never
+    # re-walks the params pytree (thousands of leaves on a real model)
+    code, cache_term, weights = _static_terms(
+        srv, (len(srv._prefills), _live_signatures(*fns)),
+        lambda: (_exe_code_bytes(*fns),
+                 _cache_bytes(getattr(srv.engine, "compile_cache",
+                                      None)),
+                 tree_device_bytes(srv.engine.params)))
+    led.add("hbm", PARAMS, weights)
+    led.add("hbm", COMPILED_PROGRAMS, code,
+            prefill_buckets=len(srv._prefills))
+    if cache_term:
+        led.add("disk", COMPILE_CACHE, cache_term[0],
+                entries=cache_term[1])
+    return led
+
+
+# -------------------------------------------------------------- OOM forensics
+
+def oom_forensics(dirpath, snapshot, *, reason, budget_bytes=None,
+                  space="hbm", filename=None, extra=None):
+    """Write the ledger + the capacity model's verdict as a forensic
+    JSON through the PR-3 ``write_forensics`` path (atomic, best-effort
+    — a dump failure never masks the OOM it accompanies).  ``space``
+    names the exhausted space (device allocator failures are ``"hbm"``,
+    an oom-killer SIGKILL is ``"host"``).  Returns the path or None."""
+    from ..analysis.capacity import verdict_from_snapshot
+    from ..runtime.health import write_forensics
+    payload = {
+        "event": "memory_forensics",
+        "reason": str(reason)[:2000],
+        "time_unix": time.time(),
+        "ledger": snapshot,
+        "verdict": verdict_from_snapshot(snapshot,
+                                         budget_bytes=budget_bytes,
+                                         space=space),
+    }
+    if extra:
+        payload.update(extra)
+    fname = filename or f"memory_forensics_{int(time.time())}.json"
+    path = write_forensics(dirpath, fname, payload)
+    if path:
+        logger.error(
+            f"memory forensics: {payload['verdict']['over_budget_subsystem']}"
+            f" named over budget — dump at {path} "
+            f"(knob: {payload['verdict']['advice']})")
+    return path

@@ -161,6 +161,13 @@ class DeepSpeedMonitorConfig:
                                               C.MONITOR_RING_SIZE_DEFAULT))
         if self.ring_size < 1:
             raise DeepSpeedConfigError("monitor.ring_size must be >= 1")
+        self.memory_interval = int(get_scalar_param(
+            m, C.MONITOR_MEMORY_INTERVAL,
+            C.MONITOR_MEMORY_INTERVAL_DEFAULT))
+        if self.memory_interval < 0:
+            raise DeepSpeedConfigError(
+                "monitor.memory_interval must be >= 0 (0 disables the "
+                "memory ledger)")
         trace = get_scalar_param(m, C.MONITOR_TRACE_STEPS,
                                  C.MONITOR_TRACE_STEPS_DEFAULT)
         if trace is not None:
@@ -177,6 +184,7 @@ class DeepSpeedMonitorConfig:
         return {"enabled": self.enabled, "sinks": list(self.sinks),
                 "dir": self.dir, "interval": self.interval,
                 "ring_size": self.ring_size,
+                "memory_interval": self.memory_interval,
                 "trace_steps": (list(self.trace_steps)
                                 if self.trace_steps else None)}
 

@@ -153,6 +153,9 @@ MONITOR_TRACE_STEPS = "trace_steps"
 MONITOR_TRACE_STEPS_DEFAULT = None     # [start, stop] -> jax.profiler window
 MONITOR_RING_SIZE = "ring_size"
 MONITOR_RING_SIZE_DEFAULT = 1024       # in-memory event ring length
+MONITOR_MEMORY_INTERVAL = "memory_interval"
+MONITOR_MEMORY_INTERVAL_DEFAULT = 50   # steps between memory-ledger `mem`
+#                                        events (0 disables the ledger)
 
 #############################################
 # Profiling

@@ -484,8 +484,20 @@ class DeepSpeedEngine:
         self.global_samples = 0
         if self.config.tensorboard.enabled:
             self._setup_tensorboard()
+        # ---- memory ledger (monitor/memory_ledger.py) ---------------------
+        # Host RSS HWM bracketed per wall-clock phase (init /
+        # first-compile / steady-step) + periodic `mem` events; the
+        # attribution is host-side reads only — the compiled step is
+        # byte-identical ledger-on vs off (--audit-step mem).
+        from ..monitor import memory_ledger as mled
+        self._rss_phases = mled.RssPhases()
+        self._rss_phases.mark(mled.PHASE_INIT)
+        self._mem_interval = self.config.monitor_config.memory_interval
+        self._oom_dumped = False
         if self.config.memory_breakdown:
-            see_memory_usage("Engine initialized", force=True)
+            see_memory_usage("Engine initialized", force=True,
+                             bus=self.monitor.bus if self.monitor.armed
+                             else None)
         if self.config.prescale_gradients or \
                 self.config.gradient_predivide_factor != 1.0:
             # reference: sum-allreduce with pre/post division to control
@@ -772,6 +784,44 @@ class DeepSpeedEngine:
             exe = fn.executable(self.state, batch, rng)
         from .compile_cache import executable_memory_analysis
         return executable_memory_analysis(exe)
+
+    def memory_ledger(self) -> dict:
+        """One memory-ledger snapshot (``monitor/memory_ledger.py``):
+        device HBM + host RSS attributed to named subsystems from the
+        LIVE state (TrainState leaves, offload-tier buffers, H2D
+        staging, NVMe swap pools, compiled programs, compile-cache
+        disk), the measured gauges, the explicit residual, and the
+        per-phase host-RSS high-water marks.  Host-side reads only."""
+        from ..monitor import memory_ledger as mled
+        return mled.attribute_engine(self).snapshot(
+            phases=self._rss_phases)
+
+    def _maybe_oom_forensics(self, exc):
+        """RESOURCE_EXHAUSTED post-mortem (docs/monitoring.md
+        #memory-explainability): dump the memory ledger + the capacity
+        model's verdict — which subsystem blew the budget and which knob
+        buys headroom — through the PR-3 ``write_forensics`` path, once,
+        then let the original error propagate.  Only inspects; never
+        swallows."""
+        if self._oom_dumped or "RESOURCE_EXHAUSTED" not in str(exc):
+            return
+        self._oom_dumped = True
+        from ..monitor import gauges as mg
+        from ..monitor import memory_ledger as mled
+        try:
+            snap = self.memory_ledger()
+            path = mled.oom_forensics(
+                self._forensic_dir(), snap, reason=exc,
+                budget_bytes=mg.hbm_limit_bytes(),
+                filename=f"memory_forensics_step"
+                         f"{self._global_steps_host}.json")
+        except Exception as e:      # a dump failure must never mask the OOM
+            logger.warning(f"memory forensics unavailable ({e})")
+            return
+        if path and self.monitor.armed:
+            self.monitor.artifact("memory_forensics", path,
+                                  step=self._global_steps_host)
+            self.monitor.flush()
 
     def close(self):
         """Release device state, live compiled executables and staging
@@ -1370,10 +1420,16 @@ class DeepSpeedEngine:
                              for mb in micro_batches]
         if self.curriculum_scheduler is not None:
             micro_batches = [self._apply_curriculum(mb) for mb in micro_batches]
-        if self._param_stream is not None:
-            return self._run_stream_step(micro_batches)
-        batch = self._stack_microbatches(micro_batches)
-        return self._run_fused_step(batch)
+        try:
+            if self._param_stream is not None:
+                return self._run_stream_step(micro_batches)
+            batch = self._stack_microbatches(micro_batches)
+            return self._run_fused_step(batch)
+        except Exception as e:
+            # an allocator OOM gets its post-mortem pre-written (ledger +
+            # capacity verdict); the error itself always propagates
+            self._maybe_oom_forensics(e)
+            raise
 
     def _apply_curriculum(self, mb):
         """Crop token sequences to the scheduled difficulty (reference:
@@ -1510,6 +1566,14 @@ class DeepSpeedEngine:
         # host sync (float()/block) only on steps that actually report — keeps
         # the hot path async so input prep overlaps device compute
         step_no = self._global_steps_host
+        # RSS HWM phase brackets (one getrusage read): init ended at
+        # __init__, first-compile ends with step 1, steady re-marks at
+        # the ledger cadence
+        from ..monitor import memory_ledger as mled
+        if step_no == 1:
+            self._rss_phases.mark(mled.PHASE_FIRST_COMPILE)
+        elif self._mem_interval and step_no % self._mem_interval == 0:
+            self._rss_phases.mark_latest(mled.PHASE_STEADY)
         reporting = step_no % self.config.steps_per_print == 0
         if reporting:
             self._report_progress(step_no, metrics)
@@ -1547,6 +1611,16 @@ class DeepSpeedEngine:
             gauges, counters = self._monitor_gauges_counters()
         spans = mon.end_step(step_no, scalars=scalars, gauges=gauges,
                              counters=counters)
+        if (self._mem_interval and mon.bus is not None and mon.bus.sinks
+                and step_no % self._mem_interval == 0):
+            # the memory ledger's periodic `mem` event (host-side reads
+            # only — the compiled step never sees this; --audit-step
+            # mem).  Gated on live sinks but NOT on monitor.interval:
+            # memory_interval alone sets this cadence, as documented —
+            # an interval-thinned monitor must not push it to the lcm.
+            from ..monitor import memory_ledger as mled
+            mled.attribute_engine(self).emit(mon, step=step_no,
+                                             phases=self._rss_phases)
         if self.config.wall_clock_breakdown and spans:
             for s in spans:
                 self.timers.record_span(s["name"], s["dur_s"])

@@ -124,25 +124,39 @@ def partition_balanced(weights, num_parts, eps=1e-3):
     return parts
 
 
-def see_memory_usage(message, force=False):
-    """Device + host memory report (parity: reference ``utils.py:818``)."""
+def see_memory_usage(message, force=False, bus=None):
+    """Device + host memory report (parity: reference ``utils.py:818``).
+
+    Readings come from the ONE shared helpers in ``monitor/gauges.py``
+    (``memory_stats`` for the device, ``host_rss_hwm_bytes`` for the
+    Linux ``ru_maxrss`` HWM — that helper's docstring owns the KB-unit
+    note, so the conversion is derived exactly once).  With ``bus``
+    (a ``MonitorBus``) the readings ALSO land as proper ``gauge``
+    events — the log line below is then just a sink-side rendering of
+    the same numbers, DSTPU104-consistent instead of a metrics
+    side-channel."""
     if not force:
         return
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        in_use = stats.get("bytes_in_use", 0) / 2**30
-        peak = stats.get("peak_bytes_in_use", 0) / 2**30
-        limit = stats.get("bytes_limit", 0) / 2**30
-        logger.info(f"{message} | device mem: in_use={in_use:.2f}GB "
-                    f"peak={peak:.2f}GB limit={limit:.2f}GB")
-    except Exception:
+    from ..monitor import gauges as mg
+    stats = mg.memory_stats()
+    rss_hwm = mg.host_rss_hwm_bytes()
+    if bus is not None:
+        for name, val in (("device_mem_in_use", stats.get("bytes_in_use")),
+                          ("device_mem_peak",
+                           stats.get("peak_bytes_in_use")),
+                          ("host_rss_hwm", rss_hwm or None)):
+            if val:
+                bus.gauge(name, int(val), context=message)
+    if stats:
+        logger.info(
+            f"{message} | device mem: "
+            f"in_use={stats.get('bytes_in_use', 0) / 2**30:.2f}GB "
+            f"peak={stats.get('peak_bytes_in_use', 0) / 2**30:.2f}GB "
+            f"limit={stats.get('bytes_limit', 0) / 2**30:.2f}GB")
+    else:
         logger.info(f"{message} | device memory stats unavailable")
-    try:
-        import resource
-        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
-        logger.info(f"{message} | host peak RSS {rss:.2f}GB")
-    except Exception:
-        pass
+    if rss_hwm:
+        logger.info(f"{message} | host peak RSS {rss_hwm / 2**30:.2f}GB")
 
 
 def call_to_str(base, *args, **kwargs):

@@ -108,15 +108,16 @@ class SynchronizedWallClockTimer:
     @staticmethod
     def memory_usage():
         """Device-memory summary (replaces torch.cuda allocator stats in
-        ``utils/timer.py memory_usage``)."""
-        try:
-            import jax
-            stats = jax.local_devices()[0].memory_stats() or {}
-            in_use = stats.get("bytes_in_use", 0)
-            peak = stats.get("peak_bytes_in_use", 0)
-            return f"mem in use {in_use / 2**30:.2f} GB | peak {peak / 2**30:.2f} GB"
-        except Exception:
+        ``utils/timer.py memory_usage``) — read through the shared
+        ``monitor/gauges.memory_stats`` helper like every other site."""
+        from ..monitor.gauges import memory_stats
+        stats = memory_stats()
+        if not stats:
             return "mem stats unavailable"
+        in_use = stats.get("bytes_in_use", 0)
+        peak = stats.get("peak_bytes_in_use", 0)
+        return (f"mem in use {in_use / 2**30:.2f} GB | "
+                f"peak {peak / 2**30:.2f} GB")
 
     def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
         from .logging import log_dist
