@@ -420,7 +420,8 @@ def measure_offload(preset, seq, micro, *, gas=1, steps=1, warmup=1,
 
 def measure_serving(preset="gpt2-125m", *, streams=8, batch_slots=8,
                     prompt_len=64, new_tokens=64, block_size=32,
-                    kv_bits=16, int8_weights=False, cache_dir=None):
+                    kv_bits=16, int8_weights=False, paged_impl=None,
+                    speculative=None, cache_dir=None):
     """Continuous-batching serving rung (docs/serving.md): N concurrent
     request streams through the ServingEngine's fused paged decode.
 
@@ -434,14 +435,15 @@ def measure_serving(preset="gpt2-125m", *, streams=8, batch_slots=8,
     from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
                                          ServingConfig, Request)
 
+    over = {} if paged_impl is None else {"paged_attention_impl": paged_impl}
     model = build(preset, dtype=jnp.bfloat16, max_seq=prompt_len + new_tokens,
-                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0, **over)
     eng = InferenceEngine(
         model=model, quantization_setting=1 if int8_weights else None,
         compile_cache=cache_dir)
     srv = ServingEngine(engine=eng, config=ServingConfig(
         batch_slots=batch_slots, block_size=block_size, kv_bits=kv_bits,
-        max_new_tokens=new_tokens))
+        max_new_tokens=new_tokens, speculative=speculative))
     rng = np.random.default_rng(0)
     V = model.config.vocab_size
     reqs = [Request(tokens=rng.integers(0, V, (prompt_len,)),
@@ -468,6 +470,7 @@ def measure_serving(preset="gpt2-125m", *, streams=8, batch_slots=8,
             "block_size": block_size,
             "kv_bits": kv_bits,
             "int8_weights": int8_weights,
+            "paged_attention_impl": srv.model.paged_attention_impl(),
             "tokens_per_sec": round(gen / dt, 1),
             "p50_ms": st["latency_ms"]["p50"],
             "p99_ms": st["latency_ms"]["p99"],
@@ -478,6 +481,8 @@ def measure_serving(preset="gpt2-125m", *, streams=8, batch_slots=8,
                          ("num_blocks", "capacity_tokens", "pool_bytes")},
             "preflight": srv.preflight_memory(),
         }
+        if speculative is not None and "speculative" in st:
+            rec["speculative"] = st["speculative"]
         # roofline attribution of the live decode executable (ds_explain
         # without the stream round-trip; analysis/roofline.py) — on CPU
         # the chip row is the NOMINAL v5e reference, honestly flagged
@@ -664,6 +669,165 @@ def measure_serving_tracing(preset="gpt2-125m", *, streams=8,
     finally:
         shutil.rmtree(base_dir, ignore_errors=True)
         shutil.rmtree(run_dir, ignore_errors=True)
+
+
+def measure_paged_kernel_vs_gather(preset="gpt2-125m", *, streams=8,
+                                   batch_slots=8, prompt_len=64,
+                                   new_tokens=32, block_size=32,
+                                   cache_dir=None):
+    """A/B twin of the serving decode's paged-attention impl
+    (docs/serving.md#paged-attention-kernel): the SAME traffic served
+    with ``paged_attention_impl="kernel"`` (the in-place Pallas kernel;
+    interpret-mode exact on CPU) vs ``"gather"`` (the legacy
+    materialized view).  Token identity is RECORDED (the
+    ``tokens_identical`` field), not asserted: on CPU the exact
+    interpret mode is bit-exact so it must read true, while the
+    compiled-TPU online mode is tolerance-bounded and a rare argmax
+    tie-break divergence would be an honest measurement, not a rung
+    failure — the bit-exactness GATE lives in
+    tests/test_paged_attention.py.  Each side reports its
+    decode-step wall p50 plus its priced ``exe_cost``/roofline verdict,
+    which is where the kernel's claim lives:
+    ``gather_materialization_bytes`` drops to exactly 0.
+
+    CPU honesty note: on this backend the kernel runs through the
+    Pallas INTERPRETER (a grid-emulation fallback, slower than XLA's
+    native gather), so CPU step walls do NOT validate the TPU claim —
+    the deleted HBM traffic only exists on the accelerator; the rung
+    regenerates the real before/after on a TPU chip."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import build
+    from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
+                                         ServingConfig, Request)
+
+    sides = {}
+    toks = {}
+    for impl in ("kernel", "gather"):
+        model = build(preset, dtype=jnp.bfloat16,
+                      max_seq=prompt_len + new_tokens,
+                      embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+                      paged_attention_impl=impl)
+        eng = InferenceEngine(model=model, compile_cache=cache_dir)
+        srv = ServingEngine(engine=eng, config=ServingConfig(
+            batch_slots=batch_slots, block_size=block_size,
+            max_new_tokens=new_tokens))
+        rng = np.random.default_rng(1)
+        V = model.config.vocab_size
+        reqs = [Request(tokens=rng.integers(0, V, (prompt_len,)),
+                        max_new_tokens=new_tokens, seed=i)
+                for i in range(streams)]
+        try:
+            srv.run([Request(tokens=rng.integers(0, V, (prompt_len,)),
+                             max_new_tokens=2, seed=10 ** 6)])
+            srv.reset_stats()
+            t0 = time.time()
+            out = srv.run(reqs)
+            dt = time.time() - t0
+            st = srv.stats()
+            gen = sum(len(out[r.uid]["tokens"]) for r in reqs)
+            toks[impl] = {r.uid: out[r.uid]["tokens"] for r in reqs}
+            cost = srv._exe_cost_fields() or {}
+            rec = {
+                "tokens_per_sec": round(gen / dt, 1),
+                "decode_step_wall_p50_ms": round(
+                    srv._step_wall_hist.quantile(0.5), 2),
+                "gather_materialization_bytes": cost.get("gather_bytes"),
+                "hbm_bytes_per_step": cost.get("hbm_bytes"),
+            }
+            roof = srv.roofline_report()
+            if roof is not None:
+                rec["roofline"] = {k: roof[k] for k in
+                                   ("bound", "achieved_frac",
+                                    "paged_attention_impl") if k in roof}
+            sides[impl] = rec
+        finally:
+            srv.close()
+            eng.close()
+    return {
+        "streams": streams, "batch_slots": batch_slots,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "block_size": block_size,
+        "kernel": sides["kernel"], "gather": sides["gather"],
+        "tokens_identical": toks["kernel"] == toks["gather"],
+        "note": ("CPU kernel side runs the Pallas interpreter (exact "
+                 "mode) — step wall is not a TPU claim; the kernel's "
+                 "gather_materialization_bytes==0 is"),
+    }
+
+
+def measure_serving_spec(preset="gpt2-125m", *, streams=8, batch_slots=8,
+                         prompt_len=64, new_tokens=64, block_size=32,
+                         spec_k=4, spec_ngram=3, cache_dir=None):
+    """Speculative-decoding twin of :func:`measure_serving`
+    (docs/serving.md#speculative-decoding): the SAME traffic served
+    plain-autoregressive vs with the self-drafting n-gram speculator
+    armed (``serving.speculative``), asserting the outputs are
+    TOKEN-IDENTICAL (the acceptance rule admits exactly the tokens the
+    model would have sampled) and reporting both tokens/s, the
+    speedup, and the measured acceptance rate.
+
+    The prompts carry repeated patterns (and greedy decode of a fixed
+    model settles into loops), so the n-gram drafter gets a realistic
+    shot — random-token prompts would measure the drafter's worst case
+    (~0 acceptance), where speculation degrades toward the plain path
+    plus the scoring overhead.  Both numbers are reported either way."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import build
+    from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
+                                         ServingConfig, Request)
+
+    model = build(preset, dtype=jnp.bfloat16,
+                  max_seq=prompt_len + new_tokens,
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+    V = model.config.vocab_size
+
+    def traffic():
+        rng = np.random.default_rng(2)
+        pat = max(4, prompt_len // 8)
+        return [Request(tokens=np.tile(rng.integers(0, V, (pat,)),
+                                       prompt_len // pat),
+                        max_new_tokens=new_tokens, seed=i)
+                for i in range(streams)]
+
+    def one_pass(speculative):
+        eng = InferenceEngine(model=model, compile_cache=cache_dir)
+        srv = ServingEngine(engine=eng, config=ServingConfig(
+            batch_slots=batch_slots, block_size=block_size,
+            max_new_tokens=new_tokens, speculative=speculative))
+        reqs = traffic()
+        try:
+            srv.run([Request(tokens=np.tile(np.arange(8) % V,
+                                            prompt_len // 8),
+                             max_new_tokens=2, seed=10 ** 6)])
+            srv.reset_stats()
+            t0 = time.time()
+            out = srv.run(reqs)
+            dt = time.time() - t0
+            st = srv.stats()
+            gen = sum(len(out[r.uid]["tokens"]) for r in reqs)
+            return (gen / dt, st,
+                    {r.uid: out[r.uid]["tokens"] for r in reqs})
+        finally:
+            srv.close()
+            eng.close()
+
+    tps_plain, _, toks_plain = one_pass(None)
+    tps_spec, st, toks_spec = one_pass(
+        {"k": spec_k, "ngram": spec_ngram})
+    spec_stats = st.get("speculative") or {}
+    return {
+        "streams": streams, "batch_slots": batch_slots,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "speculative": {"k": spec_k, "draft": "ngram",
+                        "ngram": spec_ngram},
+        "tokens_per_sec_plain": round(tps_plain, 1),
+        "tokens_per_sec_spec": round(tps_spec, 1),
+        "speedup_x": round(tps_spec / tps_plain, 2),
+        "accept_rate": spec_stats.get("accept_rate"),
+        "tokens_per_step": spec_stats.get("tokens_per_step"),
+        "decode_steps_spec": st["decode_steps"],
+        "tokens_identical": toks_plain == toks_spec,
+    }
 
 
 class _WireProbeMLP:
@@ -1085,6 +1249,32 @@ def main():
     else:
         extra["serving_125m_b8"] = {"skipped": "time budget"}
 
+    # paged-attention impl A/B: the in-place Pallas kernel vs the
+    # legacy gather (token-identical; kernel side's exe_cost must show
+    # gather_materialization_bytes == 0 — docs/serving.md)
+    if left() > 5 * 60:
+        try:
+            extra["paged_kernel_vs_gather"] = measure_paged_kernel_vs_gather(
+                "gpt2-125m", streams=8, batch_slots=8, prompt_len=64,
+                new_tokens=32, cache_dir=cache_dir)
+        except Exception as e:
+            extra["paged_kernel_vs_gather"] = {"error": str(e)[:160]}
+    else:
+        extra["paged_kernel_vs_gather"] = {"skipped": "time budget"}
+
+    # speculative-decoding twin: plain vs n-gram-drafted decode at
+    # matched (token-identical) output — tokens/s speedup + acceptance
+    # rate (docs/serving.md#speculative-decoding)
+    if left() > 6 * 60:
+        try:
+            extra["serving_125m_b8_spec"] = measure_serving_spec(
+                "gpt2-125m", streams=8, batch_slots=8, prompt_len=64,
+                new_tokens=64, cache_dir=cache_dir)
+        except Exception as e:
+            extra["serving_125m_b8_spec"] = {"error": str(e)[:160]}
+    else:
+        extra["serving_125m_b8_spec"] = {"skipped": "time budget"}
+
     # chaos twin: the same serving rung with armed fault injection
     # (journal io delay + one poisoned request) — p50/p99 must stay
     # bounded and the shed/poisoned accounting typed (docs/serving.md)
@@ -1266,6 +1456,20 @@ def main():
                 "bound": roof["bound"],
                 "achieved_frac": roof["achieved_frac"],
                 "gap_host_pct": roof["gap"]["host_pct"]}
+    paged = extra.get("paged_kernel_vs_gather") or {}
+    if "kernel" in paged:
+        headline["extra"]["paged_attn"] = {
+            "kernel_gather_bytes":
+                paged["kernel"]["gather_materialization_bytes"],
+            "gather_gather_bytes":
+                paged["gather"]["gather_materialization_bytes"],
+            "tokens_identical": paged["tokens_identical"]}
+    spec = extra.get("serving_125m_b8_spec") or {}
+    if "speedup_x" in spec:
+        headline["extra"]["spec_decode"] = {
+            "speedup_x": spec["speedup_x"],
+            "accept_rate": spec["accept_rate"],
+            "tokens_identical": spec["tokens_identical"]}
     tracing = extra.get("serving_125m_b8_tracing") or {}
     if "overhead_pct" in tracing:
         headline["extra"]["tracing"] = {

@@ -71,6 +71,9 @@ def _audit_builtin_steps(stages):
             if str(spec) == "serving-resilience":
                 findings.extend(_audit_serving_resilience())
                 continue
+            if str(spec) == "paged-attn":
+                findings.extend(_audit_paged_attention())
+                continue
             if str(spec) == "tracing":
                 findings.extend(_audit_tracing())
                 continue
@@ -389,6 +392,146 @@ def _audit_tracing():
                 prev = max(prev, s["start_ms"] + s["dur_ms"])
     finally:
         shutil.rmtree(run_dir, ignore_errors=True)
+    return findings
+
+
+def _kv_gather_eqns(closed_jaxpr, block_size, n_head, head_dim):
+    """Gathered-K/V-materialization census: every ``gather`` equation
+    (anywhere in the program, scan bodies included) whose output is a
+    per-slot block-list materialization — rank >= 5 with trailing dims
+    ``(block_size, n_head, head_dim)``, the exact shape
+    ``paged_kv.gather_kv``'s table gather produces.  The in-place
+    kernel's decode step must contain ZERO of these; the gather
+    fallback's must contain them (the detector is sanity-checked
+    against the fallback so an upstream lowering change cannot silently
+    blind it)."""
+    from .jaxpr_audit import iter_eqns
+    hits = []
+    sig = (int(block_size), int(n_head), int(head_dim))
+    for eqn, path in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "gather":
+            continue
+        for ov in eqn.outvars:
+            shape = tuple(getattr(ov.aval, "shape", ()))
+            if len(shape) >= 5 and shape[-3:] == sig:
+                hits.append((path, shape))
+    return hits
+
+
+def _audit_paged_attention():
+    """--audit-step paged-attn: the in-place paged-attention kernel
+    decode step (docs/serving.md#paged-attention-kernel) must be one
+    clean executable — zero host callbacks (DSTPU201), pool donation
+    honored (DSTPU204) — with **no gathered K/V materialization in the
+    jaxpr** (the census above; the gather-fallback twin must trip the
+    same census, proving the detector sees what the kernel deleted).
+    Speculative decoding armed must (a) keep the armed scoring step
+    just as clean and (b) produce TOKEN-IDENTICAL outputs to the
+    disarmed engine (greedy and sampled) — the determinism contract's
+    acceptance-semantics half."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from .findings import Finding
+    from .jaxpr_audit import audit_fn
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
+                                         Request)
+
+    bs, H = 8, 4
+    params_cache = {}
+
+    def build(paged_impl, speculative=None, kv_bits=16):
+        cfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
+                         n_head=H, embd_pdrop=0.0, attn_pdrop=0.0,
+                         resid_pdrop=0.0, attention_impl="jnp",
+                         paged_attention_impl=paged_impl)
+        model = GPT2(cfg, dtype=jnp.bfloat16)
+        if "p" not in params_cache:
+            params_cache["p"] = model.init(jax.random.PRNGKey(0))
+        return ServingEngine(
+            model=model, params=params_cache["p"],
+            config=ServingConfig(batch_slots=2, block_size=bs,
+                                 kv_bits=kv_bits, max_new_tokens=6,
+                                 preflight=False,
+                                 speculative=speculative))
+
+    findings = []
+    hd = 32 // H
+
+    # (1) kernel decode step, 16-bit and int8 pools: clean audit + the
+    # zero-gather census
+    for kv_bits in (16, 8):
+        srv = build("kernel", kv_bits=kv_bits)
+        srv.run([Request(tokens=np.arange(5), max_new_tokens=2)])
+        report = audit_fn(srv._decode, *srv._decode_args(),
+                          donate_argnums=(1,), mesh=srv.engine.mesh)
+        for f in report.findings:
+            f.extra = dict(f.extra, audit="paged-attn", kv_bits=kv_bits)
+        findings.extend(report.findings)
+        jaxpr = jax.make_jaxpr(srv._decode)(*srv._decode_args())
+        hits = _kv_gather_eqns(jaxpr, bs, H, hd)
+        if hits:
+            findings.append(Finding(
+                "DSTPU206", "error",
+                f"--audit-step paged-attn: the kernel decode step "
+                f"(kv{kv_bits}) still materializes gathered K/V "
+                f"({len(hits)} gather eqn(s), e.g. {hits[0][1]} at "
+                f"{hits[0][0]}) — the in-place kernel must read pool "
+                f"blocks without a dense per-slot copy",
+                eqn_path="paged-attn/zero-gather"))
+        srv.close()
+
+    # detector sanity: the gather fallback MUST trip the census
+    srv_g = build("gather")
+    srv_g._build_decode()
+    jaxpr_g = jax.make_jaxpr(srv_g._decode)(*srv_g._decode_args())
+    if not _kv_gather_eqns(jaxpr_g, bs, H, hd):
+        findings.append(Finding(
+            "DSTPU206", "error",
+            "--audit-step paged-attn: the gather-fallback twin shows NO "
+            "gathered K/V materialization — the census detector is "
+            "blind and the kernel's zero-gather verdict above proves "
+            "nothing", eqn_path="paged-attn/census-sanity"))
+    srv_g.close()
+
+    # (2) speculative decode: armed engine == disarmed engine, token
+    # for token (greedy AND sampled), and the armed step audits clean
+    def traffic():
+        return [Request(tokens=np.tile(np.arange(4), 3),
+                        max_new_tokens=6, uid=1),
+                Request(tokens=np.arange(5) % 3, max_new_tokens=5,
+                        uid=2, do_sample=True, temperature=0.8, seed=7)]
+
+    plain_srv = build("kernel")
+    plain = plain_srv.run(traffic())
+    plain_srv.close()
+    spec_srv = build("kernel", speculative={"k": 3})
+    spec = spec_srv.run(traffic())
+    for uid in (1, 2):
+        if plain[uid]["tokens"] != spec[uid]["tokens"]:
+            findings.append(Finding(
+                "DSTPU200", "error",
+                f"--audit-step paged-attn: speculative decode diverged "
+                f"from the autoregressive path on uid {uid} "
+                f"(plain={plain[uid]['tokens']}, "
+                f"spec={spec[uid]['tokens']}) — acceptance must be "
+                f"'the token the model would have sampled anyway'",
+                eqn_path="paged-attn/spec-equivalence"))
+    report = audit_fn(spec_srv._decode, *spec_srv._decode_args(),
+                      donate_argnums=(1,), mesh=spec_srv.engine.mesh)
+    for f in report.findings:
+        f.extra = dict(f.extra, audit="paged-attn-spec")
+    findings.extend(report.findings)
+    jaxpr_s = jax.make_jaxpr(spec_srv._decode)(*spec_srv._decode_args())
+    if _kv_gather_eqns(jaxpr_s, bs, H, hd):
+        findings.append(Finding(
+            "DSTPU206", "error",
+            "--audit-step paged-attn: the speculative scoring step "
+            "materializes gathered K/V — the kernel path must cover "
+            "multi-token windows too",
+            eqn_path="paged-attn/spec-zero-gather"))
+    spec_srv.close()
     return findings
 
 
@@ -818,6 +961,13 @@ def main(argv=None):
                          "sentinel-armed serving step (zero host "
                          "callbacks, donation honored, logit_nan fault "
                          "jaxpr-identical; docs/serving.md#resilience); "
+                         "'paged-attn' audits the in-place paged-"
+                         "attention kernel decode step (zero host "
+                         "callbacks, pool donation honored, NO gathered "
+                         "K/V materialization in the jaxpr — census "
+                         "sanity-checked against the gather fallback) "
+                         "and speculative-decode armed-vs-disarmed "
+                         "token equivalence (docs/serving.md); "
                          "'elastic' audits the first resharded step after "
                          "an elastic resume on half the devices "
                          "(docs/elasticity.md); 'moe' audits the quantized "

@@ -5,12 +5,13 @@ shedding, quarantine, crash-recoverable journal — ``journal.py``) is
 this repo's production-traffic addition (docs/serving.md)."""
 
 from .engine import InferenceEngine
-from .serving import (ServingConfig, ServingEngine, Request,
-                      ServingError, QueueFullError, ServingStalledError,
-                      CircuitOpenError,
+from .serving import (ServingConfig, ServingEngine, SpeculativeConfig,
+                      Request, ServingError, QueueFullError,
+                      ServingStalledError, CircuitOpenError,
                       OK, SHED, DEADLINE, POISONED, OUTCOMES)
 
-__all__ = ["InferenceEngine", "ServingEngine", "ServingConfig", "Request",
+__all__ = ["InferenceEngine", "ServingEngine", "ServingConfig",
+           "SpeculativeConfig", "Request",
            "ServingError", "QueueFullError", "ServingStalledError",
            "CircuitOpenError", "OK", "SHED", "DEADLINE", "POISONED",
            "OUTCOMES"]

@@ -25,11 +25,13 @@ host-side :class:`BlockAllocator` therefore hands out ids from
 
 XLA cost note (honest roofline accounting, docs/serving.md): the
 per-layer ``gather_kv`` materializes each slot's gathered block view —
-a dense (B, nb_max·block_size, H, hd) copy per layer per token — where
-a hand-written paged-attention kernel would read blocks in place.  KV
-bytes are small next to the weight stream at the serving batch sizes
-this targets, and the int8 pool halves them again; the kernel is the
-known next step, not a hidden cost.
+a dense (B, nb_max·block_size, H, hd) copy per layer per token.  The
+in-place Pallas kernel (``ops/transformer/paged_attention.py``, the
+default paged-attention impl) deletes that copy by DMA-ing blocks
+straight from this pool; ``gather_kv`` stays as the fallback path
+(``paged_attention_impl="gather"``) and as the oracle the kernel is
+tested bit-exact against (``analysis/roofline.py`` prices whichever
+impl is live).
 """
 
 from typing import Optional
@@ -132,17 +134,29 @@ def capacity_tokens(pool) -> int:
     return (pool["k"].shape[1] - 1) * pool["k"].shape[2]
 
 
-def write_token(pool, layer, block_tables, lengths, k, v):
-    """Scatter one decode token's K/V per slot into the pool.
+def write_tokens(pool, layer, block_tables, lengths, k, v):
+    """Scatter a W-token decode window's K/V per slot into the pool.
 
     ``layer``: scalar (traced inside the layer scan); ``block_tables``:
-    (B, nb_max) int32; ``lengths``: (B,) int32 — the new token's
-    position; ``k``/``v``: (B, H, hd) in compute dtype.  Slots whose
-    tables are all-scratch write into block 0 (discarded)."""
+    (B, nb_max) int32; ``lengths``: (B,) int32 — the FIRST window
+    token's position (window token i lands at ``lengths + i``);
+    ``k``/``v``: (B, W, H, hd) in compute dtype (W=1 is plain decode;
+    W=k+1 is the speculative scoring window).  Slots whose tables are
+    all-scratch write into block 0 (discarded), and a window position
+    that overflows the table (a speculative draft running past the
+    slot's allocation) is REDIRECTED to the scratch block instead of
+    letting the gather clamp silently overwrite the table's last real
+    block — any token whose logits depend on such a position is beyond
+    ``max_new`` and truncated by the scheduler anyway."""
     bs = pool["k"].shape[2]
-    blk = jnp.take_along_axis(block_tables, (lengths // bs)[:, None],
-                              axis=1)[:, 0]
-    off = lengths % bs
+    nb_max = block_tables.shape[1]
+    W = k.shape[1]
+    pos = lengths[:, None] + jnp.arange(W, dtype=lengths.dtype)[None, :]
+    idx = pos // bs                                        # (B, W)
+    blk = jnp.take_along_axis(block_tables,
+                              jnp.minimum(idx, nb_max - 1), axis=1)
+    blk = jnp.where(idx < nb_max, blk, SCRATCH_BLOCK)
+    off = pos % bs
     if not is_quantized_pool(pool):
         dt = pool["k"].dtype
         return dict(pool,
@@ -158,8 +172,22 @@ def write_token(pool, layer, block_tables, lengths, k, v):
                 v_scale=pool["v_scale"].at[layer, blk, off].set(sv))
 
 
-def gather_kv(pool, layer, block_tables, dtype=jnp.bfloat16):
-    """Per-slot gathered cache views for one layer.
+def write_token(pool, layer, block_tables, lengths, k, v):
+    """Single-token :func:`write_tokens` (``k``/``v``: (B, H, hd))."""
+    return write_tokens(pool, layer, block_tables, lengths,
+                        k[:, None], v[:, None])
+
+
+def gather_kv(pool, layer, block_tables, dtype):
+    """Per-slot gathered cache views for one layer — the legacy/fallback
+    paged-attention path AND the oracle the in-place Pallas kernel
+    (``ops/transformer/paged_attention.py``) is tested against.
+
+    ``dtype`` is the attention compute dtype and is REQUIRED: both this
+    path and the kernel resolve it in one place
+    (``GPT2.decode_step_paged`` passes the model compute dtype), so
+    int8 pools dequantize identically on either route — a defaulted
+    dtype here let a caller's fp16 model silently read bf16 views.
 
     Returns ``(keys, vals)`` of shape (B, nb_max·block_size, H, hd) in
     ``dtype`` — position p of slot b is row p of its view, so the

@@ -125,6 +125,92 @@ class CircuitOpenError(ServingError):
 
 
 @dataclasses.dataclass
+class SpeculativeConfig:
+    """The ``serving.speculative`` block (docs/serving.md#speculative-
+    decoding): self-drafting n-gram speculation over the paged decode.
+
+    Per scheduler step the drafter proposes ``k`` tokens per live slot
+    (``draft: "ngram"`` — the most recent previous occurrence of the
+    slot's tail ``ngram``-gram, falling back to shorter grams then to
+    last-token repeat), the fused scan scores current + k drafts in ONE
+    decode dispatch, and the per-slot accept length is computed
+    in-graph.  Accept/reject is a pure function of the request
+    (seed + committed tokens), so outputs are TOKEN-IDENTICAL to plain
+    autoregressive decode under any arrival order/co-batching — a
+    drafted token is accepted iff it equals the token the model would
+    have sampled anyway."""
+    k: int = 4                      # drafted tokens per slot per step
+    draft: str = "ngram"            # the only drafter (self-drafting)
+    ngram: int = 3                  # longest tail gram the drafter matches
+
+    def __post_init__(self):
+        assert self.k >= 1, f"speculative.k must be >= 1, got {self.k}"
+        assert self.draft == "ngram", \
+            f"speculative.draft must be 'ngram', got {self.draft!r}"
+        assert self.ngram >= 1, \
+            f"speculative.ngram must be >= 1, got {self.ngram}"
+
+    @classmethod
+    def from_value(cls, v):
+        """None/False → off; True → defaults; dict → the JSON block."""
+        if not v:
+            return None
+        if v is True:
+            return cls()
+        if isinstance(v, cls):
+            return v
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(v) - known
+        if unknown:
+            raise ValueError(
+                f"unknown serving.speculative keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        return cls(**v)
+
+
+# the drafter's search window over each slot's committed history: a
+# fixed rule (the LAST `DRAFT_WINDOW` tokens), so drafting stays a pure
+# function of the history (replay/replica-deterministic) while the
+# per-step host cost stays O(window), not O(generated-so-far)
+DRAFT_WINDOW = 1024
+
+
+def ngram_draft(history, k: int, ngram: int):
+    """Self-drafting proposal: the ``k`` tokens that followed the most
+    recent PREVIOUS occurrence of the history's tail n-gram (longest
+    gram first, shorter grams as fallback; last-token repeat when
+    nothing matches).  A pure function of the slot's committed token
+    history — the determinism contract's drafter half: replicas,
+    journal replays and permuted arrivals all draft identically.
+
+    Greedy decode of a fixed model frequently falls into repeating
+    loops, which is exactly this drafter's best case (the classic
+    prompt-lookup/self-speculation observation)."""
+    h = np.asarray(history, np.int64)
+    L = h.size
+    out = np.full((k,), int(h[-1]) if L else 0, np.int32)
+    if L < 2:
+        return out
+    for order in range(min(ngram, L - 1), 0, -1):
+        tail = h[L - order:]
+        # all previous windows of length `order` (the last one, ending
+        # at L, IS the tail — excluded)
+        n_win = L - order
+        win = np.lib.stride_tricks.sliding_window_view(h, order)[:n_win]
+        hits = np.nonzero((win == tail).all(axis=1))[0]
+        if hits.size == 0:
+            continue
+        start = int(hits[-1]) + order       # continuation of the match
+        cont = h[start:start + k]
+        if cont.size == 0:
+            continue
+        out[:cont.size] = cont
+        out[cont.size:] = int(cont[-1])
+        return out
+    return out
+
+
+@dataclasses.dataclass
 class ServingConfig:
     """Knobs for one serving deployment (docs/serving.md has the
     capacity math; JSON surface: the ``serving`` block in
@@ -161,6 +247,13 @@ class ServingConfig:
     # Sampling is a pure function of the uid, so replicas/restarts
     # sample the same requests.  0.0 = off; needs an armed monitor.
     trace_sample_rate: float = 0.0
+    # ---- speculative decoding (docs/serving.md#speculative-decoding) ----
+    # None/false = off; true = defaults; or the JSON block
+    # {"k": 4, "draft": "ngram", "ngram": 3}.  Token-identical to plain
+    # autoregressive decode (acceptance == "the model would have
+    # sampled this token anyway"); per-request acceptance stats ride
+    # the monitor bus.
+    speculative: Any = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServingConfig":
@@ -208,6 +301,14 @@ class _Slot:
         self.prompt_len = prompt_len
         self.max_new = max_new
         self.out_tokens: List[int] = []
+        # committed token history (prompt + emitted), maintained
+        # incrementally for the speculative drafter — rebuilding
+        # prompt+outputs with np.concatenate every scheduler step is
+        # O(history) host work per live slot in the hot loop
+        self.hist: List[int] = [int(t) for t in np.asarray(req.tokens)]
+        # speculative-decode acceptance accounting (per request)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
 
 class ServingEngine:
@@ -254,6 +355,9 @@ class ServingEngine:
         assert 0.0 <= config.trace_sample_rate <= 1.0, \
             f"serving.trace_sample_rate must be in [0, 1], " \
             f"got {config.trace_sample_rate!r}"
+        # speculative decoding (docs/serving.md#speculative-decoding):
+        # None = plain one-token autoregressive decode
+        self.spec = SpeculativeConfig.from_value(config.speculative)
 
         # quantized-weight routing: the SAME helper InferenceEngine
         # .generate uses (models whose decode consumes int8 leaves
@@ -313,6 +417,11 @@ class ServingEngine:
         # ---- resilience state (docs/serving.md#resilience) ----
         self._outcomes = {k: 0 for k in OUTCOMES}
         self._requeued_total = 0
+        # speculative-decode acceptance accounting (drafted vs accepted
+        # draft tokens; the bonus token after a fully-accepted window is
+        # free and not counted on either side)
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
         self._breaker_open = False
         self._forensic_path = None
         self._draining = False
@@ -320,6 +429,7 @@ class ServingEngine:
         self._step_ema_s = None   # measured decode-step wall EMA (the
         self._step_last_s = None  # predictive-deadline denominator; see
         #                           _step_estimate_s for the fast-bias)
+        self._spec_rate_ema = None  # emitted tokens/slot/step EMA (spec)
         # bounded ring of recent terminal outcomes: the poison-rate
         # window AND the breaker's forensic payload (PR-9 RingBuffer)
         self._recent = RingBuffer(max(1, int(config.poison_window)))
@@ -700,9 +810,19 @@ class ServingEngine:
         self._traces_emitted += 1
 
     # ---------------------------------------------------------- jitted steps
-    def _decode_args(self):
+    def _decode_args(self, toks=None):
+        """Operands of the armed decode step.  With speculation armed the
+        token operand is the (B, k+1) window [current, draft_1..draft_k];
+        ``toks=None`` (preflight/audit/pricing callers) sends a window
+        whose draft columns repeat the current token — same shapes, same
+        program."""
+        if toks is None:
+            toks = self._toks
+            if self.spec is not None:
+                toks = np.repeat(self._toks[:, None], self.spec.k + 1,
+                                 axis=1)
         return (self.engine.params, self.pool, jnp.asarray(self._tables),
-                jnp.asarray(self._lengths), jnp.asarray(self._toks),
+                jnp.asarray(self._lengths), jnp.asarray(toks),
                 jnp.asarray(self._seeds), jnp.asarray(self._ngen),
                 jnp.asarray(self._temps), jnp.asarray(self._flags))
 
@@ -740,11 +860,42 @@ class ServingEngine:
             nxt = jnp.where(poisoned, jnp.int32(POISON_SENTINEL_TOKEN), nxt)
             return nxt, poisoned, pool
 
+        def spec_step(params, pool, tables, lengths, toks_win, seeds, ngen,
+                      temps, flags):
+            """Speculative scoring step: ONE fused dispatch scores the
+            (B, k+1) window [current, drafts...] — window position i's
+            logits are what plain decode would see at generation index
+            ``ngen + i``, so sampling each position with its own
+            ``fold_in(seed, ngen + i)`` key reproduces the plain
+            stream EXACTLY.  A draft is accepted iff it equals the
+            token position i-1 sampled anyway; the per-slot accept
+            length (1 committed token + accepted-draft run + the free
+            bonus token) is computed in-graph.  Rejected tails never
+            advance ``lengths`` — that host-side non-advance IS the
+            rollback (stale K/V above the committed length is masked
+            and overwritten when decode reaches those positions)."""
+            logits, pool = self.model.decode_step_paged(
+                deq(params), toks_win, pool, tables, lengths)  # (B, W, V)
+            nonfin = rows_nonfinite(logits)                    # (B, W)
+            outs = []
+            for i in range(toks_win.shape[1]):
+                nxt = self._sample_tokens(logits[:, i], seeds, ngen + i,
+                                          temps, flags)
+                outs.append(jnp.where(nonfin[:, i],
+                                      jnp.int32(POISON_SENTINEL_TOKEN),
+                                      nxt))
+            out = jnp.stack(outs, axis=1)                      # (B, W)
+            match = (toks_win[:, 1:] == out[:, :-1]).astype(jnp.int32)
+            accept_len = 1 + jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            return out, accept_len, nonfin, pool
+
         c = self.config
+        spec_tag = f",spec{self.spec.k}" if self.spec is not None else ""
         self._decode = self.engine._wrap_step(
             f"serving.decode[{c.batch_slots}x{self.nb_max}"
-            f"x{c.block_size},kv{c.kv_bits},{c.top_k}]",
-            step, donate_argnums=(1,))
+            f"x{c.block_size},kv{c.kv_bits},{c.top_k}{spec_tag}]",
+            spec_step if self.spec is not None else step,
+            donate_argnums=(1,))
 
     def _prefill_fn(self, bucket: int):
         """Jitted prefill for prompts padded to ``bucket`` tokens: runs
@@ -839,18 +990,24 @@ class ServingEngine:
             self._start(free[0], req, blocks, new)
 
     def _step_estimate_s(self) -> Optional[float]:
-        """Decode-step wall estimate for predictive deadline shedding:
-        the EMA, clamped to the LAST measured step when that was faster.
-        Fast-biased on purpose — a compile/deserialize-laden first step
-        must not convince the gate that every deadline is hopeless; an
-        underestimate only admits a request the per-step deadline check
-        will still evict on time, while an overestimate sheds work the
-        server could have finished."""
+        """PER-TOKEN wall estimate for predictive deadline shedding:
+        the step EMA, clamped to the LAST measured step when that was
+        faster, divided by the measured tokens-per-step rate when
+        speculation is armed (a spec step emits up to k+1 tokens — the
+        per-step wall alone would over-shed).  Fast-biased on purpose —
+        a compile/deserialize-laden first step must not convince the
+        gate that every deadline is hopeless; an underestimate only
+        admits a request the per-step deadline check will still evict
+        on time, while an overestimate sheds work the server could have
+        finished."""
         if self._step_ema_s is None:
             return None
+        est = self._step_ema_s
         if self._step_last_s is not None:
-            return min(self._step_ema_s, self._step_last_s)
-        return self._step_ema_s
+            est = min(est, self._step_last_s)
+        if self._spec_rate_ema is not None:
+            est = est / max(1.0, self._spec_rate_ema)
+        return est
 
     def _start(self, slot: int, req: Request, blocks: List[int], new: int):
         fault.site("serving.prefill")
@@ -898,6 +1055,7 @@ class ServingEngine:
 
         s = _Slot(req, blocks, T, new)
         s.out_tokens.append(first)
+        s.hist.append(first)
         self._slots[slot] = s
         self._tables[slot] = 0
         self._tables[slot, :len(blocks)] = blocks
@@ -970,6 +1128,11 @@ class ServingEngine:
         rec["tokens"] = list(s.out_tokens)
         rec["outcome"] = outcome
         rec["t_done"] = time.monotonic()
+        if self.spec is not None:
+            # per-request acceptance stats (docs/serving.md#speculative-
+            # decoding); the run totals ride the monitor bus as counters
+            rec["spec"] = {"proposed": s.spec_proposed,
+                           "accepted": s.spec_accepted}
         self._outcomes[outcome] += 1
         self._recent.append({"uid": s.req.uid, "outcome": outcome,
                              "generated": len(s.out_tokens),
@@ -1079,14 +1242,41 @@ class ServingEngine:
                 self.journal.flush()
             return bool(self.queue)
         self._build_decode()
+        spec = self.spec
+        toks_win = None
+        if spec is not None:
+            # draft k tokens per live slot from its committed history —
+            # a pure host-side function of the request (module
+            # docstring: determinism survives), proposed as runtime
+            # operands so the compiled step never re-specializes
+            with mon.span("draft"):
+                toks_win = np.repeat(self._toks[:, None], spec.k + 1,
+                                     axis=1)
+                for i in active:
+                    s = self._slots[i]
+                    toks_win[i, 1:] = ngram_draft(
+                        s.hist[-DRAFT_WINDOW:], spec.k, spec.ngram)
         t0 = time.perf_counter()
         m_step = time.monotonic()      # decode-step span base (tracing)
         with jax.set_mesh(self.engine.mesh):
             with mon.span("dispatch"):
-                nxt, poisoned, self.pool = self._decode(*self._decode_args())
+                if spec is not None:
+                    out, accept_len, nonfin, self.pool = self._decode(
+                        *self._decode_args(toks=toks_win))
+                else:
+                    nxt, poisoned, self.pool = \
+                        self._decode(*self._decode_args())
         with mon.span("sample_join"):
-            nxt = np.asarray(nxt)
-            poisoned = np.asarray(poisoned)
+            if spec is not None:
+                out = np.asarray(out)                   # (B, k+1)
+                accept_len = np.asarray(accept_len)     # (B,)
+                nonfin = np.asarray(nonfin)             # (B, k+1)
+            else:
+                # plain decode is the W=1 window: one token, always
+                # "accepted"
+                out = np.asarray(nxt)[:, None]
+                nonfin = np.asarray(poisoned)[:, None]
+                accept_len = np.ones((out.shape[0],), np.int64)
             # the value read above synced the dispatch: this wall time is
             # a true decode-step cost, the predictive-deadline EMA's input
             dt = time.perf_counter() - t0
@@ -1104,37 +1294,81 @@ class ServingEngine:
             self._steps += 1
             c = self.config
             now = time.monotonic()
+            emitted_step = 0
             for i in active:
                 s = self._slots[i]
                 if self._traces:
                     # one span per decode step this request was live in
                     self._trace_span(s.req.uid, "decode", m_step, dt,
                                      step=self._steps)
-                if poisoned[i]:
-                    # the sentinel token is NOT appended: the request's
-                    # record keeps only its pre-poison tokens
+                a = int(accept_len[i])
+                # emission plan: walk the accepted window until poison /
+                # eos / max_new truncates it (side-effect-free, so the
+                # acceptance booking below lands BEFORE _finish writes
+                # the terminal record)
+                plan = []
+                poisoned_here = False
+                finished_here = False
+                for j in range(a):
+                    if nonfin[i, j]:
+                        # poison at this position: the sentinel token is
+                        # NOT appended — the record keeps only its
+                        # pre-poison tokens, exactly as plain decode
+                        # would have at this generation index
+                        poisoned_here = True
+                        break
+                    tok = int(out[i, j])
+                    plan.append(tok)
+                    if len(s.out_tokens) + len(plan) >= s.max_new \
+                            or tok == c.eos_token_id:
+                        # finish mid-window: accepted tokens past this
+                        # one are discarded (plain decode would have
+                        # stopped here; the slot frees either way)
+                        finished_here = True
+                        break
+                emitted = len(plan)
+                emitted_step += emitted
+                if spec is not None:
+                    # acceptance books only drafts that CONTRIBUTED an
+                    # emitted token (emitted = 1 committed + used
+                    # drafts): a draft the model agreed with but whose
+                    # token was truncated at eos/max_new/poison must not
+                    # inflate the accept rate the bus/alerting reads
+                    used = max(0, emitted - 1)
+                    s.spec_proposed += spec.k
+                    s.spec_accepted += used
+                    self._spec_proposed_total += spec.k
+                    self._spec_accepted_total += used
+                s.out_tokens.extend(plan)
+                s.hist.extend(plan)
+                if poisoned_here:
                     self._evict_poisoned(i)
                     continue
-                tok = int(nxt[i])
-                s.out_tokens.append(tok)
-                self._lengths[i] += 1
-                self._toks[i] = tok
-                self._ngen[i] += 1
-                if len(s.out_tokens) >= s.max_new or tok == c.eos_token_id:
+                if finished_here:
                     self._finish(i)
                     continue
+                self._lengths[i] += emitted
+                self._ngen[i] += emitted
+                self._toks[i] = s.out_tokens[-1]
                 dl = self.results[s.req.uid]["deadline"]
                 if dl is not None and now >= dl:
                     # mid-decode deadline: evict with the partial tokens
                     # — the slot goes back to work that can still meet
                     # its budget
                     self._finish(i, outcome=DEADLINE)
+            if spec is not None and active:
+                # tokens-per-step EMA: the predictive deadline gate's
+                # per-token denominator under speculation
+                rate = max(1.0, emitted_step / len(active))
+                self._spec_rate_ema = (
+                    rate if self._spec_rate_ema is None
+                    else 0.7 * self._spec_rate_ema + 0.3 * rate)
         if self.journal is not None:
             with mon.span("journal"):
                 # ONE buffered append per scheduler step (admits +
                 # finishes); submits flushed eagerly at submit()
                 self.journal.flush()
-        self._monitor_finish(len(active))
+        self._monitor_finish(len(active), tokens=emitted_step)
         return True
 
     def _raise_stalled(self):
@@ -1162,11 +1396,13 @@ class ServingEngine:
     # walks are cheap (O(buckets)) but need not run per generated token
     _PERCENTILES_EVERY = 16
 
-    def _monitor_finish(self, active_slots):
+    def _monitor_finish(self, active_slots, tokens=None):
         """Per-decode-step telemetry: the serving stats (previously an
         export-only dict) re-routed through the bus in the one schema.
         Cheap counters ride every emitted step; the percentile gauges
-        (a sort over the completion windows) ride a coarser cadence."""
+        (a sort over the completion windows) ride a coarser cadence.
+        ``tokens``: tokens emitted this step (== active_slots for plain
+        decode; up to (k+1)·active under speculation)."""
         mon = self.monitor
         # memory-ledger cadence: the monitor's `memory_interval` when it
         # carries one (config-built monitors; 0 = the documented off
@@ -1197,6 +1433,16 @@ class ServingEngine:
                     "requeued_total": self._requeued_total,
                     "breaker_open": int(self._breaker_open)}
         gauges = {}
+        if self.spec is not None:
+            # speculative acceptance on the bus: drafted vs accepted
+            # draft tokens (counters merge across replicas/restarts),
+            # plus the run accept-rate as a gauge for ds_top/alerting
+            counters["spec_proposed_total"] = self._spec_proposed_total
+            counters["spec_accepted_total"] = self._spec_accepted_total
+            if self._spec_proposed_total:
+                gauges["spec_accept_rate"] = round(
+                    self._spec_accepted_total / self._spec_proposed_total,
+                    4)
         if self._steps % self._PERCENTILES_EVERY == 0:
             st = self.stats()
             if "latency_ms" in st:
@@ -1214,7 +1460,8 @@ class ServingEngine:
                 if h:
                     mon.hist(hname, h, step=self._steps, unit="ms")
         self._emit_exe_cost(mon)
-        mon.set_rates(tokens_per_step=active_slots)
+        mon.set_rates(tokens_per_step=(
+            active_slots if tokens is None else tokens))
         mon.end_step(self._steps, scalars=scalars, gauges=gauges,
                      counters=counters, name="serving_step")
 
@@ -1247,20 +1494,34 @@ class ServingEngine:
         wire = mg.executable_wire_report(self._decode)
         mc = self.model.config
         c = self.config
+        # impl-aware gather pricing: the kernel path reports 0 (the
+        # bytes are GONE, not modeled-and-ignored); only the gather
+        # fallback keeps the modeled term.  ds_explain names the impl.
+        impl = self.model.paged_attention_impl()
         gather = gather_materialization_bytes(
             n_layer=mc.n_layer, batch_slots=c.batch_slots,
             nb_max=self.nb_max, block_size=c.block_size,
             n_head=mc.n_head, head_dim=mc.head_dim,
             itemsize=(1 if c.kv_bits == 8 else jnp.dtype(
-                getattr(self.model, "dtype", jnp.bfloat16)).itemsize))
+                getattr(self.model, "dtype", jnp.bfloat16)).itemsize),
+            paged_impl=impl)
         if not (flops or hbm):
             return None
-        return {"exe": "serving_step", "flops": flops, "hbm_bytes": hbm,
-                "wire_bytes": wire.get("wire_bytes_per_step", 0),
-                "gather_bytes": gather,
-                "tokens_per_step": c.batch_slots,
-                "device_kind": _jax.devices()[0].device_kind,
-                "n_chips": len(_jax.devices())}
+        # with speculation armed a step emits up to (k+1)·batch_slots
+        # tokens: report the MEASURED rate (the ds_explain verdict's
+        # per-token view must not understate spec throughput by k+1x)
+        tokens_per_step = c.batch_slots
+        if self.spec is not None and self._spec_rate_ema is not None:
+            tokens_per_step = round(c.batch_slots * self._spec_rate_ema, 1)
+        out = {"exe": "serving_step", "flops": flops, "hbm_bytes": hbm,
+               "wire_bytes": wire.get("wire_bytes_per_step", 0),
+               "gather_bytes": gather, "paged_impl": impl,
+               "tokens_per_step": tokens_per_step,
+               "device_kind": _jax.devices()[0].device_kind,
+               "n_chips": len(_jax.devices())}
+        if self.spec is not None:
+            out["speculative_k"] = self.spec.k
+        return out
 
     def _emit_exe_cost(self, mon):
         """One `exe_cost` gauge per serving configuration — the
@@ -1293,6 +1554,7 @@ class ServingEngine:
             flops=fields["flops"], hbm_bytes=fields["hbm_bytes"],
             wire_bytes=fields["wire_bytes"],
             gather_bytes=fields["gather_bytes"],
+            paged_impl=fields.get("paged_impl"),
             n_chips=fields["n_chips"])
 
     # ------------------------------------------------------------ memory ledger
@@ -1441,6 +1703,8 @@ class ServingEngine:
         self._steps = 0
         self._outcomes = {k: 0 for k in OUTCOMES}
         self._requeued_total = 0
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
         self._traces_emitted = 0
         self._recent = RingBuffer(max(1, int(self.config.poison_window)))
 
@@ -1460,6 +1724,16 @@ class ServingEngine:
                "requeued": self._requeued_total,
                "breaker_open": self._breaker_open,
                "traces_emitted": self._traces_emitted}
+        if self.spec is not None:
+            out["speculative"] = {
+                "k": self.spec.k,
+                "proposed": self._spec_proposed_total,
+                "accepted": self._spec_accepted_total,
+                "accept_rate": round(
+                    self._spec_accepted_total
+                    / max(1, self._spec_proposed_total), 4),
+                "tokens_per_step": round(
+                    self._generated_total / max(1, self._steps), 2)}
         if self._lat_hist:
             p = self._lat_hist.percentiles()
             out["latency_ms"] = {

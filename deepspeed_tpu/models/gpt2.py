@@ -84,6 +84,23 @@ class GPT2Config:
     #                   full cache copy per decoded token)
     #   "auto"        — "fused"
     decode_impl: str = "auto"
+    # paged-attention implementation for the serving decode path
+    # (decode_step_paged):
+    #   "kernel"      — the in-place Pallas kernel
+    #                   (ops/transformer/paged_attention.py): block
+    #                   tables/lengths as scalar-prefetch operands, K/V
+    #                   blocks DMA'd straight from the pool (int8 pools
+    #                   dequantized in-kernel from the fp32 scales) —
+    #                   zero gathered K/V materialization.  Runs
+    #                   compiled on TPU (online softmax) and in
+    #                   interpret mode elsewhere (exact mode: bit-exact
+    #                   vs the gather oracle, tests/test_paged_attention.py).
+    #   "gather"      — the legacy paged_kv.gather_kv materialized view
+    #                   (kept as the kernel's test oracle; its gather
+    #                   traffic is what analysis/roofline.py prices as
+    #                   gather_materialization_bytes)
+    #   "auto"        — "kernel"
+    paged_attention_impl: str = "auto"
     # GPT-Neo compatibility knobs (HFGPTNEOLayerPolicy): no score scaling and
     # a local attention window on alternating (odd) layers
     scale_attn: bool = True
@@ -695,54 +712,91 @@ class GPT2:
     # block lists into a shared pool instead of one contiguous cache
     supports_paged_decode = True
 
+    def paged_attention_impl(self) -> str:
+        """Resolve ``config.paged_attention_impl`` ("auto" → "kernel").
+
+        The live impl decides the decode step's HBM traffic, so the
+        serving layer reports it into every ``exe_cost`` gauge and
+        ``analysis/roofline.py`` prices ``gather_materialization_bytes``
+        only for the gather fallback (0 for the kernel)."""
+        impl = self.config.paged_attention_impl
+        if impl == "auto":
+            impl = "kernel"
+        assert impl in ("kernel", "gather"), (
+            f"paged_attention_impl must be auto|kernel|gather, got "
+            f"{impl!r}")
+        return impl
+
     def _attend_paged(self, q, keys, vals, lengths):
-        """Per-slot masked attention of one query token over gathered
-        pool blocks — builds the paged mask and defers to the shared
-        :meth:`_masked_attend` core.  ``q``: (B, 1, H, hd);
+        """Per-slot masked attention of a W-token query window over
+        gathered pool blocks — builds the paged mask and defers to the
+        shared :meth:`_masked_attend` core.  ``q``: (B, W, H, hd);
         ``keys``/``vals``: (B, S, H, hd) gathered block content
         (S = nb_max·block_size); ``lengths``: (B,) int32 position of the
-        CURRENT token (its K/V already written), so ``k_pos <= lengths``
-        is the causal mask and everything past it — pad tail, scratch
-        blocks, stale block content — masks out."""
-        valid = jnp.arange(keys.shape[1])[None, :] <= lengths[:, None]
-        return self._masked_attend(q, keys, vals, valid[:, None, None, :])
+        FIRST window token (its K/V already written), so
+        ``k_pos <= lengths + w`` is the causal mask for window row w and
+        everything past it — pad tail, scratch blocks, stale block
+        content, later window tokens — masks out."""
+        W = q.shape[1]
+        valid = (jnp.arange(keys.shape[1])[None, None, :]
+                 <= lengths[:, None, None]
+                 + jnp.arange(W, dtype=lengths.dtype)[None, :, None])
+        return self._masked_attend(q, keys, vals, valid[:, None])
 
     def decode_step_paged(self, params, toks, pool, block_tables, lengths):
-        """One decode token for B slots over a paged/block KV pool.
+        """One decode window for B slots over a paged/block KV pool.
 
-        ``toks``: (B,) int32 current input token per slot; ``lengths``:
-        (B,) int32 tokens already cached per slot (== the new token's
-        position); ``block_tables``: (B, nb_max) int32 pool block ids
-        (unused entries point at the reserved scratch block 0).  Returns
-        ``(logits (B, V) fp32, new_pool)``.
+        ``toks``: (B,) int32 current input token per slot — or (B, W)
+        for a multi-token window (speculative decoding scores the
+        current token + k drafts in ONE step; window token i sits at
+        position ``lengths + i`` with in-window causal masking);
+        ``lengths``: (B,) int32 tokens already cached per slot (== the
+        first window token's position); ``block_tables``: (B, nb_max)
+        int32 pool block ids (unused entries point at the reserved
+        scratch block 0).  Returns ``(logits, new_pool)`` with logits
+        (B, V) fp32 for 1-D ``toks`` and (B, W, V) for a window.
 
         Same fused shape as ``decode_impl="fused"``: one ``lax.scan``
         over the stacked layer weights, the pool carried in place, int8
-        weight payloads sliced per layer inside the scan.  Inactive
-        slots decode garbage into scratch block 0 — the scheduler
-        discards their outputs (fixed shapes keep ONE executable per
+        weight payloads sliced per layer inside the scan.  The
+        attention core is the in-place Pallas kernel by default
+        (``paged_attention_impl``): K/V blocks are read straight from
+        the pool — zero gathered copies — with ``gather_kv`` kept one
+        flag away as the fallback and test oracle.  Inactive slots
+        decode garbage into scratch block 0 — the scheduler discards
+        their outputs (fixed shapes keep ONE executable per
         (batch_slots, nb_max) config; see inference/serving.py).
         """
         from ..inference import paged_kv as pk
         from ..module_inject.module_quantize import q_gather, q_matmul
+        from ..ops.transformer.paged_attention import paged_attention
         c = self.config
         assert c.local_attn_window is None, \
             "paged decode supports standard causal attention only"
-        pos = jnp.minimum(lengths, c.max_seq - 1)
+        squeeze = toks.ndim == 1
+        if squeeze:
+            toks = toks[:, None]
+        W = toks.shape[1]
+        impl = self.paged_attention_impl()
+        pos = jnp.minimum(
+            lengths[:, None] + jnp.arange(W, dtype=lengths.dtype)[None, :],
+            c.max_seq - 1)
         x = q_gather(params["wte"], toks, self.dtype) + \
-            q_gather(params["wpe"], pos, self.dtype)
-        x = x[:, None, :]                               # (B, 1, D)
+            q_gather(params["wpe"], pos, self.dtype)    # (B, W, D)
 
         def body(carry, lp):
             h, pool, layer = carry
             hn = _layer_norm(h, lp["ln1_scale"], lp["ln1_bias"],
                              c.layer_norm_eps)
-            q, k, v = self._qkv(lp, hn)                 # (B, 1, H, hd)
-            pool = pk.write_token(pool, layer, block_tables, lengths,
-                                  k[:, 0], v[:, 0])
-            keys, vals = pk.gather_kv(pool, layer, block_tables,
-                                      self.dtype)
-            attn = self._attend_paged(q, keys, vals, lengths)
+            q, k, v = self._qkv(lp, hn)                 # (B, W, H, hd)
+            pool = pk.write_tokens(pool, layer, block_tables, lengths, k, v)
+            if impl == "kernel":
+                attn = paged_attention(q, pool, block_tables, lengths,
+                                       layer, scale_attn=c.scale_attn)
+            else:
+                keys, vals = pk.gather_kv(pool, layer, block_tables,
+                                          self.dtype)
+                attn = self._attend_paged(q, keys, vals, lengths)
             attn = self._mm(attn, lp["proj_w"], lp["proj_b"])
             return (self._ffn(lp, h + attn), pool, layer + 1), None
 
@@ -750,7 +804,9 @@ class GPT2:
             body, (x, pool, jnp.zeros((), jnp.int32)), params["blocks"])
         x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
                         c.layer_norm_eps)
-        logits = q_matmul(x[:, 0], params["wte"], w_transposed=True,
+        if squeeze:
+            x = x[:, 0]
+        logits = q_matmul(x, params["wte"], w_transposed=True,
                           out_dtype=jnp.float32)
         return logits, pool
 

@@ -1,0 +1,352 @@
+"""In-place paged attention — Pallas TPU kernel over the shared KV pool.
+
+Role parity: the reference's fused inference attention
+(``csrc/transformer/inference/csrc/softmax.cu`` + the workspace
+``layer_past`` walk) generalized to the serving layer's paged pool
+(``inference/paged_kv.py``).  The gather-based paged decode
+(``paged_kv.gather_kv``) materializes each slot's dense
+``(B, nb_max·block_size, H, hd)`` K/V view per layer per step — written
+once and read once, 4× the slot's KV bytes of HBM traffic — which is
+exactly why INFERENCE_BENCH.json's b8 decode sat at 0.48 of the
+HBM-bandwidth bound while b1 (gather ≈ cache size) sat at 0.94.  This
+kernel deletes the copy: per-slot **block tables and lengths enter as
+scalar-prefetch operands**, K/V blocks are DMA'd **directly from the
+pool in HBM**, int8 pools dequantize **in-kernel** from the fp32 block
+scales (reads priced at 1 byte/element), and the softmax accumulates
+over the slot's block walk — zero gathered copies, the pool untouched
+(read-only; donation of the pool through the decode step is unaffected).
+
+Two modes, one call (written the way ``flash_attention.py`` carries its
+BlockSpec-LUT and manual-DMA variants side by side):
+
+- ``online`` — the compiled TPU path: grid ``(B,)``, one program per
+  slot, the slot's **live** blocks (``ceil((length+W)/block_size)`` —
+  short slots skip their tail entirely) fetched through a triple-
+  buffered VMEM ring with explicit ``make_async_copy`` from the
+  HBM-resident pool (block j+2's fetch issues before block j's compute,
+  the ``_fwd_kernel_dma`` discipline), masked **online-softmax**
+  (fp32 running max/denominator) accumulation per block;
+- ``exact`` — the interpret-mode fallback (non-TPU backends / tests):
+  grid ``(B, nb_max)`` with the pallas pipeline DMA-ing blocks via
+  scalar-prefetch index maps, scores accumulated into a full
+  ``(H, W, S)`` row and the epilogue mirroring
+  ``GPT2._masked_attend`` **op-for-op** (input-dtype score matmul →
+  fp32 cast → scale → mask → softmax → probs cast to compute dtype →
+  AV) — measured **bit-exact** against the ``gather_kv`` oracle on
+  fp32/bf16/fp16 pools (tests/test_paged_attention.py), which is what
+  keeps CPU tier-1 exact when the serving decode routes through here.
+
+``mode="auto"`` resolves to ``online`` on compiled TPU and ``exact``
+under the interpreter.  Queries are a ``(B, W, H, hd)`` window —
+``W=1`` is plain decode, ``W=k+1`` is the speculative-decode scoring
+step (``inference/serving.py``) — masked causally inside the window:
+key position ``s`` is live for window row ``w`` iff
+``s <= lengths[b] + w``.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...runtime.comm.quantized import dequantize_blockwise
+
+# the oracle's mask value (GPT2._masked_attend uses finfo(f32).min;
+# flash's -1e30 would break exact-mode bit-equality)
+NEG_INF = float(np.finfo(np.float32).min)
+
+_N_BUF = 3    # DMA ring depth (flash_attention._N_KV_BUF): slot (j+2)%3
+#               held block j-1 (consumed one grid step ago), so the j+2
+#               fetch can start BEFORE block j's compute with no hazard
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def resolve_mode(mode: str) -> str:
+    """``auto`` → ``online`` on compiled TPU, ``exact`` interpreted."""
+    if mode == "auto":
+        return "exact" if _interpret() else "online"
+    assert mode in ("exact", "online"), \
+        f"paged-attention mode must be auto|exact|online, got {mode!r}"
+    return mode
+
+
+def _dequant_block(x, scale, compute_dtype):
+    """One pool block → compute dtype.  int8 payloads dequantize via the
+    fp32 block scales with EXACTLY ``paged_kv.gather_kv``'s formula
+    (``dequantize_blockwise``) so the kernel and the gather oracle read
+    identical values; 16-bit payloads just cast."""
+    if scale is None:
+        return x.astype(compute_dtype)
+    return dequantize_blockwise(x, scale, bits=8, out_dtype=compute_dtype)
+
+
+# ============================================================== exact kernel
+def _exact_kernel(*refs, block_size, nb_max, n_head, head_dim, n_window,
+                  scale_attn, compute_dtype, quantized):
+    """Grid (B, nb_max), block walk innermost (revisits scratch).
+
+    Scores land in a full (H, W, S) fp32 row; the last block's visit
+    runs the epilogue as the gather oracle computes it, op-for-op —
+    the bit-exactness contract (module docstring)."""
+    if quantized:
+        (tables_ref, lengths_ref, layer_ref, q_ref, k_ref, v_ref,
+         ks_ref, vs_ref, o_ref, scores_ref, vrow_ref) = refs
+    else:
+        (tables_ref, lengths_ref, layer_ref, q_ref, k_ref, v_ref,
+         o_ref, scores_ref, vrow_ref) = refs
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    bs, W = block_size, n_window
+
+    k = _dequant_block(k_ref[0, 0], ks_ref[0, 0] if quantized else None,
+                       compute_dtype)
+    v = _dequant_block(v_ref[0, 0], vs_ref[0, 0] if quantized else None,
+                       compute_dtype)
+    q = q_ref[0]                                    # (W, H, hd)
+    # per-(h, w, k) scores: same per-element hd-length contraction (and
+    # operand layout) as the oracle's einsum("bqhd,bkhd->bhqk") — the
+    # input-dtype matmul result casts to fp32 AFTER, like _masked_attend
+    s_cols = jnp.einsum("whd,khd->hwk", q, k)
+    scores_ref[:, :, pl.ds(j * bs, bs)] = s_cols.astype(jnp.float32)
+    vrow_ref[pl.ds(j * bs, bs)] = v
+
+    @pl.when(j == nb_max - 1)
+    def _():
+        s = scores_ref[...]
+        if scale_attn:
+            s = s / np.sqrt(head_dim)
+        S = nb_max * bs
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (n_head, W, S), 2)
+        w_pos = jax.lax.broadcasted_iota(jnp.int32, (n_head, W, S), 1)
+        valid = k_pos <= lengths_ref[b] + w_pos
+        s = jnp.where(valid, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1).astype(compute_dtype)
+        out = jnp.einsum("hwk,khd->whd", p, vrow_ref[...])
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _exact_call(q, pool, tables, lengths, layer_arr, *, scale_attn,
+                interpret):
+    B, W, H, hd = q.shape
+    bs = pool["k"].shape[2]
+    nb_max = tables.shape[1]
+    S = nb_max * bs
+    quantized = "k_scale" in pool
+
+    def kv_idx(b, j, tbl, lens, lay):
+        return (lay[0], tbl[b, j], 0, 0, 0)
+
+    def q_idx(b, j, tbl, lens, lay):
+        return (b, 0, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, W, H, hd), q_idx),
+        pl.BlockSpec((1, 1, bs, H, hd), kv_idx),
+        pl.BlockSpec((1, 1, bs, H, hd), kv_idx),
+    ]
+    args = [q, pool["k"], pool["v"]]
+    if quantized:
+        nsc = pool["k_scale"].shape[-1]
+        in_specs += [pl.BlockSpec((1, 1, bs, H, nsc), kv_idx)] * 2
+        args += [pool["k_scale"], pool["v_scale"]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3, grid=(B, nb_max),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, W, H, hd), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((H, W, S), jnp.float32),
+            pltpu.VMEM((S, H, hd), q.dtype),
+        ])
+    kernel = functools.partial(
+        _exact_kernel, block_size=bs, nb_max=nb_max, n_head=H, head_dim=hd,
+        n_window=W, scale_attn=scale_attn, compute_dtype=q.dtype,
+        quantized=quantized)
+    cp = pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, W, H, hd), q.dtype),
+        compiler_params=cp, interpret=interpret,
+    )(tables, lengths, layer_arr, *args)
+
+
+# ============================================================= online kernel
+def _online_kernel(*refs, block_size, nb_max, n_head, head_dim, n_window,
+                   scale_attn, compute_dtype, quantized):
+    """Grid (B,): ONE program per slot walks the slot's LIVE blocks
+    (``ceil((length + W) / bs)``; dead tail blocks are never fetched)
+    through a triple-buffered make_async_copy ring from the HBM pool,
+    carrying fp32 online-softmax state (m, l, acc) per (head, window
+    row).  Per-head 2-D dots keep every matmul Mosaic-lowerable (the
+    kernel is KV-bandwidth-bound; MXU utilization of the tiny
+    (W, hd)×(hd, bs) dots is not the term that matters)."""
+    if quantized:
+        (tables_ref, lengths_ref, layer_ref, q_ref,
+         k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
+         kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, acc_ref, sem) = refs
+    else:
+        (tables_ref, lengths_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref,
+         kbuf, vbuf, m_ref, l_ref, acc_ref, sem) = refs
+        ksbuf = vsbuf = None
+    b = pl.program_id(0)
+    lay = layer_ref[0]
+    bs, W, H = block_size, n_window, n_head
+    sm_scale = (1.0 / np.sqrt(head_dim)) if scale_attn else 1.0
+    length = lengths_ref[b]
+    # blocks that hold any position <= length + W - 1 (the window's last
+    # row); everything past is masked for every row — skip the DMA
+    nb_live = jnp.minimum((length + W + bs - 1) // bs, nb_max)
+
+    n_copies = 4 if quantized else 2
+
+    def fetches(j, slot):
+        ki = tables_ref[b, j]
+        out = [pltpu.make_async_copy(k_hbm.at[lay, ki], kbuf.at[slot],
+                                     sem.at[slot, 0]),
+               pltpu.make_async_copy(v_hbm.at[lay, ki], vbuf.at[slot],
+                                     sem.at[slot, 1])]
+        if quantized:
+            out += [pltpu.make_async_copy(ks_hbm.at[lay, ki],
+                                          ksbuf.at[slot], sem.at[slot, 2]),
+                    pltpu.make_async_copy(vs_hbm.at[lay, ki],
+                                          vsbuf.at[slot], sem.at[slot, 3])]
+        return out
+
+    def start(j):
+        for c in fetches(j, jax.lax.rem(j, _N_BUF)):
+            c.start()
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    start(0)
+
+    @pl.when(nb_live > 1)
+    def _():
+        start(1)
+
+    def body(j, carry):
+        @pl.when(j + 2 < nb_live)
+        def _():
+            start(j + 2)
+        slot = jax.lax.rem(j, _N_BUF)
+        for c in fetches(j, slot):
+            c.wait()
+        k = _dequant_block(kbuf[slot], ksbuf[slot] if quantized else None,
+                           compute_dtype)
+        v = _dequant_block(vbuf[slot], vsbuf[slot] if quantized else None,
+                           compute_dtype)
+        k_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (W, bs), 1)
+        w_pos = jax.lax.broadcasted_iota(jnp.int32, (W, bs), 0)
+        valid = k_pos <= length + w_pos                     # (W, bs)
+        for h in range(H):
+            q_h = q_ref[0, :, h, :]                         # (W, hd)
+            s = jax.lax.dot_general(
+                q_h, k[:, h, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale
+            s = jnp.where(valid, s, NEG_INF)
+            rows = pl.ds(h * W, W)
+            m_prev = m_ref[rows, :]                          # (W, 1)
+            m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)                           # (W, bs) fp32
+            l_ref[rows, :] = l_ref[rows, :] * alpha + \
+                jnp.sum(p, -1, keepdims=True)
+            acc_ref[rows, :] = acc_ref[rows, :] * alpha + jax.lax.dot_general(
+                p.astype(compute_dtype), v[:, h, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[rows, :] = m_new
+        return carry
+
+    jax.lax.fori_loop(0, nb_live, body, 0)
+
+    l = l_ref[:]
+    l_safe = jnp.where(l == 0.0, 1.0, l)                     # never 0: k_pos
+    out = acc_ref[:] / l_safe                                # 0 always live
+    o_ref[0] = out.reshape(H, W, head_dim).swapaxes(0, 1).astype(o_ref.dtype)
+
+
+def _online_call(q, pool, tables, lengths, layer_arr, *, scale_attn,
+                 interpret):
+    B, W, H, hd = q.shape
+    bs = pool["k"].shape[2]
+    nb_max = tables.shape[1]
+    quantized = "k_scale" in pool
+
+    in_specs = [
+        pl.BlockSpec((1, W, H, hd), lambda b, *s: (b, 0, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),      # k pool stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),      # v pool stays in HBM
+    ]
+    args = [q, pool["k"], pool["v"]]
+    scratch = [
+        pltpu.VMEM((_N_BUF, bs, H, hd), pool["k"].dtype),
+        pltpu.VMEM((_N_BUF, bs, H, hd), pool["v"].dtype),
+    ]
+    if quantized:
+        nsc = pool["k_scale"].shape[-1]
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        args += [pool["k_scale"], pool["v_scale"]]
+        scratch += [pltpu.VMEM((_N_BUF, bs, H, nsc), jnp.float32),
+                    pltpu.VMEM((_N_BUF, bs, H, nsc), jnp.float32)]
+    scratch += [
+        pltpu.VMEM((H * W, 1), jnp.float32),       # m (running max)
+        pltpu.VMEM((H * W, 1), jnp.float32),       # l (denominator)
+        pltpu.VMEM((H * W, hd), jnp.float32),      # acc
+        pltpu.SemaphoreType.DMA((_N_BUF, 4 if quantized else 2)),
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3, grid=(B,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, W, H, hd), lambda b, *s: (b, 0, 0, 0)),
+        scratch_shapes=scratch)
+    kernel = functools.partial(
+        _online_kernel, block_size=bs, nb_max=nb_max, n_head=H,
+        head_dim=hd, n_window=W, scale_attn=scale_attn,
+        compute_dtype=q.dtype, quantized=quantized)
+    cp = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, W, H, hd), q.dtype),
+        compiler_params=cp, interpret=interpret,
+    )(tables, lengths, layer_arr, *args)
+
+
+# ================================================================ public API
+def paged_attention(q, pool, block_tables, lengths, layer, *,
+                    scale_attn=True, mode="auto", interpret=None):
+    """Masked attention of a ``(B, W)`` query window over the paged pool,
+    reading K/V blocks in place (no gathered copy).
+
+    - ``q``: (B, W, H, hd) in the attention compute dtype (W=1: plain
+      decode; W=k+1: the speculative scoring window);
+    - ``pool``: the ``paged_kv`` pool pytree (16-bit or int8+scales);
+    - ``block_tables``: (B, nb_max) int32 pool block ids (scratch-0
+      padded); ``lengths``: (B,) int32 — position of the FIRST window
+      token (its K/V already written, so ``k_pos <= lengths + w`` is
+      the causal mask for window row ``w``);
+    - ``layer``: int or traced scalar (called inside the layer scan).
+
+    Returns (B, W, H·hd) in ``q.dtype`` — same contract as
+    ``gather_kv`` + ``GPT2._masked_attend``, which remains the oracle
+    this kernel is tested against (bit-exact on 16-bit pools in exact
+    mode, tolerance-bounded online/int8)."""
+    B, W, H, hd = q.shape
+    assert pool["k"].shape[3] == H and pool["k"].shape[4] == hd, \
+        (pool["k"].shape, q.shape)
+    if interpret is None:
+        interpret = _interpret()
+    mode = resolve_mode(mode)
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    call = _exact_call if mode == "exact" else _online_call
+    out = call(q, pool, tables, lengths, layer_arr,
+               scale_attn=scale_attn, interpret=interpret)
+    return out.reshape(B, W, H * hd)

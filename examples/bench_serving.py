@@ -7,11 +7,18 @@ recorded so CPU functional runs cannot be mistaken for TPU numbers.
 
 Run:  python examples/bench_serving.py [--preset gpt2-125m] [--streams 8]
       [--slots 8] [--prompt 64] [--new 64] [--block 32] [--kv-bits 16]
-      [--int8] [--chaos] [--io-delay-ms 2.0]
+      [--int8] [--paged-impl auto|kernel|gather] [--chaos] [--spec]
+      [--spec-k 4] [--io-delay-ms 2.0]
 
 ``--chaos`` runs the resilience twin instead (docs/serving.md#resilience):
 armed fault injection — io delay on the journal path + one logit_nan-
 poisoned request — reporting p50/p99 with typed shed/poisoned counts.
+``--spec`` runs the speculative-decoding twin
+(docs/serving.md#speculative-decoding): plain vs n-gram-drafted decode
+at matched (token-identical) output.  ``--paged-impl`` pins the
+paged-attention implementation (default auto → the in-place Pallas
+kernel; ``gather`` = the legacy materialized view, the kernel's test
+oracle).
 """
 
 import argparse
@@ -33,28 +40,50 @@ def main():
     ap.add_argument("--kv-bits", type=int, default=16, choices=[8, 16])
     ap.add_argument("--int8", action="store_true",
                     help="int8 weights (quantize_param_tree)")
+    ap.add_argument("--paged-impl", default="auto",
+                    choices=["auto", "kernel", "gather"],
+                    help="paged-attention implementation "
+                         "(GPT2Config.paged_attention_impl)")
     ap.add_argument("--chaos", action="store_true",
                     help="armed-fault resilience twin (journal io delay + "
                          "one poisoned request; docs/serving.md#resilience)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding twin: plain vs n-gram-"
+                         "drafted decode, token-identity asserted "
+                         "(docs/serving.md#speculative-decoding)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="with --spec: drafted tokens per slot per step")
     ap.add_argument("--io-delay-ms", type=float, default=2.0,
                     help="with --chaos: injected delay per journal append")
     args = ap.parse_args()
 
     import jax
-    from bench import measure_serving, measure_serving_chaos
+    from bench import (measure_serving, measure_serving_chaos,
+                       measure_serving_spec)
 
+    kw = dict(streams=args.streams, batch_slots=args.slots,
+              prompt_len=args.prompt, new_tokens=args.new,
+              block_size=args.block)
+    impl = None if args.paged_impl == "auto" else args.paged_impl
+    if args.chaos or args.spec:
+        # those twins run the default kernel impl / 16-bit pool: a knob
+        # they would silently drop must not end up stamped on the record
+        if impl is not None:
+            ap.error("--paged-impl applies to the plain rung only")
+        if args.spec and (args.kv_bits != 16 or args.int8):
+            ap.error("--kv-bits/--int8 apply to the plain/chaos rungs "
+                     "only")
     if args.chaos:
         rec = measure_serving_chaos(
-            args.preset, streams=args.streams, batch_slots=args.slots,
-            prompt_len=args.prompt, new_tokens=args.new,
-            block_size=args.block, kv_bits=args.kv_bits,
-            int8_weights=args.int8, io_delay_ms=args.io_delay_ms)
+            args.preset, kv_bits=args.kv_bits, int8_weights=args.int8,
+            io_delay_ms=args.io_delay_ms, **kw)
+    elif args.spec:
+        rec = measure_serving_spec(args.preset, spec_k=args.spec_k, **kw)
     else:
         rec = measure_serving(
-            args.preset, streams=args.streams, batch_slots=args.slots,
-            prompt_len=args.prompt, new_tokens=args.new,
-            block_size=args.block,
-            kv_bits=args.kv_bits, int8_weights=args.int8)
+            args.preset, kv_bits=args.kv_bits, int8_weights=args.int8,
+            paged_impl=impl, **kw)
+        rec["paged_impl"] = args.paged_impl
     rec["preset"] = args.preset
     rec["backend"] = jax.default_backend()
     rec["device_kind"] = jax.devices()[0].device_kind

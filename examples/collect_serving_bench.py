@@ -1,0 +1,98 @@
+"""Regenerate SERVING_BENCH.json (CPU-functional serving artifact).
+
+Runs every serving rung — the b8 baseline, int8-KV, 12-streams
+queueing, chaos, tracing, the paged kernel-vs-gather A/B, and the
+speculative-decoding twin — and rewrites the committed artifact with a
+backend label so CPU functional runs can never be mistaken for TPU
+numbers.  On a TPU host the same script produces the real artifact.
+
+    python examples/collect_serving_bench.py [--out SERVING_BENCH.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+NOTE = (
+    "FUNCTIONAL artifact measured on the CPU backend (this container has "
+    "no TPU attached; backend/device_kind fields are the ground truth). "
+    "It proves the serving layer end-to-end - continuous batching, paged-"
+    "KV block reuse, int8-KV pool halving, memory-preflighted admission, "
+    "queueing under 12 streams over 8 slots, chaos (journal io delay + "
+    "one poisoned request), and request tracing. CPU tokens/s is NOT a "
+    "TPU throughput claim; bench.py and examples/bench_serving.py "
+    "regenerate these numbers on the real chip (docs/serving.md). "
+    "ISSUE-14 refresh: the decode path now routes through the IN-PLACE "
+    "paged-attention Pallas kernel by default (paged_attention_impl="
+    "kernel) - on CPU that is the Pallas INTERPRETER (exact mode, bit-"
+    "exact vs the gather oracle), which is SLOWER than XLA's native "
+    "gather, so the absolute CPU tokens/s dropped vs the PR-12 artifact; "
+    "the kernel's claim is the TRAFFIC, visible in paged_kernel_vs_"
+    "gather_cpu: gather_materialization_bytes 56.6MB -> 0 at token-"
+    "identical output (the TPU wall-clock before/after regenerates on "
+    "chip, where the deleted HBM copy actually costs bandwidth - "
+    "INFERENCE_BENCH.json gpt2_125m_b8_paged_kernel carries the priced "
+    "projection). serving_125m_b8_spec_cpu is the speculative-decoding "
+    "twin (docs/serving.md#speculative-decoding): self-drafting n-gram "
+    "speculation at k=4 on loopy prompts, TOKEN-IDENTICAL to the plain "
+    "path, measured faster even on CPU (the fused scoring step amortizes "
+    "per-step fixed costs exactly as it amortizes the weight stream on "
+    "TPU); random-prompt traffic would sit near accept_rate 0 and "
+    "degrade toward the plain path, which is why the rung reports "
+    "accept_rate alongside the speedup."
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "SERVING_BENCH.json"))
+    ap.add_argument("--cache-dir", default="./.compile_cache")
+    args = ap.parse_args()
+
+    import jax
+    import bench
+
+    backend = jax.default_backend()
+    kind = jax.devices()[0].device_kind
+    tag = lambda rec: dict(rec, preset="gpt2-125m", backend=backend,
+                           device_kind=kind)
+    base = dict(streams=8, batch_slots=8, prompt_len=64, new_tokens=64,
+                cache_dir=args.cache_dir)
+
+    doc = {"note": NOTE}
+    doc["serving_125m_b8_cpu"] = tag(bench.measure_serving(**base))
+    doc["serving_125m_b8_int8kv_cpu"] = tag(
+        bench.measure_serving(kv_bits=8, **base))
+    doc["serving_125m_12streams_over_8slots_cpu"] = tag(
+        bench.measure_serving(**dict(base, streams=12)))
+    doc["serving_125m_b8_chaos_cpu"] = tag(
+        bench.measure_serving_chaos(**base))
+    doc["serving_125m_b8_tracing_cpu"] = tag(
+        bench.measure_serving_tracing(**{
+            k: v for k, v in base.items() if k != "kv_bits"}))
+    doc["paged_kernel_vs_gather_cpu"] = tag(
+        bench.measure_paged_kernel_vs_gather(
+            **dict(base, new_tokens=32)))
+    doc["serving_125m_b8_spec_cpu"] = tag(bench.measure_serving_spec(**base))
+
+    out = os.path.abspath(args.out)
+    with open(out, "w") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    print(f"wrote {out}")
+    for k, v in doc.items():
+        if isinstance(v, dict) and "tokens_per_sec" in v:
+            print(f"  {k}: {v['tokens_per_sec']} tok/s")
+    spec = doc["serving_125m_b8_spec_cpu"]
+    print(f"  spec: {spec['tokens_per_sec_plain']} -> "
+          f"{spec['tokens_per_sec_spec']} tok/s "
+          f"({spec['speedup_x']}x, accept {spec['accept_rate']}, "
+          f"identical={spec['tokens_identical']})")
+
+
+if __name__ == "__main__":
+    main()

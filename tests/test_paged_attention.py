@@ -1,0 +1,219 @@
+"""In-place paged-attention kernel vs the gather oracle
+(ops/transformer/paged_attention.py; docs/serving.md#paged-attention-kernel).
+
+The oracle is the legacy materialized path — ``paged_kv.gather_kv`` +
+``GPT2._attend_paged`` (the shared ``_masked_attend`` core) — kept
+exported exactly so the kernel has something to be tested against:
+
+- **exact mode** (the interpret/CPU fallback) must be BIT-exact on
+  16-bit pools (fp32/bf16/fp16) — that is what keeps CPU tier-1 exact
+  when the serving decode routes through the kernel — and is held to
+  the same bit-exactness on int8 pools (same dequant formula, same op
+  order);
+- **online mode** (the compiled-TPU online-softmax/DMA-ring variant,
+  run here through the interpreter) is tolerance-bounded: it skips the
+  oracle's probs→compute-dtype rounding, so agreement is to compute-
+  dtype rounding error, not bitwise.
+
+Edge coverage per the serving layer's invariants: partial last blocks,
+SCRATCH-slot inactivity (all-zero tables), per-slot length edges (block
+boundary, single token), multi-token windows (the speculative scoring
+step), and the write_tokens overflow-to-scratch guard.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+from deepspeed_tpu.inference import paged_kv as pk
+from deepspeed_tpu.ops.transformer.paged_attention import paged_attention
+
+BS, NB_MAX, NB, L, H, HD = 8, 4, 16, 2, 4, 16
+
+
+def _model(dtype=jnp.bfloat16):
+    cfg = GPT2Config(vocab_size=64, max_seq=BS * NB_MAX, n_embd=H * HD,
+                     n_layer=L, n_head=H, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    return GPT2(cfg, dtype=dtype)
+
+
+def _filled_pool(rng, dtype, kv_bits=16):
+    pool = pk.init_pool(L, NB, BS, H, HD,
+                        dtype if kv_bits == 16 else jnp.bfloat16,
+                        kv_bits=kv_bits, quant_block=8)
+    k = jnp.asarray(rng.standard_normal((L, NB * BS, H, HD)), dtype)
+    v = jnp.asarray(rng.standard_normal((L, NB * BS, H, HD)), dtype)
+    return pk.write_prefill(pool, jnp.arange(NB, dtype=jnp.int32), k, v)
+
+
+# per-slot edges in one batch: full blocks, partial last block, block
+# boundary, single token, inactive (all-scratch table)
+TABLES = np.asarray([[1, 2, 3, 4],      # len 31: partial last block
+                     [5, 6, 7, 0],      # len 23: exactly 3 blocks
+                     [8, 9, 0, 0],      # len 8: first row of block 2
+                     [10, 0, 0, 0],     # len 0: single token
+                     [0, 0, 0, 0]],     # inactive slot (scratch)
+                    np.int32)
+LENGTHS = np.asarray([31, 23, 8, 0, 0], np.int32)
+
+
+def _oracle(model, q, pool, tables, lengths, layer):
+    keys, vals = pk.gather_kv(pool, layer, jnp.asarray(tables),
+                              q.dtype)
+    return model._attend_paged(q, keys, vals, jnp.asarray(lengths))
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16, jnp.float32])
+@pytest.mark.parametrize("n_window", [1, 3])
+def test_exact_mode_bit_exact_16bit(dtype, n_window, devices):
+    """Exact mode == gather oracle, bit for bit, on 16-bit pools —
+    every length edge, partial last block, and the scratch slot."""
+    model = _model(dtype)
+    rng = np.random.default_rng(0)
+    pool = _filled_pool(rng, dtype)
+    B = TABLES.shape[0]
+    q = jnp.asarray(rng.standard_normal((B, n_window, H, HD)), dtype)
+    ref = np.asarray(_oracle(model, q, pool, TABLES, LENGTHS, 1))
+    out = np.asarray(jax.jit(
+        lambda q, p: paged_attention(q, p, TABLES, LENGTHS, 1,
+                                     mode="exact"))(q, pool))
+    assert out.dtype == ref.dtype
+    np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.parametrize("mode", ["exact", "online"])
+def test_int8_pool_within_tolerance(mode, devices):
+    """int8 pools dequantize IN-KERNEL from the fp32 block scales with
+    the oracle's exact formula: exact mode lands bit-equal, online mode
+    within compute-dtype rounding of the dequantized values."""
+    model = _model(jnp.bfloat16)
+    rng = np.random.default_rng(1)
+    pool = _filled_pool(rng, jnp.bfloat16, kv_bits=8)
+    B = TABLES.shape[0]
+    q = jnp.asarray(rng.standard_normal((B, 1, H, HD)), jnp.bfloat16)
+    ref = np.asarray(_oracle(model, q, pool, TABLES, LENGTHS, 0),
+                     np.float32)
+    out = np.asarray(jax.jit(
+        lambda q, p: paged_attention(q, p, TABLES, LENGTHS, 0,
+                                     mode=mode))(q, pool), np.float32)
+    if mode == "exact":
+        np.testing.assert_array_equal(out, ref)
+    else:
+        scale = np.abs(ref).max()
+        assert np.abs(out - ref).max() < 0.02 * scale
+
+
+@pytest.mark.parametrize("n_window", [1, 4])
+def test_online_mode_within_compute_dtype_rounding(n_window, devices):
+    """Online softmax (the compiled-TPU variant, interpreted here) must
+    track the oracle within bf16 rounding — it keeps probabilities in
+    fp32 through the accumulation where the oracle rounds them to the
+    compute dtype, so bitwise equality is not expected and ~1e-2
+    disagreement would be a real bug."""
+    model = _model(jnp.bfloat16)
+    rng = np.random.default_rng(2)
+    pool = _filled_pool(rng, jnp.bfloat16)
+    B = TABLES.shape[0]
+    q = jnp.asarray(rng.standard_normal((B, n_window, H, HD)), jnp.bfloat16)
+    ref = np.asarray(_oracle(model, q, pool, TABLES, LENGTHS, 1),
+                     np.float32)
+    out = np.asarray(jax.jit(
+        lambda q, p: paged_attention(q, p, TABLES, LENGTHS, 1,
+                                     mode="online"))(q, pool), np.float32)
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() < 1e-2 * scale
+
+
+def test_decode_step_kernel_vs_gather_impl(devices):
+    """The whole fused decode step — embeddings, QKV, pool writes,
+    attention, FFN, head — must be bit-identical between
+    ``paged_attention_impl="kernel"`` (exact interpret mode) and
+    ``"gather"`` on a 16-bit pool: the kernel is a traffic change, not
+    a math change."""
+    rng = np.random.default_rng(3)
+    logits = {}
+    pools = {}
+    for impl in ("kernel", "gather"):
+        cfg = GPT2Config(vocab_size=64, max_seq=BS * NB_MAX, n_embd=H * HD,
+                         n_layer=L, n_head=H, embd_pdrop=0.0,
+                         attn_pdrop=0.0, resid_pdrop=0.0,
+                         attention_impl="jnp", paged_attention_impl=impl)
+        model = GPT2(cfg, dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0))
+        pool = _filled_pool(np.random.default_rng(7), jnp.float32)
+        toks = jnp.asarray(rng.integers(0, 64, (TABLES.shape[0],)),
+                           jnp.int32)
+        lg, pl_out = jax.jit(model.decode_step_paged)(
+            params, toks, pool, jnp.asarray(TABLES), jnp.asarray(LENGTHS))
+        logits[impl] = np.asarray(lg)
+        pools[impl] = jax.tree_util.tree_map(np.asarray, pl_out)
+        rng = np.random.default_rng(3)        # same tokens for both
+    np.testing.assert_array_equal(logits["kernel"], logits["gather"])
+    for leaf_k, leaf_g in zip(
+            jax.tree_util.tree_leaves(pools["kernel"]),
+            jax.tree_util.tree_leaves(pools["gather"])):
+        np.testing.assert_array_equal(leaf_k, leaf_g)
+
+
+def test_multi_token_window_matches_sequential_steps(devices):
+    """A (B, W) window through decode_step_paged must produce, at each
+    window position, the same logits as W sequential single-token steps
+    committing the same tokens — the property speculative scoring
+    relies on (window position i == what plain decode would see).
+
+    Mathematically identical, not bitwise: the window matmuls carry
+    (B, W, D) operands where sequential carries (B, 1, D), so XLA's
+    reduction order differs in the last ulps — hence a tight tolerance
+    plus argmax identity (what the accept rule actually consumes)."""
+    cfg = GPT2Config(vocab_size=64, max_seq=BS * NB_MAX, n_embd=H * HD,
+                     n_layer=L, n_head=H, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(1))
+    rng = np.random.default_rng(4)
+    tables = np.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32)
+    lengths = np.asarray([9, 3], np.int32)
+    W = 3
+    toks = rng.integers(0, 64, (2, W)).astype(np.int32)
+
+    pool = _filled_pool(np.random.default_rng(8), jnp.float32)
+    win_logits, _ = jax.jit(model.decode_step_paged)(
+        params, jnp.asarray(toks), pool, jnp.asarray(tables),
+        jnp.asarray(lengths))
+
+    pool = _filled_pool(np.random.default_rng(8), jnp.float32)
+    step = jax.jit(model.decode_step_paged)
+    seq_logits = []
+    lens = jnp.asarray(lengths)
+    for i in range(W):
+        lg, pool = step(params, jnp.asarray(toks[:, i]), pool,
+                        jnp.asarray(tables), lens)
+        seq_logits.append(np.asarray(lg))
+        lens = lens + 1
+    for i in range(W):
+        win = np.asarray(win_logits[:, i])
+        np.testing.assert_allclose(win, seq_logits[i],
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_array_equal(win.argmax(-1),
+                                      seq_logits[i].argmax(-1))
+
+
+def test_write_tokens_overflow_lands_in_scratch(devices):
+    """A window position past the slot's table (a speculative draft
+    running beyond the allocation) must be REDIRECTED to the scratch
+    block — the take-along-axis clamp would otherwise silently
+    overwrite the table's LAST REAL block."""
+    pool = pk.init_pool(1, 4, 4, 1, 8, jnp.float32)
+    tables = jnp.asarray([[1, 2, 0, 0]], jnp.int32)   # 2 real blocks
+    k = jnp.ones((1, 3, 1, 8), jnp.float32)           # 3-token window
+    # first window token at position 6: positions 6, 7 fill block 2;
+    # position 8 is PAST the 2-block allocation (idx 2 -> table 0)
+    out = pk.write_tokens(pool, 0, tables, jnp.asarray([6], jnp.int32),
+                          k, 2 * k)
+    k_np = np.asarray(out["k"])
+    assert k_np[0, 2, 2:].any() and k_np[0, 2].sum() == 2 * 8  # rows 2,3
+    assert k_np[0, 1].sum() == 0          # block 1 (real) untouched
+    assert k_np[0, pk.SCRATCH_BLOCK, 0].sum() == 8   # overflow -> scratch
