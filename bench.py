@@ -671,6 +671,205 @@ def measure_serving_tracing(preset="gpt2-125m", *, streams=8,
         shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def _fleet_replica_child(spec: dict):
+    """``--fleet-replica`` child (one process = one serving replica of
+    the fleet rung): a tiny GPT-2 serving run with an ARMED monitor —
+    ``run_id``-stamped events, SLO objectives live — optionally
+    throttled by sleeping ``throttle_ms`` between scheduler steps (the
+    deliberate straggler).  Writes ``<run_dir>/replica_result.json``
+    with the raw per-request latencies so the parent can compute the
+    EXACT fleet quantiles the merged histograms are checked against."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
+                                         Request, OK, DEADLINE)
+    from deepspeed_tpu.monitor import Monitor
+
+    cfg = GPT2Config(vocab_size=256, max_seq=spec["prompt_len"]
+                     + spec["new_tokens"], n_embd=64, n_layer=4, n_head=4,
+                     embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+                     attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0))
+    mon = Monitor(run_dir=spec["run_dir"], sinks=("jsonl",),
+                  role="serving", run_id=spec["run_id"],
+                  slo=spec.get("slo"))
+    srv = ServingEngine(
+        model=model, params=params, monitor=mon,
+        compile_cache=spec.get("cache_dir"),
+        config=ServingConfig(batch_slots=spec["batch_slots"],
+                             block_size=spec["block_size"],
+                             max_new_tokens=spec["new_tokens"],
+                             preflight=False))
+    rng = np.random.default_rng(spec["seed"])
+    V = cfg.vocab_size
+    reqs = [Request(tokens=rng.integers(0, V, (spec["prompt_len"],)),
+                    max_new_tokens=spec["new_tokens"], seed=i)
+            for i in range(spec["streams"])]
+    throttle_s = spec.get("throttle_ms", 0) / 1e3
+    try:
+        # warm the executables outside the measured window, exactly like
+        # measure_serving — the straggler must be the THROTTLE, not one
+        # replica paying compile while another warm-starts
+        srv.run([Request(tokens=rng.integers(0, V, (spec["prompt_len"],)),
+                         max_new_tokens=2, seed=10 ** 6)])
+        srv.reset_stats()
+        for r in reqs:
+            srv.submit(r)
+        while srv.step():
+            if throttle_s:
+                time.sleep(throttle_s)
+        lat = [(rec["t_done"] - rec["t_submit"]) * 1e3
+               for rec in srv.results.values()
+               if rec["outcome"] in (OK, DEADLINE)
+               and rec["t_done"] is not None
+               and rec["t_submit"] is not None]
+        st = srv.stats()
+        result = {"run_id": spec["run_id"], "latencies_ms": lat,
+                  "completed": st["completed"],
+                  "decode_steps": st["decode_steps"],
+                  "generated_tokens": st["generated_tokens"],
+                  "outcomes": st["outcomes"]}
+    finally:
+        srv.close()
+        mon.close()
+    with open(os.path.join(spec["run_dir"], "replica_result.json"),
+              "w") as f:
+        json.dump(result, f)  # dstpu: disable=DSTPU104
+
+
+def measure_serving_fleet(*, replicas=3, throttled_replica=1,
+                          throttle_ms=60, streams=6, batch_slots=2,
+                          prompt_len=16, new_tokens=48, block_size=8,
+                          p99_slo_ms=None, timeout_s=420,
+                          cache_dir=None):
+    """Multi-process fleet rung (docs/monitoring.md#fleet-view): 2-4
+    REAL serving replicas — separate processes, each with an armed
+    ``run_id``-stamped monitor — with one replica deliberately
+    throttled, merged by the REAL ``ds_fleet`` CLI (``--json``).
+
+    The rung's claims, all checked here and reported honestly:
+
+    - merged latency p50/p99 within the PR-12 ε bound of the EXACT
+      quantile over all replicas' completions (raw latencies from the
+      children, rank-quantile per the histogram contract);
+    - counters sum exactly across replicas;
+    - the throttled replica is named as the straggler in the fleet
+      verdict (leave-one-out z over the observed step cadence);
+    - the fleet-wide SLO replay (``--slo``) yields the
+      ``extra.slo`` headline {objectives_met, worst_burn_rate}.
+
+    Model is intentionally tiny (the rung measures the FLEET layer, not
+    decode throughput — the serving perf rungs do that)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="serving-fleet-")
+    try:
+        slo_block = {"objectives": [
+            {"name": "p99", "series": "latency_p99_ms",
+             "max": p99_slo_ms or 1e9},
+            {"name": "errors", "series": "error_rate", "max": 0.5}]}
+        dirs, procs = [], []
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for i in range(replicas):
+            rd = os.path.join(root, f"replica{i}")
+            os.makedirs(rd)
+            dirs.append(rd)
+            spec = {"run_dir": rd, "run_id": f"replica{i}",
+                    "streams": streams, "prompt_len": prompt_len,
+                    "new_tokens": new_tokens,
+                    "batch_slots": batch_slots, "block_size": block_size,
+                    "seed": 1000 + i, "slo": slo_block,
+                    "cache_dir": cache_dir,
+                    "throttle_ms": (throttle_ms
+                                    if i == throttled_replica else 0)}
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--fleet-replica", json.dumps(spec)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                text=True, env=env))
+        errs = []
+        for p in procs:
+            _, err = p.communicate(timeout=timeout_s)
+            if p.returncode != 0:
+                errs.append((err or "")[-200:])
+        if errs:
+            return {"error": f"replica child failed: {errs[0]}"}
+
+        # exact fleet quantiles from the children's raw latencies (the
+        # oracle the merged histograms are judged against)
+        all_lat, per_replica = [], {}
+        for rd in dirs:
+            with open(os.path.join(rd, "replica_result.json")) as f:
+                res = json.load(f)
+            per_replica[res["run_id"]] = res
+            all_lat.extend(res["latencies_ms"])
+        all_lat.sort()
+
+        def exact_q(q):
+            # rank-quantile, the histogram's contract: value at rank
+            # ceil(q*n)
+            import math
+            return all_lat[max(1, math.ceil(q * len(all_lat))) - 1]
+
+        # the REAL CLI does the merge (this rung IS the ds_fleet drive)
+        slo_path = os.path.join(root, "slo.json")
+        with open(slo_path, "w") as f:
+            json.dump(slo_block, f)  # dstpu: disable=DSTPU104
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bin", "ds_fleet")] + dirs
+            + ["--json", "--slo", slo_path],
+            capture_output=True, text=True, timeout=120)
+        if out.returncode != 0:
+            return {"error": f"ds_fleet failed: {out.stderr[-200:]}"}
+        verdict = json.loads(out.stdout.strip().splitlines()[-1])
+
+        merged = verdict["hists"].get("latency_ms") or {}
+        exact_p50, exact_p99 = exact_q(0.5), exact_q(0.99)
+        eps = 0.025      # PR-12 bound (1%) + rank/representative slack
+        p50_ok = abs(merged.get("p50", 1e18) - exact_p50) \
+            <= eps * exact_p50
+        p99_ok = abs(merged.get("p99", 1e18) - exact_p99) \
+            <= eps * exact_p99
+        counters_sum_ok = (
+            verdict["counters"].get("completed_total")
+            == sum(r["completed"] for r in per_replica.values()))
+        strag = verdict["straggler"]
+        fleet_slo = verdict.get("slo_fleet") or {}
+        return {
+            "replicas": replicas,
+            "streams_per_replica": streams,
+            "throttled_replica": f"replica{throttled_replica}",
+            "throttle_ms": throttle_ms,
+            "completions_total": len(all_lat),
+            "merged_hist_count": merged.get("count"),
+            "merged_p50_ms": merged.get("p50"),
+            "exact_p50_ms": round(exact_p50, 3),
+            "merged_p99_ms": merged.get("p99"),
+            "exact_p99_ms": round(exact_p99, 3),
+            "quantiles_within_eps": bool(p50_ok and p99_ok),
+            "counters_sum_exact": bool(counters_sum_ok),
+            "straggler_named": strag.get("straggler"),
+            "straggler_correct": (strag.get("straggler")
+                                  == f"replica{throttled_replica}"),
+            "straggler_series": strag.get("series"),
+            "straggler_zscore": strag.get("zscore"),
+            "straggler_excess_frac": strag.get("excess_frac"),
+            "fleet_tokens_per_sec": verdict.get("tokens_per_sec"),
+            "slo": {"objectives_met": fleet_slo.get("objectives_met"),
+                    "objectives_total": fleet_slo.get("objectives_total"),
+                    "worst_burn_rate": fleet_slo.get("worst_burn_rate"),
+                    "slo_breaches": fleet_slo.get("slo_breaches")},
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def measure_paged_kernel_vs_gather(preset="gpt2-125m", *, streams=8,
                                    batch_slots=8, prompt_len=64,
                                    new_tokens=32, block_size=32,
@@ -1129,6 +1328,12 @@ def main():
         print(json.dumps(measure_moe_wire_compression()),  # dstpu: disable=DSTPU104
               flush=True)
         return
+    if "--fleet-replica" in sys.argv:
+        # child mode (measure_serving_fleet): one serving replica; the
+        # parse contract is the replica_result.json it writes
+        _fleet_replica_child(
+            json.loads(sys.argv[sys.argv.index("--fleet-replica") + 1]))
+        return
     t_start = time.time()
     left = lambda: TIME_BUDGET_S - (time.time() - t_start)
     cache_dir = bench_cache_dir()
@@ -1300,6 +1505,19 @@ def main():
             extra["serving_125m_b8_tracing"] = {"error": str(e)[:160]}
     else:
         extra["serving_125m_b8_tracing"] = {"skipped": "time budget"}
+
+    # fleet rung (docs/monitoring.md#fleet-view): 3 real serving
+    # replicas in separate processes, one deliberately throttled,
+    # merged by the REAL ds_fleet CLI — ε-bound quantile merge, exact
+    # counter sums, straggler named, fleet SLO verdict
+    if left() > 6 * 60:
+        try:
+            extra["serving_fleet_3rep"] = measure_serving_fleet(
+                replicas=3, throttled_replica=1, cache_dir=cache_dir)
+        except Exception as e:
+            extra["serving_fleet_3rep"] = {"error": str(e)[:160]}
+    else:
+        extra["serving_fleet_3rep"] = {"skipped": "time budget"}
 
     # 760M remat: the largest on-chip model (Adam states + remat'd
     # activations fill the 16GB HBM) — the VERDICT r2 MFU target (>=0.45)
@@ -1475,6 +1693,19 @@ def main():
         headline["extra"]["tracing"] = {
             "overhead_pct": tracing["overhead_pct"],
             "traces": tracing["traces_emitted"]}
+    fleet = extra.get("serving_fleet_3rep") or {}
+    if "straggler_correct" in fleet:
+        headline["extra"]["fleet"] = {
+            "replicas": fleet["replicas"],
+            "quantiles_within_eps": fleet["quantiles_within_eps"],
+            "counters_sum_exact": fleet["counters_sum_exact"],
+            "straggler_correct": fleet["straggler_correct"]}
+        # the SLO verdict rides the headline (satellite: ds_bench_diff
+        # gates burn_rate/slo_breaches as lower-better)
+        if fleet.get("slo", {}).get("objectives_total"):
+            headline["extra"]["slo"] = {
+                "objectives_met": fleet["slo"]["objectives_met"],
+                "worst_burn_rate": fleet["slo"]["worst_burn_rate"]}
     chaos = extra.get("serving_125m_b8_chaos") or {}
     if "tokens_per_sec" in chaos:
         headline["extra"]["serving_chaos"] = {

@@ -89,6 +89,9 @@ def _audit_builtin_steps(stages):
             if str(spec) == "mem":
                 findings.extend(_audit_mem_step(cache_dir))
                 continue
+            if str(spec) == "slo":
+                findings.extend(_audit_slo_step(cache_dir))
+                continue
             compressed = str(spec).endswith("q")
             stage = int(str(spec).rstrip("q"))
             cfg = {"train_micro_batch_size_per_gpu": 4,
@@ -867,6 +870,187 @@ def _audit_mem_step(cache_dir):
     return findings
 
 
+def _audit_slo_step(cache_dir):
+    """--audit-step slo: the SLO engine must stay host-side stream
+    consumption (docs/monitoring.md#slo-tracking).  Gates:
+
+    - twin tiny TRAIN engines — ``monitor.slo`` armed (objectives over
+      tokens/s + MFU, the training floors) vs monitor off — produce
+      byte-identical ``_train_step`` jaxprs, and the armed engine's
+      compiled step shows zero DSTPU201 host callbacks;
+    - twin SERVING engines — armed (p99/error-rate objectives) vs
+      disarmed — produce byte-identical decode-step jaxprs;
+    - the armed streams parse and carry schema-v4 ``slo`` events;
+    - the burn-rate semantics hold on synthetic streams: a sustained
+      p99 breach trips the fast+slow alert, a single transient spike
+      trips nothing."""
+    import shutil
+    import tempfile
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.monitor import (Event, Monitor, SLOConfig,
+                                       SLOEvaluator, parse_line)
+    from deepspeed_tpu.monitor.sinks import EVENTS_FILE
+    from .findings import Finding
+    from .jaxpr_audit import audit_engine, train_step_jaxpr_text
+
+    findings = []
+
+    # ---- synthetic burn-rate semantics (pure host math) --------------
+    cfg = SLOConfig.from_value({
+        "objectives": [{"name": "p99", "series": "latency_p99_ms",
+                        "max": 500.0, "target": 0.99}],
+        "fast_window": 10, "slow_window": 100,
+        "fast_burn": 10.0, "slow_burn": 10.0, "sentinel": False})
+
+    def drive(values):
+        ev = SLOEvaluator(cfg)
+        alerts = []
+        for i, v in enumerate(values):
+            for e in ev.feed(Event(kind="gauge", name="latency_p99_ms",
+                                   t=float(i), step=i, value=v)):
+                if e.kind == "alert" and e.fields.get("state") == "trip":
+                    alerts.append(i)
+        return alerts
+
+    sustained = drive([100.0] * 50 + [900.0] * 50)
+    if not sustained:
+        findings.append(Finding(
+            "DSTPU104", "error",
+            "--audit-step slo: a sustained p99 breach did not trip the "
+            "fast+slow burn-rate alert", eqn_path="slo/burn-rate"))
+    transient = drive([100.0] * 50 + [900.0] + [100.0] * 100)
+    if transient:
+        findings.append(Finding(
+            "DSTPU104", "error",
+            f"--audit-step slo: a single transient spike PAGED (trips at "
+            f"observations {transient}) — the slow window must absorb it",
+            eqn_path="slo/burn-rate"))
+
+    # ---- train twin --------------------------------------------------
+    data = (np.ones((8, 16), np.float32), np.ones((8, 16), np.float32))
+    dataset = [(data[0][i], data[1][i]) for i in range(8)]
+    mon_dir = tempfile.mkdtemp(prefix="dstpu-audit-slo-")
+
+    def build(mon_cfg):
+        cfg = {"train_micro_batch_size_per_gpu": 4,
+               "gradient_accumulation_steps": 1,
+               "steps_per_print": 10 ** 9,
+               "bf16": {"enabled": True},
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": 2},
+               "compile_cache": {"dir": cache_dir}}
+        if mon_cfg:
+            cfg["monitor"] = mon_cfg
+        return ds.initialize(config=cfg, model=_MLP(),
+                             training_data=dataset)[0]
+
+    slo_block = {"objectives": [
+        {"name": "throughput", "series": "tokens_per_sec", "min": 1e-9},
+        {"name": "mfu_floor", "series": "mfu", "min": 1e-12,
+         "target": 0.9}]}
+
+    def read_kinds(run_dir, what):
+        try:
+            with open(os.path.join(run_dir, EVENTS_FILE)) as fh:
+                return {parse_line(ln).kind for ln in fh if ln.strip()}
+        except (OSError, ValueError) as e:
+            findings.append(Finding(
+                "DSTPU104", "error",
+                f"--audit-step slo: {what} event stream did not parse "
+                f"({e})", eqn_path="slo/stream"))
+            return None
+
+    try:
+        off = build(None)
+        armed = build({"enabled": True, "dir": mon_dir,
+                       "sinks": ["jsonl"], "interval": 1,
+                       "slo": slo_block})
+        if train_step_jaxpr_text(off) != train_step_jaxpr_text(armed):
+            findings.append(Finding(
+                "DSTPU201", "error",
+                "--audit-step slo: arming the SLO engine CHANGED the "
+                "traced train step (jaxpr slo-on != slo-off) — "
+                "objective evaluation leaked into the compiled program",
+                eqn_path="slo/jaxpr-equality"))
+        off.close()
+        armed.train_batch()
+        armed.train_batch()
+        report = audit_engine(armed)
+        for f in report.findings:
+            f.extra = dict(f.extra, audit="slo-armed")
+        findings.extend(report.findings)
+        armed.close()             # terminal flush emits the slo verdicts
+        kinds = read_kinds(mon_dir, "train")
+        if kinds is not None and "slo" not in kinds:
+            findings.append(Finding(
+                "DSTPU104", "error",
+                f"--audit-step slo: the armed train run emitted no `slo` "
+                f"events (got {sorted(kinds)})", eqn_path="slo/stream"))
+    finally:
+        shutil.rmtree(mon_dir, ignore_errors=True)
+
+    # ---- serving twin ------------------------------------------------
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
+                                         Request)
+    gcfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
+                      n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                      resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(gcfg, dtype=jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0))
+    scfg = dict(batch_slots=2, block_size=8, max_new_tokens=4,
+                preflight=False)
+
+    def decode_jaxpr(srv):
+        srv._build_decode()
+        return str(jax.make_jaxpr(srv._decode)(*srv._decode_args()))
+
+    clean = ServingEngine(model=model, params=params,
+                          config=ServingConfig(**scfg))
+    clean_jaxpr = decode_jaxpr(clean)
+    clean.close()
+    run_dir = tempfile.mkdtemp(prefix="dstpu-audit-slo-srv-")
+    try:
+        mon = Monitor(run_dir=run_dir, role="serving",
+                      slo={"objectives": [
+                          {"name": "p99", "series": "latency_p99_ms",
+                           "max": 1e9},
+                          {"name": "errors", "series": "error_rate",
+                           "max": 0.5}]})
+        armed = ServingEngine(model=model, params=params, monitor=mon,
+                              config=ServingConfig(**scfg))
+        if decode_jaxpr(armed) != clean_jaxpr:
+            findings.append(Finding(
+                "DSTPU201", "error",
+                "--audit-step slo: arming the monitor+SLO engine "
+                "CHANGED the traced decode step (jaxpr armed != "
+                "disarmed)", eqn_path="slo/jaxpr-equality"))
+        armed.run([Request(tokens=np.arange(4), max_new_tokens=8,
+                           uid=u) for u in range(2)])
+        verdict = armed.slo_report()
+        if not verdict or verdict.get("objectives_total") != 2:
+            findings.append(Finding(
+                "DSTPU104", "error",
+                f"--audit-step slo: ServingEngine.slo_report() did not "
+                f"carry the armed objectives (got {verdict})",
+                eqn_path="slo/report"))
+        armed.close()
+        mon.close()
+        kinds = read_kinds(run_dir, "serving")
+        if kinds is not None and "slo" not in kinds:
+            findings.append(Finding(
+                "DSTPU104", "error",
+                f"--audit-step slo: the armed serving run emitted no "
+                f"`slo` events (got {sorted(kinds)})",
+                eqn_path="slo/stream"))
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+    return findings
+
+
 def _audit_elastic_resume():
     """--audit-step elastic: audit the FIRST compiled step after an elastic
     reshard-on-resize (docs/elasticity.md) — a ZeRO-2 elastic engine saves
@@ -988,7 +1172,14 @@ def main(argv=None):
                          "step byte-identical ledger-on vs off while "
                          "its schema-v3 `mem` events parse and name "
                          "the expected subsystems "
-                         "(docs/monitoring.md#memory-explainability)")
+                         "(docs/monitoring.md#memory-explainability); "
+                         "'slo' proves the SLO engine leaves BOTH "
+                         "compiled steps byte-identical armed vs off, "
+                         "emits parseable schema-v4 `slo` events, and "
+                         "honors the multi-window burn-rate semantics "
+                         "on synthetic streams (sustained breach trips, "
+                         "transient spike does not; "
+                         "docs/monitoring.md#slo-tracking)")
     args = ap.parse_args(argv)
 
     # findings are the stdout payload (the tier-1 gate parses --json);

@@ -16,8 +16,10 @@ Metric classification (by key name, innermost key of the JSON path):
 - **lower-better** (latency/cost family): keys ending in ``_ms``/``_s``
   (``p50_ms``, ``p99_ms``, ``ttft_*``, ``prefill_ms``, compile times),
   ``ms_per_token*``, ``*_bytes``/``*_bytes_per_step`` (wire/pool cost),
-  ``host_pct``/``overhead_pct``, and the memory family
-  (``rss_hwm_gb``, ``pool_bytes``, ``peak_bytes`` — capacity costs);
+  ``host_pct``/``overhead_pct``, the memory family
+  (``rss_hwm_gb``, ``pool_bytes``, ``peak_bytes`` — capacity costs),
+  and the slo family (``*burn_rate*``, ``slo_breaches`` — error-budget
+  costs);
 - everything else numeric is **informational** — reported when it moved,
   never gated (counts, shapes, config echoes).
 
@@ -48,6 +50,9 @@ LOWER_BETTER_BYTES = ("wire_bytes", "bytes_per_step")
 # high-water marks, KV-pool residency and projected/measured peaks are
 # capacity costs — growth beyond band is a regression
 LOWER_BETTER_MEM = ("rss_hwm_gb", "pool_bytes", "peak_bytes")
+# slo family (docs/monitoring.md#slo-tracking): burn rates and breach
+# counts are budget costs — growth beyond band is a regression
+LOWER_BETTER_SLO = ("burn_rate", "slo_breaches")
 
 
 def classify(key: str):
@@ -56,7 +61,8 @@ def classify(key: str):
     for name in HIGHER_BETTER:
         if name in k:
             return "higher"
-    for name in LOWER_BETTER + LOWER_BETTER_BYTES + LOWER_BETTER_MEM:
+    for name in (LOWER_BETTER + LOWER_BETTER_BYTES + LOWER_BETTER_MEM
+                 + LOWER_BETTER_SLO):
         if name in k:
             return "lower"
     if k.endswith(LOWER_BETTER_SUFFIX):

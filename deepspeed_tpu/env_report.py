@@ -195,7 +195,13 @@ def monitor_report():
     print(f"interval .............. every {pol['interval']} step(s)")
     print(f"ring_size ............. {pol['ring_size']} events")
     print(f"trace_steps ........... {pol['trace_steps'] or 'disabled'}")
+    print(f"rotate_mb ............. {pol['rotate_mb'] or 'disabled'}")
+    slo = pol.get("slo")
+    n_obj = len((slo or {}).get("objectives", []) or [])
+    print(f"slo ................... "
+          f"{f'{n_obj} objective(s)' if slo else 'disabled'}")
     print("tail with ............. python -m deepspeed_tpu.monitor <dir>")
+    print("fleet view ............ ds_fleet <dir1> <dir2> ...")
 
 
 def main():

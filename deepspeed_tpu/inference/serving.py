@@ -417,6 +417,9 @@ class ServingEngine:
         # ---- resilience state (docs/serving.md#resilience) ----
         self._outcomes = {k: 0 for k in OUTCOMES}
         self._requeued_total = 0
+        # (terminal, bad) totals at the last error_rate emission — the
+        # SLO engine's windowed error-rate series (monitor/slo.py)
+        self._err_window_last = (0, 0)
         # speculative-decode acceptance accounting (drafted vs accepted
         # draft tokens; the bonus token after a fully-accepted window is
         # free and not counted on either side)
@@ -1416,9 +1419,13 @@ class ServingEngine:
                 and mem_every and self._steps % mem_every == 0):
             from ..monitor import memory_ledger as mled
             mled.attribute_serving(self).emit(mon, step=self._steps)
-        if not mon.armed or not mon.should_emit(self._steps):
+        if not mon.armed:
             mon.end_step(self._steps, name="serving_step")
             return
+        # scalars/counters are cheap host reads: pass them even on
+        # thinned steps so the monitor's terminal flush (drain/close)
+        # lands the run's FINAL state in the stream — `monitor.interval`
+        # must not truncate what ds_fleet merges see
         scalars = {"active_slots": active_slots,
                    "queued": len(self.queue),
                    "completed_total": self._completed_total,
@@ -1426,13 +1433,38 @@ class ServingEngine:
                    "free_blocks": self.allocator.free_blocks}
         # resilience outcomes as counters: the ds_top serving line and
         # any alerting pipeline read shed/deadline/poison pressure from
-        # the one event stream (docs/monitoring.md)
+        # the one event stream (docs/monitoring.md).  The cumulative
+        # completion/token totals ride as counters too — counters are
+        # what ds_fleet SUMS across replicas (fleet.py), and the fleet's
+        # completed count must equal the sum of the replicas' exactly
         counters = {"shed_total": self._outcomes[SHED],
                     "deadline_total": self._outcomes[DEADLINE],
                     "poisoned_total": self._outcomes[POISONED],
                     "requeued_total": self._requeued_total,
-                    "breaker_open": int(self._breaker_open)}
+                    "breaker_open": int(self._breaker_open),
+                    "completed_total": self._completed_total,
+                    "generated_total": self._generated_total}
         gauges = {}
+        # windowed error rate from the outcome counters (the SLO
+        # engine's error-budget series, docs/monitoring.md#slo-tracking):
+        # bad/total over the terminal outcomes since the last EMISSION —
+        # a cumulative ratio would dilute a fresh burn under a long
+        # healthy history.  The baseline advances only on emitted steps:
+        # a thinned step's gauge lands at most once (the terminal-flush
+        # tail), so advancing the baseline there would silently drop its
+        # outcomes from the error budget forever.
+        term = sum(self._outcomes.values())
+        bad = term - self._outcomes[OK]
+        d_term = term - self._err_window_last[0]
+        if d_term > 0:
+            gauges["error_rate"] = round(
+                (bad - self._err_window_last[1]) / d_term, 4)
+        if not mon.should_emit(self._steps):
+            mon.end_step(self._steps, scalars=scalars, gauges=gauges,
+                         counters=counters, name="serving_step")
+            return
+        if d_term > 0:
+            self._err_window_last = (term, bad)
         if self.spec is not None:
             # speculative acceptance on the bus: drafted vs accepted
             # draft tokens (counters merge across replicas/restarts),
@@ -1556,6 +1588,18 @@ class ServingEngine:
             gather_bytes=fields["gather_bytes"],
             paged_impl=fields.get("paged_impl"),
             n_chips=fields["n_chips"])
+
+    # ----------------------------------------------------------------- slo
+    def slo_report(self) -> Optional[dict]:
+        """The live SLO engine's roll-up verdict (``monitor/slo.py``;
+        docs/monitoring.md#slo-tracking): per-objective error budgets +
+        burn rates over the serving series this engine emits
+        (``latency_p99_ms``/``ttft_p50_ms``/``error_rate``/
+        ``tokens_per_sec``), plus the regression sentinel's trip count.
+        What a bench rung embeds as ``extra.slo`` and the SLO-driven
+        autotuner (ROADMAP #5) scores candidates by.  None unless the
+        attached monitor carries a ``monitor.slo`` config."""
+        return self.monitor.slo_verdict()
 
     # ------------------------------------------------------------ memory ledger
     def memory_ledger(self) -> dict:
@@ -1703,6 +1747,7 @@ class ServingEngine:
         self._steps = 0
         self._outcomes = {k: 0 for k in OUTCOMES}
         self._requeued_total = 0
+        self._err_window_last = (0, 0)
         self._spec_proposed_total = 0
         self._spec_accepted_total = 0
         self._traces_emitted = 0

@@ -28,7 +28,13 @@ an armed monitor leaves the compiled program byte-identical (gated by
 the jaxpr-equality test and the ``--audit-step monitor`` stage).
 
 Consumption: ``python -m deepspeed_tpu.monitor <run_dir>`` (``ds_top``)
-tails the JSONL stream into a refreshing terminal table.
+tails the JSONL stream into a refreshing terminal table; ``ds_fleet``
+(``monitor/fleet.py``, or ``--fleet dir1 dir2 ...``) merges N
+per-replica streams into one fleet view with exact histogram merges and
+a straggler verdict.  The v4 kinds — ``slo`` (rolling error-budget
+verdicts) and ``alert`` (burn-rate trips + the live regression
+sentinel) — come from the declarative SLO engine (``monitor/slo.py``,
+config block ``monitor.slo``).
 
 See docs/monitoring.md for the schema, span taxonomy, configuration
 (config ``monitor`` block > env ``DSTPU_MONITOR`` > ``deepspeed
@@ -41,13 +47,18 @@ from .ring import RingBuffer
 from .bus import MonitorBus
 from .spans import SpanRecorder
 from .sinks import (Sink, JSONLSink, CSVSink, RingBufferSink,
-                    TensorboardSink, SinkUnavailable, EVENTS_FILE)
+                    TensorboardSink, SinkUnavailable, EVENTS_FILE,
+                    stream_segments)
 from .core import Monitor, NullMonitor, from_config
+from .slo import (Objective, SentinelConfig, SLOConfig, SLOEvaluator,
+                  RegressionSentinel)
 
 __all__ = [
     "SCHEMA_VERSION", "EVENT_KINDS", "Event", "parse_line",
     "LogHistogram", "RingBuffer", "MonitorBus", "SpanRecorder",
     "Sink", "JSONLSink", "CSVSink", "RingBufferSink", "TensorboardSink",
-    "SinkUnavailable", "EVENTS_FILE",
+    "SinkUnavailable", "EVENTS_FILE", "stream_segments",
     "Monitor", "NullMonitor", "from_config",
+    "Objective", "SentinelConfig", "SLOConfig", "SLOEvaluator",
+    "RegressionSentinel",
 ]

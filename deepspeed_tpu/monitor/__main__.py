@@ -5,6 +5,7 @@ Usage::
     python -m deepspeed_tpu.monitor <run_dir | events.jsonl> \
         [--interval 2] [--once] [--tail N]
     python -m deepspeed_tpu.monitor <run_dir> --export-trace [--out X.json]
+    python -m deepspeed_tpu.monitor --fleet dir1 dir2 ...   # -> ds_fleet
 
 Reads ``events.jsonl`` incrementally (only bytes appended since the last
 poll), folds the events into one aggregate view (latest step scalars,
@@ -22,32 +23,41 @@ import sys
 import time
 
 from .events import parse_line
-from .sinks import EVENTS_FILE
+from .sinks import EVENTS_FILE, resolve_stream  # noqa: F401 (re-export:
+# bench/tests resolve run dirs through this module's historical name)
 
 
 class StreamFollower:
     """Incremental JSONL reader: remembers the byte offset, returns only
-    complete new lines each poll (a partial trailing line is carried)."""
+    complete new lines each poll (a partial trailing line is carried).
 
-    def __init__(self, path):
+    Segment-aware (docs/monitoring.md#stream-rotation): when the sink
+    rotates the active file to ``events.jsonl.<n>``, the follower
+    finishes the rotated segment from its remembered offset (matched by
+    inode — the rename preserves it) before moving to the fresh active
+    file, so no event is ever skipped or double-read across a rotation.
+    Unread older segments found on first poll are read in order, which
+    is also how ``ds_fleet`` reads a whole rotated stream."""
+
+    def __init__(self, path, max_version=None):
         self.path = path
         self.offset = 0
         self._carry = ""
+        self._ino = None              # inode of the file `offset` is into
+        self._done = set()            # fully-consumed rotated segments
         self.bad_lines = 0
+        self.max_version = max_version   # None -> this build's ceiling
 
-    def poll(self):
+    def _read_from(self, path, start):
+        """Complete new lines of one file from byte ``start``; returns
+        (events, end_offset)."""
         try:
-            size = os.path.getsize(self.path)
+            with open(path, "r", encoding="utf-8") as f:
+                f.seek(start)
+                chunk = f.read()
+                end = f.tell()
         except OSError:
-            return []
-        if size < self.offset:        # truncated/rotated: restart
-            self.offset, self._carry = 0, ""
-        if size == self.offset:
-            return []
-        with open(self.path, "r", encoding="utf-8") as f:
-            f.seek(self.offset)
-            chunk = f.read()
-            self.offset = f.tell()
+            return [], start
         data = self._carry + chunk
         lines = data.split("\n")
         self._carry = lines.pop()     # "" on a complete final line
@@ -56,9 +66,80 @@ class StreamFollower:
             if not line.strip():
                 continue
             try:
-                events.append(parse_line(line))
+                if self.max_version is None:
+                    events.append(parse_line(line))
+                else:
+                    events.append(parse_line(
+                        line, max_version=self.max_version))
             except Exception:
                 self.bad_lines += 1
+        return events, end
+
+    @staticmethod
+    def _ino_of(path):
+        try:
+            return os.stat(path).st_ino
+        except OSError:
+            return None
+
+    def poll(self):
+        from .sinks import stream_segments
+        events = []
+        # rotated segments first (oldest → newest): the one our offset
+        # was into — identified by inode — resumes from that offset, any
+        # other unread segment reads from the top
+        for seg in stream_segments(self.path):
+            if seg in self._done:
+                continue
+            ino = self._ino_of(seg)
+            start = self.offset if (self._ino is not None
+                                    and ino == self._ino) else 0
+            got, _ = self._read_from(seg, start)
+            events.extend(got)
+            if self._carry:
+                # rotated segments are immutable: a torn trailing line
+                # can only be a crash mid-write — count it, drop it
+                self.bad_lines += 1
+                self._carry = ""
+            self._done.add(seg)
+            if self._ino is not None and ino == self._ino:
+                self._ino, self.offset = None, 0
+        # then the active file
+        ino = self._ino_of(self.path)
+        if ino is None:
+            return events
+        if ino != self._ino and self._ino is not None:
+            # the active file was rotated AFTER the segment scan above:
+            # drain the renamed file (matched by inode) before switching,
+            # so the boundary is never skipped or double-read
+            for seg in stream_segments(self.path):
+                if seg not in self._done and self._ino_of(seg) == self._ino:
+                    got, _ = self._read_from(seg, self.offset)
+                    events.extend(got)
+                    if self._carry:
+                        self.bad_lines += 1
+                        self._carry = ""
+                    self._done.add(seg)
+                    break
+            else:
+                # rename not visible in the listing yet: leave the
+                # offset alone and resolve on the next poll
+                return events
+            self._ino, self.offset = None, 0
+        if ino != self._ino:
+            # fresh active file (first poll, or a rotation we just
+            # drained above): start from the top
+            self._ino, self.offset, self._carry = ino, 0, ""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return events
+        if size < self.offset:        # truncated in place: restart
+            self.offset, self._carry = 0, ""
+        if size == self.offset:
+            return events
+        got, self.offset = self._read_from(self.path, self.offset)
+        events.extend(got)
         return events
 
 
@@ -76,6 +157,9 @@ class Aggregate:
         self.traces = 0               # request traces seen
         self.last_trace = None        # newest trace event fields
         self.mem = None               # newest memory-ledger event fields
+        self.slo = {}                 # objective name -> newest slo fields
+        self.alerts = []              # newest-last alert events (bounded)
+        self.alerts_total = 0
         self.events = 0
         self.skips_total = 0
         self.last_t = None
@@ -107,6 +191,12 @@ class Aggregate:
                 self.last_trace = e.fields
             elif e.kind == "mem":
                 self.mem = e.fields
+            elif e.kind == "slo":
+                self.slo[e.name] = e.fields
+            elif e.kind == "alert":
+                self.alerts_total += 1
+                self.alerts.append(e)
+                del self.alerts[:-4]
 
 
 def _fmt(v, unit=""):
@@ -212,6 +302,32 @@ def render(agg: Aggregate, source: str, clock=time.time) -> str:
             parts.append(f"residual {_fmt(resid, 'B')}")
         parts.append(f"rss hwm {_fmt(m.get('rss_hwm_gb'))}GB")
         lines += ["-" * 78, "mem: " + "  |  ".join(parts)]
+    if agg.slo or agg.alerts_total:
+        # SLO line (docs/monitoring.md#slo-tracking): per-objective
+        # verdict — met/BURNING, budget remaining, fast/slow burn rates
+        parts = []
+        for name, f in sorted(agg.slo.items()):
+            bound = (f"<={_fmt(f.get('max'))}" if f.get("max") is not None
+                     else f">={_fmt(f.get('min'))}")
+            state = "BURNING" if f.get("alerting") else (
+                "ok" if f.get("met") else "breached")
+            budget_pct = (f.get("budget_remaining_frac") or 0) * 100
+            parts.append(
+                f"{name} [{f.get('series', '?')}{bound}] {state} "
+                f"budget {_fmt(budget_pct)}% "
+                f"burn {_fmt(f.get('burn_fast'))}/"
+                f"{_fmt(f.get('burn_slow'))}")
+        line = "slo: " + ("  |  ".join(parts) if parts else "-")
+        if agg.alerts_total:
+            last = agg.alerts[-1]
+            detail = last.fields.get("state")
+            if not detail:
+                rel = (last.fields.get("rel_change") or 0) * 100
+                detail = f"+{_fmt(rel)}%"
+            line += (f"   alerts: {agg.alerts_total} "
+                     f"(last {last.name}: "
+                     f"{last.fields.get('series', '?')} {detail})")
+        lines += ["-" * 78, line]
     if agg.traces:
         lt = agg.last_trace or {}
         lines.append(
@@ -238,16 +354,20 @@ def render(agg: Aggregate, source: str, clock=time.time) -> str:
     return "\n".join(lines)
 
 
-def resolve_stream(path: str) -> str:
-    return (path if path.endswith(".jsonl")
-            else os.path.join(path, EVENTS_FILE))
-
-
 def main(argv=None):
+    # fleet mode hands the whole argv to ds_fleet (monitor/fleet.py):
+    # N run dirs, merged view, straggler verdict
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if "--fleet" in argv:
+        from .fleet import main as fleet_main
+        return fleet_main([a for a in argv if a != "--fleet"])
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.monitor",
         description="ds_top: live terminal view of a monitor event stream")
     ap.add_argument("run", help="monitor run dir (or an events.jsonl path)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="merge MULTIPLE run dirs into the ds_fleet view "
+                         "(accepts many dirs; see bin/ds_fleet)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh period in seconds (default 2)")
     ap.add_argument("--once", action="store_true",

@@ -14,9 +14,13 @@ from .events import Event
 
 
 class MonitorBus:
-    def __init__(self, sinks=(), clock=time.time):
+    def __init__(self, sinks=(), clock=time.time, run_id=None):
         self._sinks = list(sinks)
         self._clock = clock
+        # replica stamp: every event this bus emits carries `run` so N
+        # per-replica streams merge into one fleet view with attribution
+        # intact (monitor/fleet.py / ds_fleet)
+        self.run_id = str(run_id) if run_id else None
         self.dead_sinks = {}          # sink name -> repr(exception)
         self.emitted = 0
 
@@ -29,6 +33,8 @@ class MonitorBus:
 
     def emit(self, event: Event):
         self.emitted += 1
+        if self.run_id is not None and event.run is None:
+            event.run = self.run_id
         for sink in tuple(self._sinks):
             try:
                 sink.write(event)
@@ -86,6 +92,20 @@ class MonitorBus:
         docs/monitoring.md#memory-explainability) — per-subsystem
         attributed bytes + measured gauges + the residual."""
         self.emit(Event(kind="mem", name=name, t=self._clock(),
+                        step=step, fields=fields))
+
+    def slo(self, name, step=None, **fields):
+        """One objective's rolling SLO verdict (schema-v4 ``slo`` event;
+        docs/monitoring.md#slo-tracking) — error-budget remaining and
+        the fast/slow burn rates."""
+        self.emit(Event(kind="slo", name=name, t=self._clock(),
+                        step=step, fields=fields))
+
+    def alert(self, name, step=None, **fields):
+        """One typed alert (schema-v4 ``alert`` event): a burn-rate trip
+        or a regression-sentinel change-point, plus its ``resolved``
+        twin (docs/monitoring.md#slo-tracking)."""
+        self.emit(Event(kind="alert", name=name, t=self._clock(),
                         step=step, fields=fields))
 
     # -------------------------------------------------------------- lifecycle

@@ -33,6 +33,7 @@ from .trace import TraceWindow
 DEFAULT_RUN_DIR = "ds_monitor"
 ENV_ENABLED = "DSTPU_MONITOR"
 ENV_DIR = "DSTPU_MONITOR_DIR"
+ENV_RUN_ID = "DSTPU_RUN_ID"
 
 # scalar-sync lag in steps (mirrors health_check.check_interval's default):
 # reading step t's device scalars after step t+1 dispatched blocks only on
@@ -69,7 +70,12 @@ class NullMonitor:
     bus = None
     ring = None
     run_dir = None
+    run_id = None
     memory_interval = None
+    slo = None
+
+    def slo_verdict(self):
+        return None
 
     def span(self, name):
         return _NULL_CTX
@@ -124,6 +130,30 @@ class NullMonitor:
         return {"enabled": False}
 
 
+class _SLOBridge:
+    """Pseudo-sink: feeds every bus emission through the SLO evaluator
+    (``monitor/slo.py``) and re-emits the due ``slo``/``alert`` events.
+    Reentrant ``bus.emit`` is safe — the evaluator ignores the kinds it
+    produces — and the bus's failure isolation applies: an evaluator
+    bug detaches telemetry, never the step."""
+
+    name = "slo"
+
+    def __init__(self, evaluator, bus):
+        self.evaluator = evaluator
+        self._bus = bus
+
+    def write(self, event):
+        for e in self.evaluator.feed(event):
+            self._bus.emit(e)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
 class Monitor:
     """Armed runtime telemetry for one process (see module docstring)."""
 
@@ -131,10 +161,15 @@ class Monitor:
 
     def __init__(self, *, run_dir=None, sinks=("jsonl", "ring"),
                  interval=1, trace_steps=None, ring_size=1024, retry=None,
-                 role="train", clock=time.time, memory_interval=None):
+                 role="train", clock=time.time, memory_interval=None,
+                 run_id=None, slo=None, rotate_mb=0):
         self.run_dir = run_dir
         self.role = role
         self.interval = max(1, int(interval))
+        # replica stamp for fleet merges (monitor/fleet.py): explicit >
+        # env DSTPU_RUN_ID > host-pid.  Stamped on every event by the bus.
+        self.run_id = str(run_id or os.environ.get(ENV_RUN_ID, "").strip()
+                          or _default_run_id())
         # memory-ledger cadence carried WITH the monitor so consumers
         # that never see the config block (ServingEngine takes a Monitor
         # object) still honor `monitor.memory_interval` — None means
@@ -154,7 +189,8 @@ class Monitor:
                 continue
             try:
                 sink = make_sink(kind, run_dir, retry=retry,
-                                 ring_size=ring_size)
+                                 ring_size=ring_size,
+                                 rotate_bytes=int(rotate_mb or 0) << 20)
             except SinkUnavailable as e:
                 logger.warning(f"monitor: sink {kind!r} unavailable ({e}); "
                                "continuing without it")
@@ -162,7 +198,17 @@ class Monitor:
             if isinstance(sink, RingBufferSink):
                 self.ring = sink.ring
             built.append(sink)
-        self.bus = MonitorBus(built, clock=clock)
+        self.bus = MonitorBus(built, clock=clock, run_id=self.run_id)
+        # SLO engine (monitor/slo.py): a bridge sink feeds every bus
+        # emission through the evaluator and re-emits the due slo/alert
+        # events — live and offline replay share one code path
+        from .slo import SLOConfig
+        self.slo = None
+        slo_cfg = SLOConfig.from_value(slo)
+        if slo_cfg is not None:
+            from .slo import SLOEvaluator
+            self.slo = SLOEvaluator(slo_cfg)
+            self.bus.attach(_SLOBridge(self.slo, self.bus))
         self._trace = None
         if trace_steps:
             start, stop = trace_steps
@@ -172,6 +218,10 @@ class Monitor:
         self._rates = {}              # tokens_per_step/flops_per_step/peak
         self._root = None
         self._pending = []            # lagged step-event queue
+        self._tail = None             # newest interval-thinned step (the
+        #                               flush-at-close fix: a 7-step run
+        #                               at interval=5 must not lose steps
+        #                               6-7's gauges from the stream)
         self._last_step = None
         self.steps_seen = 0
 
@@ -245,9 +295,16 @@ class Monitor:
         self._last_step = step_no
         self.steps_seen += 1
         if not self.should_emit(step_no):
+            # off-interval: stash the newest step so a terminal flush
+            # (drain/close) can still land it — interval thinning must
+            # not drop the run's FINAL steps from the stream
+            if bool(self.bus.sinks):
+                self._tail = (step_no, name, dict(scalars or {}), wall,
+                              dict(gauges or {}), dict(counters or {}))
             if self._trace is not None:
                 self._trace_after(step_no)
             return done
+        self._tail = None
         for s in done:
             self.bus.span(s["name"], s["dur_s"], step=step_no,
                           parent=s["parent"])
@@ -332,8 +389,27 @@ class Monitor:
                               stop_step=self._trace.stop_step)
             self.bus.flush()
 
+    # ----------------------------------------------------------------- slo
+    def slo_verdict(self):
+        """The SLO engine's roll-up verdict (None when ``monitor.slo``
+        is not configured) — what ``ServingEngine.slo_report()`` and the
+        bench/autotuner consume (docs/monitoring.md#slo-tracking)."""
+        return self.slo.verdict() if self.slo is not None else None
+
     # ------------------------------------------------------------- lifecycle
     def flush(self):
+        if self._tail is not None:
+            # terminal flush of the newest interval-thinned step: its
+            # step event, rate gauges and host gauges/counters land now,
+            # so short runs and ds_fleet merges see complete streams
+            step_no, name, scalars, wall, gauges, counters = self._tail
+            self._tail = None
+            self._emit_rate_gauges(step_no, wall)
+            for gname, gval in gauges.items():
+                self.bus.gauge(gname, gval, step=step_no)
+            for cname, cval in counters.items():
+                self.bus.counter(cname, cval, step=step_no)
+            self._pending.append((step_no, name, scalars, wall))
         while self._pending:
             self._emit_step(self._pending.pop(0))
         self.bus.flush()
@@ -342,15 +418,36 @@ class Monitor:
         if self._trace is not None:
             self._trace.abort()
         self.flush()
+        if self.slo is not None:
+            # whole-run SLO verdict, one terminal `slo` event per
+            # objective (short runs may never hit the emit cadence)
+            for e in self.slo.final_events(step=self._last_step,
+                                           t=time.time()):
+                self.bus.emit(e)
+            self.bus.flush()
         self.bus.close()
 
     def report(self) -> dict:
         return {"enabled": True, "dir": self.run_dir, "role": self.role,
-                "interval": self.interval,
+                "interval": self.interval, "run_id": self.run_id,
                 "sinks": [getattr(s, "name", "?") for s in self.bus.sinks],
                 "dead_sinks": dict(self.bus.dead_sinks),
                 "events_emitted": self.bus.emitted,
+                "slo": (self.slo.cfg.describe() if self.slo is not None
+                        else None),
                 "steps_seen": self.steps_seen}
+
+
+def _default_run_id() -> str:
+    """host-pid replica stamp: unique enough to tell fleet replicas
+    apart without coordination (explicit ``monitor.run_id`` / env
+    ``DSTPU_RUN_ID`` wins for stable names)."""
+    import socket
+    try:
+        host = socket.gethostname().split(".")[0]
+    except OSError:
+        host = "host"
+    return f"{host}-{os.getpid()}"
 
 
 def env_enabled(default=None):
@@ -381,4 +478,7 @@ def from_config(cfg, *, override_enabled=None, retry=None, role="train"):
     return Monitor(run_dir=resolve_run_dir(cfg.dir), sinks=cfg.sinks,
                    interval=cfg.interval, trace_steps=cfg.trace_steps,
                    ring_size=cfg.ring_size, retry=retry, role=role,
-                   memory_interval=getattr(cfg, "memory_interval", None))
+                   memory_interval=getattr(cfg, "memory_interval", None),
+                   run_id=getattr(cfg, "run_id", None),
+                   slo=getattr(cfg, "slo", None),
+                   rotate_mb=getattr(cfg, "rotate_mb", 0))

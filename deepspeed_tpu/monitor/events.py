@@ -22,7 +22,20 @@ and offline consumers parse exactly one format:
                device HBM and host RSS attributed to named subsystems,
                with the measured-minus-attributed *residual* and the
                per-phase host RSS high-water marks — what ``ds_mem``
-               and the ``ds_top`` memory line read (v3).
+               and the ``ds_top`` memory line read (v3);
+- ``slo``      one objective's rolling verdict from the SLO engine
+               (``monitor/slo.py``): error-budget remaining and the
+               fast/slow-window burn rates over a declared objective —
+               what the ``ds_top`` SLO line and
+               ``ServingEngine.slo_report()`` read (v4);
+- ``alert``    a typed page-worthy condition: a multi-window burn-rate
+               trip or the live regression sentinel's change-point
+               verdict ("the last N steps are X% slower"), plus the
+               matching ``resolved`` record when it clears (v4).
+
+Every event also carries an optional ``run`` stamp (the producing
+replica's ``run_id``) so N per-replica streams merge into one fleet
+view (``monitor/fleet.py`` / ``ds_fleet``) without losing attribution.
 
 The wire format is one JSON object per line, ``sort_keys`` + compact
 separators, ``None`` fields dropped; non-finite floats are serialized as
@@ -44,15 +57,15 @@ import json
 import math
 from typing import Any, Dict, Optional
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 EVENT_KINDS = ("step", "span", "gauge", "counter", "artifact", "hist",
-               "trace", "mem")
+               "trace", "mem", "slo", "alert")
 
 # schema version that introduced each kind (absent -> 1); events stamp
-# this, so a v1/v2 consumer keeps parsing the kinds it knows from a v3
-# producer and count-and-skips exactly the newer ones
-KIND_VERSIONS = {"hist": 2, "trace": 2, "mem": 3}
+# this, so a v1/v2/v3 consumer keeps parsing the kinds it knows from a
+# v4 producer and count-and-skips exactly the newer ones
+KIND_VERSIONS = {"hist": 2, "trace": 2, "mem": 3, "slo": 4, "alert": 4}
 
 
 def _scalar(v):
@@ -96,6 +109,7 @@ class Event:
     path: Optional[str] = None            # artifact payload location
     fields: Dict[str, Any] = dataclasses.field(default_factory=dict)
     v: Optional[int] = None       # stamped per kind (KIND_VERSIONS)
+    run: Optional[str] = None     # producing replica's run_id (fleet merge)
 
     def __post_init__(self):
         if self.kind not in EVENT_KINDS:
@@ -112,6 +126,8 @@ class Event:
             self.value = float(_scalar(self.value))
         if self.dur_s is not None:
             self.dur_s = float(self.dur_s)
+        if self.run is not None:
+            self.run = str(self.run)
         self.fields = {str(k): _scalar(val) for k, val in
                        (self.fields or {}).items()}
 
@@ -119,7 +135,7 @@ class Event:
         """Compact dict form: None-valued optionals are dropped."""
         out = {"v": self.v, "kind": self.kind, "name": self.name,
                "t": self.t}
-        for key in ("step", "value", "dur_s", "parent", "path"):
+        for key in ("step", "value", "dur_s", "parent", "path", "run"):
             val = getattr(self, key)
             if val is not None:
                 out[key] = val
@@ -147,7 +163,7 @@ class Event:
                    step=d.get("step"), value=d.get("value"),
                    dur_s=d.get("dur_s"), parent=d.get("parent"),
                    path=d.get("path"), fields=dict(d.get("fields") or {}),
-                   v=v)
+                   v=v, run=d.get("run"))
 
 
 def parse_line(line: str, max_version: int = SCHEMA_VERSION) -> Event:

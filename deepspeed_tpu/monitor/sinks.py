@@ -14,6 +14,7 @@ import csv
 import io
 import json
 import os
+import re
 
 from ..utils.logging import logger
 from ..utils.retry import RetryPolicy, retry_call
@@ -24,7 +25,41 @@ EVENTS_FILE = "events.jsonl"
 EVENTS_CSV_FILE = "events.csv"
 
 CSV_COLUMNS = ("v", "kind", "name", "t", "step", "value", "dur_s",
-               "parent", "path", "fields")
+               "parent", "path", "run", "fields")
+
+# rotated-segment suffix: events.jsonl.1, .2, ... (monotonically
+# increasing; the bare path is always the ACTIVE segment)
+_SEGMENT_RE = re.compile(r"\.(\d+)$")
+
+
+def resolve_stream(path: str) -> str:
+    """A run dir or a direct ``*.jsonl`` path → the stream path (the
+    one rule every consumer — ``ds_top``, ``ds_fleet``, the trace
+    export — resolves a run argument by)."""
+    return (path if path.endswith(".jsonl")
+            else os.path.join(path, EVENTS_FILE))
+
+
+def stream_segments(path: str):
+    """Rotated segments of a JSONL stream, oldest first (the active
+    ``path`` itself is NOT included).  The same-class fix as the PR-10
+    journal rotation: a long-running server's stream grows unbounded, so
+    the sink rotates by size and readers (``ds_top``/``ds_fleet``)
+    follow across segments."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for name in names:
+        if not name.startswith(base + "."):
+            continue
+        m = _SEGMENT_RE.search(name)
+        if m and name[:-len(m.group(0))] == base:
+            out.append((int(m.group(1)), os.path.join(d, name)))
+    return [p for _, p in sorted(out)]
 
 
 class SinkUnavailable(RuntimeError):
@@ -118,12 +153,47 @@ class _AppendFileSink(Sink):
 
 
 class JSONLSink(_AppendFileSink):
-    """The default stream: one compact JSON event per line."""
+    """The default stream: one compact JSON event per line.
+
+    ``rotate_bytes`` > 0 arms size-based segment rotation: when the
+    active file reaches the bound after a flush, it is renamed to
+    ``events.jsonl.<n>`` (n monotonically increasing) and the next
+    append starts a fresh active file.  Rotation happens BETWEEN
+    flushes — every segment ends on a complete line — and rotated
+    segments are never written again, so a concurrent reader
+    (:class:`..__main__.StreamFollower`) follows across the boundary
+    torn-tail-safe and ``ds_fleet`` reads segments + active as one
+    stream."""
 
     name = "jsonl"
 
+    def __init__(self, path, retry=None, flush_every: int = 64,
+                 rotate_bytes: int = 0):
+        super().__init__(path, retry=retry, flush_every=flush_every)
+        self.rotate_bytes = max(0, int(rotate_bytes))
+        self.rotations = 0
+
     def _format(self, event: Event) -> str:
         return event.to_json() + "\n"
+
+    def _append(self, data: str):
+        super()._append(data)
+        if self.rotate_bytes and self._fh.tell() >= self.rotate_bytes:
+            self._rotate()
+
+    def _rotate(self):
+        segs = stream_segments(self.path)
+        n = (int(_SEGMENT_RE.search(segs[-1]).group(1)) + 1) if segs else 1
+        self._close_fh()
+        try:
+            os.replace(self.path, f"{self.path}.{n}")
+        except OSError as e:
+            # rotation is best-effort: a failed rename keeps appending
+            # to the (oversized) active file instead of losing events
+            logger.warning(f"monitor sink: rotation failed ({e}); "
+                           "stream continues un-rotated")
+            return
+        self.rotations += 1
 
 
 class CSVSink(_AppendFileSink):
@@ -214,7 +284,7 @@ class TensorboardSink(Sink):
 
 
 def make_sink(kind, run_dir, *, retry=None, ring_size=1024,
-              flush_every=64):
+              flush_every=64, rotate_bytes=0):
     """Build one sink by config name (``monitor.sinks`` entries).  File
     sinks need ``run_dir``; raises :class:`SinkUnavailable` when the
     backend cannot serve (caller logs once and drops the sink)."""
@@ -222,7 +292,8 @@ def make_sink(kind, run_dir, *, retry=None, ring_size=1024,
         return RingBufferSink(maxlen=ring_size)
     if kind == "jsonl":
         return JSONLSink(os.path.join(run_dir, EVENTS_FILE), retry=retry,
-                         flush_every=flush_every)
+                         flush_every=flush_every,
+                         rotate_bytes=rotate_bytes)
     if kind == "csv":
         return CSVSink(os.path.join(run_dir, EVENTS_CSV_FILE), retry=retry,
                        flush_every=flush_every)

@@ -1,0 +1,454 @@
+"""The SLO engine: declarative objectives, rolling error budgets with
+multi-window burn-rate alerting, and the live regression sentinel.
+
+ROADMAP #3's replica router and #5's SLO-driven autotuner both consume a
+*verdict* ("is this configuration meeting its latency objective, and how
+fast is it burning budget?"), not raw series.  This module produces that
+verdict from the series the monitor already emits — nothing here touches
+the compiled step (``--audit-step slo`` pins the train AND decode jaxprs
+byte-identical SLO-armed vs off).
+
+**Objectives** are declared over existing stream series
+(docs/monitoring.md#slo-tracking)::
+
+    "monitor": {"slo": {"objectives": [
+        {"name": "p99", "series": "latency_p99_ms", "max": 500},
+        {"name": "errors", "series": "error_rate", "max": 0.01},
+        {"name": "throughput", "series": "tokens_per_sec", "min": 800,
+         "target": 0.95}
+    ]}}
+
+Each observation of the series is *good* (within ``max``/``min``) or
+*bad*; ``target`` is the fraction of observations that must be good
+(default 0.99), so the **error budget** is ``1 - target``.
+
+**Burn rate** is the SRE-book quantity: the observed bad fraction over a
+window divided by the budget — burn 1.0 spends the budget exactly at its
+sustainable rate, burn 10 exhausts it 10x too fast.  Alerting is
+**multi-window**: the alert fires only when BOTH the slow (long) window
+and the fast (short) window burn above their thresholds.  The slow
+window means a single transient spike cannot page (its contribution to
+the long window is tiny); the fast window means a breach that already
+*stopped* does not keep paging (docs/monitoring.md has the worked
+example).  Both windows are counted in observations of the series —
+wall-clock-free, so offline replay over a stream (``ds_fleet --slo``)
+produces the identical verdict as the live engine.
+
+**The regression sentinel** is the runtime twin of ``ds_bench_diff``:
+a rolling-baseline change-point detector over the step-wall and
+tokens/s streams that catches "the last N steps are 15% slower" while
+the job is still running, not at the next bench.  The baseline is a
+LAGGED window (the ``baseline`` observations *preceding* the ``recent``
+window), compared by median so a single outlier step cannot fake (or
+mask) a regression; on a trip it emits a typed ``alert`` event and
+REBASES onto the new level, so a persistent regression pages once, and
+a recovery back past the old baseline is reported as improvement.
+
+Everything here is a pure stream consumer: :meth:`SLOEvaluator.feed`
+takes :class:`~.events.Event` objects and returns the ``slo``/``alert``
+events due — the live monitor bridges it onto the bus
+(``core.Monitor``), and offline consumers (``ds_fleet``, tests, the
+autotuner) replay a recorded stream through the same code.
+"""
+
+import dataclasses
+import statistics
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .events import Event
+
+# fast/slow burn thresholds follow the SRE-workbook pairing: the slow
+# window pages on a sustained burn that would exhaust ~a tenth of the
+# budget over its span; the fast window confirms the burn is CURRENT
+DEFAULT_TARGET = 0.99
+DEFAULT_FAST_WINDOW = 24
+DEFAULT_SLOW_WINDOW = 240
+DEFAULT_FAST_BURN = 10.0
+DEFAULT_SLOW_BURN = 10.0
+DEFAULT_EMIT_EVERY = 16
+
+
+@dataclasses.dataclass
+class Objective:
+    """One declared objective over a stream series.  Exactly one of
+    ``max`` (latency/error ceilings) or ``min`` (throughput/MFU floors)
+    bounds the series; ``target`` is the good-observation fraction the
+    SLO promises (budget = ``1 - target``)."""
+    name: str
+    series: str
+    max: Optional[float] = None
+    min: Optional[float] = None
+    target: float = DEFAULT_TARGET
+
+    def __post_init__(self):
+        if not self.name or not self.series:
+            raise ValueError("slo objective needs a name and a series")
+        if (self.max is None) == (self.min is None):
+            raise ValueError(
+                f"slo objective {self.name!r} must set exactly one of "
+                f"max/min (got max={self.max}, min={self.min})")
+        if not (0.0 < self.target < 1.0):
+            raise ValueError(
+                f"slo objective {self.name!r}: target must be in (0, 1), "
+                f"got {self.target}")
+
+    @property
+    def budget(self) -> float:
+        """The error budget ``1 - target``, rounded to kill the float
+        residue of the subtraction (``1.0 - 0.99`` is 0.01000…009, which
+        would push a boundary-exact burn of 10.0 to 9.999… and slide
+        the documented deterministic trip step by one)."""
+        return round(1.0 - self.target, 12)
+
+    def good(self, value: float) -> bool:
+        if self.max is not None:
+            return value <= self.max
+        return value >= self.min
+
+    def describe(self) -> dict:
+        bound = ({"max": self.max} if self.max is not None
+                 else {"min": self.min})
+        return {"name": self.name, "series": self.series,
+                "target": self.target, **bound}
+
+
+@dataclasses.dataclass
+class SentinelConfig:
+    """The regression sentinel's knobs (``monitor.slo.sentinel``)."""
+    enabled: bool = True
+    recent: int = 50            # change-point window (observations)
+    baseline: int = 200         # lagged baseline window (observations)
+    threshold: float = 0.15     # relative change that trips (15%)
+    min_baseline: int = 30      # observations before the baseline arms
+    series: tuple = ("step_wall_ms", "tokens_per_sec")
+
+    def __post_init__(self):
+        if self.recent < 2 or self.baseline < 2:
+            raise ValueError("slo.sentinel windows must be >= 2")
+        if self.min_baseline < 2:
+            raise ValueError("slo.sentinel.min_baseline must be >= 2")
+        if not (0.0 < self.threshold < 10.0):
+            raise ValueError(
+                f"slo.sentinel.threshold must be in (0, 10), got "
+                f"{self.threshold}")
+        self.series = tuple(self.series)
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """The parsed ``monitor.slo`` block (docs/config-json.md)."""
+    objectives: List[Objective] = dataclasses.field(default_factory=list)
+    fast_window: int = DEFAULT_FAST_WINDOW
+    slow_window: int = DEFAULT_SLOW_WINDOW
+    fast_burn: float = DEFAULT_FAST_BURN
+    slow_burn: float = DEFAULT_SLOW_BURN
+    emit_every: int = DEFAULT_EMIT_EVERY
+    sentinel: Optional[SentinelConfig] = dataclasses.field(
+        default_factory=SentinelConfig)
+
+    def __post_init__(self):
+        if self.fast_window < 1 or self.slow_window < 1:
+            raise ValueError("slo windows must be >= 1 observations")
+        if self.fast_window > self.slow_window:
+            raise ValueError(
+                f"slo.fast_window ({self.fast_window}) must be <= "
+                f"slow_window ({self.slow_window})")
+        if self.fast_burn <= 0 or self.slow_burn <= 0:
+            raise ValueError("slo burn thresholds must be > 0")
+        if self.emit_every < 1:
+            raise ValueError("slo.emit_every must be >= 1")
+
+    @classmethod
+    def from_value(cls, v) -> Optional["SLOConfig"]:
+        """None/False → no SLO engine; an :class:`SLOConfig` passes
+        through; a dict is the JSON ``monitor.slo`` block."""
+        if not v:
+            return None
+        if isinstance(v, cls):
+            return v
+        if not isinstance(v, dict):
+            raise ValueError(
+                f"monitor.slo must be a JSON object, got {type(v).__name__}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(v) - known
+        if unknown:
+            raise ValueError(
+                f"unknown monitor.slo keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        kw = dict(v)
+        objectives = []
+        for od in kw.pop("objectives", []) or []:
+            if isinstance(od, Objective):
+                objectives.append(od)
+                continue
+            ok = {f.name for f in dataclasses.fields(Objective)}
+            bad = set(od) - ok
+            if bad:
+                raise ValueError(
+                    f"unknown slo objective keys: {sorted(bad)} "
+                    f"(known: {sorted(ok)})")
+            objectives.append(Objective(**od))
+        sent = kw.pop("sentinel", cls.__dataclass_fields__[
+            "sentinel"].default_factory())
+        if isinstance(sent, dict):
+            ok = {f.name for f in dataclasses.fields(SentinelConfig)}
+            bad = set(sent) - ok
+            if bad:
+                raise ValueError(
+                    f"unknown slo.sentinel keys: {sorted(bad)} "
+                    f"(known: {sorted(ok)})")
+            sent = SentinelConfig(**sent)
+        elif sent in (False, None):
+            sent = SentinelConfig(enabled=False)
+        elif sent is True:
+            sent = SentinelConfig()
+        return cls(objectives=objectives, sentinel=sent, **kw)
+
+    def describe(self) -> dict:
+        return {"objectives": [o.describe() for o in self.objectives],
+                "fast_window": self.fast_window,
+                "slow_window": self.slow_window,
+                "fast_burn": self.fast_burn, "slow_burn": self.slow_burn,
+                "sentinel": (dataclasses.asdict(self.sentinel)
+                             if self.sentinel else None)}
+
+
+class _ObjectiveState:
+    """Rolling windows + budget accounting for one objective."""
+
+    def __init__(self, obj: Objective, cfg: SLOConfig):
+        self.obj = obj
+        self.cfg = cfg
+        self.fast = deque(maxlen=cfg.fast_window)   # 1 = bad, 0 = good
+        self.slow = deque(maxlen=cfg.slow_window)
+        self.observations = 0
+        self.breaches = 0            # bad observations, whole run
+        self.alerting = False        # latched while both windows burn
+        self.alerts = 0              # trips, whole run
+        self.last_value = None
+
+    def observe(self, value: float) -> Optional[str]:
+        """Feed one series observation; returns ``"trip"``/``"resolve"``
+        when the multi-window alert state changes, else None."""
+        bad = 0 if self.obj.good(value) else 1
+        self.observations += 1
+        self.breaches += bad
+        self.fast.append(bad)
+        self.slow.append(bad)
+        self.last_value = float(value)
+        burning = (self.burn_rate(self.fast) >= self.cfg.fast_burn
+                   and self.burn_rate(self.slow) >= self.cfg.slow_burn)
+        if burning and not self.alerting:
+            self.alerting = True
+            self.alerts += 1
+            return "trip"
+        if not burning and self.alerting:
+            self.alerting = False
+            return "resolve"
+        return None
+
+    def burn_rate(self, window) -> float:
+        """Bad fraction over the FULL window span / the error budget.
+        The denominator is the window's capacity, not the observations
+        seen: while the window fills, missing data counts as good — so
+        one early spike cannot page through a nearly-empty slow window
+        (its burn is 1/capacity/budget, not 1/1/budget), while a truly
+        bad-from-the-start service still accumulates enough bad
+        observations to cross the threshold within one window."""
+        if not window:
+            return 0.0
+        return (sum(window) / window.maxlen) / self.obj.budget
+
+    def budget_remaining(self) -> float:
+        """Whole-run error budget remaining as a fraction (can go
+        negative: the budget is overspent, not clamped away)."""
+        if not self.observations:
+            return 1.0
+        return 1.0 - (self.breaches / self.observations) / self.obj.budget
+
+    def verdict(self) -> dict:
+        return {**self.obj.describe(),
+                "observations": self.observations,
+                "breaches": self.breaches,
+                "last_value": self.last_value,
+                "burn_fast": round(self.burn_rate(self.fast), 4),
+                "burn_slow": round(self.burn_rate(self.slow), 4),
+                "budget_remaining_frac": round(self.budget_remaining(), 4),
+                "alerting": self.alerting,
+                "alerts": self.alerts,
+                "met": (not self.alerting
+                        and self.budget_remaining() >= 0.0)}
+
+
+class RegressionSentinel:
+    """Rolling-baseline change-point detector over one series.
+
+    Keeps the last ``baseline + recent`` observations; the baseline is
+    the ``baseline``-sized window LAGGED behind the ``recent`` window
+    (never overlapping it), compared median-to-median.  ``direction``
+    says which way is worse: ``"up"`` for step-wall (slower = larger),
+    ``"down"`` for tokens/s (slower = smaller).  On a trip the detector
+    REBASES (the recent level becomes the new baseline), so a persistent
+    regression alerts once instead of every step."""
+
+    def __init__(self, series: str, cfg: SentinelConfig,
+                 direction: str = "up"):
+        assert direction in ("up", "down")
+        self.series = series
+        self.cfg = cfg
+        self.direction = direction
+        self._baseline = deque(maxlen=cfg.baseline)
+        self._recent = deque(maxlen=cfg.recent)
+        self.trips = 0
+
+    def observe(self, value: float) -> Optional[dict]:
+        """Feed one observation; returns the alert payload when the
+        recent window's median has moved past threshold vs the
+        baseline's, else None."""
+        if len(self._recent) == self._recent.maxlen:
+            # the observation about to fall off the recent window
+            # graduates into the lagged baseline — the two windows never
+            # overlap, so a slow drift cannot poison its own baseline
+            # faster than `baseline` observations
+            self._baseline.append(self._recent[0])
+        self._recent.append(float(value))
+        if (len(self._baseline) < self.cfg.min_baseline
+                or len(self._recent) < self._recent.maxlen):
+            return None
+        base = statistics.median(self._baseline)
+        recent = statistics.median(self._recent)
+        if base == 0:
+            return None
+        rel = (recent - base) / abs(base)
+        worse = rel if self.direction == "up" else -rel
+        if worse < self.cfg.threshold:
+            return None
+        self.trips += 1
+        payload = {"series": self.series, "kind": "regression",
+                   "baseline": round(base, 4), "recent": round(recent, 4),
+                   "rel_change": round(rel, 4),
+                   "direction": self.direction,
+                   "window": self._recent.maxlen,
+                   "threshold": self.cfg.threshold}
+        # rebase by clearing BOTH windows: the post-trip level becomes
+        # the new baseline as observations refill, so one regression
+        # pages exactly once — rebasing onto the (half-transitioned)
+        # recent window would page a second time as the transition
+        # completes, and not rebasing would page every step.  A further
+        # worsening after the refill pages again, correctly.
+        self._baseline.clear()
+        self._recent.clear()
+        return payload
+
+
+# the sentinel's default stream wiring: which serieses it watches and
+# which direction is "worse" for each (step wall grows, throughput drops)
+_SENTINEL_DIRECTIONS = {"step_wall_ms": "up", "tokens_per_sec": "down",
+                        "samples_per_sec": "down"}
+
+
+class SLOEvaluator:
+    """Feeds a monitor event stream through the objectives + sentinel
+    and produces the ``slo``/``alert`` events due (module docstring).
+
+    Live: ``core.Monitor`` attaches a bridge sink that calls
+    :meth:`feed` for every bus emission and re-emits what comes back.
+    Offline: feed a recorded stream in order and read :meth:`verdict`.
+    """
+
+    def __init__(self, cfg: SLOConfig, clock=None):
+        self.cfg = cfg
+        self._clock = clock          # None -> stamp from the fed event's t
+        self._states = [_ObjectiveState(o, cfg) for o in cfg.objectives]
+        self._by_series: Dict[str, List[_ObjectiveState]] = {}
+        for st in self._states:
+            self._by_series.setdefault(st.obj.series, []).append(st)
+        self._sentinels: Dict[str, RegressionSentinel] = {}
+        if cfg.sentinel and cfg.sentinel.enabled:
+            for series in cfg.sentinel.series:
+                self._sentinels[series] = RegressionSentinel(
+                    series, cfg.sentinel,
+                    direction=_SENTINEL_DIRECTIONS.get(series, "up"))
+
+    # ------------------------------------------------------------- feeding
+    def feed(self, event: Event) -> List[Event]:
+        """Consume one stream event; returns the ``slo``/``alert``
+        events now due (possibly empty).  Ignores the kinds it produces,
+        so a bus bridge cannot recurse."""
+        if event.kind in ("slo", "alert"):
+            return []
+        out: List[Event] = []
+        step, t = event.step, event.t
+        if event.kind == "gauge" and event.value is not None:
+            out.extend(self._observe(event.name, event.value, step, t))
+        elif event.kind == "step":
+            wall = event.fields.get("wall_s")
+            if wall is not None:
+                out.extend(self._observe("step_wall_ms", wall * 1e3,
+                                         step, t))
+        return out
+
+    def _now(self, t):
+        return self._clock() if self._clock is not None else t
+
+    def _observe(self, series, value, step, t) -> List[Event]:
+        out = []
+        value = float(value)
+        for st in self._by_series.get(series, ()):
+            change = st.observe(value)
+            due = (change is not None
+                   or st.observations % self.cfg.emit_every == 0)
+            if change is not None:
+                out.append(Event(
+                    kind="alert", name="slo_burn", t=self._now(t),
+                    step=step,
+                    fields={"objective": st.obj.name, "series": series,
+                            "kind": "burn_rate", "state": change,
+                            "burn_fast": round(st.burn_rate(st.fast), 4),
+                            "burn_slow": round(st.burn_rate(st.slow), 4),
+                            "last_value": st.last_value,
+                            **st.obj.describe()}))
+            if due:
+                out.append(Event(kind="slo", name=st.obj.name,
+                                 t=self._now(t), step=step,
+                                 fields=st.verdict()))
+        sent = self._sentinels.get(series)
+        if sent is not None:
+            payload = sent.observe(value)
+            if payload is not None:
+                out.append(Event(kind="alert", name="regression",
+                                 t=self._now(t), step=step,
+                                 fields=payload))
+        return out
+
+    def feed_many(self, events) -> List[Event]:
+        out = []
+        for e in events:
+            out.extend(self.feed(e))
+        return out
+
+    # ------------------------------------------------------------- verdicts
+    def final_events(self, step=None, t=0.0) -> List[Event]:
+        """One terminal ``slo`` event per objective — emitted at
+        drain/close so short runs (and fleet merges) always carry the
+        whole-run verdict even off the emit cadence."""
+        return [Event(kind="slo", name=st.obj.name, t=self._now(t),
+                      step=step, fields=st.verdict())
+                for st in self._states]
+
+    def verdict(self) -> dict:
+        """The roll-up ``slo_report()``/bench/autotuner consumption
+        shape: per-objective verdicts + the headline aggregates."""
+        objs = [st.verdict() for st in self._states]
+        burns = [max(o["burn_fast"], o["burn_slow"]) for o in objs]
+        return {
+            "objectives": objs,
+            "objectives_total": len(objs),
+            "objectives_met": sum(1 for o in objs if o["met"]),
+            "worst_burn_rate": round(max(burns), 4) if burns else 0.0,
+            "slo_breaches": sum(o["breaches"] for o in objs),
+            "alerts": sum(o["alerts"] for o in objs),
+            "regressions": sum(s.trips for s in self._sentinels.values()),
+            "sentinel_series": sorted(self._sentinels),
+        }

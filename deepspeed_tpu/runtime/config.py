@@ -179,12 +179,32 @@ class DeepSpeedMonitorConfig:
                     f"1 <= start <= stop (got {trace!r})")
             trace = (int(trace[0]), int(trace[1]))
         self.trace_steps = trace
+        self.run_id = get_scalar_param(m, C.MONITOR_RUN_ID,
+                                       C.MONITOR_RUN_ID_DEFAULT)
+        self.rotate_mb = int(get_scalar_param(m, C.MONITOR_ROTATE_MB,
+                                              C.MONITOR_ROTATE_MB_DEFAULT))
+        if self.rotate_mb < 0:
+            raise DeepSpeedConfigError(
+                "monitor.rotate_mb must be >= 0 (0 disables rotation)")
+        # monitor.slo: the declarative SLO engine (monitor/slo.py;
+        # docs/monitoring.md#slo-tracking) — validated at parse time so
+        # a typo'd objective fails the config, not the 400th step
+        slo = get_dict_param(m, C.MONITOR_SLO, C.MONITOR_SLO_DEFAULT)
+        if slo is not None:
+            from ..monitor.slo import SLOConfig
+            try:
+                SLOConfig.from_value(slo)
+            except ValueError as e:
+                raise DeepSpeedConfigError(f"monitor.slo: {e}")
+        self.slo = slo
 
     def describe(self) -> dict:
         return {"enabled": self.enabled, "sinks": list(self.sinks),
                 "dir": self.dir, "interval": self.interval,
                 "ring_size": self.ring_size,
                 "memory_interval": self.memory_interval,
+                "run_id": self.run_id, "rotate_mb": self.rotate_mb,
+                "slo": self.slo,
                 "trace_steps": (list(self.trace_steps)
                                 if self.trace_steps else None)}
 

@@ -155,6 +155,13 @@ MONITOR_RING_SIZE = "ring_size"
 MONITOR_RING_SIZE_DEFAULT = 1024       # in-memory event ring length
 MONITOR_MEMORY_INTERVAL = "memory_interval"
 MONITOR_MEMORY_INTERVAL_DEFAULT = 50   # steps between memory-ledger `mem`
+MONITOR_RUN_ID = "run_id"
+MONITOR_RUN_ID_DEFAULT = None          # None -> DSTPU_RUN_ID or host-pid
+MONITOR_ROTATE_MB = "rotate_mb"
+MONITOR_ROTATE_MB_DEFAULT = 0          # 0 = no JSONL segment rotation
+MONITOR_SLO = "slo"
+MONITOR_SLO_DEFAULT = None             # None = SLO engine off; else the
+#                                        monitor.slo block (monitor/slo.py)
 #                                        events (0 disables the ledger)
 
 #############################################

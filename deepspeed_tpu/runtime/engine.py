@@ -855,6 +855,17 @@ class DeepSpeedEngine:
         if ps is not None:
             ps.close()
         self._offload = None
+        if (self.monitor.armed and self.monitor.bus is not None
+                and self.monitor.bus.sinks):
+            # terminal hist flush: a run shorter than the timer's
+            # emission cadence must still leave its whole-run step-time
+            # distribution in the stream (what ds_fleet merges read)
+            tt = getattr(self, "tput_timer", None)
+            if tt is not None and getattr(tt, "step_time_hist", None):
+                self.monitor.bus.hist("train_step_time_ms",
+                                      tt.step_time_hist,
+                                      step=self._global_steps_host,
+                                      unit="ms")
         self.monitor.close()
         import gc
         gc.collect()

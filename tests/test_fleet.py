@@ -1,0 +1,410 @@
+"""Fleet observability (``monitor/fleet.py`` / ``bin/ds_fleet``;
+docs/monitoring.md#fleet-view): cross-replica merge exactness, straggler
+detection, JSONL segment rotation with tail-following, the monitor's
+flush-at-close fix, and the schema-v4 forward-compat contract.
+
+Tier-1 CI coverage (ISSUE 15 satellites): the REAL ``ds_fleet`` CLI is
+driven over the two COMMITTED artifact streams under
+``tests/data/fleet/`` on every run; merged histograms must equal the
+histogram of the concatenated traffic bucket-for-bucket; the
+deliberately-slowed replica of a synthetic 3-replica stream must be
+named; a v3 reader must count-and-skip exactly the ``slo``/``alert``
+kinds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.monitor import (Event, LogHistogram, Monitor,
+                                   parse_line)
+from deepspeed_tpu.monitor.__main__ import (Aggregate, StreamFollower,
+                                            render)
+from deepspeed_tpu.monitor import fleet as flt
+from deepspeed_tpu.monitor.sinks import (EVENTS_FILE, JSONLSink,
+                                         stream_segments)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "fleet")
+
+
+def _write_stream(dirpath, events):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, EVENTS_FILE), "w") as f:
+        for e in events:
+            f.write(e.to_json() + "\n")
+    return dirpath
+
+
+def _replica_events(run_id, *, lat_values, cadence_s, queued, steps=20,
+                    t0=0.0, completed=None):
+    h = LogHistogram()
+    h.add_many(lat_values)
+    out, t = [], t0
+    for s in range(1, steps + 1):
+        t += cadence_s
+        out.append(Event(kind="step", name="serving_step", t=t, step=s,
+                         run=run_id,
+                         fields={"wall_s": cadence_s * 0.9,
+                                 "queued": queued}))
+    out.append(Event(kind="hist", name="latency_ms", t=t, step=steps,
+                     run=run_id, fields=h.to_dict()))
+    out.append(Event(kind="counter", name="completed_total", t=t,
+                     step=steps, run=run_id,
+                     value=completed if completed is not None
+                     else len(lat_values)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# merge exactness (ISSUE 15 acceptance)
+# ---------------------------------------------------------------------------
+
+def test_fleet_merge_is_exact_bucket_for_bucket(tmp_path):
+    """The merged fleet histogram equals the histogram of the
+    CONCATENATED traffic — same buckets, same counts (the PR-12 merge
+    primitive applied across replica streams), and the merged quantiles
+    are within the ε bound of the exact rank quantile."""
+    rng = np.random.default_rng(7)
+    traffic = [rng.lognormal(4.5, 0.6, 400) for _ in range(3)]
+    dirs = []
+    for i, lat in enumerate(traffic):
+        dirs.append(_write_stream(
+            tmp_path / f"r{i}",
+            _replica_events(f"r{i}", lat_values=lat.tolist(),
+                            cadence_s=0.01, queued=1)))
+    view = flt.FleetFollower([str(d) for d in dirs]).poll()
+    merged = view.merged_hists()["latency_ms"]
+    oracle = LogHistogram()
+    allv = np.concatenate(traffic)
+    oracle.add_many(allv.tolist())
+    assert merged == oracle                       # bucket-for-bucket
+    assert merged.count == allv.size
+    exact = np.sort(allv)
+    for q in (0.5, 0.99):
+        rank_val = exact[max(1, int(np.ceil(q * allv.size))) - 1]
+        assert abs(merged.quantile(q) - rank_val) <= 0.025 * rank_val
+
+
+def test_fleet_counters_sum_exactly(tmp_path):
+    dirs = [
+        _write_stream(tmp_path / "a", _replica_events(
+            "a", lat_values=[10.0] * 7, cadence_s=0.01, queued=0,
+            completed=7)),
+        _write_stream(tmp_path / "b", _replica_events(
+            "b", lat_values=[10.0] * 11, cadence_s=0.01, queued=0,
+            completed=11)),
+    ]
+    view = flt.FleetFollower([str(d) for d in dirs]).poll()
+    assert view.summed_counters()["completed_total"] == 18
+    v = view.verdict()
+    assert v["counters"]["completed_total"] == 18
+    assert [r["label"] for r in v["replicas"]] == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# straggler detection (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+def test_straggler_names_the_slowed_replica(tmp_path):
+    """Synthetic 3-replica stream, one slowed 3x in step cadence: the
+    leave-one-out z-score names exactly that replica."""
+    dirs = []
+    for i in range(3):
+        cadence = 0.150 if i == 1 else 0.050
+        dirs.append(_write_stream(
+            tmp_path / f"r{i}",
+            _replica_events(f"r{i}", lat_values=[100.0] * 10,
+                            cadence_s=cadence, queued=1)))
+    verdict = flt.FleetFollower([str(d) for d in dirs]).poll().straggler()
+    assert verdict["straggler"] == "r1"
+    assert verdict["series"] == "step_cadence_ms"
+    assert verdict["zscore"] >= flt.STRAGGLER_ZMAX
+    assert verdict["excess_frac"] >= flt.STRAGGLER_MIN_EXCESS
+
+
+def test_balanced_fleet_names_no_straggler(tmp_path):
+    dirs = []
+    for i in range(3):
+        dirs.append(_write_stream(
+            tmp_path / f"r{i}",
+            _replica_events(f"r{i}", lat_values=[100.0] * 10,
+                            cadence_s=0.050 + 0.002 * i, queued=i % 2)))
+    verdict = flt.FleetFollower([str(d) for d in dirs]).poll().straggler()
+    assert verdict["straggler"] is None
+    assert "step_cadence_ms" in verdict["signals"]
+
+
+def test_queue_depth_straggler_needs_absolute_excess(tmp_path):
+    """Queue depth 1-vs-2 is scheduler jitter (100% relative!) — only a
+    meaningful absolute backlog names a straggler on that series."""
+    def fleet_with_queues(queues, sub):
+        dirs = []
+        for i, q in enumerate(queues):
+            dirs.append(_write_stream(
+                tmp_path / sub / f"r{i}",
+                _replica_events(f"r{i}", lat_values=[100.0] * 10,
+                                cadence_s=0.050, queued=q)))
+        return flt.FleetFollower([str(d) for d in dirs]).poll()
+
+    assert fleet_with_queues([1, 2, 1], "jitter") \
+        .straggler()["straggler"] is None
+    backlog = fleet_with_queues([1, 9, 1], "backlog").straggler()
+    assert backlog["straggler"] == "r1"
+    assert backlog["series"] == "queue_depth"
+
+
+# ---------------------------------------------------------------------------
+# JSONL rotation + segment-aware following (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+def test_rotation_segments_and_fresh_read(tmp_path):
+    path = str(tmp_path / EVENTS_FILE)
+    sink = JSONLSink(path, flush_every=1, rotate_bytes=300)
+    for i in range(40):
+        sink.write(Event(kind="gauge", name="g", t=float(i), step=i,
+                         value=float(i)))
+    sink.close()
+    assert sink.rotations >= 2
+    assert len(stream_segments(path)) == sink.rotations
+    # a fresh reader sees the WHOLE stream, in order, across segments
+    got = StreamFollower(path).poll()
+    assert [e.step for e in got] == list(range(40))
+
+
+def test_follower_tails_across_live_rotation(tmp_path):
+    """A follower polling WHILE the sink rotates never skips or
+    double-reads an event — the ds_top/ds_fleet live-tail contract."""
+    path = str(tmp_path / EVENTS_FILE)
+    sink = JSONLSink(path, flush_every=1, rotate_bytes=250)
+    follower = StreamFollower(path)
+    seen = []
+    for i in range(50):
+        sink.write(Event(kind="gauge", name="g", t=float(i), step=i,
+                         value=float(i)))
+        if i % 3 == 0:
+            seen.extend(follower.poll())
+    sink.close()
+    seen.extend(follower.poll())
+    assert [e.step for e in seen] == list(range(50))
+    assert follower.bad_lines == 0
+
+
+def test_follower_torn_tail_is_carried_then_completed(tmp_path):
+    path = str(tmp_path / EVENTS_FILE)
+    e0 = Event(kind="gauge", name="g", t=0.0, step=0, value=1.0)
+    e1 = Event(kind="gauge", name="g", t=1.0, step=1, value=2.0)
+    full = e1.to_json() + "\n"
+    with open(path, "w") as f:
+        f.write(e0.to_json() + "\n" + full[:10])      # torn tail
+    follower = StreamFollower(path)
+    assert [e.step for e in follower.poll()] == [0]
+    with open(path, "a") as f:
+        f.write(full[10:])                            # writer finishes
+    assert [e.step for e in follower.poll()] == [1]
+    assert follower.bad_lines == 0
+
+
+def test_monitor_rotate_mb_plumbs_to_sink(tmp_path):
+    mon = Monitor(run_dir=str(tmp_path), sinks=("jsonl",), rotate_mb=0)
+    sink = mon.bus.sinks[0]
+    assert sink.rotate_bytes == 0
+    mon.close()
+
+
+# ---------------------------------------------------------------------------
+# flush-at-close fix (ISSUE 15 satellite: interval=5 over a 7-step run)
+# ---------------------------------------------------------------------------
+
+def test_interval_thinning_does_not_drop_final_steps(tmp_path):
+    """The regression test from the issue: interval=5 over a 7-step run
+    must still land step 7's step event, gauges and counters at close —
+    a ds_fleet merge over short runs must see complete streams."""
+    mon = Monitor(run_dir=str(tmp_path), sinks=("jsonl",), interval=5,
+                  run_id="short")
+    for s in range(1, 8):
+        mon.begin_step()
+        mon.end_step(s, scalars={"loss": 1.0 / s},
+                     gauges={"latency_p99_ms": 40.0 + s},
+                     counters={"completed_total": s})
+    mon.close()
+    evs = [parse_line(ln)
+           for ln in open(tmp_path / EVENTS_FILE) if ln.strip()]
+    steps = [e.step for e in evs if e.kind == "step"]
+    assert steps == [5, 7]                     # interval step + terminal
+    final_gauge = [e for e in evs if e.kind == "gauge"
+                   and e.name == "latency_p99_ms"][-1]
+    assert final_gauge.step == 7 and final_gauge.value == 47.0
+    final_counter = [e for e in evs if e.kind == "counter"][-1]
+    assert final_counter.step == 7 and final_counter.value == 7
+    loss7 = [e for e in evs if e.kind == "step"][-1]
+    assert loss7.fields["loss"] == pytest.approx(1.0 / 7)
+
+
+def test_emitted_interval_step_is_not_double_flushed(tmp_path):
+    """A run ending ON the interval must not re-emit its last step."""
+    mon = Monitor(run_dir=str(tmp_path), sinks=("jsonl",), interval=5)
+    for s in range(1, 11):
+        mon.begin_step()
+        mon.end_step(s, scalars={"loss": 1.0})
+    mon.close()
+    evs = [parse_line(ln)
+           for ln in open(tmp_path / EVENTS_FILE) if ln.strip()]
+    assert [e.step for e in evs if e.kind == "step"] == [5, 10]
+
+
+# ---------------------------------------------------------------------------
+# schema v4 forward-compat (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+def test_v3_reader_count_and_skips_slo_and_alert():
+    """v4 adds `slo`/`alert` stamped v:4.  A v3 reader parses every
+    older kind from a mixed v4 stream and rejects EXACTLY the new kinds
+    (which stream followers count-and-skip); the v4 reader round-trips
+    everything including the new `run` stamp."""
+    h = LogHistogram()
+    h.add_many([1.0, 5.0])
+    mixed = [
+        Event(kind="step", name="serving_step", t=1.0, step=3, run="rA",
+              fields={"wall_s": 0.01}),
+        Event(kind="hist", name="latency_ms", t=2.0, step=3, run="rA",
+              fields=h.to_dict()),
+        Event(kind="mem", name="memory", t=3.0, step=3, run="rA",
+              fields={"hbm": {"params": 1}}),
+        Event(kind="slo", name="p99", t=4.0, step=3, run="rA",
+              fields={"series": "latency_p99_ms", "met": True}),
+        Event(kind="alert", name="slo_burn", t=5.0, step=3, run="rA",
+              fields={"state": "trip"}),
+    ]
+    lines = [e.to_json() for e in mixed]
+    assert [parse_line(ln) for ln in lines] == mixed       # v4 reader
+    assert all(json.loads(ln)["run"] == "rA" for ln in lines)
+    ok, skipped = [], 0
+    for ln in lines:
+        try:
+            ok.append(parse_line(ln, max_version=3))       # v3 reader
+        except ValueError:
+            skipped += 1
+    assert [e.kind for e in ok] == ["step", "hist", "mem"]
+    assert skipped == 2
+    # a v3-reading StreamFollower does the count-and-skip itself
+    assert mixed[3].v == 4 and mixed[4].v == 4
+
+
+def test_v3_follower_counts_and_skips_new_kinds(tmp_path):
+    path = str(tmp_path / EVENTS_FILE)
+    with open(path, "w") as f:
+        f.write(Event(kind="step", name="s", t=1.0, step=1,
+                      fields={"wall_s": 0.1}).to_json() + "\n")
+        f.write(Event(kind="slo", name="p99", t=2.0, step=1,
+                      fields={"met": True}).to_json() + "\n")
+        f.write(Event(kind="alert", name="slo_burn", t=3.0,
+                      step=1).to_json() + "\n")
+    old_reader = StreamFollower(path, max_version=3)
+    got = old_reader.poll()
+    assert [e.kind for e in got] == ["step"]
+    assert old_reader.bad_lines == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI: ds_fleet over the committed artifact streams (tier-1 smoke) +
+# --fleet routing
+# ---------------------------------------------------------------------------
+
+def test_cli_smoke_ds_fleet_over_committed_streams():
+    """Tier-1 smoke over the REAL CLI: ds_fleet merges the two committed
+    replica streams — counters sum, histograms merge, no straggler on
+    the balanced pair — on every run (the PR-13 ds_mem/ds_bench_diff
+    pattern)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_fleet"),
+         os.path.join(FIXTURES, "replica_a"),
+         os.path.join(FIXTURES, "replica_b"), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    v = json.loads(r.stdout.strip().splitlines()[-1])
+    assert v["counters"]["completed_total"] == 22
+    assert v["hists"]["latency_ms"]["count"] == 22
+    assert v["straggler"]["straggler"] is None
+    assert {rep["label"] for rep in v["replicas"]} == \
+        {"replica_a", "replica_b"}
+    # the replicas' own slo events roll up in the verdict
+    assert v["slo"]["objectives_met"] == 2
+    # human frame renders too
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_fleet"),
+         os.path.join(FIXTURES, "replica_a"),
+         os.path.join(FIXTURES, "replica_b"), "--once"],
+        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 0, r2.stderr
+    assert "merged hist" in r2.stdout and "replica_a" in r2.stdout
+
+
+def test_python_m_monitor_fleet_routing():
+    r = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.monitor", "--fleet",
+         os.path.join(FIXTURES, "replica_a"),
+         os.path.join(FIXTURES, "replica_b"), "--once"],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    assert "ds_fleet — 2 replica(s)" in r.stdout
+
+
+def test_fleet_slo_replay_over_merged_stream(tmp_path):
+    """``ds_fleet --slo``: the merged raw streams replay through the
+    SAME SLOEvaluator the live engines run — a fleet-wide p99 breach
+    that no single replica's window would catch still burns the fleet
+    budget."""
+    dirs = []
+    for i in range(2):
+        events = _replica_events(f"r{i}", lat_values=[100.0] * 5,
+                                 cadence_s=0.01, queued=0)
+        events += [Event(kind="gauge", name="latency_p99_ms",
+                         t=100.0 + j, step=j, run=f"r{i}", value=900.0)
+                   for j in range(30)]
+        dirs.append(_write_stream(tmp_path / f"r{i}", events))
+    slo_cfg = {"objectives": [{"name": "p99",
+                               "series": "latency_p99_ms",
+                               "max": 500.0}],
+               "fast_window": 4, "slow_window": 16,
+               "fast_burn": 5.0, "slow_burn": 5.0, "sentinel": False}
+    slo_path = tmp_path / "slo.json"
+    slo_path.write_text(json.dumps(slo_cfg))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_fleet"),
+         str(dirs[0]), str(dirs[1]), "--json", "--slo", str(slo_path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    v = json.loads(r.stdout.strip().splitlines()[-1])
+    fleet_slo = v["slo_fleet"]
+    assert fleet_slo["objectives_met"] == 0
+    assert fleet_slo["slo_breaches"] == 60
+    assert fleet_slo["worst_burn_rate"] >= 5.0
+
+
+def test_ds_top_renders_slo_line():
+    agg = Aggregate()
+    agg.feed([
+        Event(kind="slo", name="p99", t=1.0, step=4,
+              fields={"series": "latency_p99_ms", "max": 500.0,
+                      "met": True, "alerting": False,
+                      "budget_remaining_frac": 0.8, "burn_fast": 0.5,
+                      "burn_slow": 0.1}),
+        Event(kind="alert", name="regression", t=2.0, step=4,
+              fields={"series": "step_wall_ms", "kind": "regression",
+                      "rel_change": 0.22}),
+    ])
+    frame = render(agg, "x", clock=lambda: 3.0)
+    assert "slo:" in frame and "p99" in frame
+    assert "budget 80.0%" in frame
+    assert "alerts: 1" in frame and "step_wall_ms" in frame
+
+
+def test_render_fleet_frame_is_pure():
+    view = flt.FleetView([])
+    assert "0 replica(s)" in flt.render_fleet(view)

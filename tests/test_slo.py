@@ -1,0 +1,344 @@
+"""The SLO engine (``monitor/slo.py``; docs/monitoring.md#slo-tracking):
+declarative objectives, rolling error budgets with multi-window
+burn-rate alerting, and the live regression sentinel.
+
+Flagship acceptance (ISSUE 15): a known sustained p99 breach trips the
+fast+slow burn-rate alert at the EXPECTED observation, a clean stream
+with one transient spike trips nothing (both directions tested), and
+the compiled train + decode steps are byte-identical SLO-armed vs off
+(the jaxpr gate rides ``--audit-step slo``; the host-side equality is
+re-proven here on the serving engine).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference import Request, ServingConfig, ServingEngine
+from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+from deepspeed_tpu.monitor import (Event, Monitor, Objective,
+                                   RegressionSentinel, SentinelConfig,
+                                   SLOConfig, SLOEvaluator, parse_line)
+from deepspeed_tpu.monitor.sinks import EVENTS_FILE
+
+
+def _gauge(name, value, i):
+    return Event(kind="gauge", name=name, t=float(i), step=i, value=value)
+
+
+def _cfg(**kw):
+    base = {"objectives": [{"name": "p99", "series": "latency_p99_ms",
+                            "max": 500.0, "target": 0.99}],
+            "fast_window": 10, "slow_window": 100,
+            "fast_burn": 10.0, "slow_burn": 10.0, "sentinel": False}
+    base.update(kw)
+    return SLOConfig.from_value(base)
+
+
+def _drive(ev, values, series="latency_p99_ms"):
+    """Feed a value series; returns (trip indices, resolve indices)."""
+    trips, resolves = [], []
+    for i, v in enumerate(values):
+        for e in ev.feed(_gauge(series, v, i)):
+            if e.kind == "alert" and e.name == "slo_burn":
+                (trips if e.fields["state"] == "trip"
+                 else resolves).append(i)
+    return trips, resolves
+
+
+# ---------------------------------------------------------------------------
+# config parsing / validation
+# ---------------------------------------------------------------------------
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        Objective(name="x", series="y")              # no bound
+    with pytest.raises(ValueError):
+        Objective(name="x", series="y", max=1, min=0)  # both bounds
+    with pytest.raises(ValueError):
+        Objective(name="x", series="y", max=1, target=1.0)
+    o = Objective(name="x", series="y", min=5.0)
+    assert o.good(5.0) and not o.good(4.9)
+    o2 = Objective(name="x", series="y", max=5.0)
+    assert o2.good(5.0) and not o2.good(5.1)
+
+
+def test_config_rejects_unknown_keys_and_bad_windows():
+    with pytest.raises(ValueError):
+        SLOConfig.from_value({"objectves": []})       # typo'd key
+    with pytest.raises(ValueError):
+        SLOConfig.from_value({"objectives": [
+            {"name": "x", "series": "y", "max": 1, "typo": 2}]})
+    with pytest.raises(ValueError):
+        SLOConfig.from_value({"fast_window": 50, "slow_window": 10})
+    with pytest.raises(ValueError):
+        SLOConfig.from_value({"sentinel": {"threshold": 0.0}})
+    assert SLOConfig.from_value(None) is None
+    assert SLOConfig.from_value(False) is None
+    cfg = SLOConfig.from_value({"sentinel": False})
+    assert not cfg.sentinel.enabled
+
+
+# ---------------------------------------------------------------------------
+# burn-rate semantics (the flagship acceptance)
+# ---------------------------------------------------------------------------
+
+def test_sustained_breach_trips_at_expected_observation():
+    """target 0.99 → budget 1%.  fast: 10-obs window, burn >= 10 needs
+    >= 1 bad in the window.  slow: 100-obs window, burn >= 10 needs
+    >= 10 bad over the window's full CAPACITY (missing data counts
+    good while it fills).  Breach starts at observation 50 (0-indexed):
+    the fast window trips immediately, the slow window accumulates its
+    10th bad observation at index 59 — the EXPECTED trip step,
+    deterministically."""
+    trips, _ = _drive(SLOEvaluator(_cfg()),
+                      [100.0] * 50 + [900.0] * 100)
+    assert trips and trips[0] == 59
+
+
+def test_transient_spike_trips_nothing():
+    """One spike: the fast window burns (1/10 = burn 10) but the slow
+    window absorbs it (1/100 = burn 1 < 10) — no page, in either
+    series direction.  Also pinned EARLY in the run: a lone spike among
+    the first observations must not page through a still-filling slow
+    window (burn is over the window's capacity, not the count seen)."""
+    trips, _ = _drive(SLOEvaluator(_cfg()),
+                      [100.0] * 50 + [900.0] + [100.0] * 150)
+    assert trips == []
+    trips, _ = _drive(SLOEvaluator(_cfg()),
+                      [100.0] * 3 + [900.0] + [100.0] * 150)
+    assert trips == []
+    # min-objective direction: a single throughput dip must not page
+    cfg = _cfg(objectives=[{"name": "tput", "series": "tokens_per_sec",
+                            "min": 800.0, "target": 0.99}])
+    trips, _ = _drive(SLOEvaluator(cfg), [1000.0] * 50 + [10.0]
+                      + [1000.0] * 150, series="tokens_per_sec")
+    assert trips == []
+
+
+def test_sustained_throughput_floor_breach_trips():
+    cfg = _cfg(objectives=[{"name": "tput", "series": "tokens_per_sec",
+                            "min": 800.0, "target": 0.99}])
+    trips, _ = _drive(SLOEvaluator(cfg), [1000.0] * 50 + [10.0] * 100,
+                      series="tokens_per_sec")
+    assert trips and trips[0] == 59
+
+
+def test_alert_resolves_when_burn_stops():
+    """After the breach ends, the fast window drains first; the alert
+    resolves (typed `resolve` event) once both windows are below their
+    thresholds — and the budget accounting keeps the whole-run truth."""
+    ev = SLOEvaluator(_cfg())
+    trips, resolves = _drive(
+        ev, [100.0] * 50 + [900.0] * 20 + [100.0] * 200)
+    assert len(trips) == 1
+    assert len(resolves) == 1 and resolves[0] > trips[0]
+    st = ev.verdict()["objectives"][0]
+    assert st["breaches"] == 20 and not st["alerting"]
+    assert st["budget_remaining_frac"] < 0       # 20/270 >> 1% budget
+
+
+def test_budget_remaining_math():
+    ev = SLOEvaluator(_cfg(objectives=[
+        {"name": "p99", "series": "latency_p99_ms", "max": 500.0,
+         "target": 0.9}]))
+    _drive(ev, [100.0] * 95 + [900.0] * 5)
+    st = ev.verdict()["objectives"][0]
+    # 5 bad / 100 obs over a 10% budget = half the budget spent
+    assert st["budget_remaining_frac"] == pytest.approx(0.5)
+    assert st["met"]
+
+
+def test_slo_events_emitted_on_cadence_and_carry_verdict():
+    ev = SLOEvaluator(_cfg(emit_every=8))
+    out = []
+    for i in range(16):
+        out.extend(ev.feed(_gauge("latency_p99_ms", 100.0, i)))
+    slo = [e for e in out if e.kind == "slo"]
+    assert len(slo) == 2 and slo[0].fields["met"]
+    assert slo[0].fields["observations"] == 8
+    # ignores kinds it produces (bridge-recursion guard) and unrelated
+    # series
+    assert ev.feed(slo[0]) == []
+    assert ev.feed(_gauge("some_other_series", 1e9, 99)) == []
+
+
+# ---------------------------------------------------------------------------
+# regression sentinel
+# ---------------------------------------------------------------------------
+
+def test_sentinel_catches_step_wall_regression_and_rebases():
+    cfg = SentinelConfig(recent=20, baseline=50, threshold=0.15,
+                         min_baseline=10)
+    s = RegressionSentinel("step_wall_ms", cfg, direction="up")
+    trips = []
+    vals = [100.0] * 60 + [125.0] * 60          # +25% step wall
+    for i, v in enumerate(vals):
+        if s.observe(v) is not None:
+            trips.append(i)
+    assert len(trips) == 1                      # rebase: pages once
+    assert trips[0] >= 60                       # after the change point
+    assert trips[0] <= 60 + cfg.recent + 1      # within one recent window
+
+
+def test_sentinel_ignores_noise_and_small_drift():
+    rng = np.random.default_rng(0)
+    cfg = SentinelConfig(recent=20, baseline=50, threshold=0.15,
+                         min_baseline=10)
+    s = RegressionSentinel("step_wall_ms", cfg)
+    vals = 100.0 + rng.normal(0.0, 3.0, 400)    # 3% noise
+    vals[200:] += 5.0                           # +5% drift < threshold
+    assert all(s.observe(v) is None for v in vals)
+
+
+def test_sentinel_tokens_per_sec_direction():
+    """Throughput DROP is the regression (direction='down'); a rise is
+    an improvement and must not page."""
+    cfg = SentinelConfig(recent=10, baseline=20, threshold=0.15,
+                         min_baseline=10)
+    down = RegressionSentinel("tokens_per_sec", cfg, direction="down")
+    trips = [i for i, v in enumerate([1000.0] * 40 + [700.0] * 20)
+             if down.observe(v) is not None]
+    assert len(trips) == 1
+    up = RegressionSentinel("tokens_per_sec", cfg, direction="down")
+    assert all(up.observe(v) is None
+               for v in [1000.0] * 40 + [1500.0] * 20)
+
+
+def test_evaluator_feeds_sentinel_from_step_events():
+    """The sentinel watches the step-wall stream via the step events'
+    wall_s — the same events the monitor already emits."""
+    cfg = SLOConfig.from_value({
+        "objectives": [],
+        "sentinel": {"recent": 10, "baseline": 20, "threshold": 0.15,
+                     "min_baseline": 10}})
+    ev = SLOEvaluator(cfg)
+    alerts = []
+    walls = [0.010] * 40 + [0.0150] * 20        # 10ms → 15ms steps
+    for i, w in enumerate(walls):
+        for e in ev.feed(Event(kind="step", name="serving_step",
+                               t=float(i), step=i,
+                               fields={"wall_s": w})):
+            alerts.append(e)
+    assert [e.name for e in alerts] == ["regression"]
+    f = alerts[0].fields
+    assert f["series"] == "step_wall_ms" and f["rel_change"] > 0.15
+    assert ev.verdict()["regressions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# live wiring: Monitor bridge + serving slo_report
+# ---------------------------------------------------------------------------
+
+def test_monitor_bridge_emits_slo_and_alert_events(tmp_path):
+    """An armed Monitor with a monitor.slo block writes schema-v4 slo
+    and alert events into its JSONL stream — emitted THROUGH the bus so
+    every sink sees them, stamped with the run_id."""
+    mon = Monitor(run_dir=str(tmp_path), sinks=("jsonl",), run_id="rA",
+                  slo={"objectives": [
+                      {"name": "p99", "series": "latency_p99_ms",
+                       "max": 500.0}],
+                      "fast_window": 4, "slow_window": 8,
+                      "fast_burn": 5.0, "slow_burn": 5.0,
+                      "sentinel": False})
+    for i in range(12):
+        mon.gauge("latency_p99_ms", 900.0, step=i)
+    mon.close()
+    evs = [parse_line(ln)
+           for ln in open(tmp_path / EVENTS_FILE) if ln.strip()]
+    kinds = {e.kind for e in evs}
+    assert {"slo", "alert"} <= kinds
+    assert all(e.run == "rA" for e in evs)
+    slo = [e for e in evs if e.kind == "slo"][-1]
+    assert slo.v == 4 and slo.fields["alerting"]
+    trip = [e for e in evs if e.kind == "alert"][0]
+    assert trip.fields["state"] == "trip"
+    assert mon.slo_verdict()["objectives_met"] == 0
+
+
+def test_monitor_without_slo_block_emits_none():
+    mon = Monitor(run_dir=None, sinks=())
+    assert mon.slo is None and mon.slo_verdict() is None
+
+
+@pytest.fixture(scope="module")
+def tiny_serving():
+    cfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.bfloat16)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def test_serving_slo_report_and_jaxpr_equality(tiny_serving, tmp_path):
+    """ServingEngine.slo_report() carries the armed objectives after a
+    real run, and arming the SLO engine leaves the traced decode step
+    byte-identical (the --audit-step slo gate, re-proven host-side)."""
+    model, params = tiny_serving
+    scfg = dict(batch_slots=2, block_size=8, max_new_tokens=4,
+                preflight=False)
+
+    def decode_jaxpr(srv):
+        srv._build_decode()
+        return str(jax.make_jaxpr(srv._decode)(*srv._decode_args()))
+
+    clean = ServingEngine(model=model, params=params,
+                          config=ServingConfig(**scfg))
+    clean_jaxpr = decode_jaxpr(clean)
+    clean.close()
+
+    mon = Monitor(run_dir=str(tmp_path), sinks=("jsonl",),
+                  role="serving", run_id="srv0",
+                  slo={"objectives": [
+                      {"name": "p99", "series": "latency_p99_ms",
+                       "max": 1e9},
+                      {"name": "errors", "series": "error_rate",
+                       "max": 0.5}]})
+    armed = ServingEngine(model=model, params=params, monitor=mon,
+                          config=ServingConfig(**scfg))
+    assert decode_jaxpr(armed) == clean_jaxpr
+    armed.run([Request(tokens=np.arange(4), max_new_tokens=8, uid=u)
+               for u in range(3)])
+    v = armed.slo_report()
+    assert v["objectives_total"] == 2
+    err = [o for o in v["objectives"] if o["series"] == "error_rate"][0]
+    assert err["met"] and err["observations"] >= 1
+    armed.close()
+    mon.close()
+    evs = [parse_line(ln)
+           for ln in open(tmp_path / EVENTS_FILE) if ln.strip()]
+    assert any(e.kind == "slo" for e in evs)
+    # the serving error_rate series rides the bus as a gauge
+    assert any(e.kind == "gauge" and e.name == "error_rate" for e in evs)
+
+
+def test_config_block_validates_at_parse_time():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "monitor": {
+            "slo": {"objectives": [{"name": "x", "series": "y"}]}}})
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "monitor": {
+        "slo": {"objectives": [{"name": "p99",
+                                "series": "latency_p99_ms",
+                                "max": 500}]},
+        "run_id": "r1", "rotate_mb": 64}})
+    d = cfg.monitor_config.describe()
+    assert d["run_id"] == "r1" and d["rotate_mb"] == 64
+    assert d["slo"]["objectives"][0]["name"] == "p99"
+
+
+def test_bench_diff_classifies_slo_family_lower_better():
+    from deepspeed_tpu.analysis import bench_diff as bd
+    assert bd.classify("worst_burn_rate") == "lower"
+    assert bd.classify("slo_breaches") == "lower"
+    base = {"slo": {"worst_burn_rate": 1.0, "slo_breaches": 2}}
+    worse = {"slo": {"worst_burn_rate": 20.0, "slo_breaches": 40}}
+    r = bd.compare(base, worse)
+    assert len(r["regressions"]) == 2
+    r2 = bd.compare(worse, base)
+    assert not r2["regressions"]
