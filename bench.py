@@ -870,6 +870,189 @@ def measure_serving_fleet(*, replicas=3, throttled_replica=1,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def measure_serving_router_chaos(*, replicas=3, streams=9, prompt_len=12,
+                                 new_tokens=24, batch_slots=2, block_size=8,
+                                 straggler_replica=1, throttle_ms=40,
+                                 crash_replica=2, crash_finish_visit=3,
+                                 timeout_s=420, cache_dir=None):
+    """Router chaos rung (docs/serving.md#replica-router): 3 REAL
+    subprocess serving replicas behind :class:`ReplicaRouter`
+    (``ProcessReplica`` directory protocol), with
+
+    - one replica THROTTLED (the sentinel-named straggler the router
+      must DRAIN, not kill — it still finishes its work), and
+    - one replica KILLED mid-traffic by the armed fault harness
+      (``DSTPU_FAULT=crash_at=serving.journal_crash_finish@N`` in its
+      environment: the worker dies inside ``RequestJournal.finish`` on
+      its Nth finish — the answered-but-not-durably-finished window,
+      the worst instant for exactly-once semantics).
+
+    The rung's claims, all measured and reported honestly:
+
+    - ``lost_requests`` == 0: every accepted uid reaches a terminal
+      outcome (the dead replica's pending work requeues off its journal
+      onto the siblings);
+    - ``duplicate_answers`` == 0: the router's uid dedup — nothing is
+      served twice across the crash handoff;
+    - every completed output TOKEN-IDENTICAL to a single-replica
+      sequential oracle (the sampling-stream contract: placement and
+      requeueing cannot change the tokens);
+    - ``handoff_requeue_ms``: the fail-over cost (lower-better in
+      ``ds_bench_diff``'s router family).
+
+    Model is intentionally tiny (the rung measures the ROUTER layer —
+    the serving perf rungs measure decode throughput)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from deepspeed_tpu.inference import (ProcessReplica, ReplicaRouter,
+                                         RouterConfig, OK, Request,
+                                         ServingEngine, ServingConfig)
+    from deepspeed_tpu.inference.router import READY_FILE
+    from deepspeed_tpu.utils.retry import RetryPolicy
+
+    root = tempfile.mkdtemp(prefix="serving-router-chaos-")
+    ds_router = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bin", "ds_router")
+    crash_site = f"serving.journal_crash_finish@{crash_finish_visit}"
+    procs = []
+    try:
+        handles, sources = [], {}
+        for i in range(replicas):
+            rd = os.path.join(root, f"replica{i}")
+            os.makedirs(rd)
+            name = f"replica{i}"
+            spec = {"root": rd, "name": name,
+                    "batch_slots": batch_slots, "block_size": block_size,
+                    "max_new_tokens": new_tokens,
+                    "cache_dir": cache_dir,
+                    "warm_prompt_len": prompt_len,
+                    "throttle_ms": (throttle_ms
+                                    if i == straggler_replica else 0)}
+            spec_path = os.path.join(rd, "spec.json")
+            with open(spec_path, "w") as f:
+                json.dump(spec, f)  # dstpu: disable=DSTPU104
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            if i == crash_replica:
+                # armed in the WORKER's environment: the worker dies on
+                # its (crash_finish_visit-1)th real finish (the warmup
+                # request's finish is visit 1) — deterministically
+                # mid-traffic once it owns >=2 requests
+                env["DSTPU_FAULT"] = f"crash_at={crash_site}"
+            proc = subprocess.Popen(
+                [sys.executable, ds_router, "--worker", spec_path],
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                text=True, env=env)
+            procs.append(proc)
+            handles.append(ProcessReplica(name, rd, proc=proc))
+            sources[name] = os.path.join(rd, "monitor")
+        deadline = time.monotonic() + timeout_s / 2
+        for i, h in enumerate(handles):
+            ready = os.path.join(h.root, READY_FILE)
+            while not os.path.exists(ready):
+                if procs[i].poll() is not None:
+                    err = (procs[i].communicate()[1] or "")[-200:]
+                    return {"error": f"replica{i} died at startup: {err}"}
+                if time.monotonic() > deadline:
+                    return {"error": f"replica{i} never became ready"}
+                time.sleep(0.05)
+
+        router = ReplicaRouter(
+            handles, stream_sources=sources,
+            config=RouterConfig(
+                suspect_after_s=1.5, dead_after_s=5.0,
+                probe_retry=RetryPolicy(max_attempts=8, base_delay_s=0.2,
+                                        max_delay_s=1.0,
+                                        jitter_mode="full",
+                                        sleep=lambda s: None)))
+        rng = np.random.default_rng(17)
+        reqs = [Request(tokens=rng.integers(0, 256, (prompt_len,)),
+                        max_new_tokens=1 + new_tokens * (1 + i % 3) // 3,
+                        seed=500 + i, do_sample=(i % 2 == 0),
+                        temperature=0.8)
+                for i in range(streams)]
+        specs = [(np.asarray(r.tokens).copy(), r.max_new_tokens, r.seed,
+                  r.do_sample, r.temperature) for r in reqs]
+        t0 = time.perf_counter()
+        uids = [router.submit(r) for r in reqs]
+        router.run(timeout_s=timeout_s / 2)
+        wall_s = time.perf_counter() - t0
+        st = router.stats()
+        states = router.states()
+        router.close()
+        for p in procs:
+            try:
+                p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+
+        # the zero-loss oracle: the SAME request specs through one
+        # sequential worker-shaped engine in this process (same model
+        # seed/dtype, compile-cache shared) — completed outputs must
+        # match token for token
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+        cfg = GPT2Config(vocab_size=256, max_seq=96, n_embd=64, n_layer=4,
+                         n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                         resid_pdrop=0.0, attention_impl="jnp")
+        model = GPT2(cfg, dtype=jnp.bfloat16)
+        params = model.init(jax.random.PRNGKey(0))
+        oracle = ServingEngine(
+            model=model, params=params, compile_cache=cache_dir,
+            config=ServingConfig(batch_slots=batch_slots,
+                                 block_size=block_size,
+                                 max_new_tokens=new_tokens,
+                                 preflight=False))
+        try:
+            refs = oracle.run(
+                [Request(tokens=tok, max_new_tokens=mnt, seed=seed,
+                         do_sample=ds, temperature=temp, uid=10_000 + i)
+                 for i, (tok, mnt, seed, ds, temp) in enumerate(specs)])
+        finally:
+            oracle.close()
+        mismatches = sum(
+            1 for i, uid in enumerate(uids)
+            if router.results[uid]["outcome"] == OK
+            and list(router.results[uid]["tokens"])
+            != list(refs[10_000 + i]["tokens"]))
+
+        lost = sum(1 for uid in uids
+                   if router.results[uid]["outcome"] is None)
+        return {
+            "replicas": replicas, "streams": streams,
+            "crash_replica": f"replica{crash_replica}",
+            "crash_site": crash_site,
+            "crash_fired": procs[crash_replica].returncode != 0,
+            "straggler_replica": f"replica{straggler_replica}",
+            "throttle_ms": throttle_ms,
+            "wall_s": round(wall_s, 3),
+            "lost_requests": lost,
+            "duplicate_answers": st["duplicates_suppressed"],
+            "completed_ok": st["outcomes"].get(OK, 0),
+            "requeued": st["requeued_total"],
+            "adopted_finishes": st["adopted_finishes"],
+            "handoff_requeue_ms": (round(max(st["handoff_requeue_ms"]), 3)
+                                   if st["handoff_requeue_ms"] else None),
+            "token_mismatches_vs_oracle": mismatches,
+            "token_identical_to_oracle": mismatches == 0,
+            "dead_replica_detected": any(
+                e["replica"] == f"replica{crash_replica}"
+                for e in st["dead_events"]),
+            "straggler_drained": any(
+                e["replica"] == f"replica{straggler_replica}"
+                for e in st["drain_events"]),
+            "final_states": {k: v["state"] for k, v in states.items()},
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def measure_paged_kernel_vs_gather(preset="gpt2-125m", *, streams=8,
                                    batch_slots=8, prompt_len=64,
                                    new_tokens=32, block_size=32,
@@ -1518,6 +1701,20 @@ def main():
             extra["serving_fleet_3rep"] = {"error": str(e)[:160]}
     else:
         extra["serving_fleet_3rep"] = {"skipped": "time budget"}
+
+    # router chaos rung (docs/serving.md#replica-router): 3 real
+    # subprocess replicas behind ReplicaRouter, one throttled (drained
+    # as the straggler), one killed mid-traffic by the armed fault
+    # harness — zero lost uids, zero duplicate answers, outputs
+    # token-identical to the sequential oracle
+    if left() > 5 * 60:
+        try:
+            extra["serving_router_chaos"] = measure_serving_router_chaos(
+                replicas=3, cache_dir=cache_dir)
+        except Exception as e:
+            extra["serving_router_chaos"] = {"error": str(e)[:160]}
+    else:
+        extra["serving_router_chaos"] = {"skipped": "time budget"}
 
     # 760M remat: the largest on-chip model (Adam states + remat'd
     # activations fill the 16GB HBM) — the VERDICT r2 MFU target (>=0.45)

@@ -19,7 +19,9 @@ Metric classification (by key name, innermost key of the JSON path):
   ``host_pct``/``overhead_pct``, the memory family
   (``rss_hwm_gb``, ``pool_bytes``, ``peak_bytes`` — capacity costs),
   and the slo family (``*burn_rate*``, ``slo_breaches`` — error-budget
-  costs);
+  costs), and the router family (``lost_requests``,
+  ``duplicate_answers``, ``handoff_requeue_ms`` — zero-loss serving
+  costs: any growth is a robustness regression);
 - everything else numeric is **informational** — reported when it moved,
   never gated (counts, shapes, config echoes).
 
@@ -53,6 +55,12 @@ LOWER_BETTER_MEM = ("rss_hwm_gb", "pool_bytes", "peak_bytes")
 # slo family (docs/monitoring.md#slo-tracking): burn rates and breach
 # counts are budget costs — growth beyond band is a regression
 LOWER_BETTER_SLO = ("burn_rate", "slo_breaches")
+# router family (docs/serving.md#replica-router): lost requests and
+# duplicate answers must be exactly zero (the zero-loss contract), and
+# handoff requeue latency is the fail-over cost — growth is a
+# robustness regression
+LOWER_BETTER_ROUTER = ("lost_requests", "duplicate_answers",
+                       "handoff_requeue_ms")
 
 
 def classify(key: str):
@@ -62,7 +70,7 @@ def classify(key: str):
         if name in k:
             return "higher"
     for name in (LOWER_BETTER + LOWER_BETTER_BYTES + LOWER_BETTER_MEM
-                 + LOWER_BETTER_SLO):
+                 + LOWER_BETTER_SLO + LOWER_BETTER_ROUTER):
         if name in k:
             return "lower"
     if k.endswith(LOWER_BETTER_SUFFIX):

@@ -204,11 +204,42 @@ def monitor_report():
     print("fleet view ............ ds_fleet <dir1> <dir2> ...")
 
 
+def router_report():
+    """Resolved replica-router policy (docs/serving.md#replica-router):
+    the health state machine's thresholds, probe backoff, and
+    degradation knobs as a router built in this environment would
+    resolve them."""
+    from .inference.router import RouterConfig
+
+    print("-" * 64)
+    print("Replica router (bin/ds_router):")
+    print("-" * 64)
+    pol = _safe(lambda: RouterConfig().describe())
+    if not isinstance(pol, dict):
+        print(f"policy ................ {pol}")
+        return
+    print(f"suspect after ......... {pol['suspect_after_s']}s heartbeat "
+          "silence (placement stops)")
+    print(f"dead after ............ {pol['dead_after_s']}s (journal "
+          "replay + requeue onto siblings)")
+    print(f"probe backoff ......... {pol['probe_backoff']}")
+    print(f"straggler drain ....... z>={pol['straggler_zmax']} and "
+          f"excess>={pol['straggler_min_excess']:.0%} (drain, not kill)")
+    print(f"drain heals after ..... {pol['drain_clear_evals']} clean "
+          "verdict(s)")
+    print(f"slo burn drain ........ worst burn >= {pol['slo_burn_drain']}")
+    print(f"deadline_ms ........... {pol['deadline_ms'] or 'disabled'}")
+    print(f"max_outstanding ....... "
+          f"{pol['max_outstanding'] or 'unbounded'}")
+    print("observe with .......... ds_router <dir1> <dir2> ... [--once]")
+
+
 def main():
     op_report()
     compile_cache_report()
     comms_compression_report()
     monitor_report()
+    router_report()
     debug_report()
 
 

@@ -18,9 +18,21 @@ comma-separated spec, e.g.::
     DSTPU_FAULT=ckpt_crash_after_model_file,io_error_p=0.2,io_delay_ms=50
 
 tokens:
-- ``crash_at=<site>``              raise ``InjectedCrash`` at the named site
+- ``crash_at=<site>[@N]``          raise ``InjectedCrash`` at the named site
                                    (one-shot: disarms after firing so the
-                                   recovery path can run in-process)
+                                   recovery path can run in-process).
+                                   ``@N`` defers the crash to the N-th
+                                   VISIT of the site (1-based) — "die at
+                                   scheduler step 12", mid-traffic, not
+                                   at the first opportunity
+- ``hang_at=<site>[@N]``           sleep ``hang_s`` seconds at the named
+                                   site (one-shot, then continue) — a
+                                   simulated wedge/GC-pause/network stall
+                                   that RESOLVES, unlike a crash: the
+                                   process survives and finishes its
+                                   work late (the router's
+                                   hung-replica-answers-anyway case)
+- ``hang_s=<float>``               hang_at sleep duration (default 0.25s)
 - ``<area>_crash_<point>``         sugar for ``crash_at=<area>.<point>``
                                    (``ckpt_crash_after_model_file`` ->
                                    ``ckpt.after_model_file``)
@@ -81,6 +93,16 @@ SITES = (
     "serving.step",            # serving scheduler iteration (host boundary)
     "serving.admit",           # serving admission (queue -> slot) boundary
     "serving.prefill",         # before a request's prefill dispatch
+    # replica-worker loop boundaries (inference/router.py): one visit per
+    # worker iteration, so `@N` kills/hangs a REPLICA mid-traffic — the
+    # router chaos rung's deterministic replacement for ad-hoc SIGKILL
+    "serving.replica_crash_step",   # worker dies here (no clean shutdown)
+    "serving.replica_hang_step",    # worker stalls here, then continues
+    # between computing a request's answer and journaling its finish:
+    # the answered-but-not-durably-finished window (a crash here makes
+    # the uid replay as PENDING although a result may already be out —
+    # the router's dedup-by-uid case)
+    "serving.journal_crash_finish",
 )
 
 _IO_PREFIXES = ("io.", "aio.")
@@ -112,11 +134,31 @@ def _parse_window(val):
     return (lo, hi)
 
 
+def _parse_site_at(val):
+    """``"site"`` -> (site, None); ``"site@N"`` -> (site, N) with N the
+    1-based visit index the trigger fires on."""
+    val = str(val).strip()
+    if "@" in val:
+        site_name, n = val.rsplit("@", 1)
+        visit = int(n)
+        if visit < 1:
+            raise ValueError(f"visit index must be >= 1 in {val!r}")
+        return site_name.strip(), visit
+    return val, None
+
+
 class FaultPlan:
     def __init__(self, crash_sites=(), io_error_p=0.0, io_delay_ms=0.0,
                  max_faults=None, seed=0, grad_nan=None, loss_spike=None,
-                 spike_factor=1e4, logit_nan=()):
-        unknown = set(crash_sites) - set(SITES)
+                 spike_factor=1e4, logit_nan=(), crash_at_visit=None,
+                 hang_at=None, hang_s=0.25):
+        # crash_at_visit / hang_at: {site: visit} — fire on that 1-based
+        # VISIT of the site (crash_sites entries fire on the next visit)
+        self.crash_at_visit = dict(crash_at_visit or {})
+        self.hang_at = dict(hang_at or {})
+        self.hang_s = float(hang_s)
+        unknown = (set(crash_sites) | set(self.crash_at_visit)
+                   | set(self.hang_at)) - set(SITES)
         assert not unknown, f"unknown fault sites {sorted(unknown)}; " \
                             f"valid: {SITES}"
         self.crash_sites = set(crash_sites)
@@ -145,8 +187,17 @@ class FaultPlan:
                 key, val = token.split("=", 1)
                 key = key.strip()
                 if key == "crash_at":
-                    crash.append(val.strip())
-                elif key in ("io_error_p", "io_delay_ms", "spike_factor"):
+                    site_name, visit = _parse_site_at(val)
+                    if visit is None:
+                        crash.append(site_name)
+                    else:
+                        kw.setdefault("crash_at_visit", {})[site_name] = visit
+                elif key == "hang_at":
+                    site_name, visit = _parse_site_at(val)
+                    # visit None = fire on the very next visit
+                    kw.setdefault("hang_at", {})[site_name] = visit or 1
+                elif key in ("io_error_p", "io_delay_ms", "spike_factor",
+                             "hang_s"):
                     kw[key] = float(val)
                 elif key in ("max_faults", "seed"):
                     kw[key] = int(val)
@@ -202,6 +253,16 @@ def site(name, path=None):
         return
     p = _PLAN
     p.hits[name] = p.hits.get(name, 0) + 1
+    if name in p.hang_at and p.hits[name] >= p.hang_at[name]:
+        # one-shot stall that RESOLVES: the site continues afterwards
+        del p.hang_at[name]
+        logger.warning(f"fault: injected {p.hang_s}s hang at {name}")
+        time.sleep(p.hang_s)
+    if name in p.crash_at_visit and p.hits[name] >= p.crash_at_visit[name]:
+        del p.crash_at_visit[name]    # one-shot, like crash_sites
+        raise InjectedCrash(f"injected crash at {name} "
+                            f"(visit {p.hits[name]})"
+                            + (f" ({path})" if path else ""))
     if name in p.crash_sites:
         p.crash_sites.discard(name)   # one-shot: recovery can proceed
         raise InjectedCrash(f"injected crash at {name}"

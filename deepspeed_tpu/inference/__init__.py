@@ -9,9 +9,15 @@ from .serving import (ServingConfig, ServingEngine, SpeculativeConfig,
                       Request, ServingError, QueueFullError,
                       ServingStalledError, CircuitOpenError,
                       OK, SHED, DEADLINE, POISONED, OUTCOMES)
+from .router import (ReplicaRouter, RouterConfig, ReplicaHandle,
+                     LocalReplica, ProcessReplica,
+                     HEALTHY, SUSPECT, DRAINING, DEAD)
 
 __all__ = ["InferenceEngine", "ServingEngine", "ServingConfig",
            "SpeculativeConfig", "Request",
            "ServingError", "QueueFullError", "ServingStalledError",
            "CircuitOpenError", "OK", "SHED", "DEADLINE", "POISONED",
-           "OUTCOMES"]
+           "OUTCOMES",
+           "ReplicaRouter", "RouterConfig", "ReplicaHandle",
+           "LocalReplica", "ProcessReplica",
+           "HEALTHY", "SUSPECT", "DRAINING", "DEAD"]

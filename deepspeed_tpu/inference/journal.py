@@ -39,6 +39,7 @@ from ..utils.logging import logger
 from ..utils.retry import RetryPolicy, retry_call
 
 JOURNAL_FILE = "requests.jsonl"
+ROTATED_FILE = JOURNAL_FILE + ".1"    # one retired generation (rotate())
 
 
 class RequestJournal:
@@ -94,6 +95,12 @@ class RequestJournal:
         self.record("admit", uid=int(uid))
 
     def finish(self, uid, outcome, tokens):
+        # the answered-but-not-durably-finished window: a crash injected
+        # here leaves the uid PENDING in the journal although its answer
+        # may already have been computed (and, behind a router, even
+        # observed) — the requeue-dedup case docs/serving.md#replica-router
+        # exists for
+        fault.site("serving.journal_crash_finish", path=self.path)
         self.record("finish", uid=int(uid), outcome=str(outcome),
                     tokens=None if tokens is None
                     else [int(t) for t in tokens])
@@ -144,18 +151,42 @@ class RequestJournal:
         self.flushes += 1
 
     def rotate(self):
-        """Truncate the journal.  Called by a recovering engine when the
-        previous generation shut down CLEAN with nothing pending: every
-        journaled uid reached a terminal outcome and was handed to its
-        caller, so the history is dead weight — without rotation each
-        restart would replay (and re-materialize) every request ever
-        served."""
+        """Retire the live journal to ``requests.jsonl.1``.  Called by a
+        recovering engine when the previous generation shut down CLEAN
+        with nothing pending: every journaled uid reached a terminal
+        outcome and was handed to its caller, so the history is dead
+        weight — without rotation each restart would replay (and
+        re-materialize) every request ever served.
+
+        Durability of the rotation itself: the rename is atomic, and the
+        DIRECTORY entry is fsynced after it — without the directory
+        fsync a power cut can resurrect the pre-rename state (both
+        names, or the old name) and a later replay would double-count
+        the retired generation as live.  One retired generation is kept
+        (the previous ``.1`` is dropped first) so :func:`replay` can
+        still recover uid continuity — and report torn lines — across
+        the rotation boundary."""
         self.flush()
         if self._fd is not None:
             os.close(self._fd)
             self._fd = None
-        with open(self.path, "w"):
-            pass
+        if not os.path.exists(self.path):
+            return
+
+        def _retire():
+            fault.site("io.write", path=self.path)
+            rotated = os.path.join(self.dir, ROTATED_FILE)
+            os.replace(self.path, rotated)     # atomic; drops any old .1
+            os.close(os.open(self.path,        # fresh empty live journal
+                             os.O_CREAT | os.O_WRONLY, 0o644))
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)                  # make the rename durable
+            finally:
+                os.close(dfd)
+
+        retry_call(_retire, policy=self._retry,
+                   describe=f"journal rotate ({self.path})")
 
     def close(self):
         try:
@@ -166,26 +197,7 @@ class RequestJournal:
                 self._fd = None
 
 
-def replay(dirpath):
-    """Fold a journal back into recovery state.
-
-    Returns ``{"pending": [submit-record dicts, journal order],
-    "finished": {uid: finish-record}, "max_uid": int,
-    "clean_shutdown": bool}``.  ``pending`` holds every submitted uid
-    without a finish record — submitted-but-queued and in-flight alike
-    (a crash loses the distinction, and both re-run identically).
-
-    Torn trailing lines (a kill mid-append) and unparseable lines are
-    skipped with a warning count — replay of a crashed journal must
-    never itself crash."""
-    path = os.path.join(dirpath, JOURNAL_FILE)
-    state = {"pending": [], "finished": {}, "max_uid": -1,
-             "clean_shutdown": False}
-    if not os.path.isfile(path):
-        return state
-    submitted = {}          # uid -> submit record (insertion-ordered)
-    bad = 0
-
+def _read_lines(path):
     def _read():
         fault.site("io.read", path=path)
         with open(path, "r", encoding="utf-8") as f:
@@ -193,15 +205,70 @@ def replay(dirpath):
 
     data = retry_call(_read, policy=RetryPolicy(),
                       describe=f"journal replay ({path})")
-    for line in data.split("\n"):
-        if not line.strip():
-            continue
+    return [ln for ln in data.split("\n") if ln.strip()]
+
+
+def _parse_lines(lines):
+    """Parse journal lines; a bad LAST line is a torn tail (the
+    expected artifact of a kill mid-append), a bad line anywhere else is
+    foreign matter (corruption, a stray writer).  Returns
+    ``(records, torn, foreign)``."""
+    records, torn, foreign = [], 0, 0
+    for i, line in enumerate(lines):
         try:
             rec = json.loads(line)
-            kind = rec["kind"]
+            kind = rec["kind"]          # noqa: F841 — shape check
         except (ValueError, KeyError, TypeError):
-            bad += 1        # torn tail or foreign line: skip, keep going
+            if i == len(lines) - 1:
+                torn += 1
+            else:
+                foreign += 1
             continue
+        records.append(rec)
+    return records, torn, foreign
+
+
+def replay(dirpath):
+    """Fold a journal back into recovery state.
+
+    Returns ``{"pending": [submit-record dicts, journal order],
+    "finished": {uid: finish-record}, "max_uid": int,
+    "clean_shutdown": bool, "torn_lines": int, "foreign_lines": int}``.
+    ``pending`` holds every submitted uid without a finish record —
+    submitted-but-queued and in-flight alike (a crash loses the
+    distinction, and both re-run identically).
+
+    The retired segment (``requests.jsonl.1``, see
+    :meth:`RequestJournal.rotate`) is read for **uid continuity only**:
+    a segment is only ever rotated out after a clean shutdown with
+    nothing pending, so by construction it holds no recoverable state —
+    but its uids were issued, and a restarted engine (or a router
+    deduping by uid) must never re-issue them.  Its torn/foreign lines
+    still count: "recovered with N torn records" is a verdict the
+    caller can surface, not a log line to forget.
+
+    Torn trailing lines (a kill mid-append) and unparseable lines are
+    skipped and COUNTED — replay of a crashed journal must never itself
+    crash."""
+    path = os.path.join(dirpath, JOURNAL_FILE)
+    rotated = os.path.join(dirpath, ROTATED_FILE)
+    state = {"pending": [], "finished": {}, "max_uid": -1,
+             "clean_shutdown": False, "torn_lines": 0, "foreign_lines": 0}
+    if os.path.isfile(rotated):
+        records, torn, foreign = _parse_lines(_read_lines(rotated))
+        state["torn_lines"] += torn
+        state["foreign_lines"] += foreign
+        for rec in records:
+            if rec["kind"] == "submit":
+                state["max_uid"] = max(state["max_uid"], int(rec["uid"]))
+    if not os.path.isfile(path):
+        return state
+    submitted = {}          # uid -> submit record (insertion-ordered)
+    records, torn, foreign = _parse_lines(_read_lines(path))
+    state["torn_lines"] += torn
+    state["foreign_lines"] += foreign
+    for rec in records:
+        kind = rec["kind"]
         if kind == "submit":
             uid = int(rec["uid"])
             submitted[uid] = rec
@@ -214,11 +281,11 @@ def replay(dirpath):
             state["clean_shutdown"] = bool(rec.get("clean", False))
             continue
         # admit/requeue records are informational for replay
-        if kind != "shutdown":
-            state["clean_shutdown"] = False
+        state["clean_shutdown"] = False
     state["pending"] = list(submitted.values())
-    if bad:
-        logger.warning(f"journal replay: skipped {bad} unparseable "
-                       f"line(s) in {path} (torn tail from a kill is "
-                       "expected)")
+    if state["torn_lines"] or state["foreign_lines"]:
+        logger.warning(
+            f"journal replay: skipped {state['torn_lines']} torn and "
+            f"{state['foreign_lines']} foreign line(s) under {dirpath} "
+            "(a torn tail from a kill is expected)")
     return state

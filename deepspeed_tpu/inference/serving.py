@@ -516,12 +516,16 @@ class ServingEngine:
             self._requeued_total += 1
         if state["pending"]:
             self.journal.flush()
+            torn = state.get("torn_lines", 0)
+            foreign = state.get("foreign_lines", 0)
             log_dist(
                 f"serving journal recovery: re-queued "
                 f"{len(state['pending'])} in-flight request(s), restored "
                 f"{len(state['finished'])} finished record(s) "
-                f"(clean_shutdown={state['clean_shutdown']}) from "
-                f"{self.config.journal_dir}", ranks=[0])
+                f"(clean_shutdown={state['clean_shutdown']}"
+                + (f", torn_lines={torn}" if torn else "")
+                + (f", foreign_lines={foreign}" if foreign else "")
+                + f") from {self.config.journal_dir}", ranks=[0])
 
     # ------------------------------------------------------------- capacity
     def capacity(self) -> dict:

@@ -354,8 +354,12 @@ def render_fleet(view: FleetView, slo_verdict=None,
     lines.append("-" * 78)
     counters = view.summed_counters()
     if counters:
+        # router handoff counters (inference/router.py) roll up beside
+        # the per-replica outcome counters: a fleet view that hides
+        # requeues/suppressed duplicates hides the fail-overs
         keys = ("shed_total", "deadline_total", "poisoned_total",
-                "requeued_total")
+                "requeued_total", "router_requeued_total",
+                "router_duplicates_suppressed_total")
         parts = [f"{k.replace('_total', '')} {int(counters[k])}"
                  for k in keys if k in counters]
         extra = [f"{k} {int(v)}" for k, v in sorted(counters.items())

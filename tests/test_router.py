@@ -1,0 +1,597 @@
+"""Replica-router tests (docs/serving.md#replica-router): the fleet
+controller's contracts, each against the oracle that makes it a claim
+rather than a feature list:
+
+- **state machine**: healthy → suspect (heartbeat silence) → healthy
+  (fresh heartbeat) or dead (silence past the bound / probes exhausted),
+  with FULL-jitter probe backoff; straggler/SLO verdicts DRAIN (stop
+  placement, keep collecting answers) and heal after consecutive clean
+  verdicts — drain is not kill;
+- **requeue-dedup**: a request requeued off a "dead" replica that later
+  answers anyway yields EXACTLY one result (set-once by uid, the late
+  answer counted as suppressed duplicate, never served);
+- **crash handoff**: a replica that dies mid-traffic (the new
+  ``serving.journal_crash_finish`` site — answered but not durably
+  finished) loses nothing: journaled finishes are adopted, pending uids
+  requeue onto the sibling, and every completed output is
+  token-identical to a single-replica sequential oracle;
+- **journal**: ``rotate()`` renames (directory-fsynced) instead of
+  truncating, preserving uid continuity across generations; ``replay()``
+  reads across the rotation boundary and REPORTS torn/foreign line
+  counts instead of logging and forgetting;
+- **fault harness**: ``crash_at=<site>@N`` visit-indexed firing and the
+  one-shot ``hang_at``/``hang_s`` stall;
+- **CLI**: ``bin/ds_router --once`` over the committed fleet fixture
+  streams (the tier-1 smoke), and ``ds_report``'s resolved router
+  policy block.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import journal as jr
+from deepspeed_tpu.inference import (Request, OK, SHED, DEADLINE,
+                                     ReplicaRouter, RouterConfig,
+                                     ReplicaHandle, LocalReplica,
+                                     ServingEngine, ServingConfig,
+                                     HEALTHY, SUSPECT, DRAINING, DEAD)
+from deepspeed_tpu.inference.router import (observe_states, render_router,
+                                            main as router_main)
+from deepspeed_tpu.utils.retry import RetryPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = [os.path.join(REPO, "tests", "data", "fleet", d)
+            for d in ("replica_a", "replica_b")]
+
+
+# ------------------------------------------------------------ test rigs
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeReplica(ReplicaHandle):
+    """A scripted replica: heartbeat follows the fake clock unless
+    frozen (a hang), answers are injected by the test (a hung replica
+    can answer LATE, long after the router declared it dead)."""
+
+    def __init__(self, name, clock):
+        self.name = name
+        self._clock = clock
+        self.hb = clock()
+        self.inbox = []
+        self.frozen = False
+        self.exited = False
+        self._answers = []
+
+    def submit(self, req):
+        self.inbox.append(req)
+
+    def pump(self):
+        if not self.frozen:
+            self.hb = self._clock()
+
+    def answer(self, uid, tokens, outcome=OK):
+        self._answers.append({"uid": uid, "outcome": outcome,
+                              "tokens": tokens})
+
+    def poll(self):
+        out, self._answers = self._answers, []
+        return out
+
+    def heartbeat(self):
+        return self.hb
+
+    def alive(self):
+        return not self.exited
+
+
+def _cfg(**over):
+    base = dict(suspect_after_s=1.0, dead_after_s=4.0,
+                probe_retry=RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                                        max_delay_s=0.2,
+                                        jitter_mode="full", seed=7,
+                                        sleep=lambda s: None),
+                monitor_interval=1)
+    base.update(over)
+    return RouterConfig(**base)
+
+
+def _req(uid=None, n=4, seed=0, max_new=2):
+    return Request(tokens=np.arange(n) % 64, max_new_tokens=max_new,
+                   seed=seed, uid=uid)
+
+
+def _write_events(dirp, label, t0, gap_s, n=8, queued=1, start_step=0,
+                  mode="a"):
+    os.makedirs(dirp, exist_ok=True)
+    with open(os.path.join(dirp, "events.jsonl"), mode) as f:
+        for i in range(n):
+            f.write(json.dumps(
+                {"kind": "step", "name": "serving_step",
+                 "t": t0 + i * gap_s, "step": start_step + i, "v": 1,
+                 "run": label,
+                 "fields": {"wall_s": gap_s * 0.8,
+                            "queued": queued}}) + "\n")
+
+
+# -------------------------------------------------------- fault harness
+def test_fault_crash_at_visit_parsing_and_firing(fault_harness):
+    fault = fault_harness
+    plan = fault.configure("crash_at=serving.replica_crash_step@3")
+    assert plan.crash_at_visit == {"serving.replica_crash_step": 3}
+    fault.site("serving.replica_crash_step")
+    fault.site("serving.replica_crash_step")
+    with pytest.raises(fault.InjectedCrash, match="visit 3"):
+        fault.site("serving.replica_crash_step")
+    # one-shot: the site disarms after firing
+    fault.site("serving.replica_crash_step")
+
+
+def test_fault_hang_at_is_one_shot_and_survivable(fault_harness,
+                                                  monkeypatch):
+    fault = fault_harness
+    naps = []
+    monkeypatch.setattr("deepspeed_tpu.fault.time.sleep",
+                        lambda s: naps.append(s))
+    fault.configure("hang_at=serving.replica_hang_step@2,hang_s=1.5")
+    fault.site("serving.replica_hang_step")       # visit 1: no hang
+    assert naps == []
+    fault.site("serving.replica_hang_step")       # visit 2: hang, survive
+    assert naps == [1.5]
+    fault.site("serving.replica_hang_step")       # one-shot
+    assert naps == [1.5]
+
+
+def test_fault_unknown_site_still_rejected(fault_harness):
+    with pytest.raises(AssertionError, match="unknown fault sites"):
+        fault_harness.configure("crash_at=serving.nonsense@2")
+
+
+# --------------------------------------------------------------- journal
+def test_journal_rotate_renames_with_dir_fsync_and_keeps_uid_continuity(
+        tmp_path):
+    jd = str(tmp_path)
+    j = jr.RequestJournal(jd)
+    for uid in range(3):
+        j.submit(_req(uid=uid, seed=uid))
+        j.finish(uid, OK, [1, 2])
+    j.shutdown(clean=True)
+    j.close()
+    j.rotate()
+    rotated = os.path.join(jd, jr.ROTATED_FILE)
+    live = os.path.join(jd, jr.JOURNAL_FILE)
+    assert os.path.isfile(rotated) and os.path.getsize(rotated) > 0
+    assert os.path.isfile(live) and os.path.getsize(live) == 0
+    # the retired generation yields NO recoverable state, but its uids
+    # stay burned: a restarted engine (or a router deduping by uid)
+    # must never re-issue uid 0-2
+    state = jr.replay(jd)
+    assert state["pending"] == [] and state["finished"] == {}
+    assert state["max_uid"] == 2
+    # a second rotation keeps exactly ONE retired generation
+    j2 = jr.RequestJournal(jd)
+    j2.submit(_req(uid=7, seed=7))
+    j2.finish(7, OK, [3])
+    j2.shutdown(clean=True)
+    j2.close()
+    j2.rotate()
+    assert not os.path.exists(rotated + ".1")
+    assert jr.replay(jd)["max_uid"] == 7
+
+
+def test_journal_replay_across_rotation_boundary_with_torn_tail(tmp_path):
+    jd = str(tmp_path)
+    j = jr.RequestJournal(jd)
+    for uid in range(3):
+        j.submit(_req(uid=uid, seed=uid))
+        j.finish(uid, OK, [1, 2])
+    j.shutdown(clean=True)
+    j.close()
+    j.rotate()
+    # a torn tail in the RETIRED segment (kill mid-append, pre-rotation)
+    with open(os.path.join(jd, jr.ROTATED_FILE), "a") as f:
+        f.write('{"kind":"submit","uid":99')          # truncated JSON
+    # generation 2: one pending submit, then a foreign line AND a torn
+    # tail in the live file
+    j2 = jr.RequestJournal(jd)
+    j2.submit(_req(uid=1001, seed=1))
+    j2.close()
+    with open(os.path.join(jd, jr.JOURNAL_FILE), "a") as f:
+        f.write("### not json at all\n")
+        f.write('{"kind":"fin')
+    state = jr.replay(jd)
+    assert [r["uid"] for r in state["pending"]] == [1001]
+    assert state["finished"] == {}                    # .1 is uid-only
+    assert state["max_uid"] == 1001
+    assert state["torn_lines"] == 2                   # one per segment
+    assert state["foreign_lines"] == 1
+    assert not state["clean_shutdown"]
+
+
+# ---------------------------------------------------------- state machine
+def test_health_state_machine_suspect_recovers_and_dies():
+    clk = FakeClock()
+    a, b = FakeReplica("a", clk), FakeReplica("b", clk)
+    router = ReplicaRouter([a, b], config=_cfg(), clock=clk)
+    router.pump()
+    assert router.states()["a"]["state"] == HEALTHY
+    # heartbeat silence -> suspect; placement must stop
+    a.frozen = True
+    clk.advance(1.5)
+    router.pump()
+    assert router.states()["a"]["state"] == SUSPECT
+    uid = router.submit(_req())
+    router.pump()
+    assert b.inbox and b.inbox[0].uid == uid          # placed on b only
+    assert not a.inbox
+    # probes back off with FULL jitter: the scheduled gap stays within
+    # the policy's delay bounds (uniform(0, nominal))
+    st = router._replicas["a"]
+    lo, hi = _cfg().probe_retry.delay_bounds(0)
+    assert lo <= st.next_probe_t - clk() <= hi
+    # a fresh heartbeat heals it
+    a.frozen = False
+    clk.advance(0.5)
+    router.pump()                                     # pump refreshes hb
+    clk.advance(0.25)                                 # > max probe jitter
+    router.pump()
+    assert router.states()["a"]["state"] == HEALTHY
+    # silence past dead_after_s kills it
+    a.frozen = True
+    clk.advance(1.5)
+    router.pump()
+    assert router.states()["a"]["state"] == SUSPECT
+    clk.advance(10.0)
+    router.pump()
+    assert router.states()["a"]["state"] == DEAD
+    assert router.stats()["dead_events"][0]["replica"] == "a"
+    # dead is terminal: a revived heartbeat must not resurrect it
+    a.frozen = False
+    clk.advance(0.1)
+    router.pump()
+    assert router.states()["a"]["state"] == DEAD
+
+
+def test_process_exit_is_immediately_dead():
+    clk = FakeClock()
+    a, b = FakeReplica("a", clk), FakeReplica("b", clk)
+    router = ReplicaRouter([a, b], config=_cfg(), clock=clk)
+    uid = router.submit(_req())
+    router.pump()
+    owner = a if a.inbox else b
+    owner.exited = True
+    clk.advance(0.1)
+    router.pump()
+    assert router.states()[owner.name]["state"] == DEAD
+    assert router.stats()["dead_events"][0]["reason"] == "process exit"
+    # the uid moved to the survivor
+    survivor = b if owner is a else a
+    assert any(r.uid == uid for r in survivor.inbox)
+
+
+def test_requeue_dedup_late_answer_yields_exactly_one_result():
+    """The ISSUE's dedup oracle: a request requeued off a 'dead' (hung)
+    replica that later answers anyway must yield EXACTLY one result."""
+    clk = FakeClock()
+    a, b = FakeReplica("a", clk), FakeReplica("b", clk)
+    router = ReplicaRouter([a, b], config=_cfg(), clock=clk)
+    uid = router.submit(_req(seed=7))
+    router.pump()
+    owner = a if a.inbox else b
+    sibling = b if owner is a else a
+    # the owner hangs (alive, but silent) long enough to be declared
+    # dead; small clock steps keep the SIBLING's heartbeat fresh (each
+    # pump refreshes it) while the hung owner ages out
+    owner.frozen = True
+    for _ in range(20):
+        clk.advance(0.6)
+        router.pump()
+        if router.states()[owner.name]["state"] == DEAD:
+            break
+    assert router.states()[owner.name]["state"] == DEAD
+    assert router.states()[sibling.name]["state"] == HEALTHY
+    assert router.stats()["requeued_total"] == 1
+    assert len(router.stats()["handoff_requeue_ms"]) == 1
+    assert any(r.uid == uid for r in sibling.inbox)   # requeued onto sibling
+    # the sibling answers first
+    sibling.answer(uid, [5, 6])
+    router.pump()
+    assert router.results[uid]["outcome"] == OK
+    assert router.results[uid]["tokens"] == [5, 6]
+    # ... and the hung replica answers LATE: suppressed, never re-served
+    owner.answer(uid, [5, 6])
+    clk.advance(0.01)
+    router.pump()
+    assert router.stats()["duplicates_suppressed"] == 1
+    assert router.results[uid]["tokens"] == [5, 6]
+    rec = router.pop_result(uid)
+    assert rec["outcome"] == OK
+    with pytest.raises(KeyError):
+        router.pop_result(uid)                        # exactly once
+
+
+def test_straggler_verdict_drains_not_kills_and_heals(tmp_path):
+    """The fleet sentinel names a straggler -> the router DRAINS it
+    (placement stops, answers still collected); after the verdict
+    clears for drain_clear_evals evaluations it heals."""
+    clk = FakeClock()
+    a, b = FakeReplica("a", clk), FakeReplica("b", clk)
+    da, db = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_events(da, "a", t0=100.0, gap_s=0.01, n=8)
+    _write_events(db, "b", t0=100.0, gap_s=0.05, n=8)   # 5x slower
+    router = ReplicaRouter([a, b], config=_cfg(drain_clear_evals=2),
+                           clock=clk,
+                           stream_sources={"a": da, "b": db})
+    router.pump()
+    assert router.states()["b"]["state"] == DRAINING
+    assert "straggler" in router.states()["b"]["reason"]
+    assert router.stats()["drain_events"][0]["replica"] == "b"
+    # drain, not kill: no placement on b, but its late answer is taken
+    uid = router.submit(_req())
+    router.pump()
+    assert a.inbox and not b.inbox
+    b.answer(999, [1])                                # unknown uid: counted
+    router.pump()
+    assert router.stats()["unknown_results"] == 1
+    # the straggler catches up: enough fast steps to drop its median gap
+    _write_events(db, "b", t0=101.0, gap_s=0.01, n=24, start_step=8)
+    router.pump()                                     # clean verdict 1
+    router.pump()                                     # clean verdict 2
+    assert router.states()["b"]["state"] == HEALTHY
+    a.answer(uid, [3, 4])
+    router.pump()
+    assert router.results[uid]["outcome"] == OK
+
+
+def test_slo_burn_rate_drains(tmp_path):
+    clk = FakeClock()
+    a, b = FakeReplica("a", clk), FakeReplica("b", clk)
+    da, db = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_events(da, "a", t0=100.0, gap_s=0.01, n=8)
+    _write_events(db, "b", t0=100.0, gap_s=0.01, n=8)
+    with open(os.path.join(da, "events.jsonl"), "a") as f:
+        f.write(json.dumps(
+            {"kind": "slo", "name": "p99", "t": 101.0, "v": 1, "run": "a",
+             "fields": {"met": False, "burn_fast": 20.0,
+                        "burn_slow": 3.0}}) + "\n")
+    router = ReplicaRouter([a, b], config=_cfg(slo_burn_drain=10.0),
+                           clock=clk,
+                           stream_sources={"a": da, "b": db})
+    router.pump()
+    assert router.states()["a"]["state"] == DRAINING
+    assert "slo burn" in router.states()["a"]["reason"]
+
+
+def test_router_admission_shed_and_deadline_typed():
+    clk = FakeClock()
+    a = FakeReplica("a", clk)
+    router = ReplicaRouter([a], clock=clk,
+                           config=_cfg(max_outstanding=2,
+                                       deadline_ms=1000.0))
+    u1, u2 = router.submit(_req(seed=1)), router.submit(_req(seed=2))
+    u3 = router.submit(_req(seed=3))                  # over the bound
+    assert router.results[u3]["outcome"] == SHED
+    # no healthy replica in time: the router's own deadline fires
+    a.frozen = True
+    clk.advance(1.5)
+    router.pump()                                     # a -> suspect
+    assert router.states()["a"]["state"] == SUSPECT
+    clk.advance(0.2)
+    router.pump()                                     # queued past budget
+    assert router.results[u1]["outcome"] == DEADLINE
+    assert router.results[u2]["outcome"] == DEADLINE
+    st = router.stats()
+    assert st["outcomes"][SHED] == 1
+    assert st["outcomes"][DEADLINE] == 2
+    assert st["lost"] == 0
+
+
+# -------------------------------------------- real engines (LocalReplica)
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg = GPT2Config(vocab_size=128, max_seq=64, n_embd=32, n_layer=2,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _engine(tiny, **over):
+    model, params = tiny
+    base = dict(batch_slots=2, block_size=8, max_new_tokens=4)
+    base.update(over)
+    return ServingEngine(model=model, params=params,
+                         config=ServingConfig(**base))
+
+
+def _oracle_outputs(tiny, reqs):
+    """Single-replica sequential run of the same specs — the
+    token-identity reference (sampling streams are pure functions of
+    the request, so routing/requeueing cannot change them)."""
+    oracle = _engine(tiny)
+    res = oracle.run([Request(tokens=r.tokens.copy(),
+                              max_new_tokens=r.max_new_tokens,
+                              seed=r.seed, do_sample=r.do_sample,
+                              temperature=r.temperature, uid=10_000 + i)
+                      for i, r in enumerate(reqs)])
+    oracle.close()
+    return [list(res[10_000 + i]["tokens"]) for i in range(len(reqs))]
+
+
+def _traffic(n):
+    """Mixed greedy/sampled requests — the token-identity claim must
+    hold for SAMPLED streams (seed-determined), not just argmax."""
+    rng = np.random.default_rng(3)
+    return [Request(tokens=rng.integers(0, 128, (4 + i % 3,)),
+                    max_new_tokens=1 + i % 3, seed=100 + i,
+                    do_sample=(i % 2 == 0), temperature=0.8)
+            for i in range(n)]
+
+
+def test_router_over_local_replicas_token_identical_to_oracle(tiny,
+                                                              devices):
+    """2 live replicas, mixed traffic: every answer token-identical to a
+    single-replica sequential run of the same specs (sampling streams
+    are pure functions of the request — placement cannot change them)."""
+    router = ReplicaRouter(
+        [LocalReplica("r0", _engine(tiny)),
+         LocalReplica("r1", _engine(tiny))],
+        config=_cfg(suspect_after_s=60, dead_after_s=120))
+    reqs = _traffic(8)
+    uids = [router.submit(r) for r in reqs]
+    router.run(timeout_s=120)
+    st = router.stats()
+    assert st["lost"] == 0 and st["outcomes"][OK] == len(reqs)
+    assert st["routed_total"] == len(reqs)
+    # both replicas actually served traffic (placement spreads)
+    assert all(v["state"] == HEALTHY for v in st["replicas"].values())
+    refs = _oracle_outputs(tiny, reqs)
+    for i, uid in enumerate(uids):
+        assert list(router.results[uid]["tokens"]) == refs[i], \
+            f"uid {uid} diverged from the sequential oracle"
+    router.close()
+
+
+class CrashingLocalReplica(LocalReplica):
+    """Models the process boundary for an injected kill: an
+    ``InjectedCrash`` escaping the engine marks the 'process' dead —
+    in-memory results become unreachable (a real dead process returns
+    nothing), only the journal survives."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.dead = False
+
+    def pump(self):
+        from deepspeed_tpu.fault import InjectedCrash
+        if self.dead:
+            return
+        try:
+            super().pump()
+        except InjectedCrash:
+            self.dead = True
+
+    def poll(self):
+        return [] if self.dead else super().poll()
+
+    def alive(self):
+        return not self.dead
+
+    def close(self):
+        if not self.dead:
+            super().close()
+
+
+def test_crash_handoff_zero_loss_token_identical(tiny, tmp_path, devices,
+                                                 fault_harness):
+    """Kill replica r0 in the answered-but-not-durably-finished window
+    (``serving.journal_crash_finish``): its journal replays the uid as
+    PENDING, the router requeues onto r1, and every completed output is
+    token-identical to the sequential oracle — zero loss, zero
+    duplicates."""
+    fault_harness.configure("crash_at=serving.journal_crash_finish@2")
+    r0 = CrashingLocalReplica(
+        "r0", _engine(tiny, journal_dir=str(tmp_path / "j0")))
+    # r1 journal-less: the fault site's visit count is global to the
+    # process, so only r0 may visit it for `@2` to be deterministic
+    r1 = LocalReplica("r1", _engine(tiny))
+    router = ReplicaRouter([r0, r1],
+                           config=_cfg(suspect_after_s=60,
+                                       dead_after_s=120))
+    reqs = _traffic(8)
+    uids = [router.submit(r) for r in reqs]
+    router.run(timeout_s=120)
+    st = router.stats()
+    assert r0.dead, "the injected crash must have fired"
+    assert st["dead_events"] and \
+        st["dead_events"][0]["replica"] == "r0"
+    assert st["requeued_total"] >= 1, "handoff must requeue r0's work"
+    assert st["lost"] == 0
+    assert st["outcomes"][OK] == len(reqs)
+    assert st["duplicates_suppressed"] == 0
+    assert len(st["handoff_requeue_ms"]) == 1
+    refs = _oracle_outputs(tiny, reqs)
+    for i, uid in enumerate(uids):
+        assert list(router.results[uid]["tokens"]) == refs[i], \
+            f"uid {uid} diverged after handoff"
+    router.close()
+
+
+# -------------------------------------------------------- observe / CLI
+def test_observe_states_over_committed_fixtures():
+    from deepspeed_tpu.monitor.fleet import FleetFollower
+    follower = FleetFollower(FIXTURES)
+    view = follower.poll()
+    rows = observe_states(view, RouterConfig())
+    assert {r["replica"] for r in rows} == {"replica_a", "replica_b"}
+    # static fixtures age relative to the NEWEST stamp: both healthy
+    assert all(r["state"] == HEALTHY for r in rows)
+    frame = render_router(view, RouterConfig())
+    assert "placeable: 2/2" in frame
+    # an hour later with no events, both would be dead
+    rows = observe_states(view, RouterConfig(),
+                          now=max(r.last_t for r in view.replicas) + 3600)
+    assert all(r["state"] == DEAD for r in rows)
+
+
+def test_cli_smoke_ds_router_once_over_committed_streams():
+    """The tier-1 smoke the ISSUE names: the real CLI over the committed
+    fleet fixture streams."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_router")]
+        + FIXTURES + ["--once"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "ds_router — 2 replica(s)" in out.stdout
+    assert "placeable: 2/2" in out.stdout
+
+
+def test_cli_ds_router_json_contract():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_router")]
+        + FIXTURES + ["--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-500:]
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert {r["replica"] for r in doc["replicas"]} == \
+        {"replica_a", "replica_b"}
+    assert doc["policy"]["suspect_after_s"] == 2.0
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "ds_router"),
+         str(os.path.join(REPO, "no-such-dir")), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 1
+    assert "error" in json.loads(out.stdout)
+
+
+def test_ds_report_prints_router_policy(capsys):
+    from deepspeed_tpu.env_report import router_report
+    router_report()
+    out = capsys.readouterr().out
+    assert "Replica router" in out
+    assert "full jitter" in out
+    assert "drain, not kill" in out
+
+
+def test_bench_diff_classifies_router_family_lower_better():
+    from deepspeed_tpu.analysis.bench_diff import classify
+    assert classify("lost_requests") == "lower"
+    assert classify("duplicate_answers") == "lower"
+    assert classify("handoff_requeue_ms") == "lower"
+    assert classify("max_handoff_requeue_ms") == "lower"
