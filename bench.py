@@ -671,6 +671,70 @@ def measure_serving_tracing(preset="gpt2-125m", *, streams=8,
         shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def measure_serving_sanitize(preset="gpt2-125m", *, streams=8,
+                             batch_slots=8, prompt_len=64, new_tokens=64,
+                             block_size=32, cache_dir=None):
+    """Armed-sanitizer twin of :func:`measure_serving`
+    (docs/static-analysis.md#sanitizer): the SAME rung run twice —
+    ``ServingConfig(sanitize=False)`` vs ``sanitize=True`` — so the
+    reported overhead isolates the shadow-table bookkeeping term.  The
+    jaxpr-equality test + ``--audit-step serving-lifecycle`` prove the
+    compiled step is byte-identical; this rung prices the host-side
+    cost and asserts the armed run finishes clean (0 findings,
+    token-identical output)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import build
+    from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
+                                         ServingConfig, Request)
+
+    model = build(preset, dtype=jnp.bfloat16,
+                  max_seq=prompt_len + new_tokens,
+                  embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0)
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    # identical prompts for both passes — the twin's token-identity
+    # check is meaningless otherwise
+    prompts = [rng.integers(0, V, (prompt_len,)) for _ in range(streams)]
+    warm = rng.integers(0, V, (prompt_len,))
+
+    def one_pass(sanitize_on):
+        eng = InferenceEngine(model=model, compile_cache=cache_dir)
+        srv = ServingEngine(engine=eng, config=ServingConfig(
+            batch_slots=batch_slots, block_size=block_size,
+            max_new_tokens=new_tokens, sanitize=sanitize_on))
+        reqs = [Request(tokens=p, max_new_tokens=new_tokens, seed=i)
+                for i, p in enumerate(prompts)]
+        try:
+            srv.run([Request(tokens=warm, max_new_tokens=2,
+                             seed=10 ** 6)])
+            srv.reset_stats()
+            t0 = time.time()
+            srv.run(reqs)
+            dt = time.time() - t0
+            gen = sum(len(srv.results[r.uid]["tokens"]) for r in reqs)
+            toks = [list(srv.results[r.uid]["tokens"]) for r in reqs]
+            san = (srv.stats().get("sanitizer") or {})
+        finally:
+            srv.close()
+            eng.close()
+        return gen / dt, toks, san
+
+    tps_off, toks_off, _ = one_pass(False)
+    tps_on, toks_on, san = one_pass(True)
+    return {
+        "streams": streams,
+        "batch_slots": batch_slots,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "tokens_per_sec_off": round(tps_off, 1),
+        "tokens_per_sec_on": round(tps_on, 1),
+        "overhead_pct": round(100.0 * (tps_off - tps_on) / tps_off, 2),
+        "tokens_identical": toks_off == toks_on,
+        "sanitizer_checks": san.get("checks", 0),
+        "sanitizer_findings": san.get("findings", 0),
+    }
+
+
 def _fleet_replica_child(spec: dict):
     """``--fleet-replica`` child (one process = one serving replica of
     the fleet rung): a tiny GPT-2 serving run with an ARMED monitor —
@@ -1689,6 +1753,20 @@ def main():
     else:
         extra["serving_125m_b8_tracing"] = {"skipped": "time budget"}
 
+    # armed-sanitizer twin: the serving rung with the lifecycle shadow
+    # sanitizer on vs off — host-side overhead of the shadow table,
+    # token-identical output, 0 findings on a clean run
+    # (docs/static-analysis.md#sanitizer)
+    if left() > 5 * 60:
+        try:
+            extra["serving_125m_b8_sanitize"] = measure_serving_sanitize(
+                "gpt2-125m", streams=8, batch_slots=8, prompt_len=64,
+                new_tokens=64, cache_dir=cache_dir)
+        except Exception as e:
+            extra["serving_125m_b8_sanitize"] = {"error": str(e)[:160]}
+    else:
+        extra["serving_125m_b8_sanitize"] = {"skipped": "time budget"}
+
     # fleet rung (docs/monitoring.md#fleet-view): 3 real serving
     # replicas in separate processes, one deliberately throttled,
     # merged by the REAL ds_fleet CLI — ε-bound quantile merge, exact
@@ -1890,6 +1968,13 @@ def main():
         headline["extra"]["tracing"] = {
             "overhead_pct": tracing["overhead_pct"],
             "traces": tracing["traces_emitted"]}
+    sanitize = extra.get("serving_125m_b8_sanitize") or {}
+    if "overhead_pct" in sanitize:
+        headline["extra"]["sanitize"] = {
+            "overhead_pct": sanitize["overhead_pct"],
+            "checks": sanitize["sanitizer_checks"],
+            "findings": sanitize["sanitizer_findings"],
+            "tokens_identical": sanitize["tokens_identical"]}
     fleet = extra.get("serving_fleet_3rep") or {}
     if "straggler_correct" in fleet:
         headline["extra"]["fleet"] = {

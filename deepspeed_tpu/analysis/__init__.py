@@ -10,7 +10,17 @@ Two passes (docs/static-analysis.md):
     collective census within a declared comms budget, and no
     weak-typed-scalar recompile hazards.
   - :mod:`lint` — an AST rule engine (bare except, swallowed OSError,
-    tracing-safety rules) with per-site suppression comments.
+    tracing-safety rules, and the DSTPU3xx lifecycle/typestate family
+    over the serving control plane) with per-site suppression comments
+    (stale suppressions are themselves findings, DSTPU003).
+  - the **lifecycle verifier** (docs/static-analysis.md#lifecycle) —
+    three layers over one set of FSM specs
+    (``lint/lifecycle.py``): static typestate rules (DSTPU30x), the
+    runtime :class:`~.sanitize.ShadowSanitizer` (DSTPU31x, armed via
+    ``--sanitize``/``DSTPU_SANITIZE``/``analysis.sanitize``), and the
+    :mod:`~.interleave` handoff permutation explorer (DSTPU320).
+    ``interleave`` is imported as a submodule on purpose — it drives
+    the router, which would make a top-level import circular.
 
 CLI: ``python -m deepspeed_tpu.analysis [paths] [--rules ...] [--json]``.
 """
@@ -20,10 +30,13 @@ from .findings import Finding, counts_by_severity, worst_severity
 from .jaxpr_audit import AuditReport, audit_engine, audit_fn, iter_eqns
 from .lint import REGISTRY, lint_file, lint_paths, select_rules
 from .lint import rules as _rules  # noqa: F401  (populate REGISTRY)
+from .lint import lifecycle as _lifecycle  # noqa: F401  (DSTPU3xx family)
+from .sanitize import (SANITIZER_CODES, SanitizerError, ShadowSanitizer)
 
 __all__ = [
     "AuditReport", "CommsBudget", "COLLECTIVE_KINDS", "Finding",
-    "REGISTRY", "audit_engine", "audit_fn", "check_budget",
+    "REGISTRY", "SANITIZER_CODES", "SanitizerError", "ShadowSanitizer",
+    "audit_engine", "audit_fn", "check_budget",
     "counts_by_severity", "iter_eqns", "lint_file", "lint_paths",
     "select_rules", "summarize", "worst_severity",
 ]

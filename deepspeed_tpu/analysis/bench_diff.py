@@ -61,6 +61,16 @@ LOWER_BETTER_SLO = ("burn_rate", "slo_breaches")
 # robustness regression
 LOWER_BETTER_ROUTER = ("lost_requests", "duplicate_answers",
                        "handoff_requeue_ms")
+# sanitizer family (docs/static-analysis.md#sanitizer): a clean rung
+# must report zero lifecycle findings — any growth is a serving bug,
+# not noise
+LOWER_BETTER_SANITIZE = ("sanitizer_findings",)
+# exact count contracts where ZERO is the baseline by design: any
+# growth regresses even though a relative band cannot gate it (the
+# zero-baseline report-never-regress policy below is for
+# rounded-to-0.0 gauges, not for these)
+ZERO_CONTRACT = ("sanitizer_findings", "lost_requests",
+                 "duplicate_answers", "slo_breaches")
 
 
 def classify(key: str):
@@ -70,7 +80,8 @@ def classify(key: str):
         if name in k:
             return "higher"
     for name in (LOWER_BETTER + LOWER_BETTER_BYTES + LOWER_BETTER_MEM
-                 + LOWER_BETTER_SLO + LOWER_BETTER_ROUTER):
+                 + LOWER_BETTER_SLO + LOWER_BETTER_ROUTER
+                 + LOWER_BETTER_SANITIZE):
         if name in k:
             return "lower"
     if k.endswith(LOWER_BETTER_SUFFIX):
@@ -104,11 +115,14 @@ def compare(base: dict, new: dict, band: float = DEFAULT_BAND,
         va, vb = a[path], b[path]
         if va == vb:
             continue
-        if not va:
+        if not va and not any(name in key.lower()
+                              for name in ZERO_CONTRACT):
             # zero baseline: no relative band can gate this (delta is
             # infinite for ANY change) — report, never regress.  A
             # rounded-to-0.0 gap_host_pct moving to 0.3 is noise, not
             # a perf cliff; absolute gating needs a real baseline.
+            # Exact zero-contract counts (ZERO_CONTRACT) stay gated:
+            # there, zero IS the contract and any growth is a bug.
             direction = None
         delta = (vb - va) / abs(va) if va else float("inf")
         this_band = bands.get(key, bands.get(path, band))

@@ -1,0 +1,339 @@
+"""Handoff interleaving explorer (DSTPU320) — the third lifecycle layer.
+
+The static DSTPU3xx rules prove the router's code obeys the lifecycle
+specs at every SITE; the shadow sanitizer proves one EXECUTION obeyed
+them.  Neither proves the protocol is safe under every ORDERING of the
+control-plane events that can race in production: heartbeat aging, a
+straggler-drain verdict, a crash, a late answer from the corpse, a
+journaled finish the router never observed.  This module closes that
+gap the model-checking way: enumerate **every permutation** of a
+bounded event set, drive the real :class:`ReplicaRouter` (no mocks of
+the code under test — only the replicas are scripted) through each
+ordering with a deterministic step clock, settle, and assert the
+zero-loss/exactly-once contracts that ``docs/serving.md`` promises:
+
+- **zero lost uids** — every submitted uid reaches a terminal outcome;
+- **exactly-once finalize** — the set-once result table is respected at
+  the MECHANISM level (an audited ``_finalize`` counts calls per uid;
+  the table alone cannot distinguish "finalized once" from "finalized
+  twice with the same value");
+- **token determinism** — whichever replica serves a uid, by recompute,
+  late answer, or journal adoption, the tokens are identical (the
+  sampling-stream contract);
+- **pop-once** — each result pops exactly once, a second pop raises;
+- **drained bookkeeping** — no replica keeps phantom ``assigned`` uids
+  and the router queue is empty once everything resolved.
+
+Events are CONDITIONAL where the real controller's are: the scripted
+drain verdict only fires on a HEALTHY replica, because
+``_check_fleet_verdicts`` never drains a suspect or dead one — the
+explorer must enumerate reachable interleavings, not inject
+FSM-illegal transitions and blame the router.
+
+Scale: the default :func:`crash_handoff_scenario` has 6 events → 720
+orderings, a deliberate tier-1 size (a few seconds of scripted pumps,
+no model, no device).  ``extended=True`` adds a replica freeze →
+5040 orderings for the ``slow``-marked sweep.  Entry points:
+:func:`explore` (library), ``python -m deepspeed_tpu.analysis
+--audit-step serving-lifecycle`` (CLI, with the sanitizer jaxpr-parity
+proof).
+"""
+
+import itertools
+import math
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+from ..inference import journal as jr
+from ..inference.router import (ReplicaRouter, ReplicaHandle, RouterConfig,
+                                HEALTHY, DRAINING)
+from ..inference.serving import Request, OK
+from ..utils.retry import RetryPolicy
+from .findings import Finding
+
+INTERLEAVE_VIOLATION = "DSTPU320"
+
+
+class StepClock:
+    """Deterministic manual clock — time moves only when an event or
+    the settle loop advances it, so every permutation replays
+    exactly."""
+
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+class ScriptedReplica(ReplicaHandle):
+    """A replica the explorer fully controls: heartbeat follows the
+    step clock unless frozen, death is a flag, answers are injected —
+    and an optional REAL on-disk journal lets a permutation exercise
+    ``journal.replay`` adoption, not a stub of it."""
+
+    def __init__(self, name, clock, journal_root=None):
+        self.name = name
+        self._clock = clock
+        self.hb = clock()
+        self.inbox = []
+        self.frozen = False
+        self.exited = False
+        self._answers = []
+        self._jdir = None
+        self._journal = None
+        if journal_root is not None:
+            self._jdir = os.path.join(journal_root, name)
+            os.makedirs(self._jdir, exist_ok=True)
+
+    # ------------------------------------------------ handle interface
+    def submit(self, req):
+        self.inbox.append(req)
+
+    def pump(self):
+        if not self.frozen and not self.exited:
+            self.hb = self._clock()
+
+    def poll(self):
+        out, self._answers = self._answers, []
+        return out
+
+    def heartbeat(self):
+        return self.hb
+
+    def alive(self):
+        return not self.exited
+
+    @property
+    def journal_dir(self):
+        return self._jdir
+
+    # ------------------------------------------------ script controls
+    def answer(self, uid, tokens, outcome=OK):
+        """Inject a finished result (legal even frozen/dead — a hung
+        replica answering LATE is exactly the dedup case)."""
+        self._answers.append({"uid": int(uid), "outcome": outcome,
+                              "tokens": list(tokens)})
+
+    def serve(self, token_fn):
+        """Answer everything in the inbox (a healthy replica doing its
+        job); no-op while frozen or dead."""
+        if self.frozen or self.exited:
+            return
+        for req in self.inbox:
+            self.answer(int(req.uid), token_fn(int(req.uid)))
+        self.inbox = []
+
+    def journal_finish(self, uid, tokens, outcome=OK):
+        """Durably journal a finish the router has NOT observed — the
+        crash-handoff adoption case (answered, journaled, died before
+        the router's next poll)."""
+        assert self._jdir is not None, f"replica {self.name} has no journal"
+        if self._journal is None:
+            self._journal = jr.RequestJournal(self._jdir, clock=self._clock)
+        self._journal.finish(int(uid), outcome, list(tokens))
+
+
+class _AuditedRouter(ReplicaRouter):
+    """The real router plus a finalize call-counter per uid — the
+    exactly-once oracle the end-state table cannot provide."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.finalize_counts = {}
+
+    def _finalize(self, rec, outcome, tokens, why):
+        uid = int(rec["uid"])
+        self.finalize_counts[uid] = self.finalize_counts.get(uid, 0) + 1
+        super()._finalize(rec, outcome, tokens, why)
+
+
+# ------------------------------------------------------------- scenario
+def _token_fn(uid):
+    # pure function of the request — the determinism contract in
+    # miniature (docs/serving.md: fold_in(PRNGKey(seed), index))
+    return [int(uid) * 10 + 1, int(uid) * 10 + 2]
+
+
+def crash_handoff_scenario(extended=False):
+    """The default bounded event set: replica ``a`` takes traffic,
+    ages, may be drained by a verdict, crashes with work in flight,
+    journals a finish the router never saw, and answers late from the
+    grave; replica ``b`` survives and absorbs the handoff.  6 events
+    (720 orderings); ``extended`` adds a freeze (hang) → 7 events
+    (5040)."""
+
+    def build(workdir):
+        clock = StepClock(1000.0)
+        a = ScriptedReplica("a", clock, journal_root=workdir)
+        b = ScriptedReplica("b", clock)
+        cfg = RouterConfig(
+            suspect_after_s=1.0, dead_after_s=4.0,
+            probe_retry=RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                                    max_delay_s=0.2, jitter_mode="full",
+                                    seed=7, sleep=lambda s: None),
+            monitor_interval=1)
+        router = _AuditedRouter([a, b], cfg, clock=clock)
+        uids = [router.submit(Request(tokens=np.arange(4) % 64,
+                                      max_new_tokens=2, seed=i))
+                for i in range(3)]
+        router.pump()                       # deterministic placement
+        a_uids = sorted(router._replicas["a"].assigned)
+        assert a_uids, "scenario assumes replica a took traffic"
+        return {"router": router, "clock": clock, "a": a, "b": b,
+                "uids": uids, "a_uids": a_uids, "token_fn": _token_fn}
+
+    def ev_pump(w):
+        w["router"].pump()
+
+    def ev_age(w):
+        # heartbeats go stale (no replica pump until the next router
+        # pump) — the suspect/probe path
+        w["clock"].advance(1.5)
+
+    def ev_drain_a(w):
+        # the straggler/SLO verdict — fires only on HEALTHY, exactly
+        # like _check_fleet_verdicts (conditional event, see module
+        # docstring)
+        st = w["router"]._replicas["a"]
+        if st.state == HEALTHY:
+            w["router"]._set_state(st, DRAINING, w["clock"](),
+                                   "scripted straggler verdict")
+
+    def ev_crash_a(w):
+        w["a"].exited = True
+
+    def ev_journal_finish_a(w):
+        uid = w["a_uids"][0]
+        w["a"].journal_finish(uid, w["token_fn"](uid))
+
+    def ev_late_answer_a(w):
+        uid = w["a_uids"][-1]
+        w["a"].answer(uid, w["token_fn"](uid))
+
+    def ev_freeze_a(w):
+        w["a"].frozen = True
+
+    events = [("pump", ev_pump),
+              ("age-heartbeats", ev_age),
+              ("drain-a", ev_drain_a),
+              ("crash-a", ev_crash_a),
+              ("journal-finish-a", ev_journal_finish_a),
+              ("late-answer-a", ev_late_answer_a)]
+    if extended:
+        events.append(("freeze-a", ev_freeze_a))
+    return {"name": "crash-handoff" + ("-extended" if extended else ""),
+            "build": build, "events": events}
+
+
+# -------------------------------------------------------------- explore
+def _settle(w, max_iters=64):
+    """Post-scenario service: the surviving replicas answer their
+    inboxes and the router pumps until nothing is outstanding (bounded
+    — a protocol that CANNOT settle is itself a violation, reported by
+    the lost-uid check)."""
+    r = w["router"]
+    for _ in range(max_iters):
+        if not r._outstanding():
+            return
+        w["clock"].advance(1.0)
+        for rep in (w["a"], w["b"]):
+            rep.serve(w["token_fn"])
+        r.pump()
+
+
+def _check(w):
+    """The contract checks; returns human-readable violation strings."""
+    viol = []
+    r = w["router"]
+    for uid in w["uids"]:
+        rec = r.results.get(uid)
+        if rec is None:
+            viol.append(f"uid {uid} vanished from the result table")
+        elif rec["outcome"] is None:
+            viol.append(f"uid {uid} lost — no terminal outcome after "
+                        f"settle")
+    for uid in w["uids"]:
+        n = r.finalize_counts.get(uid, 0)
+        if n != 1:
+            viol.append(f"uid {uid} finalized {n} time(s) — set-once "
+                        f"requires exactly 1")
+    for uid in w["uids"]:
+        rec = r.results.get(uid)
+        if rec is None or rec["outcome"] is None:
+            continue
+        if rec["outcome"] != OK:
+            viol.append(f"uid {uid} ended {rec['outcome']!r}, expected "
+                        f"{OK!r} (no shed/deadline policy is armed)")
+        elif list(rec["tokens"] or []) != w["token_fn"](uid):
+            viol.append(f"uid {uid} tokens {rec['tokens']} != "
+                        f"deterministic {w['token_fn'](uid)} — the "
+                        f"re-run/late-answer/adoption paths disagreed")
+    popped = 0
+    for uid in w["uids"]:
+        try:
+            r.pop_result(uid)
+            popped += 1
+        except Exception as e:            # lost uids already reported
+            viol.append(f"pop_result({uid}) failed: {type(e).__name__}")
+    if popped:
+        try:
+            r.pop_result(w["uids"][0])
+            viol.append(f"uid {w['uids'][0]} popped TWICE — the "
+                        f"exactly-once serve contract is broken")
+        except KeyError:
+            pass
+    for name, st in r._replicas.items():
+        if st.assigned:
+            viol.append(f"replica {name!r} still holds phantom assigned "
+                        f"uids {sorted(st.assigned)}")
+    if r.queue:
+        viol.append(f"{len(r.queue)} request(s) stranded in the router "
+                    f"queue")
+    return viol
+
+
+def explore(scenario=None, max_permutations=None, workdir=None):
+    """Run ``scenario`` under every permutation of its event set.
+
+    Returns a report dict; ``report["findings"]`` holds one
+    :class:`Finding` (rule ``DSTPU320``, severity error) per violating
+    ordering, carrying the exact event order in ``extra`` so a failure
+    is a reproducer, not a shrug."""
+    scenario = scenario or crash_handoff_scenario()
+    labels = [lbl for lbl, _ in scenario["events"]]
+    own_tmp = workdir is None
+    if own_tmp:
+        workdir = tempfile.mkdtemp(prefix="dstpu-interleave-")
+    explored, findings = 0, []
+    try:
+        for perm in itertools.permutations(scenario["events"]):
+            if max_permutations is not None and \
+                    explored >= max_permutations:
+                break
+            explored += 1
+            order = [lbl for lbl, _ in perm]
+            w = scenario["build"](
+                os.path.join(workdir, f"perm-{explored:05d}"))
+            for _, fn in perm:
+                fn(w)
+            _settle(w)
+            for v in _check(w):
+                findings.append(Finding(
+                    INTERLEAVE_VIOLATION, "error",
+                    f"[{' -> '.join(order)}] {v}",
+                    eqn_path=f"interleave/{scenario['name']}",
+                    extra={"order": order, "scenario": scenario["name"]}))
+    finally:
+        if own_tmp:
+            shutil.rmtree(workdir, ignore_errors=True)
+    return {"scenario": scenario["name"], "events": labels,
+            "total_permutations": math.factorial(len(labels)),
+            "explored": explored, "violations": len(findings),
+            "findings": findings, "ok": not findings}

@@ -61,11 +61,18 @@ class Suppressions:
 
     Only REAL comment tokens count (via ``tokenize``) — suppression text
     quoted inside a string or docstring (e.g. a module documenting the
-    syntax) must not silently disable rules."""
+    syntax) must not silently disable rules.
+
+    Consumption is tracked: :meth:`active` records which suppression it
+    matched, so :func:`lint_file` can flag the STALE ones (DSTPU003 — a
+    suppression whose rule no longer fires is debt that hides the next
+    real finding)."""
 
     def __init__(self, src: str):
         self.by_line = {}      # lineno -> set of rule ids
         self.file_level = set()
+        self.consumed = set()       # (comment lineno, rule id) pairs used
+        self.file_consumed = set()  # file-level rule ids used
         try:
             tokens = list(tokenize.generate_tokens(
                 io.StringIO(src).readline))
@@ -85,16 +92,39 @@ class Suppressions:
 
     def active(self, rule_id: str, lineno) -> bool:
         if rule_id in self.file_level:
+            self.file_consumed.add(rule_id)
             return True
         if lineno is None:
             return False
         # the flagged line itself, or a standalone comment just above it
-        return (rule_id in self.by_line.get(lineno, ()) or
-                rule_id in self.by_line.get(lineno - 1, ()))
+        for at in (lineno, lineno - 1):
+            if rule_id in self.by_line.get(at, ()):
+                self.consumed.add((at, rule_id))
+                return True
+        return False
 
 
 def _ids(text):
     return {t.strip() for t in text.split(",") if t.strip()}
+
+
+@register
+class UnusedSuppression(Rule):
+    """Engine-level rule: the findings are emitted by :func:`lint_file`
+    (stale-suppression detection needs the whole run's consumption
+    state, not one AST); ``check`` is intentionally empty.  Registered
+    as a normal rule so ``--list-rules``/``--rules`` see it and a site
+    can opt out per file."""
+    id = "DSTPU003"
+    name = "unused-suppression"
+    severity = "warning"
+    description = ("A `# dstpu: disable=` suppression whose rule did not "
+                   "fire at that site — stale debt that would hide the "
+                   "next real finding there.  Delete the comment (or fix "
+                   "the drift that moved the finding).")
+
+    def check(self, tree, src, relpath):
+        return ()
 
 
 def iter_py_files(paths):
@@ -113,12 +143,23 @@ def iter_py_files(paths):
 
 def select_rules(rule_ids=None):
     from . import rules as _rules  # noqa: F401  (populates REGISTRY)
+    from . import lifecycle as _lifecycle  # noqa: F401  (DSTPU3xx family)
     if rule_ids is None:
         return list(REGISTRY.values())
-    unknown = set(rule_ids) - set(REGISTRY)
+    expanded = []
+    for rid in rule_ids:
+        if rid.endswith("xx"):     # family selector, e.g. DSTPU3xx
+            family = sorted(r for r in REGISTRY
+                            if r.startswith(rid[:-2]))
+            assert family, f"no rules in family {rid!r}; " \
+                           f"known: {sorted(REGISTRY)}"
+            expanded.extend(family)
+        else:
+            expanded.append(rid)
+    unknown = set(expanded) - set(REGISTRY)
     assert not unknown, f"unknown rule ids: {sorted(unknown)}; " \
                         f"known: {sorted(REGISTRY)}"
-    return [REGISTRY[r] for r in rule_ids]
+    return [REGISTRY[r] for r in expanded]
 
 
 def lint_file(path, rules=None, root=None, src=None):
@@ -139,7 +180,36 @@ def lint_file(path, rules=None, root=None, src=None):
         for f in rule.check(tree, src, relpath):
             if not sup.active(f.rule, f.line):
                 out.append(f)
+    out.extend(_stale_suppressions(sup, rules, relpath))
     return out
+
+
+def _stale_suppressions(sup, rules, relpath):
+    """DSTPU003 findings for suppressions no selected rule consumed.
+    Only suppressions of rules that actually RAN can be judged stale —
+    a `--rules DSTPU002` pass must not condemn a DSTPU104 comment."""
+    ran = {r.id for r in rules}
+    if UnusedSuppression.id not in ran:
+        return
+    stale_rule = REGISTRY[UnusedSuppression.id]
+    for lineno, ids in sorted(sup.by_line.items()):
+        for rid in sorted((ids & ran) - {UnusedSuppression.id}):
+            if (lineno, rid) in sup.consumed:
+                continue
+            f = stale_rule.finding(
+                relpath, lineno,
+                f"unused suppression of {rid} — the rule did not fire "
+                f"here; delete the stale comment")
+            if not sup.active(f.rule, f.line):
+                yield f
+    for rid in sorted((sup.file_level & ran)
+                      - {UnusedSuppression.id} - sup.file_consumed):
+        f = stale_rule.finding(
+            relpath, 1,
+            f"unused file-level suppression of {rid} — the rule did "
+            f"not fire anywhere in this file")
+        if not sup.active(f.rule, f.line):
+            yield f
 
 
 def lint_paths(paths, rules=None, root=None):

@@ -234,12 +234,38 @@ def router_report():
     print("observe with .......... ds_router <dir1> <dir2> ... [--once]")
 
 
+def sanitize_report():
+    """Resolved lifecycle shadow-sanitizer policy
+    (docs/static-analysis.md#sanitizer): the DSTPU_SANITIZE env
+    override + config default, exactly as a serving engine built in
+    this environment would arm."""
+    from .analysis import sanitize
+
+    print("-" * 64)
+    print("Lifecycle sanitizer (DSTPU_SANITIZE / config "
+          "`analysis.sanitize`):")
+    print("-" * 64)
+    pol = _safe(lambda: sanitize.describe())
+    if not isinstance(pol, dict):
+        print(f"policy ................ {pol}")
+        return
+    print(f"enabled ............... {pol['enabled']} ({pol['source']})")
+    print(f"halt on finding ....... {pol['halt']}")
+    codes = ", ".join(f"{k}={v}" for k, v in pol["codes"].items())
+    print(f"checks ................ {codes}")
+    print("static twin ........... python -m deepspeed_tpu.analysis "
+          "--rules DSTPU3xx")
+    print("full audit ............ python -m deepspeed_tpu.analysis "
+          "--audit-step serving-lifecycle")
+
+
 def main():
     op_report()
     compile_cache_report()
     comms_compression_report()
     monitor_report()
     router_report()
+    sanitize_report()
     debug_report()
 
 

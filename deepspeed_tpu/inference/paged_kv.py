@@ -77,6 +77,12 @@ class BlockAllocator:
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
+    def is_allocated(self, b: int) -> bool:
+        """True while ``b`` is checked out (kv-block FSM: allocated or
+        quarantined) — the exception-path cleanup probe, so recovery
+        code never guesses at the free list's contents."""
+        return b in self._in_use
+
     def alloc(self, n: int):
         """``n`` block ids, or None when the pool cannot serve them."""
         if n < 1 or n > len(self._free):
@@ -86,8 +92,25 @@ class BlockAllocator:
         return out
 
     def free(self, blocks):
+        """Return blocks to the free list.  Rejections are real
+        exceptions, not asserts: a double free or a free of the reserved
+        scratch block is silent pool corruption (two tenants writing one
+        block) and must fail under ``python -O`` too — the DSTPU3xx
+        lifecycle audit's kv-block FSM says only 'allocated' blocks may
+        return to 'free'."""
+        blocks = list(blocks)
+        seen = set()
         for b in blocks:
-            assert b in self._in_use, f"double free of block {b}"
+            if b == SCRATCH_BLOCK:
+                raise ValueError(
+                    f"free of reserved scratch block {SCRATCH_BLOCK} — "
+                    "it is never allocated and never freed")
+            if b not in self._in_use or b in seen:
+                raise ValueError(
+                    f"double free of block {b} (not in use; kv-block "
+                    "FSM allows free only from 'allocated')")
+            seen.add(b)
+        for b in blocks:
             self._in_use.discard(b)
             self._free.append(b)
 

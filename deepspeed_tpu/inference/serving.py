@@ -254,6 +254,13 @@ class ServingConfig:
     # sampled this token anyway"); per-request acceptance stats ride
     # the monitor bus.
     speculative: Any = None
+    # ---- shadow sanitizer (docs/static-analysis.md#sanitizer) ----
+    # None → resolve from env DSTPU_SANITIZE / `deepspeed --sanitize`
+    # (OFF by default); True/False pin it.  Pure host-side shadow
+    # bookkeeping — the compiled decode step is byte-identical armed
+    # vs off (--audit-step serving-lifecycle proves it).
+    sanitize: Optional[bool] = None
+    sanitize_halt: bool = True      # raise at the first finding
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServingConfig":
@@ -382,6 +389,23 @@ class ServingEngine:
                 mc.head_dim, cache_dtype, kv_bits=config.kv_bits,
                 quant_block=config.kv_quant_block)
         self.allocator = pk.BlockAllocator(self.num_blocks)
+        # shadow lifecycle sanitizer (docs/static-analysis.md#sanitizer):
+        # OFF by default; config pin wins, else env DSTPU_SANITIZE /
+        # `deepspeed --sanitize`.  Pure host-side shadow bookkeeping —
+        # one `is not None` test per hook when disarmed, and the
+        # compiled decode step is byte-identical armed vs off
+        # (--audit-step serving-lifecycle).
+        from ..analysis import sanitize as _sanitize
+        self._sanitizer = None
+        armed = (_sanitize.resolve_enabled(False)
+                 if config.sanitize is None else bool(config.sanitize))
+        if armed:
+            self._sanitizer = _sanitize.ShadowSanitizer(
+                self.num_blocks, scratch_block=pk.SCRATCH_BLOCK,
+                halt=config.sanitize_halt)
+            logger.warning("serving: shadow sanitizer ARMED "
+                           "(DSTPU31x lifecycle checks, halt="
+                           f"{config.sanitize_halt})")
 
         S = config.batch_slots
         self._slots: List[Optional[_Slot]] = [None] * S
@@ -991,10 +1015,30 @@ class ServingEngine:
             blocks = self.allocator.alloc(nb)
             if blocks is None:
                 return
+            if self._sanitizer is not None:
+                self._sanitizer.on_alloc(blocks, uid=req.uid)
             self.queue.popleft()
             if self.journal is not None:
                 self.journal.admit(req.uid)
-            self._start(free[0], req, blocks, new)
+            slot = free[0]
+            try:
+                self._start(slot, req, blocks, new)
+            except Exception:
+                # a prefill that dies mid-dispatch (device OOM, a
+                # poisoned executable) must not leak the blocks: free
+                # them unless _start already seated the slot (the slot
+                # owns them then) or already returned them itself (the
+                # quarantine-at-prefill path).  InjectedCrash is a
+                # BaseException on purpose — a simulated kill skips
+                # this cleanup exactly like a real one would.
+                s = self._slots[slot]
+                if ((s is None or s.blocks is not blocks)
+                        and all(self.allocator.is_allocated(b)
+                                for b in blocks)):
+                    self.allocator.free(blocks)
+                    if self._sanitizer is not None:
+                        self._sanitizer.on_free(blocks, uid=req.uid)
+                raise
 
     def _step_estimate_s(self) -> Optional[float]:
         """PER-TOKEN wall estimate for predictive deadline shedding:
@@ -1049,8 +1093,12 @@ class ServingEngine:
             # sentinel token is never surfaced, and the blocks go back
             # scrubbed (prompt K/V of a poisoned forward may be
             # non-finite too)
+            if self._sanitizer is not None:
+                self._sanitizer.on_scrub(blocks, uid=req.uid)
             self._set_blocks(blocks, poison=False)
             self.allocator.free(blocks)
+            if self._sanitizer is not None:
+                self._sanitizer.on_free(blocks, uid=req.uid)
             logger.warning(
                 f"serving: request {req.uid} QUARANTINED at prefill — "
                 f"non-finite logits; typed '{POISONED}' result "
@@ -1066,6 +1114,8 @@ class ServingEngine:
         self._slots[slot] = s
         self._tables[slot] = 0
         self._tables[slot, :len(blocks)] = blocks
+        if self._sanitizer is not None:
+            self._sanitizer.on_attach(req.uid, blocks)
         self._lengths[slot] = T
         self._toks[slot] = first
         self._seeds[slot] = req.seed
@@ -1083,6 +1133,8 @@ class ServingEngine:
             # Only a slot that will actually decode is poisoned: a
             # request finishing at prefill frees its blocks above, and
             # they must go back clean.
+            if self._sanitizer is not None:
+                self._sanitizer.on_quarantine(blocks, uid=req.uid)
             self._set_blocks(blocks, poison=True)
 
     def _set_blocks(self, blocks: List[int], poison: bool):
@@ -1129,8 +1181,17 @@ class ServingEngine:
         if outcome == POISONED:
             # quarantine eviction: scrub the non-finite rows out of the
             # blocks BEFORE they return to the free list
+            if self._sanitizer is not None:
+                # scrub-while-referenced is checked against OTHER live
+                # sequences — the shadow's refcount gate (the check the
+                # radix prefix cache will inherit)
+                self._sanitizer.on_scrub(s.blocks, uid=s.req.uid)
             self._set_blocks(s.blocks, poison=False)
+        if self._sanitizer is not None:
+            self._sanitizer.on_detach(s.req.uid)
         self.allocator.free(s.blocks)
+        if self._sanitizer is not None:
+            self._sanitizer.on_free(s.blocks, uid=s.req.uid)
         rec = self.results[s.req.uid]
         rec["tokens"] = list(s.out_tokens)
         rec["outcome"] = outcome
@@ -1733,6 +1794,8 @@ class ServingEngine:
         rec = self.results[uid]
         if rec["t_done"] is None:
             raise RuntimeError(f"request {uid} is still in flight")
+        if self._sanitizer is not None:
+            self._sanitizer.on_serve(uid)
         return self.results.pop(uid)
 
     def reset_stats(self):
@@ -1793,6 +1856,8 @@ class ServingEngine:
             out["ttft_ms"] = {
                 "p50": round(p["p50"], 2), "p99": round(p["p99"], 2),
                 "p999": round(p["p999"], 2)}
+        if self._sanitizer is not None:
+            out["sanitizer"] = self._sanitizer.stats()
         return out
 
     def compile_report(self):
@@ -1811,6 +1876,10 @@ class ServingEngine:
             # a drain failure (wedged backend, armed crash site) must not
             # leak the pool/executables/journal fd: teardown runs anyway
             self.drain()
+            if self._sanitizer is not None:
+                # after a clean drain every block must be home —
+                # anything still allocated is a leak (DSTPU312)
+                self._sanitizer.on_close()
         finally:
             try:
                 if self.journal is not None:

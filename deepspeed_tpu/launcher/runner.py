@@ -121,6 +121,21 @@ def parse_args(args=None):
                              "(sets DSTPU_COMMS_COMPRESSION=0) — e.g. to "
                              "bisect a numerics question against the "
                              "lossless wire")
+    parser.add_argument("--sanitize", default=None, action="store_true",
+                        dest="sanitize",
+                        help="Arm the lifecycle shadow sanitizer (sets "
+                             "DSTPU_SANITIZE=1, overriding a config that "
+                             "disables it): ASan-style DSTPU31x checks — "
+                             "double-free/use-after-free/leak on KV "
+                             "blocks, uid double-serve — on every serving "
+                             "engine; host-side only, the compiled decode "
+                             "step is byte-identical; see "
+                             "docs/static-analysis.md#sanitizer")
+    parser.add_argument("--no-sanitize", dest="sanitize",
+                        action="store_false",
+                        help="Force the shadow sanitizer OFF (sets "
+                             "DSTPU_SANITIZE=0) even when the config "
+                             "enables it")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -253,6 +268,8 @@ def main(args=None):
     if args.comms_compression is not None:
         env["DSTPU_COMMS_COMPRESSION"] = \
             "1" if args.comms_compression else "0"
+    if args.sanitize is not None:
+        env["DSTPU_SANITIZE"] = "1" if args.sanitize else "0"
     cmd_tail = [args.user_script] + list(args.user_args)
 
     if not active or (len(active) == 1 and not args.force_multi):

@@ -209,6 +209,39 @@ class DeepSpeedMonitorConfig:
                                 if self.trace_steps else None)}
 
 
+class DeepSpeedAnalysisConfig:
+    """Lifecycle shadow-sanitizer policy (``analysis/sanitize.py``;
+    docs/static-analysis.md#sanitizer): the ``analysis.sanitize`` block
+    arms ASan-style DSTPU31x lifecycle checking on serving engines
+    built from this config.  Env ``DSTPU_SANITIZE`` (set by ``deepspeed
+    --sanitize`` / ``--no-sanitize``) overrides ``enabled`` in either
+    direction — the monitor/comms-compression arming pattern."""
+
+    def __init__(self, param_dict):
+        from ..analysis.sanitize import resolve_enabled
+        a = get_dict_param(param_dict, C.ANALYSIS, {}) or {}
+        s = get_dict_param(a, C.ANALYSIS_SANITIZE, {}) or {}
+        self.sanitize_config_enabled = bool(get_scalar_param(
+            s, C.ANALYSIS_SANITIZE_ENABLED,
+            C.ANALYSIS_SANITIZE_ENABLED_DEFAULT))
+        self.sanitize_enabled = resolve_enabled(
+            self.sanitize_config_enabled)
+        self.sanitize_halt = bool(get_scalar_param(
+            s, C.ANALYSIS_SANITIZE_HALT, C.ANALYSIS_SANITIZE_HALT_DEFAULT))
+        unknown = set(s) - {C.ANALYSIS_SANITIZE_ENABLED,
+                            C.ANALYSIS_SANITIZE_HALT}
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"analysis.sanitize: unknown key(s) {sorted(unknown)}; "
+                f"valid: ['{C.ANALYSIS_SANITIZE_ENABLED}', "
+                f"'{C.ANALYSIS_SANITIZE_HALT}']")
+
+    def describe(self) -> dict:
+        from ..analysis.sanitize import describe
+        return describe(config_enabled=self.sanitize_config_enabled,
+                        halt=self.sanitize_halt)
+
+
 class DeepSpeedPipelineConfig:
     def __init__(self, param_dict):
         pipe_dict = get_dict_param(param_dict, C.PIPELINE, {})
@@ -830,6 +863,7 @@ class DeepSpeedConfig:
         self.flops_profiler = DeepSpeedFlopsProfilerConfig(pd)
         self.tensorboard = DeepSpeedTensorboardConfig(pd)
         self.monitor_config = DeepSpeedMonitorConfig(pd)
+        self.analysis_config = DeepSpeedAnalysisConfig(pd)
         self.pipeline = DeepSpeedPipelineConfig(pd)
         self.curriculum = DeepSpeedCurriculumConfig(pd)
         self.pld = DeepSpeedPLDConfig(pd)

@@ -164,6 +164,17 @@ MONITOR_SLO_DEFAULT = None             # None = SLO engine off; else the
 #                                        monitor.slo block (monitor/slo.py)
 #                                        events (0 disables the ledger)
 
+# lifecycle shadow sanitizer (analysis/sanitize.py;
+# docs/static-analysis.md#sanitizer).  Env DSTPU_SANITIZE (set by
+# `deepspeed --sanitize` / `--no-sanitize`) overrides `enabled` in
+# either direction, the monitor/comms-compression arming pattern.
+ANALYSIS = "analysis"
+ANALYSIS_SANITIZE = "sanitize"
+ANALYSIS_SANITIZE_ENABLED = "enabled"
+ANALYSIS_SANITIZE_ENABLED_DEFAULT = False   # OFF: zero cost by default
+ANALYSIS_SANITIZE_HALT = "halt"
+ANALYSIS_SANITIZE_HALT_DEFAULT = True  # raise at the first finding
+
 #############################################
 # Profiling
 #############################################

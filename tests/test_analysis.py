@@ -155,10 +155,11 @@ def test_suppression_line_and_file_level():
                 "try:\n    f()\nexcept OSError:\n    pass\n"
                 "try:\n    g()\nexcept OSError:\n    pass\n")
     assert _rules_fired(bad_file)[1] == []
-    # suppressing one rule does not hide another
+    # suppressing one rule does not hide another — and a suppression
+    # of a rule that never fires there is itself stale (DSTPU003)
     mixed = ("try:\n    f()\nexcept OSError:  # dstpu: disable=DSTPU001\n"
              "    pass\n")
-    assert _rules_fired(mixed)[1] == ["DSTPU002"]
+    assert _rules_fired(mixed)[1] == ["DSTPU002", "DSTPU003"]
 
 
 def test_rule_filter_and_unknown_rule():
@@ -417,16 +418,19 @@ def test_engine_audit_seeded_callback_is_caught(mesh8):
 # ===========================================================================
 
 def test_cli_json_clean_on_repo():
-    """`python -m deepspeed_tpu.analysis --json` must exit 0 on the repo
-    with machine-readable output — CI gates on this."""
+    """`python -m deepspeed_tpu.analysis --strict --json` must exit 0 on
+    the repo with machine-readable output — CI gates on this (strict:
+    warnings, including stale DSTPU003 suppressions, also fail)."""
     proc = subprocess.run(
-        [sys.executable, "-m", "deepspeed_tpu.analysis", "--json"],
+        [sys.executable, "-m", "deepspeed_tpu.analysis", "--strict",
+         "--json"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
     assert payload["ok"] is True
     assert payload["counts"]["error"] == 0
+    assert payload["counts"]["warning"] == 0
     assert payload["rules"] == sorted(r.id for r in select_rules())
 
 
@@ -602,4 +606,187 @@ def test_cli_audit_step_elastic_resume(devices):
     (docs/elasticity.md)."""
     from deepspeed_tpu.analysis.__main__ import _audit_elastic_resume
     findings = _audit_elastic_resume()
+    assert findings == [], [str(f) for f in findings]
+
+
+# ===========================================================================
+# DSTPU3xx: typestate lint over the serving lifecycles (the static layer
+# of the lifecycle verifier; runtime layers covered in test_lifecycle.py)
+# ===========================================================================
+
+def test_lifecycle_transition_rule_illegal_edge():
+    """DSTPU301: a _set_state call whose (guarded-from, to) pair is not
+    in the replica-health table — DEAD is terminal."""
+    bad = ("class R:\n"
+           "    def revive(self, st, now):\n"
+           "        if st.state == DEAD:\n"
+           "            self._set_state(st, HEALTHY, now, 'oops')\n")
+    findings = lint_file("inference/router.py",
+                         rules=select_rules(["DSTPU301"]), src=bad)
+    assert [f.rule for f in findings] == ["DSTPU301"]
+    assert "DEAD -> HEALTHY" in findings[0].message
+    # the same edge out of SUSPECT is legal — table-driven, not a ban
+    ok = bad.replace("DEAD:", "SUSPECT:")
+    assert lint_file("inference/router.py",
+                     rules=select_rules(["DSTPU301"]), src=ok) == []
+
+
+def test_lifecycle_transition_rule_out_of_api_store():
+    bad = ("class R:\n"
+           "    def kill(self, st):\n"
+           "        st.state = DEAD\n")
+    findings = lint_file("inference/router.py",
+                         rules=select_rules(["DSTPU301"]), src=bad)
+    assert [f.rule for f in findings] == ["DSTPU301"]
+    assert "_set_state" in findings[0].message
+    # the owning API itself may store; __init__ may seed the initial
+    ok = ("class R:\n"
+          "    def __init__(self):\n"
+          "        self.state = HEALTHY\n"
+          "    def _set_state(self, st, to, now):\n"
+          "        st.state = to\n")
+    assert lint_file("inference/router.py",
+                     rules=select_rules(["DSTPU301"]), src=ok) == []
+    # ...but __init__ seeding a non-initial state is a violation
+    seeded = ok.replace("self.state = HEALTHY", "self.state = DEAD")
+    findings = lint_file("inference/router.py",
+                         rules=select_rules(["DSTPU301"]), src=seeded)
+    assert [f.rule for f in findings] == ["DSTPU301"]
+    assert "must start" in findings[0].message
+
+
+def test_out_of_api_mutation_rule():
+    """DSTPU302: allocator internals poked from outside the owner."""
+    bad = ("def steal(engine):\n"
+           "    engine.allocator._free.append(0)\n"
+           "    engine.allocator._in_use.discard(3)\n")
+    findings = lint_file("inference/serving.py",
+                         rules=select_rules(["DSTPU302"]), src=bad)
+    assert [f.rule for f in findings] == ["DSTPU302", "DSTPU302"]
+    # the owning class mutates freely
+    ok = ("class BlockAllocator:\n"
+          "    def free(self, blocks):\n"
+          "        self._free.append(blocks[0])\n")
+    assert lint_file("inference/paged_kv.py",
+                     rules=select_rules(["DSTPU302"]), src=ok) == []
+    # out of scope (not an inference/ file): rule does not apply
+    assert lint_file("training/opt.py",
+                     rules=select_rules(["DSTPU302"]), src=bad) == []
+
+
+def test_unpaired_alloc_rule_exit_paths():
+    """DSTPU303: every return/raise exit (exception edges included)
+    must free the allocation or let it escape to an owner."""
+    bad = ("def admit(a):\n"
+           "    blocks = a.alloc(3)\n"
+           "    if blocks is None:\n"
+           "        return None\n"
+           "    return 1\n")                    # leaks on this return
+    findings = lint_file("inference/serving.py",
+                         rules=select_rules(["DSTPU303"]), src=bad)
+    assert [f.rule for f in findings] == ["DSTPU303"]
+    assert findings[0].line == 5
+
+    bad_edge = ("def admit(a):\n"
+                "    blocks = a.alloc(2)\n"
+                "    try:\n"
+                "        risky()\n"
+                "    except RuntimeError:\n"
+                "        raise\n"               # exception edge leaks
+                "    a.free(blocks)\n")
+    findings = lint_file("inference/serving.py",
+                         rules=select_rules(["DSTPU303"]), src=bad_edge)
+    assert [f.rule for f in findings] == ["DSTPU303"]
+    assert findings[0].line == 6
+
+    # clean twin: None-guard exempt, handler frees before re-raising
+    # behind a did-the-slot-take-them test, success path escapes
+    ok = ("def admit(a):\n"
+          "    blocks = a.alloc(2)\n"
+          "    if blocks is None:\n"
+          "        return None\n"
+          "    try:\n"
+          "        seat(blocks)\n"
+          "    except RuntimeError:\n"
+          "        if held() is not blocks:\n"
+          "            a.free(blocks)\n"
+          "        raise\n"
+          "    return blocks\n")
+    assert lint_file("inference/serving.py",
+                     rules=select_rules(["DSTPU303"]), src=ok) == []
+
+
+def test_set_once_result_rule():
+    """DSTPU304: terminal fields / record create / pop outside the
+    declared owners."""
+    bad = ("class R:\n"
+           "    def hack(self, uid):\n"
+           "        self.results[uid] = {}\n"
+           "        self.results[uid]['outcome'] = 'OK'\n"
+           "        self.results.pop(uid)\n")
+    findings = lint_file("inference/router.py",
+                         rules=select_rules(["DSTPU304"]), src=bad)
+    assert [f.rule for f in findings] == ["DSTPU304"] * 3
+    # the declared owners are allowed
+    ok = ("class R:\n"
+          "    def submit(self, uid):\n"
+          "        self.results[uid] = {}\n"
+          "    def _finalize(self, rec):\n"
+          "        rec['outcome'] = 'OK'\n"
+          "    def pop_result(self, uid):\n"
+          "        return self.results.pop(uid)\n")
+    assert lint_file("inference/router.py",
+                     rules=select_rules(["DSTPU304"]), src=ok) == []
+    # serving has different owners for the same discipline
+    findings = lint_file("inference/serving.py",
+                         rules=select_rules(["DSTPU304"]), src=ok)
+    assert {f.rule for f in findings} == {"DSTPU304"}
+
+
+def test_lifecycle_family_selector():
+    ids = sorted(r.id for r in select_rules(["DSTPU3xx"]))
+    assert ids == ["DSTPU301", "DSTPU302", "DSTPU303", "DSTPU304"]
+
+
+def test_lifecycle_specs_well_formed():
+    """The declarative tables the three layers share: every transition
+    target is a declared state, initial is declared, and the runtime
+    sanitizer mirrors the kv-block states verbatim."""
+    from deepspeed_tpu.analysis.lint import lifecycle as lc
+    from deepspeed_tpu.analysis import sanitize as sz
+    for fsm in lc.FSMS:
+        states = set(fsm["states"])
+        assert fsm["initial"] in states
+        assert set(fsm["transitions"]) == states
+        for frm, tos in fsm["transitions"].items():
+            assert set(tos) <= states, (fsm["name"], frm)
+    assert (sz.FREE, sz.ALLOCATED, sz.QUARANTINED) \
+        == lc.KV_BLOCK_FSM["states"]
+    assert lc.REPLICA_FSM["transitions"]["DEAD"] == ()   # terminal
+
+
+def test_stale_suppression_warns():
+    """DSTPU003: a disable comment whose rule does not fire there is
+    itself a (warning) finding; a consumed one is not."""
+    stale = "x = 1  # dstpu: disable=DSTPU001\n"
+    findings, fired = _rules_fired(stale)
+    assert fired == ["DSTPU003"]
+    assert findings[0].severity == "warning"
+    assert "DSTPU001" in findings[0].message
+    consumed = "try:\n    f()\nexcept:  # dstpu: disable=DSTPU001\n    pass\n"
+    assert _rules_fired(consumed)[1] == []
+    # a rule that did not RUN cannot be judged stale
+    _, fired = _rules_fired(stale, rules=["DSTPU002", "DSTPU003"])
+    assert fired == []
+    # stale file-level suppressions are judged too
+    stale_file = "# dstpu: disable-file=DSTPU001\nx = 1\n"
+    assert _rules_fired(stale_file)[1] == ["DSTPU003"]
+
+
+def test_cli_audit_step_serving_lifecycle(devices):
+    """`--audit-step serving-lifecycle`: all six sanitizer classes
+    demonstrably caught, armed-vs-off jaxpr + token equality on a real
+    serving twin, and the full 720-ordering interleave sweep — clean."""
+    from deepspeed_tpu.analysis.__main__ import _audit_serving_lifecycle
+    findings = _audit_serving_lifecycle()
     assert findings == [], [str(f) for f in findings]

@@ -1,0 +1,328 @@
+"""Lifecycle verifier: shadow sanitizer (DSTPU31x), the armed-vs-off
+equality discipline, the alloc/free exception-edge regressions, and the
+handoff interleaving explorer (DSTPU320).
+
+The static half of the same specs (DSTPU30x rules over
+``lint/lifecycle.py``'s FSM tables) is covered in test_analysis.py —
+one spec, three enforcement layers, three test surfaces.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
+                                     Request)
+from deepspeed_tpu.analysis import sanitize as sz
+from deepspeed_tpu.analysis import interleave as il
+from deepspeed_tpu.analysis.sanitize import (SanitizerError,
+                                             ShadowSanitizer)
+
+
+def _tiny_model():
+    cfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    return GPT2(cfg, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = _tiny_model()
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+# ===================================================================
+# shadow sanitizer: every violation class caught, clean runs quiet
+# ===================================================================
+
+def test_sanitizer_double_free():
+    san = ShadowSanitizer(8)
+    san.on_alloc([1, 2])
+    san.on_free([1, 2])
+    with pytest.raises(SanitizerError) as ei:
+        san.on_free([1])
+    assert ei.value.finding.rule == sz.DOUBLE_FREE
+    assert ei.value.finding.extra["block"] == 1
+
+
+def test_sanitizer_use_after_free_on_attach():
+    san = ShadowSanitizer(8)
+    # block 3 was never allocated: a table referencing it is a UAF
+    with pytest.raises(SanitizerError) as ei:
+        san.on_attach(7, [3])
+    assert ei.value.finding.rule == sz.USE_AFTER_FREE
+
+
+def test_sanitizer_use_after_free_on_overlapping_alloc():
+    san = ShadowSanitizer(8)
+    san.on_alloc([2])
+    with pytest.raises(SanitizerError) as ei:
+        san.on_alloc([2])                 # handed out twice
+    assert ei.value.finding.rule == sz.USE_AFTER_FREE
+
+
+def test_sanitizer_free_while_referenced():
+    san = ShadowSanitizer(8)
+    san.on_alloc([4])
+    san.on_attach(1, [4])
+    with pytest.raises(SanitizerError) as ei:
+        san.on_free([4], uid=2)           # a DIFFERENT uid frees it
+    assert ei.value.finding.rule == sz.USE_AFTER_FREE
+    assert ei.value.finding.extra["holder"] == 1
+
+
+def test_sanitizer_leak_at_close():
+    san = ShadowSanitizer(8)
+    san.on_alloc([1, 5])
+    with pytest.raises(SanitizerError) as ei:
+        san.on_close()
+    assert ei.value.finding.rule == sz.LEAK_AT_CLOSE
+    assert ei.value.finding.extra["blocks"] == [1, 5]
+
+
+def test_sanitizer_scratch_write():
+    san = ShadowSanitizer(8)
+    san.on_alloc([2])
+    with pytest.raises(SanitizerError) as ei:
+        san.on_attach(1, [0, 2])          # scratch block 0 in a table
+    assert ei.value.finding.rule == sz.SCRATCH_WRITE
+
+
+def test_sanitizer_uid_double_serve():
+    san = ShadowSanitizer(8)
+    san.on_serve(42)
+    with pytest.raises(SanitizerError) as ei:
+        san.on_serve(42)
+    assert ei.value.finding.rule == sz.DOUBLE_SERVE
+
+
+def test_sanitizer_scrub_while_referenced():
+    san = ShadowSanitizer(8)
+    san.on_alloc([3])
+    san.on_attach(1, [3])
+    with pytest.raises(SanitizerError) as ei:
+        san.on_scrub([3], uid=2)          # scrub under another reader
+    assert ei.value.finding.rule == sz.SCRUB_REFERENCED
+    # quarantine of a block another uid still reads: same class
+    san2 = ShadowSanitizer(8, halt=False)
+    san2.on_alloc([3])
+    san2.on_attach(1, [3])
+    san2.on_quarantine([3], uid=2)
+    assert [f.rule for f in san2.findings] == [sz.SCRUB_REFERENCED]
+
+
+def test_sanitizer_clean_lifecycle_and_stats():
+    """The full legal path — alloc, attach, detach, scrub (by the
+    owner), free, serve, close — produces zero findings."""
+    san = ShadowSanitizer(8)
+    san.on_alloc([1, 2], uid=5)
+    san.on_attach(5, [1, 2])
+    san.on_scrub([1, 2], uid=5)           # owner scrubs its own blocks
+    san.on_detach(5)
+    san.on_free([1, 2], uid=5)
+    san.on_serve(5)
+    san.on_close()
+    assert san.findings == []
+    st = san.stats()
+    assert st["findings"] == 0 and st["checks"] == 7
+    assert st["live_blocks"] == 0 and st["served_uids"] == 1
+
+
+def test_sanitizer_halt_false_collects():
+    san = ShadowSanitizer(8, halt=False)
+    san.on_alloc([1])
+    san.on_free([1])
+    san.on_free([1])                      # double free — collected
+    san.on_serve(9)
+    san.on_serve(9)                       # double serve — collected
+    assert [f.rule for f in san.findings] == [sz.DOUBLE_FREE,
+                                              sz.DOUBLE_SERVE]
+
+
+def test_sanitizer_env_resolution(monkeypatch):
+    monkeypatch.delenv("DSTPU_SANITIZE", raising=False)
+    assert sz.env_enabled() is None
+    assert sz.resolve_enabled(False) is False
+    assert sz.resolve_enabled(True) is True
+    monkeypatch.setenv("DSTPU_SANITIZE", "1")
+    assert sz.resolve_enabled(False) is True    # env arms over config
+    monkeypatch.setenv("DSTPU_SANITIZE", "off")
+    assert sz.resolve_enabled(True) is False    # env disarms over config
+    pol = sz.describe(config_enabled=True)
+    assert pol["enabled"] is False
+    assert pol["source"] == "env DSTPU_SANITIZE"
+    assert set(pol["codes"]) == set(sz.SANITIZER_CODES)
+
+
+# ===================================================================
+# armed serving engine: byte-identical program, identical tokens,
+# clean run quiet, exception edges leak-free
+# ===================================================================
+
+def _reqs(n=3, seed0=0):
+    rng = np.random.default_rng(7)
+    return [Request(tokens=rng.integers(0, 64, (6,)), max_new_tokens=3,
+                    seed=seed0 + i) for i in range(n)]
+
+
+def test_sanitize_armed_jaxpr_and_tokens_identical(tiny, devices):
+    """The request-tracing equality discipline applied to the
+    sanitizer: arming it must leave the TRACED decode step
+    byte-identical and the generated tokens unchanged — the shadow
+    table is host bookkeeping, never program content (--audit-step
+    serving-lifecycle gates the same invariant)."""
+    model, params = tiny
+
+    def jaxpr_text(srv):
+        srv._build_decode()
+        return str(jax.make_jaxpr(srv._decode)(*srv._decode_args()))
+
+    def run(sanitize_on):
+        srv = ServingEngine(model=model, params=params,
+                            config=ServingConfig(batch_slots=2,
+                                                 block_size=8,
+                                                 sanitize=sanitize_on))
+        jx = jaxpr_text(srv)
+        out = srv.run(_reqs())
+        toks = [list(out[uid]["tokens"]) for uid in sorted(out)]
+        stats = srv.stats()
+        srv.close()
+        return jx, toks, stats
+
+    jx_off, toks_off, st_off = run(False)
+    jx_on, toks_on, st_on = run(True)
+    assert jx_on == jx_off
+    assert toks_on == toks_off
+    assert "sanitizer" not in st_off
+    assert st_on["sanitizer"]["findings"] == 0
+    assert st_on["sanitizer"]["checks"] > 0
+
+
+def test_sanitize_armed_via_env(tiny, devices, monkeypatch):
+    """``ServingConfig(sanitize=None)`` (the default) defers to
+    DSTPU_SANITIZE — the launcher's --sanitize wiring."""
+    model, params = tiny
+    monkeypatch.setenv("DSTPU_SANITIZE", "1")
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8))
+    assert srv._sanitizer is not None
+    srv.run(_reqs(1))
+    assert srv.stats()["sanitizer"]["findings"] == 0
+    srv.close()
+
+
+def test_admit_prefill_exception_frees_blocks(tiny, devices):
+    """The satellite-(a) regression: a prefill that dies mid-dispatch
+    must not leak its freshly-allocated blocks (DSTPU303's runtime
+    twin — the exception edge in _admit)."""
+    model, params = tiny
+    srv = ServingEngine(model=model, params=params,
+                        config=ServingConfig(batch_slots=1, block_size=8,
+                                             sanitize=True))
+    before = srv.allocator.free_blocks
+
+    def boom(slot, req, blocks, new):
+        raise RuntimeError("poisoned prefill")
+
+    srv._start = boom
+    srv.submit(_reqs(1)[0])
+    with pytest.raises(RuntimeError, match="poisoned prefill"):
+        srv._admit()
+    # blocks came home, nothing seated, and the armed sanitizer agrees
+    assert srv.allocator.free_blocks == before
+    assert all(s is None for s in srv._slots)
+    assert srv.stats()["sanitizer"]["findings"] == 0
+    srv._sanitizer.on_close()             # no leak at close either
+    del srv._start                        # restore the bound method
+    srv.close()
+
+
+def test_allocator_is_allocated_probe():
+    from deepspeed_tpu.inference import paged_kv as pk
+    a = pk.BlockAllocator(4)
+    got = a.alloc(2)
+    assert all(a.is_allocated(b) for b in got)
+    assert not a.is_allocated(pk.SCRATCH_BLOCK)
+    a.free(got)
+    assert not any(a.is_allocated(b) for b in got)
+
+
+# ===================================================================
+# handoff interleaving explorer (DSTPU320)
+# ===================================================================
+
+def test_interleave_full_sweep_clean(tmp_path):
+    """Every ordering of the 6-event crash-handoff scenario preserves
+    the zero-loss/exactly-once contract — the model-checking gate over
+    the REAL router."""
+    rep = il.explore(workdir=str(tmp_path))
+    assert rep["scenario"] == "crash-handoff"
+    assert rep["total_permutations"] == 720
+    assert rep["explored"] == 720         # full coverage, no sampling
+    assert rep["violations"] == 0 and rep["findings"] == []
+    assert rep["ok"] is True
+    assert len(rep["events"]) == 6
+
+
+def test_interleave_bounded_exploration(tmp_path):
+    rep = il.explore(max_permutations=12, workdir=str(tmp_path))
+    assert rep["explored"] == 12
+    assert rep["total_permutations"] == 720   # truncation is explicit
+    assert rep["ok"] is True
+
+
+def test_interleave_detects_seeded_violation(tmp_path):
+    """A scenario whose settle leaves a uid unanswered must produce
+    typed DSTPU320 findings carrying the ordering — the explorer's
+    detection path, not just its happy path."""
+    scen = il.crash_handoff_scenario()
+
+    def ev_crash_both(w):
+        w["a"].exited = True
+        w["b"].exited = True              # nobody left to serve
+
+    scen["events"] = [("pump", scen["events"][0][1]),
+                      ("crash-both", ev_crash_both)]
+    scen["name"] = "crash-both"
+    rep = il.explore(scenario=scen, workdir=str(tmp_path))
+    assert rep["explored"] == 2 and not rep["ok"]
+    assert rep["violations"] > 0
+    for f in rep["findings"]:
+        assert f.rule == il.INTERLEAVE_VIOLATION
+        assert f.extra["order"] in (["pump", "crash-both"],
+                                    ["crash-both", "pump"])
+
+
+def test_bench_diff_gates_sanitizer_findings():
+    """ds_bench_diff: sanitizer_findings is a zero-contract count —
+    any growth from the committed 0 regresses (the generic
+    zero-baseline policy reports-never-regresses; these counts are
+    exempt), and overhead_pct rides the lower-better band."""
+    from deepspeed_tpu.analysis import bench_diff as bd
+    base = {"s": {"sanitizer_findings": 0, "overhead_pct": 2.6,
+                  "tokens_per_sec_on": 2.0}}
+    worse = {"s": {"sanitizer_findings": 2, "overhead_pct": 2.6,
+                   "tokens_per_sec_on": 2.0}}
+    res = bd.compare(base, worse)
+    assert [r["path"] for r in res["regressions"]] \
+        == ["s.sanitizer_findings"]
+    slow = {"s": {"sanitizer_findings": 0, "overhead_pct": 9.9,
+                  "tokens_per_sec_on": 2.0}}
+    res = bd.compare(base, slow)
+    assert [r["path"] for r in res["regressions"]] == ["s.overhead_pct"]
+    assert bd.classify("tokens_per_sec_on") == "higher"
+
+
+@pytest.mark.slow
+def test_interleave_extended_sweep_clean(tmp_path):
+    """The 7-event (5040-ordering) extended scenario — adds a freeze
+    (hang) to the crash/drain/journal/late-answer set."""
+    rep = il.explore(scenario=il.crash_handoff_scenario(extended=True),
+                     workdir=str(tmp_path))
+    assert rep["total_permutations"] == 5040
+    assert rep["explored"] == 5040
+    assert rep["ok"] is True

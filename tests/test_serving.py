@@ -51,8 +51,16 @@ def test_block_allocator_alloc_free_reuse():
     assert a.free_blocks == 3
     again = a.alloc(3)
     assert set(again) == set(got)         # freed blocks recycle
-    with pytest.raises(AssertionError, match="double free"):
+    # rejections are ValueError (live under python -O), and validate-
+    # first: a rejected batch must not partially mutate the free list
+    before = (a.free_blocks, a.used_blocks)
+    with pytest.raises(ValueError, match="double free"):
         a.free([again[0], again[0]])
+    with pytest.raises(ValueError, match="scratch"):
+        a.free([pk.SCRATCH_BLOCK])
+    assert (a.free_blocks, a.used_blocks) == before
+    assert a.is_allocated(again[0])
+    assert not a.is_allocated(pk.SCRATCH_BLOCK)
 
 
 def test_blocks_needed_math():
