@@ -12,7 +12,9 @@ Metric classification (by key name, innermost key of the JSON path):
 - **higher-better** (throughput family): ``tokens_per_sec``, ``tok_s``,
   ``mfu`` (and ``projected_mfu*``), ``samples_per_sec``,
   ``fraction_of_bound``, ``achieved_frac``, ``reduction_x``,
-  ``bound_tokens_per_sec``, ``decode_tokens_per_sec``;
+  ``bound_tokens_per_sec``, ``decode_tokens_per_sec``, and the
+  migration wins ``migrated_streams`` / ``recompute_tokens_saved``
+  (restore-first handoffs and the decode work they avoided);
 - **lower-better** (latency/cost family): keys ending in ``_ms``/``_s``
   (``p50_ms``, ``p99_ms``, ``ttft_*``, ``prefill_ms``, compile times),
   ``ms_per_token*``, ``*_bytes``/``*_bytes_per_step`` (wire/pool cost),
@@ -21,7 +23,10 @@ Metric classification (by key name, innermost key of the JSON path):
   and the slo family (``*burn_rate*``, ``slo_breaches`` — error-budget
   costs), and the router family (``lost_requests``,
   ``duplicate_answers``, ``handoff_requeue_ms`` — zero-loss serving
-  costs: any growth is a robustness regression);
+  costs: any growth is a robustness regression), and the migration
+  family (``migration_fallbacks`` — each one is a stream that paid
+  full recompute because its image was unusable; ``restore_ms`` gates
+  through the ``_ms`` suffix rule);
 - everything else numeric is **informational** — reported when it moved,
   never gated (counts, shapes, config echoes).
 
@@ -44,7 +49,8 @@ DEFAULT_BAND = 0.2         # ±20%: this container's measured CPU-tier noise
 
 HIGHER_BETTER = ("tokens_per_sec", "tok_s", "samples_per_sec", "mfu",
                  "fraction_of_bound", "achieved_frac", "reduction_x",
-                 "bound_tokens_per_sec", "decode_tokens_per_sec")
+                 "bound_tokens_per_sec", "decode_tokens_per_sec",
+                 "migrated_streams", "recompute_tokens_saved")
 LOWER_BETTER_SUFFIX = ("_ms", "_s")
 LOWER_BETTER = ("ms_per_token", "overhead_pct", "host_pct")
 LOWER_BETTER_BYTES = ("wire_bytes", "bytes_per_step")
@@ -65,6 +71,11 @@ LOWER_BETTER_ROUTER = ("lost_requests", "duplicate_answers",
 # must report zero lifecycle findings — any growth is a serving bug,
 # not noise
 LOWER_BETTER_SANITIZE = ("sanitizer_findings",)
+# migration family (docs/serving.md#kv-migration): every fallback is a
+# stream that paid full recompute because its KV image was torn,
+# corrupt, or unplaceable — growth is a robustness regression
+# (restore_ms gates via the _ms suffix rule)
+LOWER_BETTER_MIGRATION = ("migration_fallbacks",)
 # exact count contracts where ZERO is the baseline by design: any
 # growth regresses even though a relative band cannot gate it (the
 # zero-baseline report-never-regress policy below is for
@@ -81,7 +92,7 @@ def classify(key: str):
             return "higher"
     for name in (LOWER_BETTER + LOWER_BETTER_BYTES + LOWER_BETTER_MEM
                  + LOWER_BETTER_SLO + LOWER_BETTER_ROUTER
-                 + LOWER_BETTER_SANITIZE):
+                 + LOWER_BETTER_SANITIZE + LOWER_BETTER_MIGRATION):
         if name in k:
             return "lower"
     if k.endswith(LOWER_BETTER_SUFFIX):

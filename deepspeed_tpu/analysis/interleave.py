@@ -40,6 +40,7 @@ proof).
 """
 
 import itertools
+import json
 import math
 import os
 import shutil
@@ -47,10 +48,11 @@ import tempfile
 
 import numpy as np
 
+from ..checkpoint import atomic
 from ..inference import journal as jr
 from ..inference.router import (ReplicaRouter, ReplicaHandle, RouterConfig,
                                 HEALTHY, DRAINING)
-from ..inference.serving import Request, OK
+from ..inference.serving import Request, OK, stream_snapshot_dir
 from ..utils.retry import RetryPolicy
 from .findings import Finding
 
@@ -78,7 +80,7 @@ class ScriptedReplica(ReplicaHandle):
     and an optional REAL on-disk journal lets a permutation exercise
     ``journal.replay`` adoption, not a stub of it."""
 
-    def __init__(self, name, clock, journal_root=None):
+    def __init__(self, name, clock, journal_root=None, ledger=None):
         self.name = name
         self._clock = clock
         self.hb = clock()
@@ -88,13 +90,31 @@ class ScriptedReplica(ReplicaHandle):
         self._answers = []
         self._jdir = None
         self._journal = None
+        # KV-migration script state: router-handed snapshot hints,
+        # a crash-mid-restore flag, and the shared emission ledger the
+        # no-stale-tokens oracle reads
+        self.restore_hints = {}
+        self.restore_broken = False
+        self._ledger = ledger
         if journal_root is not None:
             self._jdir = os.path.join(journal_root, name)
             os.makedirs(self._jdir, exist_ok=True)
 
     # ------------------------------------------------ handle interface
-    def submit(self, req):
+    def submit(self, req, snapshot_dir=None):
         self.inbox.append(req)
+        if snapshot_dir is not None:
+            # resolve the restore EAGERLY, like submit_restored: seat
+            # the image at admission or fall back to recompute on the
+            # spot.  restore_broken models a crash mid-import — the
+            # stream silently degrades to the plain recompute path
+            if self.restore_broken:
+                return
+            # the scripted replica TRUSTS the router-handed image —
+            # the oracles must catch a bad handoff, the replica must
+            # not mask it
+            with open(os.path.join(snapshot_dir, "stream.json")) as f:
+                self.restore_hints[int(req.uid)] = json.load(f)
 
     def pump(self):
         if not self.frozen and not self.exited:
@@ -123,11 +143,28 @@ class ScriptedReplica(ReplicaHandle):
 
     def serve(self, token_fn):
         """Answer everything in the inbox (a healthy replica doing its
-        job); no-op while frozen or dead."""
+        job); no-op while frozen or dead.  A stream seated from a
+        restore at :meth:`submit` resumes from the snapshot's position,
+        emitting ONLY the post-snapshot suffix; everything else is a
+        full recompute.  Emissions land on the shared ledger for the
+        no-stale-tokens oracle."""
         if self.frozen or self.exited:
             return
         for req in self.inbox:
-            self.answer(int(req.uid), token_fn(int(req.uid)))
+            uid = int(req.uid)
+            snap = self.restore_hints.pop(uid, None)
+            full = token_fn(uid)
+            if snap is not None:
+                pos = int(snap["pos"])
+                full = list(snap["prefix"]) + full[pos:]
+                emitted, via = range(pos, len(full)), "restore"
+            else:
+                emitted, via = range(len(full)), "recompute"
+            if self._ledger is not None:
+                for i in emitted:
+                    self._ledger.append({"replica": self.name, "uid": uid,
+                                         "index": i, "via": via})
+            self.answer(uid, full)
         self.inbox = []
 
     def journal_finish(self, uid, tokens, outcome=OK):
@@ -232,6 +269,97 @@ def crash_handoff_scenario(extended=False):
             "build": build, "events": events}
 
 
+def migration_scenario():
+    """The KV-migration event alphabet (docs/serving.md#kv-migration):
+    replica ``a`` commits a cadence snapshot of one stream, a SECOND
+    snapshot is torn mid-write (staged, never committed, content
+    poisoned so an erroneous restore fails the token-identity oracle),
+    ``a`` crashes, the survivor's restore may itself die mid-import
+    (falling back to recompute), and a journaled finish races all of
+    it.  6 events → 720 orderings.  On top of the base contracts the
+    sweep asserts the **no-stale-tokens oracle**: a restored stream
+    never re-emits a token index the original already reported durably
+    (i.e. restore emission starts at the snapshot position), and a
+    torn image is never restored at all."""
+
+    def build(workdir):
+        clock = StepClock(1000.0)
+        ledger = []
+        a = ScriptedReplica("a", clock, journal_root=workdir,
+                            ledger=ledger)
+        b = ScriptedReplica("b", clock, ledger=ledger)
+        cfg = RouterConfig(
+            suspect_after_s=1.0, dead_after_s=4.0,
+            probe_retry=RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                                    max_delay_s=0.2, jitter_mode="full",
+                                    seed=7, sleep=lambda s: None),
+            monitor_interval=1)
+        router = _AuditedRouter([a, b], cfg, clock=clock)
+        uids = [router.submit(Request(tokens=np.arange(4) % 64,
+                                      max_new_tokens=2, seed=i))
+                for i in range(3)]
+        router.pump()                       # deterministic placement
+        a_uids = sorted(router._replicas["a"].assigned)
+        assert a_uids, "scenario assumes replica a took traffic"
+        return {"router": router, "clock": clock, "a": a, "b": b,
+                "uids": uids, "a_uids": a_uids, "token_fn": _token_fn,
+                "ledger": ledger, "snap_pos": {}}
+
+    def ev_pump(w):
+        w["router"].pump()
+
+    def ev_snapshot_a(w):
+        # the cadence snapshot: only a LIVE replica exports (the
+        # engine's step loop died with the process), committed through
+        # the real stage/manifest/rename protocol so find_latest_valid
+        # accepts it
+        if w["a"].exited:
+            return
+        uid = w["a_uids"][0]
+        pos = 1
+        sdir = stream_snapshot_dir(w["a"].journal_dir, uid)
+        stage = atomic.stage_path(sdir, "snap-000001")
+        os.makedirs(stage, exist_ok=True)
+        with open(os.path.join(stage, "stream.json"), "w") as f:
+            json.dump({"uid": uid, "pos": pos,
+                       "prefix": w["token_fn"](uid)[:pos]}, f)
+        atomic.write_manifest(stage, meta={"global_steps": pos})
+        atomic.commit_staged(sdir, "snap-000001")
+        w["snap_pos"][uid] = pos
+
+    def ev_torn_snapshot_a(w):
+        # crash mid-snapshot: a NEWER image staged but never committed
+        # (no manifest, no rename).  Its content is poisoned — if any
+        # path ever restores it, the token-identity oracle screams
+        uid = w["a_uids"][0]
+        sdir = stream_snapshot_dir(w["a"].journal_dir, uid)
+        stage = atomic.stage_path(sdir, "snap-000002")
+        os.makedirs(stage, exist_ok=True)
+        with open(os.path.join(stage, "stream.json"), "w") as f:
+            json.dump({"uid": uid, "pos": 1, "prefix": [999]}, f)
+
+    def ev_crash_a(w):
+        w["a"].exited = True
+
+    def ev_break_restore_b(w):
+        # crash mid-restore at the survivor: the import dies and the
+        # stream falls back to a full recompute (submit_restored's
+        # fallback contract) — never a lost or duplicated uid
+        w["b"].restore_broken = True
+
+    def ev_journal_finish_a(w):
+        uid = w["a_uids"][-1]
+        w["a"].journal_finish(uid, w["token_fn"](uid))
+
+    events = [("pump", ev_pump),
+              ("snapshot-a", ev_snapshot_a),
+              ("torn-snapshot-a", ev_torn_snapshot_a),
+              ("crash-a", ev_crash_a),
+              ("break-restore-b", ev_break_restore_b),
+              ("journal-finish-a", ev_journal_finish_a)]
+    return {"name": "kv-migration", "build": build, "events": events}
+
+
 # -------------------------------------------------------------- explore
 def _settle(w, max_iters=64):
     """Post-scenario service: the surviving replicas answer their
@@ -296,6 +424,25 @@ def _check(w):
     if r.queue:
         viol.append(f"{len(r.queue)} request(s) stranded in the router "
                     f"queue")
+    # no-stale-tokens oracle (migration scenarios only): a restored
+    # stream resumes AT the committed snapshot position — indices the
+    # original already reported durably are never re-emitted — and a
+    # uid with no committed snapshot is never served via restore (a
+    # torn image restored is exactly that)
+    snap_pos = w.get("snap_pos") or {}
+    for e in (w.get("ledger") or []):
+        if e["via"] != "restore":
+            continue
+        pos = snap_pos.get(e["uid"])
+        if pos is None:
+            viol.append(f"uid {e['uid']} served via restore with no "
+                        f"committed snapshot — a torn/corrupt image "
+                        f"was restored")
+        elif e["index"] < pos:
+            viol.append(f"uid {e['uid']} re-emitted token index "
+                        f"{e['index']} via restore; the original "
+                        f"durably reported indices < {pos} "
+                        f"(no-stale-tokens)")
     return viol
 
 

@@ -107,7 +107,9 @@ PROTECTED_ATTRS = {
     "_in_use": ("BlockAllocator",),      # allocator live-block set
     "_buf": ("RequestJournal",),         # journal append buffer
     "assigned": ("_ReplicaState", "_place", "_record_result", "_handoff"),
-    "_tables": ("__init__", "_start", "_finish"),   # slot block tables
+    # slot block tables: _restore_stream is the migration-era second
+    # admission path (seats a restored slot), a peer of _start
+    "_tables": ("__init__", "_start", "_finish", "_restore_stream"),
     "blocks": ("__init__",),             # per-sequence block list (_Slot)
 }
 

@@ -234,6 +234,35 @@ def router_report():
     print("observe with .......... ds_router <dir1> <dir2> ... [--once]")
 
 
+def kv_snapshot_report():
+    """Resolved KV snapshot/migration policy
+    (docs/serving.md#kv-migration): the ``serving.kv_snapshot`` block as
+    a serving engine built in this environment would resolve it — off by
+    default, with the defaults an armed config would get."""
+    from .inference.serving import describe_kv_snapshot
+
+    print("-" * 64)
+    print("KV snapshot / crash migration (config `serving.kv_snapshot`):")
+    print("-" * 64)
+    pol = _safe(lambda: describe_kv_snapshot())
+    if not isinstance(pol, dict):
+        print(f"policy ................ {pol}")
+        return
+    eff = pol if pol.get("enabled") else pol.get("defaults_when_armed", {})
+    print(f"enabled ............... {pol.get('enabled')} "
+          "(off by default; jaxpr-identical when armed)")
+    print(f"cadence ............... every {eff.get('every_tokens')} "
+          "token(s) per stream")
+    print(f"retention ............. keep_n={eff.get('keep_n')} "
+          "(rotate like checkpoint.keep_n)")
+    print(f"export on evict ....... {eff.get('export_on_evict')} "
+          "(deadline-evicted streams stay restorable)")
+    print(f"verify ................ {eff.get('verify')} "
+          "(manifest + per-block sha256)")
+    print(f"handoff ............... {eff.get('handoff')}")
+    print(f"wire format ........... {eff.get('wire_format')}")
+
+
 def sanitize_report():
     """Resolved lifecycle shadow-sanitizer policy
     (docs/static-analysis.md#sanitizer): the DSTPU_SANITIZE env
@@ -265,6 +294,7 @@ def main():
     comms_compression_report()
     monitor_report()
     router_report()
+    kv_snapshot_report()
     sanitize_report()
     debug_report()
 

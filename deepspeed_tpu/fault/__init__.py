@@ -51,6 +51,15 @@ tokens:
                                    by ``spike_factor`` over the window —
                                    finite but wildly out-of-distribution
 - ``spike_factor=<float>``         loss_spike multiplier (default 1e4)
+- ``corrupt_at=<site>[@N]``        VALUE corruption at a named site: the
+                                   site's owner consults
+                                   :func:`corrupt_at` (a query, like
+                                   ``poison_uid``) and, when armed,
+                                   corrupts its own payload bytes
+                                   in-place — bit rot, not a crash; the
+                                   process continues.  One-shot; ``@N``
+                                   defers to the N-th visit.  Drives
+                                   ``serving.kv_image_corrupt``
 - ``logit_nan=<uid>``              VALUE corruption for SERVING: poison
                                    request ``uid``'s KV blocks right after
                                    its prefill (host-side pool edit — the
@@ -103,6 +112,19 @@ SITES = (
     # the uid replay as PENDING although a result may already be out —
     # the router's dedup-by-uid case)
     "serving.journal_crash_finish",
+    # KV snapshot/migration (docs/fault-tolerance.md#kv-migration):
+    # between staging a stream's KV image and its commit rename — a
+    # crash here leaves a torn `.tmp` snapshot that manifest resolution
+    # must skip (detectable, never restorable)
+    "serving.kv_snapshot_torn",
+    # post-commit bit rot of a snapshot payload; a VALUE fault
+    # (`corrupt_at=`, consulted via :func:`corrupt_at`, not a crash) —
+    # restore must catch it via manifest/per-block digests and fall
+    # back to recompute with a typed `migration_fallback` event
+    "serving.kv_image_corrupt",
+    # mid-restore on the SURVIVOR: blocks allocated, image not yet
+    # seated — the restore path must unwind without leaking blocks
+    "serving.crash_during_restore",
 )
 
 _IO_PREFIXES = ("io.", "aio.")
@@ -151,14 +173,16 @@ class FaultPlan:
     def __init__(self, crash_sites=(), io_error_p=0.0, io_delay_ms=0.0,
                  max_faults=None, seed=0, grad_nan=None, loss_spike=None,
                  spike_factor=1e4, logit_nan=(), crash_at_visit=None,
-                 hang_at=None, hang_s=0.25):
-        # crash_at_visit / hang_at: {site: visit} — fire on that 1-based
-        # VISIT of the site (crash_sites entries fire on the next visit)
+                 hang_at=None, hang_s=0.25, corrupt_at=None):
+        # crash_at_visit / hang_at / corrupt_at: {site: visit} — fire on
+        # that 1-based VISIT of the site (crash_sites entries fire on
+        # the next visit)
         self.crash_at_visit = dict(crash_at_visit or {})
         self.hang_at = dict(hang_at or {})
+        self.corrupt_at = dict(corrupt_at or {})
         self.hang_s = float(hang_s)
         unknown = (set(crash_sites) | set(self.crash_at_visit)
-                   | set(self.hang_at)) - set(SITES)
+                   | set(self.hang_at) | set(self.corrupt_at)) - set(SITES)
         assert not unknown, f"unknown fault sites {sorted(unknown)}; " \
                             f"valid: {SITES}"
         self.crash_sites = set(crash_sites)
@@ -196,6 +220,9 @@ class FaultPlan:
                     site_name, visit = _parse_site_at(val)
                     # visit None = fire on the very next visit
                     kw.setdefault("hang_at", {})[site_name] = visit or 1
+                elif key == "corrupt_at":
+                    site_name, visit = _parse_site_at(val)
+                    kw.setdefault("corrupt_at", {})[site_name] = visit or 1
                 elif key in ("io_error_p", "io_delay_ms", "spike_factor",
                              "hang_s"):
                     kw[key] = float(val)
@@ -323,6 +350,27 @@ def corrupt_batch(batch, index):
         p.hits["fault.loss_spike"] = p.hits.get("fault.loss_spike", 0) + 1
         return _map_float_leaves(batch, lambda a: a * p.spike_factor)
     return batch
+
+
+def corrupt_at(name):
+    """True when the armed plan marks site ``name`` for in-place VALUE
+    corruption (spec key ``corrupt_at=<site>[@N]``, one-shot).
+
+    Like :func:`corrupt_batch`/:func:`poison_uid`, this is a QUERY, not
+    a raise site: the owning code (the KV snapshot writer for
+    ``serving.kv_image_corrupt``) flips its own committed payload bytes
+    when this returns True — simulated bit rot the restore path must
+    catch by digest, while the process itself keeps running."""
+    if _PLAN is None:
+        return False
+    p = _PLAN
+    p.hits[name] = p.hits.get(name, 0) + 1
+    if name in p.corrupt_at and p.hits[name] >= p.corrupt_at[name]:
+        del p.corrupt_at[name]        # one-shot, like crash_sites
+        logger.warning(f"fault: injected payload corruption at {name} "
+                       f"(visit {p.hits[name]})")
+        return True
+    return False
 
 
 def poison_uid(uid):

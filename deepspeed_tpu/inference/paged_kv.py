@@ -34,12 +34,16 @@ tested bit-exact against (``analysis/roofline.py`` prices whichever
 impl is live).
 """
 
+import hashlib
+import json
+import os
 from typing import Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import fault
 from ..runtime.comm.quantized import (quantize_blockwise,
                                       dequantize_blockwise, pick_block)
 
@@ -254,3 +258,201 @@ def write_prefill(pool, blocks, k, v):
     qv, sv = quantize_blockwise(v, block_size=qb, bits=8)
     return dict(pool, k=put("k", qk), v=put("v", qv),
                 k_scale=put("k_scale", sk), v_scale=put("v_scale", sv))
+
+
+# -------------------------------------------------- block images (migration)
+# A *block image* is one sequence's block list serialized in the PR-8
+# wire format — int8 payloads + fp32 block scales over the head dim —
+# so an in-flight decode's KV state can move between workers
+# (docs/serving.md#kv-migration).  int8 pools export by PASS-THROUGH
+# (bit-exact, so a restored stream re-decodes token-identically);
+# full-width pools quantize on export and dequantize on import (wire
+# precision, the same trade the comms compressor makes).  Per-block
+# SHA-256 digests ride in the image so corruption is pinned to a block,
+# and the on-disk form commits through the ``checkpoint/atomic.py``
+# stage/manifest/rename protocol: a torn write is detectable, never
+# restorable.
+
+IMAGE_FILE = "image.npz"
+IMAGE_HEAD_FILE = "image.json"
+
+
+class BlockImageError(RuntimeError):
+    """A block image failed validation (torn, corrupt, or wrong
+    geometry) — the caller must fall back to recompute, never restore."""
+
+
+def _block_digests(k, v, k_scale, v_scale):
+    """Per-block SHA-256 over the payload AND scale bytes of each block
+    (axis 1 of every image array)."""
+    out = []
+    for i in range(k.shape[1]):
+        h = hashlib.sha256()
+        for arr in (k, v, k_scale, v_scale):
+            h.update(np.ascontiguousarray(arr[:, i]).tobytes())
+        out.append(h.hexdigest())
+    return out
+
+
+def export_block_image(pool, blocks, quant_block: int = 64) -> dict:
+    """Serialize ``blocks`` (one sequence's block list) as an in-memory
+    int8+scales image — host numpy arrays of shape (L, nb, bs, H, hd)
+    plus (L, nb, bs, H, hd//qb) scales, per-block digests, and the
+    geometry needed to validate an import."""
+    idx = jnp.asarray(np.asarray(blocks, np.int32))
+    if is_quantized_pool(pool):
+        qb = pool_quant_block(pool)
+        qk, sk = pool["k"][:, idx], pool["k_scale"][:, idx]
+        qv, sv = pool["v"][:, idx], pool["v_scale"][:, idx]
+    else:
+        qb = pick_block(pool["k"].shape[-1], quant_block)
+        qk, sk = quantize_blockwise(pool["k"][:, idx], block_size=qb, bits=8)
+        qv, sv = quantize_blockwise(pool["v"][:, idx], block_size=qb, bits=8)
+    qk, sk, qv, sv = (np.asarray(jax.device_get(x))
+                      for x in (qk, sk, qv, sv))
+    return {"k": qk, "v": qv, "k_scale": sk, "v_scale": sv,
+            "quant_block": int(qb),
+            "source_bits": 8 if is_quantized_pool(pool) else 16,
+            "block_sha256": _block_digests(qk, qv, sk, sv)}
+
+
+def verify_block_image(image) -> list:
+    """Indices (into the image's block axis) whose bytes no longer match
+    their recorded digest — empty for a healthy image."""
+    fresh = _block_digests(image["k"], image["v"],
+                           image["k_scale"], image["v_scale"])
+    return [i for i, (a, b) in enumerate(zip(fresh, image["block_sha256"]))
+            if a != b]
+
+
+def import_block_image(pool, blocks, image, pad_to=None):
+    """Scatter a verified image into ``blocks`` of ``pool`` (the
+    :func:`write_prefill` idiom), returning the new pool.
+
+    int8 pools take the payloads and scales verbatim (requires the same
+    ``quant_block``); full-width pools dequantize to the pool dtype.
+    Geometry or digest mismatches raise :class:`BlockImageError` — a
+    bad image must degrade to recompute, never scatter garbage.
+
+    ``pad_to`` pads the scatter to a fixed block count (extra lanes
+    write zeros into :data:`SCRATCH_BLOCK`, garbage by design), so one
+    XLA compile serves every restore regardless of stream depth — the
+    specialization on ``len(blocks)`` otherwise puts a fresh trace
+    (~100-650 ms) inside each first-of-its-size restore window."""
+    k = image["k"]
+    L, nb, bs, H, hd = k.shape
+    pshape = pool["k"].shape
+    if (L, bs, H, hd) != (pshape[0], pshape[2], pshape[3], pshape[4]):
+        raise BlockImageError(
+            f"image geometry {(L, bs, H, hd)} does not match pool "
+            f"{(pshape[0], pshape[2], pshape[3], pshape[4])}")
+    if len(blocks) != nb:
+        raise BlockImageError(
+            f"image holds {nb} blocks, import got {len(blocks)} ids")
+    bad = verify_block_image(image)
+    if bad:
+        raise BlockImageError(f"block digest mismatch at image block(s) "
+                              f"{bad} — refusing to restore")
+    pad = max(0, int(pad_to or 0) - nb)
+    idx = jnp.asarray(np.concatenate(
+        [np.asarray(blocks, np.int32),
+         np.full((pad,), SCRATCH_BLOCK, np.int32)]))
+
+    def _pad(x):
+        # host-side, BEFORE any device op: padding on device would
+        # re-specialize the very compiles pad_to exists to pin
+        x = np.asarray(x)
+        if pad:
+            x = np.concatenate(
+                [x, np.zeros((L, pad) + x.shape[2:], x.dtype)], axis=1)
+        return x
+
+    def put(name, x):
+        return pool[name].at[:, idx].set(jnp.asarray(x))
+
+    if is_quantized_pool(pool):
+        if pool_quant_block(pool) != int(image["quant_block"]):
+            raise BlockImageError(
+                f"image quant_block {image['quant_block']} != pool "
+                f"{pool_quant_block(pool)}")
+        return dict(pool, k=put("k", _pad(image["k"])),
+                    v=put("v", _pad(image["v"])),
+                    k_scale=put("k_scale", _pad(image["k_scale"])),
+                    v_scale=put("v_scale", _pad(image["v_scale"])))
+    dt = pool["k"].dtype
+    dk = dequantize_blockwise(jnp.asarray(_pad(image["k"])),
+                              jnp.asarray(_pad(image["k_scale"])),
+                              bits=8, out_dtype=dt)
+    dv = dequantize_blockwise(jnp.asarray(_pad(image["v"])),
+                              jnp.asarray(_pad(image["v_scale"])),
+                              bits=8, out_dtype=dt)
+    return dict(pool, k=put("k", dk), v=put("v", dv))
+
+
+def save_block_image(save_dir: str, tag: str, image: dict,
+                     meta: Optional[dict] = None) -> str:
+    """Commit ``image`` as ``<save_dir>/<tag>/`` via the atomic
+    checkpoint protocol: stage ``image.npz`` + ``image.json``, manifest
+    (per-file sha256), one publish rename.  Returns the committed dir.
+
+    Fault sites: ``serving.kv_snapshot_torn`` fires between staging and
+    commit (a kill there leaves an invisible ``.tmp``);
+    ``serving.kv_image_corrupt`` (a ``corrupt_at=`` VALUE fault) flips a
+    committed payload byte — bit rot the restore digests must catch."""
+    from ..checkpoint import atomic
+    import shutil
+    os.makedirs(save_dir, exist_ok=True)
+    stage = atomic.stage_path(save_dir, tag)
+    if os.path.isdir(stage):
+        shutil.rmtree(stage)
+    os.makedirs(stage)
+    np.savez(os.path.join(stage, IMAGE_FILE),
+             k=image["k"], v=image["v"],
+             k_scale=image["k_scale"], v_scale=image["v_scale"])
+    head = {"quant_block": int(image["quant_block"]),
+            "source_bits": int(image["source_bits"]),
+            "shape": list(image["k"].shape),
+            "block_sha256": list(image["block_sha256"])}
+    with open(os.path.join(stage, IMAGE_HEAD_FILE), "w") as f:
+        json.dump(head, f)  # dstpu: disable=DSTPU104 (wire format, not metrics)
+    fault.site("serving.kv_snapshot_torn", path=stage)
+    atomic.write_manifest(stage, meta or {})
+    atomic.commit_staged(save_dir, tag)
+    final = os.path.join(save_dir, str(tag))
+    if fault.corrupt_at("serving.kv_image_corrupt"):
+        payload = os.path.join(final, IMAGE_FILE)
+        with open(payload, "r+b") as f:
+            f.seek(os.path.getsize(payload) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+    return final
+
+
+def load_block_image(ckpt_dir: str, verify: str = "full"):
+    """Load a committed image dir back into the in-memory form, raising
+    :class:`BlockImageError` unless the manifest verifies at ``verify``
+    level AND every per-block digest matches.  Returns
+    ``(image, manifest_meta)``."""
+    from ..checkpoint import atomic
+    ok, problems = atomic.verify_checkpoint(ckpt_dir, level=verify)
+    if not ok:
+        raise BlockImageError(
+            f"image manifest failed verification: {problems}")
+    manifest = atomic.read_manifest(ckpt_dir) or {}
+    try:
+        with open(os.path.join(ckpt_dir, IMAGE_HEAD_FILE)) as f:
+            head = json.load(f)
+        with np.load(os.path.join(ckpt_dir, IMAGE_FILE)) as z:
+            image = {name: z[name] for name in
+                     ("k", "v", "k_scale", "v_scale")}
+    except Exception as e:  # torn zip / missing file / bad json
+        raise BlockImageError(f"unreadable image in {ckpt_dir}: {e}") from e
+    image.update(quant_block=head["quant_block"],
+                 source_bits=head["source_bits"],
+                 block_sha256=head["block_sha256"])
+    bad = verify_block_image(image)
+    if bad:
+        raise BlockImageError(f"block digest mismatch at image block(s) "
+                              f"{bad} in {ckpt_dir}")
+    return image, manifest.get("meta", {})

@@ -74,7 +74,7 @@ from ..utils.logging import logger
 from ..utils.retry import RetryPolicy
 from . import journal as jr
 from .serving import (Request, QueueFullError, ServingError,
-                      OK, SHED, DEADLINE)
+                      OK, SHED, DEADLINE, stream_snapshot_dir)
 
 # health states (docs/serving.md#replica-router)
 HEALTHY = "healthy"
@@ -136,9 +136,16 @@ class ReplicaHandle:
 
     name: str = "?"
 
-    def submit(self, req: Request):
+    def submit(self, req: Request, snapshot_dir: Optional[str] = None):
         """Place one request on this replica (must journal it durably
-        before acknowledging, where a journal exists)."""
+        before acknowledging, where a journal exists).  When
+        ``snapshot_dir`` names a committed KV block image of the stream
+        (docs/serving.md#kv-migration), the replica should attempt
+        restore-first admission (``ServingEngine.submit_restored``) and
+        fall back to plain recompute on any image defect.  In-process
+        handles return the restore outcome dict synchronously;
+        subprocess handles return ``None`` and report the outcome
+        through their journal's ``restore`` record."""
         raise NotImplementedError
 
     def poll(self) -> List[dict]:
@@ -186,9 +193,14 @@ class LocalReplica(ReplicaHandle):
         self._hb = clock()
         self._submitted = set()
 
-    def submit(self, req: Request):
-        self.engine.submit(req)
+    def submit(self, req: Request, snapshot_dir: Optional[str] = None):
+        out = None
+        if snapshot_dir is not None:
+            out = self.engine.submit_restored(req, snapshot_dir)
+        else:
+            self.engine.submit(req)
         self._submitted.add(req.uid)
+        return out
 
     def pump(self):
         self.engine.step()
@@ -253,7 +265,7 @@ class ProcessReplica(ReplicaHandle):
         self._offset = 0             # journal tail position
         os.makedirs(self.inbox, exist_ok=True)
 
-    def submit(self, req: Request):
+    def submit(self, req: Request, snapshot_dir: Optional[str] = None):
         spec = {"uid": int(req.uid),
                 "tokens": [int(t) for t in np.asarray(req.tokens).ravel()],
                 "max_new_tokens": (None if req.max_new_tokens is None
@@ -261,6 +273,10 @@ class ProcessReplica(ReplicaHandle):
                 "temperature": float(req.temperature),
                 "do_sample": bool(req.do_sample),
                 "seed": int(req.seed)}
+        if snapshot_dir is not None:
+            # restore-first hint: the worker attempts submit_restored
+            # and reports the outcome via its journal's restore record
+            spec["snapshot_dir"] = snapshot_dir
         path = os.path.join(self.inbox, f"req-{int(req.uid):08d}.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -290,6 +306,13 @@ class ProcessReplica(ReplicaHandle):
                 out.append({"uid": int(rec["uid"]),
                             "outcome": rec.get("outcome"),
                             "tokens": rec.get("tokens")})
+            elif rec.get("kind") == "restore":
+                # restore-first outcome report from submit_restored —
+                # the router's migration counters feed on these
+                out.append({"kind": "restore", "uid": int(rec["uid"]),
+                            "restored": bool(rec.get("restored")),
+                            "restore_ms": rec.get("restore_ms", 0.0),
+                            "tokens_saved": rec.get("tokens_saved", 0)})
         return out
 
     def heartbeat(self) -> Optional[float]:
@@ -370,6 +393,13 @@ class ReplicaRouter:
         self._foreign_recovered = 0
         self._adopted_finishes = 0
         self._outcomes = {OK: 0, SHED: 0, DEADLINE: 0}
+        # KV migration (docs/serving.md#kv-migration): restore-first
+        # handoff outcome counters — ds_bench_diff gates on these
+        self._migrated_streams = 0
+        self._migrated_uids: List[int] = []
+        self._migration_fallbacks = 0
+        self._recompute_tokens_saved = 0
+        self._restore_ms: List[float] = []
         self._handoff_ms: List[float] = []
         self._drain_events: List[dict] = []
         self._dead_events: List[dict] = []
@@ -529,11 +559,56 @@ class ReplicaRouter:
         return view.label if view.label in self._replicas else None
 
     # ----------------------------------------------------------- handoff
+    def _find_stream_snapshot(self, jd: str, uid: int) -> Optional[str]:
+        """Newest manifest-valid KV snapshot of ``uid`` on the dead
+        replica's journal, or None.  No snapshot directory at all is the
+        silent common case (snapshots off, or cadence never reached);
+        a directory holding NO valid image — every tag torn or corrupt
+        — is the loud case: a typed ``migration_fallback`` event fires
+        and the stream recomputes."""
+        sdir = stream_snapshot_dir(jd, uid)
+        if not os.path.isdir(sdir):
+            return None
+        from ..checkpoint import atomic
+        tag = atomic.find_latest_valid(sdir)
+        if tag is None:
+            self._migration_fallbacks += 1
+            logger.warning(
+                f"router: uid {uid} has snapshot images under {sdir} but "
+                "none is manifest-valid (torn/corrupt) — falling back to "
+                "recompute (typed migration_fallback)")
+            if self.monitor.armed:
+                self.monitor.trace("migration_fallback", step=self._pumps,
+                                   uid=int(uid),
+                                   reason="no manifest-valid snapshot")
+            return None
+        return os.path.join(sdir, tag)
+
+    def _note_restore_outcome(self, out: dict):
+        """Fold one restore-first outcome (synchronous dict from a
+        LocalReplica, journal ``restore`` record from a worker) into the
+        migration counters.  An engine-side fallback already emitted its
+        typed event on the replica's own monitor stream — the router
+        only counts it."""
+        if out.get("restored"):
+            self._migrated_streams += 1
+            if out.get("uid") is not None:
+                self._migrated_uids.append(int(out["uid"]))
+            self._restore_ms.append(float(out.get("restore_ms") or 0.0))
+            self._recompute_tokens_saved += int(out.get("tokens_saved") or 0)
+        else:
+            self._migration_fallbacks += 1
+
     def _handoff(self, st: _ReplicaState, now):
-        """Recover a dead replica's unfinished work: adopt journaled
-        finishes the router had not observed yet, requeue everything
-        else onto the siblings (same Request, fresh deadline budget —
-        token-identical by the sampling-stream contract)."""
+        """Recover a dead replica's unfinished work, restore-first:
+        adopt journaled finishes the router had not observed yet, then
+        for each remaining uid try to seat its newest manifest-valid KV
+        snapshot on a healthy sibling (``submit_restored`` — only the
+        post-snapshot suffix re-decodes, token-identical by the
+        sampling-stream contract); anything without a usable image — or
+        whose placement is refused — falls back to the plain requeue
+        path (same Request, fresh deadline budget, full recompute).
+        Either way: never a lost uid, never a duplicated one."""
         t0 = time.perf_counter()
         # drain the results channel one last time (answers that landed
         # before death must not be recomputed)
@@ -551,7 +626,9 @@ class ReplicaRouter:
                     self._record_result(st, {
                         "uid": int(uid), "outcome": rec.get("outcome"),
                         "tokens": rec.get("tokens")})
-        requeued = 0
+        requeued = migrated = 0
+        targets = [s for s in self._replicas.values()
+                   if s.state == HEALTHY]
         for uid in sorted(st.assigned):
             rec = self.results.get(uid)
             if rec is None or rec["outcome"] is not None:
@@ -562,6 +639,25 @@ class ReplicaRouter:
                 # a re-run deserves a fresh budget (the same re-arm the
                 # journal-recovery path applies — serving.py Request)
                 rec["deadline"] = now + self.config.deadline_ms / 1e3
+            snap = self._find_stream_snapshot(jd, uid) if jd else None
+            if snap is not None and targets:
+                target = min(targets, key=self._placement_score)
+                try:
+                    out = target.handle.submit(rec["request"],
+                                               snapshot_dir=snap)
+                except (QueueFullError, ValueError, ServingError) as e:
+                    logger.warning(
+                        f"router: restore placement of uid {uid} on "
+                        f"{target.handle.name!r} refused ({e}) — "
+                        "requeueing for recompute")
+                else:
+                    rec["replica"] = target.handle.name
+                    target.assigned.add(uid)
+                    self._routed_total += 1
+                    migrated += 1
+                    if out is not None:      # in-process: outcome now;
+                        self._note_restore_outcome(out)
+                    continue                 # workers report via journal
             self.queue.append(rec["request"])
             requeued += 1
         st.assigned.clear()
@@ -574,7 +670,8 @@ class ReplicaRouter:
             self.monitor.gauge("router_handoff_requeue_ms", ms)
         logger.warning(
             f"router: handoff from dead replica {st.handle.name!r}: "
-            f"requeued {requeued} uid(s) in {ms:.1f}ms"
+            f"placed {migrated} stream(s) restore-first, requeued "
+            f"{requeued} uid(s) for recompute in {ms:.1f}ms"
             + (f", torn_lines={self._torn_recovered}"
                if self._torn_recovered else ""))
 
@@ -642,6 +739,10 @@ class ReplicaRouter:
                 self._record_result(st, res)
 
     def _record_result(self, st: _ReplicaState, res: dict):
+        if res.get("kind") == "restore":
+            # a worker's restore-first outcome report, not a finish
+            self._note_restore_outcome(res)
+            return
         uid = int(res["uid"])
         rec = self.results.get(uid)
         if rec is None:
@@ -700,7 +801,11 @@ class ReplicaRouter:
                       "router_completed_total": self._outcomes.get(OK, 0),
                       "router_shed_total": self._outcomes.get(SHED, 0),
                       "router_deadline_total":
-                          self._outcomes.get(DEADLINE, 0)})
+                          self._outcomes.get(DEADLINE, 0),
+                      "router_migrated_streams_total":
+                          self._migrated_streams,
+                      "router_migration_fallbacks_total":
+                          self._migration_fallbacks})
 
     # ------------------------------------------------------------- drive
     def run(self, requests=None, timeout_s: Optional[float] = None):
@@ -775,6 +880,11 @@ class ReplicaRouter:
             "torn_lines_recovered": self._torn_recovered,
             "foreign_lines_recovered": self._foreign_recovered,
             "handoff_requeue_ms": [round(v, 3) for v in self._handoff_ms],
+            "migrated_streams": self._migrated_streams,
+            "migrated_uids": list(self._migrated_uids),
+            "migration_fallbacks": self._migration_fallbacks,
+            "recompute_tokens_saved": self._recompute_tokens_saved,
+            "restore_ms": [round(v, 3) for v in self._restore_ms],
             "drain_events": list(self._drain_events),
             "dead_events": list(self._dead_events),
             "replicas": self.states(),
@@ -837,6 +947,8 @@ def replica_worker(spec: dict):
             block_size=spec.get("block_size", 8),
             max_new_tokens=spec.get("max_new_tokens", 16),
             journal_dir=os.path.join(root, "journal"),
+            kv_bits=spec.get("kv_bits", 16),
+            kv_snapshot=spec.get("kv_snapshot"),
             preflight=False))
     throttle_s = spec.get("throttle_ms", 0) / 1e3
     try:
@@ -872,7 +984,14 @@ def replica_worker(spec: dict):
                     temperature=rspec.get("temperature", 1.0),
                     do_sample=rspec.get("do_sample", False),
                     seed=rspec.get("seed", 0), uid=rspec["uid"])
-                srv.submit(req)      # journaled durably ...
+                snap = rspec.get("snapshot_dir")
+                if snap:
+                    # restore-first migration: seat the dead sibling's
+                    # KV image (or fall back to recompute inside);
+                    # journals the submit durably either way
+                    srv.submit_restored(req, snap)
+                else:
+                    srv.submit(req)  # journaled durably ...
                 os.unlink(path)      # ... BEFORE the inbox entry dies
             progressed = srv.step()
             if throttle_s:

@@ -69,6 +69,7 @@ slot assignment, or what else shares the batch (tested:
 
 import dataclasses
 import os
+import shutil
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -109,6 +110,15 @@ class QueueFullError(ServingError):
     """``submit()`` refused: the queue is at its high watermark under
     ``overload: reject`` (callers can distinguish load shedding from a
     malformed request, which raises ``ValueError``)."""
+
+
+class KVRestoreError(ServingError):
+    """A KV snapshot could not be restored into this engine (torn or
+    corrupt image, mismatched geometry, no capacity).  Always caught by
+    :meth:`ServingEngine.submit_restored`, which degrades the stream to
+    the plain recompute queue with a typed ``migration_fallback``
+    monitor event — the error type exists so that fallback is a
+    decision, never an accident."""
 
 
 class ServingStalledError(ServingError):
@@ -210,6 +220,81 @@ def ngram_draft(history, k: int, ngram: int):
     return out
 
 
+# ------------------------------------------- KV snapshot/migration config
+KV_SNAPSHOT_DIR = "kv_snapshots"
+
+
+def stream_snapshot_dir(journal_dir: str, uid: int) -> str:
+    """On-disk home of one stream's committed KV snapshot images —
+    beside the request journal, one atomic-checkpoint ``save_dir`` per
+    uid (tags inside, newest = deepest decode position), so a router
+    reaches a dead replica's snapshots exactly the way it already
+    reaches its journal."""
+    return os.path.join(journal_dir, KV_SNAPSHOT_DIR, f"uid-{int(uid):08d}")
+
+
+@dataclasses.dataclass
+class KVSnapshotConfig:
+    """The ``serving.kv_snapshot`` block (docs/serving.md#kv-migration).
+
+    Off by default.  Arming needs ``journal_dir``: snapshots only make
+    sense where a journal already makes the uid durable, and they live
+    beside it.  Everything here is host-side — the compiled decode step
+    is byte-identical armed vs off (PR-9 discipline, asserted by the
+    tier-1 jaxpr-equality test)."""
+    every_tokens: int = 32    # per-stream cadence, in emitted tokens
+    keep_n: int = 2           # retained images per stream (the
+    #                           checkpoint.keep_n mirror; retention's
+    #                           terminal half is deletion at finish/close)
+    export_on_evict: bool = True  # final image at a DEADLINE eviction —
+    #                               the partial work stays restorable
+    verify: str = "full"      # manifest level a restore demands:
+    #                           full | size | off (per-block digests
+    #                           are always checked)
+
+    def __post_init__(self):
+        assert self.every_tokens >= 1, \
+            f"kv_snapshot.every_tokens must be >= 1, got {self.every_tokens}"
+        assert self.keep_n >= 1, \
+            f"kv_snapshot.keep_n must be >= 1, got {self.keep_n}"
+        assert self.verify in ("full", "size", "off"), \
+            f"kv_snapshot.verify must be full|size|off, got {self.verify!r}"
+
+    @classmethod
+    def from_value(cls, v):
+        """None/False → off; True → defaults; dict → the JSON block."""
+        if not v:
+            return None
+        if v is True:
+            return cls()
+        if isinstance(v, cls):
+            return v
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(v) - known
+        if unknown:
+            raise ValueError(
+                f"unknown serving.kv_snapshot keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        return cls(**v)
+
+    def describe(self) -> dict:
+        return {"enabled": True, "every_tokens": self.every_tokens,
+                "keep_n": self.keep_n,
+                "export_on_evict": self.export_on_evict,
+                "verify": self.verify,
+                "handoff": "restore-first, recompute-fallback",
+                "wire_format": "int8+scales block image, per-block sha256"}
+
+
+def describe_kv_snapshot(value=None) -> dict:
+    """Resolved snapshot/migration policy for ``bin/ds_report``."""
+    kvs = KVSnapshotConfig.from_value(value)
+    if kvs is None:
+        return {"enabled": False,
+                "defaults_when_armed": KVSnapshotConfig().describe()}
+    return kvs.describe()
+
+
 @dataclasses.dataclass
 class ServingConfig:
     """Knobs for one serving deployment (docs/serving.md has the
@@ -261,6 +346,12 @@ class ServingConfig:
     # vs off (--audit-step serving-lifecycle proves it).
     sanitize: Optional[bool] = None
     sanitize_halt: bool = True      # raise at the first finding
+    # ---- KV snapshot/migration (docs/serving.md#kv-migration) ----
+    # None/false = off; true = defaults; or the JSON block
+    # {"every_tokens": 32, "keep_n": 2, "export_on_evict": true,
+    # "verify": "full"}.  Needs journal_dir (images live beside the
+    # journal); restore-first crash handoff reads them via the router.
+    kv_snapshot: Any = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServingConfig":
@@ -407,8 +498,23 @@ class ServingEngine:
                            "(DSTPU31x lifecycle checks, halt="
                            f"{config.sanitize_halt})")
 
+        # KV snapshot/migration (docs/serving.md#kv-migration): periodic
+        # per-stream block images beside the journal, restore-first crash
+        # handoff.  Off by default; host-side only.
+        self.kvs = KVSnapshotConfig.from_value(config.kv_snapshot)
+        if self.kvs is not None and not config.journal_dir:
+            raise ValueError(
+                "serving.kv_snapshot needs journal_dir: snapshot images "
+                "live beside the request journal, and a snapshot without "
+                "a durable uid is unrestorable (docs/serving.md#kv-"
+                "migration)")
+        # restore-path compile warmup fires after the FIRST decode step
+        # (see _warm_restore_path for why it cannot run here)
+        self._kv_warm_pending = self.kvs is not None
+
         S = config.batch_slots
         self._slots: List[Optional[_Slot]] = [None] * S
+        self._snap_last = np.zeros((S,), np.int32)  # ngen at last snapshot
         self._tables = np.zeros((S, self.nb_max), np.int32)
         self._lengths = np.zeros((S,), np.int32)
         self._toks = np.zeros((S,), np.int32)
@@ -441,6 +547,12 @@ class ServingEngine:
         # ---- resilience state (docs/serving.md#resilience) ----
         self._outcomes = {k: 0 for k in OUTCOMES}
         self._requeued_total = 0
+        # KV migration accounting (docs/serving.md#kv-migration)
+        self._kv_snapshots_total = 0
+        self._kv_migrated_total = 0
+        self._kv_fallback_total = 0
+        self._kv_tokens_saved_total = 0
+        self._kv_restore_ms: List[float] = []
         # (terminal, bad) totals at the last error_rate emission — the
         # SLO engine's windowed error-rate series (monitor/slo.py)
         self._err_window_last = (0, 0)
@@ -1137,6 +1249,264 @@ class ServingEngine:
                 self._sanitizer.on_quarantine(blocks, uid=req.uid)
             self._set_blocks(blocks, poison=True)
 
+    # ---------------------- KV snapshot/restore (docs/serving.md#kv-migration)
+    def _snapshot_slot(self, slot: int) -> str:
+        """Export one live slot's KV blocks + stream state as a committed
+        snapshot image under ``stream_snapshot_dir(journal_dir, uid)``:
+        stage ``image.npz``/``image.json``, manifest, publish rename
+        (``checkpoint/atomic.py`` — a torn write is detectable, never
+        restorable), then apply ``keep_n`` retention.  Entirely
+        host-side: the compiled decode step never sees any of it."""
+        from ..checkpoint import atomic
+        s = self._slots[slot]
+        uid = s.req.uid
+        ngen = int(self._ngen[slot])
+        sdir = stream_snapshot_dir(self.config.journal_dir, uid)
+        with jax.set_mesh(self.engine.mesh):
+            image = pk.export_block_image(
+                self.pool, s.blocks, quant_block=self.config.kv_quant_block)
+        meta = {
+            # atomic.py's newest-first ordering key: the decode position
+            "global_steps": ngen,
+            "stream": {
+                "uid": int(uid),
+                "prompt": [int(t) for t in np.asarray(s.req.tokens)],
+                "out_tokens": [int(t) for t in s.out_tokens],
+                "max_new_tokens": int(s.max_new),
+                "seed": int(s.req.seed),
+                "temperature": float(s.req.temperature),
+                "do_sample": bool(s.req.do_sample),
+                "num_blocks": len(s.blocks),
+                "block_size": int(self.config.block_size),
+                "kv_bits": int(self.config.kv_bits)}}
+        final = pk.save_block_image(sdir, f"snap-{ngen:06d}", image, meta)
+        keep = self.kvs.keep_n if self.kvs is not None else 1
+        atomic.rotate_checkpoints(sdir, keep, level="size")
+        self._snap_last[slot] = ngen
+        self._kv_snapshots_total += 1
+        return final
+
+    def _snapshot_slot_safe(self, slot: int):
+        """Cadence wrapper: a failed snapshot must not take serving down
+        — the stream simply stays recompute-only at migration time.  An
+        :class:`fault.InjectedCrash` (a simulated kill, e.g. the
+        ``kv_snapshot_torn`` site) propagates like the real thing."""
+        try:
+            self._snapshot_slot(slot)
+        except Exception as e:
+            logger.warning(
+                f"serving: kv snapshot of uid {self._slots[slot].req.uid} "
+                f"failed ({e}); stream stays recompute-only")
+
+    def _delete_stream_snapshots(self, uid: int):
+        """Retention's terminal half: a finished uid's images are dead
+        weight — nothing ever restores a completed stream."""
+        if not self.config.journal_dir:
+            return
+        sdir = stream_snapshot_dir(self.config.journal_dir, uid)
+        if os.path.isdir(sdir):
+            shutil.rmtree(sdir, ignore_errors=True)
+
+    def _cleanup_snapshot_dirs(self):
+        """``close()``'s retention half: drop every stream's images
+        except those of still-pending uids (a drain timeout leaves their
+        requests journaled in-flight, and a restart or a router handoff
+        may still restore them).  Without this, nothing owns snapshot
+        retention once the engine is gone."""
+        if not self.config.journal_dir:
+            return
+        root = os.path.join(self.config.journal_dir, KV_SNAPSHOT_DIR)
+        if not os.path.isdir(root):
+            return
+        keep = {int(u) for u, r in self.results.items()
+                if r["outcome"] is None}
+        for name in os.listdir(root):
+            try:
+                uid = int(name.split("-", 1)[1])
+            except (IndexError, ValueError):
+                continue         # not ours; never delete what we don't own
+            if uid not in keep:
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+        try:
+            os.rmdir(root)       # only when empty
+        except OSError:  # dstpu: disable=DSTPU002 (non-empty root is the signal)
+            pass
+
+    def submit_restored(self, req: Request, snapshot_dir: str) -> dict:
+        """Restore-first admission for a migrated stream: journal the
+        request durably on THIS engine (its submit record lives on the
+        dead replica's journal, not here), then try to seat it directly
+        from ``snapshot_dir`` — a committed image of the dead replica's
+        KV — so only the post-snapshot suffix re-decodes
+        (token-identical: sampling is a pure function of
+        ``(seed, token_index)``).  ANY restore defect — torn or corrupt
+        image, wrong geometry, no free slot or blocks — degrades loudly
+        to the plain recompute queue with a typed ``migration_fallback``
+        monitor event.  The uid is never lost (journaled before the
+        attempt) and never duplicated (either seated OR queued, never
+        both).
+
+        Returns ``{"uid", "restored", "restore_ms", "tokens_saved",
+        "reason"}`` (``reason`` set on fallback)."""
+        uid = self.submit(req, _requeue=True)
+        if self.journal is not None:
+            dl = (req.deadline_ms if req.deadline_ms is not None
+                  else self.config.deadline_ms)
+            self.journal.submit(req, deadline_ms=dl)
+        t0 = time.perf_counter()
+        reason, saved = None, 0
+        try:
+            saved = self._restore_stream(req, snapshot_dir)
+            restored = True
+        except (pk.BlockImageError, KVRestoreError) as e:
+            restored, reason = False, str(e)
+        ms = (time.perf_counter() - t0) * 1e3
+        if restored:
+            # submit() queued the request; the restore seated it
+            # directly, so unqueue it — seated OR queued, never both
+            assert self.queue and self.queue[-1] is req
+            self.queue.pop()
+            self._kv_migrated_total += 1
+            self._kv_tokens_saved_total += saved
+            self._kv_restore_ms.append(ms)
+        else:
+            self._kv_fallback_total += 1
+            logger.warning(
+                f"serving: KV restore of uid {uid} fell back to recompute "
+                f"({reason}) — typed migration_fallback "
+                "(docs/serving.md#kv-migration)")
+            if self.monitor.armed:
+                self.monitor.trace("migration_fallback", step=self._steps,
+                                   uid=int(uid), reason=str(reason)[:200])
+        if self.journal is not None:
+            # informational for replay; the router's poll channel for
+            # subprocess replicas (ProcessReplica tails it)
+            self.journal.record("restore", uid=int(uid), restored=restored,
+                                restore_ms=round(ms, 3), tokens_saved=saved)
+            self.journal.flush()
+        return {"uid": uid, "restored": restored,
+                "restore_ms": round(ms, 3), "tokens_saved": saved,
+                "reason": reason}
+
+    def _warm_restore_path(self):
+        """Compile-warm the block-image round-trip against the LIVE
+        pool, once, right after the first decode step.  The import
+        scatter's trace cache keys on the pool's sharding, and the
+        first decode step replaces the init-time placement with the
+        decode jit's output sharding — an init-time warm is invalidated
+        by the very first step.  pad_to pins the scatter to one
+        nb_max-wide shape, so this single round-trip covers every
+        future restore regardless of stream depth (measured ~130-650 ms
+        cold vs ~5 ms warm — latency that otherwise lands inside a
+        crash handoff's restore window).  Block 0 is the scratch block,
+        garbage by design, so rewriting it with its own (de)quantized
+        image is inert."""
+        with jax.set_mesh(self.engine.mesh):
+            warm = pk.export_block_image(
+                self.pool, [pk.SCRATCH_BLOCK],
+                quant_block=self.config.kv_quant_block)
+            self.pool = pk.import_block_image(
+                self.pool, [pk.SCRATCH_BLOCK], warm, pad_to=self.nb_max)
+
+    def _restore_stream(self, req: Request, snapshot_dir: str) -> int:
+        """Seat ``req`` directly from a committed image: verify manifest
+        + per-block digests, allocate fresh blocks, scatter the image
+        into the pool, and resume decode at the snapshot's exact
+        position.  Returns the recompute tokens saved (prompt prefill +
+        already-emitted decode steps).  Raises
+        :class:`KVRestoreError`/:class:`pk.BlockImageError` on any
+        defect — :meth:`submit_restored` owns the fallback."""
+        # a survivor restores even when it doesn't snapshot itself
+        kvs = self.kvs or KVSnapshotConfig()
+        image, meta = pk.load_block_image(snapshot_dir, verify=kvs.verify)
+        stream = (meta or {}).get("stream")
+        if not stream:
+            raise KVRestoreError(
+                f"snapshot {snapshot_dir} carries no stream metadata")
+        if int(stream["uid"]) != int(req.uid):
+            raise KVRestoreError(
+                f"snapshot is of uid {stream['uid']}, not {req.uid}")
+        prompt = np.asarray(stream["prompt"], np.int32)
+        if not np.array_equal(prompt, np.asarray(req.tokens, np.int32)):
+            raise KVRestoreError(
+                "snapshot prompt differs from the request being restored")
+        out_tokens = [int(t) for t in stream["out_tokens"]]
+        if not out_tokens:
+            raise KVRestoreError("snapshot holds no emitted tokens")
+        if int(stream["block_size"]) != self.config.block_size:
+            raise KVRestoreError(
+                f"snapshot block_size {stream['block_size']} != pool "
+                f"{self.config.block_size}")
+        new = int(req.max_new_tokens)
+        nb = pk.blocks_needed(prompt.size + new, self.config.block_size)
+        if int(stream["num_blocks"]) != nb:
+            raise KVRestoreError(
+                f"snapshot covers {stream['num_blocks']} block(s); this "
+                f"request needs {nb}")
+        free = [i for i, sl in enumerate(self._slots) if sl is None]
+        if not free:
+            raise KVRestoreError("no free slot for restore")
+        blocks = self.allocator.alloc(nb)
+        if blocks is None:
+            raise KVRestoreError(
+                f"allocator cannot serve {nb} block(s) "
+                f"({self.allocator.free_blocks} free)")
+        if self._sanitizer is not None:
+            # imported blocks enter the shadow FSM owned-and-referenced,
+            # exactly like an admit (DSTPU31x)
+            self._sanitizer.on_alloc(blocks, uid=req.uid)
+        slot = free[0]
+        try:
+            fault.site("serving.crash_during_restore")
+            with jax.set_mesh(self.engine.mesh):
+                self.pool = pk.import_block_image(
+                    self.pool, blocks, image, pad_to=self.nb_max)
+            s = _Slot(req, blocks, int(prompt.size), new)
+            s.out_tokens = list(out_tokens)
+            s.hist.extend(out_tokens)
+            self._slots[slot] = s
+            self._tables[slot] = 0
+            self._tables[slot, :len(blocks)] = blocks
+            if self._sanitizer is not None:
+                self._sanitizer.on_attach(req.uid, blocks)
+        except BaseException:
+            # UNLIKE _admit's prefill edge, cleanup runs for
+            # BaseException here too: a failed restore leaves the
+            # SURVIVOR alive — it is the migration that died, not this
+            # process — so the blocks must go home or this engine leaks
+            # them for its whole remaining life (DSTPU312 at close).  A
+            # real kill doesn't care either way: the allocator dies with
+            # the process.
+            sl = self._slots[slot]
+            if ((sl is None or sl.blocks is not blocks)
+                    and all(self.allocator.is_allocated(b)
+                            for b in blocks)):
+                self.allocator.free(blocks)
+                if self._sanitizer is not None:
+                    self._sanitizer.on_free(blocks, uid=req.uid)
+            raise
+        # decode resumes where the snapshot stopped: lengths trails
+        # out_tokens by the one token whose KV the NEXT step writes
+        # (_start's invariant), and sampling continues at
+        # fold_in(seed, ngen) — token-identical to the dead replica's
+        # stream by the determinism contract
+        self._lengths[slot] = int(prompt.size) + len(out_tokens) - 1
+        self._toks[slot] = out_tokens[-1]
+        self._seeds[slot] = req.seed
+        self._ngen[slot] = len(out_tokens)
+        self._temps[slot] = req.temperature
+        self._flags[slot] = req.do_sample
+        self._snap_last[slot] = len(out_tokens)
+        rec = self.results[req.uid]
+        rec["t_first"] = time.monotonic()
+        if (len(out_tokens) >= new
+                or out_tokens[-1] == self.config.eos_token_id):
+            # a snapshot taken exactly at the stream's end (an
+            # export_on_evict image can be): finish immediately instead
+            # of decoding past the budget
+            self._finish(slot)
+        return int(prompt.size) + len(out_tokens)
+
     def _set_blocks(self, blocks: List[int], poison: bool):
         """Pool edit over a block list, outside the decode step:
         ``poison=True`` NaN-fills the payload (int8 pools NaN the fp32
@@ -1178,6 +1548,14 @@ class ServingEngine:
 
     def _finish(self, slot: int, outcome: str = OK):
         s = self._slots[slot]
+        if (self.kvs is not None and self.kvs.export_on_evict
+                and outcome == DEADLINE and s.out_tokens):
+            # on-evict export: a deadline eviction keeps its partial
+            # tokens — one final image (while the blocks are still ours)
+            # keeps the partial KV restorable too.  Every OTHER terminal
+            # outcome deletes the stream's images below: nothing ever
+            # restores a completed uid.
+            self._snapshot_slot_safe(slot)
         if outcome == POISONED:
             # quarantine eviction: scrub the non-finite rows out of the
             # blocks BEFORE they return to the free list
@@ -1220,7 +1598,14 @@ class ServingEngine:
                            generated=len(s.out_tokens))
         if self.journal is not None:
             self.journal.finish(s.req.uid, outcome, rec["tokens"])
+        if not (self.kvs is not None and self.kvs.export_on_evict
+                and outcome == DEADLINE):
+            # eos-evict (and every non-resumable outcome) owns deleting
+            # the stream's on-disk images — the retention fix: before
+            # this, nothing did
+            self._delete_stream_snapshots(s.req.uid)
         self._slots[slot] = None
+        self._snap_last[slot] = 0
         self._tables[slot] = 0
         self._lengths[slot] = 0
         self._toks[slot] = 0
@@ -1334,6 +1719,9 @@ class ServingEngine:
                 else:
                     nxt, poisoned, self.pool = \
                         self._decode(*self._decode_args())
+        if self._kv_warm_pending:
+            self._kv_warm_pending = False
+            self._warm_restore_path()
         with mon.span("sample_join"):
             if spec is not None:
                 out = np.asarray(out)                   # (B, k+1)
@@ -1424,6 +1812,16 @@ class ServingEngine:
                     # — the slot goes back to work that can still meet
                     # its budget
                     self._finish(i, outcome=DEADLINE)
+                    continue
+                if (self.kvs is not None
+                        and int(self._ngen[i]) - int(self._snap_last[i])
+                        >= self.kvs.every_tokens):
+                    # periodic per-stream image at the configured token
+                    # cadence (docs/serving.md#kv-migration) — host-side
+                    # export + atomic commit; the compiled step above
+                    # never changes
+                    with mon.span("kv_snapshot"):
+                        self._snapshot_slot_safe(i)
             if spec is not None and active:
                 # tokens-per-step EMA: the predictive deadline gate's
                 # per-token denominator under speculation
@@ -1509,6 +1907,13 @@ class ServingEngine:
                     "breaker_open": int(self._breaker_open),
                     "completed_total": self._completed_total,
                     "generated_total": self._generated_total}
+        if (self.kvs is not None or self._kv_migrated_total
+                or self._kv_fallback_total):
+            # KV migration counters (docs/serving.md#kv-migration):
+            # summed fleet-wide by ds_fleet like every other counter
+            counters["kv_snapshots_total"] = self._kv_snapshots_total
+            counters["migrated_streams_total"] = self._kv_migrated_total
+            counters["migration_fallbacks_total"] = self._kv_fallback_total
         gauges = {}
         # windowed error rate from the outcome counters (the SLO
         # engine's error-budget series, docs/monitoring.md#slo-tracking):
@@ -1817,6 +2222,11 @@ class ServingEngine:
         self._err_window_last = (0, 0)
         self._spec_proposed_total = 0
         self._spec_accepted_total = 0
+        self._kv_snapshots_total = 0
+        self._kv_migrated_total = 0
+        self._kv_fallback_total = 0
+        self._kv_tokens_saved_total = 0
+        self._kv_restore_ms = []
         self._traces_emitted = 0
         self._recent = RingBuffer(max(1, int(self.config.poison_window)))
 
@@ -1858,6 +2268,20 @@ class ServingEngine:
                 "p999": round(p["p999"], 2)}
         if self._sanitizer is not None:
             out["sanitizer"] = self._sanitizer.stats()
+        if (self.kvs is not None or self._kv_migrated_total
+                or self._kv_fallback_total):
+            kv = {"snapshots": self._kv_snapshots_total,
+                  "migrated_streams": self._kv_migrated_total,
+                  "migration_fallbacks": self._kv_fallback_total,
+                  "recompute_tokens_saved": self._kv_tokens_saved_total}
+            if self._kv_restore_ms:
+                kv["restore_ms"] = {
+                    "mean": round(sum(self._kv_restore_ms)
+                                  / len(self._kv_restore_ms), 3),
+                    "max": round(max(self._kv_restore_ms), 3)}
+            if self.kvs is not None:
+                kv["policy"] = self.kvs.describe()
+            out["kv_snapshot"] = kv
         return out
 
     def compile_report(self):
@@ -1880,6 +2304,10 @@ class ServingEngine:
                 # after a clean drain every block must be home —
                 # anything still allocated is a leak (DSTPU312)
                 self._sanitizer.on_close()
+            # snapshot retention at teardown: finished uids' images go;
+            # journaled still-pending uids keep theirs (a restart or a
+            # router handoff may restore them)
+            self._cleanup_snapshot_dirs()
         finally:
             try:
                 if self.journal is not None:

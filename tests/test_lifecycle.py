@@ -326,3 +326,43 @@ def test_interleave_extended_sweep_clean(tmp_path):
     assert rep["total_permutations"] == 5040
     assert rep["explored"] == 5040
     assert rep["ok"] is True
+
+
+def test_interleave_migration_sweep_clean(tmp_path):
+    """The migration alphabet (snapshot / torn-snapshot / crash /
+    broken-restore / journal-finish interleaved with a pump): every
+    one of the 720 orderings preserves exactly-once AND the
+    no-stale-tokens oracle — a restored stream never re-emits a token
+    index the snapshot already covered, and a torn (uncommitted) image
+    is never the thing a survivor restores from."""
+    rep = il.explore(scenario=il.migration_scenario(),
+                     workdir=str(tmp_path))
+    assert rep["scenario"] == "kv-migration"
+    assert rep["total_permutations"] == 720
+    assert rep["explored"] == 720
+    assert rep["violations"] == 0 and rep["findings"] == []
+    assert rep["ok"] is True
+    assert len(rep["events"]) == 6
+
+
+def test_interleave_migration_detects_stale_tokens(tmp_path):
+    """Detection path of the no-stale-tokens oracle: bump the recorded
+    snapshot position ABOVE where the survivor actually resumes, so a
+    real restore re-emits 'already-durable' indices — the sweep must
+    flag it, not bless it.  Trimmed to the 4 events that guarantee at
+    least one ordering with a live restore (snapshot < crash < pump)."""
+    scen = il.migration_scenario()
+    ev = dict(scen["events"])
+
+    def poison_pos(w):
+        for uid in list(w.get("snap_pos") or {}):
+            w["snap_pos"][uid] += 5
+    scen["events"] = [("snapshot-a", ev["snapshot-a"]),
+                      ("crash-a", ev["crash-a"]),
+                      ("pump", ev["pump"]),
+                      ("poison-pos", poison_pos)]
+    scen["name"] = "kv-migration-stale"
+    rep = il.explore(scenario=scen, workdir=str(tmp_path))
+    assert rep["explored"] == 24
+    assert not rep["ok"] and rep["violations"] > 0
+    assert any("no-stale-tokens" in f.message for f in rep["findings"])
