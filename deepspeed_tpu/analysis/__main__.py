@@ -178,10 +178,14 @@ def _audit_decode_step():
     zero host callbacks (DSTPU201), donation declared-vs-honored on the
     KV pool/cache (DSTPU204), and no weak-scalar recompile hazards
     (DSTPU205) — the serving hot loop must stay a single clean
-    executable (docs/serving.md)."""
+    executable (docs/serving.md).  The serving step is audited with the
+    prefix cache ARMED (docs/serving.md#prefix-sharing): sharing is
+    pure host-side block bookkeeping, so the armed decode jaxpr must be
+    byte-identical to the cache-off trace."""
     import numpy as np
     import jax
     import jax.numpy as jnp
+    from .findings import Finding
     from .jaxpr_audit import audit_fn
     from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
     from deepspeed_tpu.inference import (InferenceEngine, ServingEngine,
@@ -194,14 +198,40 @@ def _audit_decode_step():
     params = model.init(jax.random.PRNGKey(0))
     findings = []
     for kv_bits in (16, 8):
+        scfg = dict(batch_slots=2, block_size=8, kv_bits=kv_bits,
+                    max_new_tokens=4, preflight=False)
+        plain = ServingEngine(model=model, params=params,
+                              config=ServingConfig(**scfg))
+        plain._build_decode()
+        plain_jaxpr = str(jax.make_jaxpr(plain._decode)(
+            *plain._decode_args()))
+        plain.close()
         srv = ServingEngine(
             model=model, params=params,
-            config=ServingConfig(batch_slots=2, block_size=8,
-                                 kv_bits=kv_bits, max_new_tokens=4,
-                                 preflight=False))
-        # one request warms the executables audit_fn will inspect
-        srv.run([Request(tokens=np.arange(5), max_new_tokens=2)])
+            config=ServingConfig(prefix_cache=True, **scfg))
         srv._build_decode()
+        if str(jax.make_jaxpr(srv._decode)(
+                *srv._decode_args())) != plain_jaxpr:
+            findings.append(Finding(
+                "DSTPU201", "error",
+                "--audit-step decode: arming serving.prefix_cache "
+                f"CHANGED the traced decode step (kv_bits={kv_bits}) — "
+                "sharing must stay host-side block bookkeeping, never "
+                "program content", eqn_path="serving/jaxpr-equality"))
+        # a shared-prefix pair warms the executables audit_fn will
+        # inspect AND takes a real radix-cache hit, so the step audited
+        # below is the one that served shared blocks
+        srv.run([Request(tokens=np.arange(12), max_new_tokens=2, uid=1),
+                 Request(tokens=np.concatenate(
+                     [np.arange(8), np.array([33, 34, 35, 36])]),
+                     max_new_tokens=2, uid=2)])
+        if not srv.stats()["prefix_cache"]["requests_hit"]:
+            findings.append(Finding(
+                "DSTPU200", "warning",
+                "--audit-step decode: the shared-prefix pair produced "
+                f"no radix-cache hit (kv_bits={kv_bits}) — the audited "
+                "step never exercised sharing",
+                eqn_path="serving/prefix-cache"))
         report = audit_fn(srv._decode, *srv._decode_args(),
                           donate_argnums=(1,), mesh=srv.engine.mesh)
         for f in report.findings:
@@ -315,15 +345,17 @@ def _audit_serving_lifecycle():
       synthetically against a :class:`ShadowSanitizer`, must be caught
       (a sanitizer that misses a seeded double-free proves nothing
       about a clean run);
-    - **interleaving sweep** — the full 720-ordering
+    - **interleaving sweeps** — the full 720-ordering
       :func:`~.interleave.crash_handoff_scenario` permutation sweep
-      over the real router must report zero violations."""
+      over the real router, and the 720-ordering
+      :func:`~.interleave.prefix_sharing_scenario` refcount sweep over
+      the real allocator + radix cache, must report zero violations."""
     import numpy as np
     import jax
     import jax.numpy as jnp
     from .findings import Finding
     from . import sanitize
-    from .interleave import explore
+    from .interleave import explore, prefix_sharing_scenario
     from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
     from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
                                          Request)
@@ -356,6 +388,9 @@ def _audit_serving_lifecycle():
     seeded(sanitize.SCRUB_REFERENCED,
            lambda s: (s.on_alloc([3]), s.on_attach(1, [3]),
                       s.on_scrub([3], uid=2)))
+    seeded(sanitize.SCRUB_SHARED,
+           lambda s: (s.on_alloc([3]), s.on_share([3]),
+                      s.on_scrub([3], uid=1)))
 
     # ---- jaxpr parity + token identity: armed vs off ----------------
     cfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
@@ -410,17 +445,18 @@ def _audit_serving_lifecycle():
             "ZERO sanitizer checks — the hooks are not wired",
             eqn_path="sanitize/clean-run"))
 
-    # ---- interleaving sweep -----------------------------------------
-    report = explore()
-    if not report["ok"]:
-        findings.extend(report["findings"])
-    if report["explored"] != report["total_permutations"]:
-        findings.append(Finding(
-            "DSTPU200", "error",
-            f"--audit-step serving-lifecycle: interleave sweep covered "
-            f"{report['explored']}/{report['total_permutations']} "
-            f"orderings — the sweep must be exhaustive",
-            eqn_path="interleave/coverage"))
+    # ---- interleaving sweeps ----------------------------------------
+    for report in (explore(), explore(prefix_sharing_scenario())):
+        if not report["ok"]:
+            findings.extend(report["findings"])
+        if report["explored"] != report["total_permutations"]:
+            findings.append(Finding(
+                "DSTPU200", "error",
+                f"--audit-step serving-lifecycle: "
+                f"{report['scenario']} interleave sweep covered "
+                f"{report['explored']}/{report['total_permutations']} "
+                f"orderings — the sweep must be exhaustive",
+                eqn_path="interleave/coverage"))
     return findings
 
 

@@ -50,7 +50,8 @@ DEFAULT_BAND = 0.2         # ±20%: this container's measured CPU-tier noise
 HIGHER_BETTER = ("tokens_per_sec", "tok_s", "samples_per_sec", "mfu",
                  "fraction_of_bound", "achieved_frac", "reduction_x",
                  "bound_tokens_per_sec", "decode_tokens_per_sec",
-                 "migrated_streams", "recompute_tokens_saved")
+                 "migrated_streams", "recompute_tokens_saved",
+                 "prefix_hit_rate", "max_streams")
 LOWER_BETTER_SUFFIX = ("_ms", "_s")
 LOWER_BETTER = ("ms_per_token", "overhead_pct", "host_pct")
 LOWER_BETTER_BYTES = ("wire_bytes", "bytes_per_step")
@@ -76,6 +77,11 @@ LOWER_BETTER_SANITIZE = ("sanitizer_findings",)
 # corrupt, or unplaceable — growth is a robustness regression
 # (restore_ms gates via the _ms suffix rule)
 LOWER_BETTER_MIGRATION = ("migration_fallbacks",)
+# prefix-sharing family (docs/serving.md#prefix-sharing):
+# unique_block_frac is physical-over-logical block residency — a rise
+# means the radix cache is deduplicating LESS of the co-tenant KV
+# (prefix_hit_rate gates the other direction via HIGHER_BETTER)
+LOWER_BETTER_PREFIX = ("unique_block_frac",)
 # exact count contracts where ZERO is the baseline by design: any
 # growth regresses even though a relative band cannot gate it (the
 # zero-baseline report-never-regress policy below is for
@@ -92,7 +98,8 @@ def classify(key: str):
             return "higher"
     for name in (LOWER_BETTER + LOWER_BETTER_BYTES + LOWER_BETTER_MEM
                  + LOWER_BETTER_SLO + LOWER_BETTER_ROUTER
-                 + LOWER_BETTER_SANITIZE + LOWER_BETTER_MIGRATION):
+                 + LOWER_BETTER_SANITIZE + LOWER_BETTER_MIGRATION
+                 + LOWER_BETTER_PREFIX):
         if name in k:
             return "lower"
     if k.endswith(LOWER_BETTER_SUFFIX):

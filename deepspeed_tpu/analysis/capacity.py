@@ -67,7 +67,9 @@ KNOB_ADVICE = {
     "compiled_programs": "fewer live signatures: pin batch shapes / lower "
                          "prefill bucket count (smaller max_seq)",
     "paged_kv_pool": "kv_bits=8 halves pool bytes; shrink num_blocks / "
-                     "batch_slots / block_size",
+                     "batch_slots / block_size; serving.prefix_cache "
+                     "shares common-prefix blocks (admission then "
+                     "charges unique blocks only)",
     "host_master_fp32": "move the fp32 master to the NVMe swapper tier "
                         "(ROADMAP #4; runtime/swap_tensor/)",
     "host_grad_landing_fp32": "data_types.grad_accum_dtype=bf16 halves "
@@ -291,9 +293,36 @@ def replay_maxparams(doc, *, tolerance=REPLAY_TOLERANCE) -> dict:
 
 # ------------------------------------------------------------ serving capacity
 
+def request_unique_blocks(*, prompt_tokens, max_new_tokens, block_size,
+                          max_seq=None, shared_prefix_tokens=0) -> dict:
+    """THE per-request block math — the one function serving admission
+    (``ServingEngine._admit``), ``ds_mem --max-streams`` and the memory
+    ledger's shared/unique split all call, so the three can never
+    disagree (regression-pinned in tests/test_serving.py).
+
+    ``total_blocks`` is the classic cost (``paged_kv.blocks_needed`` of
+    prompt+generation).  ``shared_blocks`` is how many leading blocks a
+    prefix-cache hit of ``shared_prefix_tokens`` covers, clamped to
+    ``(prompt_tokens - 1) // block_size`` — the final prompt token (and
+    every position the decode step will WRITE) must land in a PRIVATE
+    block, the same clamp ``ServingEngine._prefix_match`` applies.
+    ``unique_blocks`` is what admission actually charges."""
+    bs = max(1, int(block_size))
+    prompt = max(1, int(prompt_tokens))
+    total_tokens = prompt + int(max_new_tokens)
+    if max_seq:
+        total_tokens = min(total_tokens, int(max_seq))
+    total = max(1, _ceil_div(total_tokens, bs))   # = pk.blocks_needed
+    shared = max(0, min(int(shared_prefix_tokens) // bs,
+                        (prompt - 1) // bs, total))
+    return {"total_blocks": total, "shared_blocks": shared,
+            "unique_blocks": total - shared}
+
+
 def serving_plan(*, n_layer, n_head, head_dim, max_seq, block_size=16,
                  kv_bits=16, quant_block=64, batch_slots=8, num_blocks=0,
-                 max_new_tokens=64, weight_bytes=0) -> dict:
+                 max_new_tokens=64, weight_bytes=0, prompt_tokens=None,
+                 shared_prefix_tokens=0) -> dict:
     """Closed-form serving memory plan mirroring ``paged_kv.init_pool``'s
     arithmetic exactly (tested equal to ``pool_bytes`` of a real pool):
     per-block bytes, total pool bytes for the configuration's block
@@ -316,14 +345,22 @@ def serving_plan(*, n_layer, n_head, head_dim, max_seq, block_size=16,
     else:
         per_tok = 2 * cell * BF16_BYTES
     per_block = n_layer * block_size * per_tok
-    blocks_per_request = _ceil_div(
-        min(max_seq, block_size + max_new_tokens), block_size)
+    # the unified per-request math (request_unique_blocks): the default
+    # prompt (one block) reproduces the classic
+    # ceil(min(max_seq, block_size + max_new) / block_size) exactly
+    ub = request_unique_blocks(
+        prompt_tokens=(block_size if prompt_tokens is None
+                       else prompt_tokens),
+        max_new_tokens=max_new_tokens, block_size=block_size,
+        max_seq=max_seq, shared_prefix_tokens=shared_prefix_tokens)
     return {
         "paged_kv_pool": per_block * num_blocks,
         "per_block_bytes": per_block,
         "num_blocks": num_blocks,
         "nb_max": nb_max,
-        "blocks_per_request": blocks_per_request,
+        "blocks_per_request": ub["total_blocks"],
+        "shared_prefix_blocks": ub["shared_blocks"],
+        "unique_blocks_per_request": ub["unique_blocks"],
         "weight_bytes": int(weight_bytes),
     }
 
@@ -336,11 +373,20 @@ def max_streams(plan: dict, budget_bytes, *, safety=0.92,
     allocates (the serving twin of :func:`max_params_b`)."""
     usable = budget_bytes * safety - plan["weight_bytes"] - workspace_bytes
     blocks = max(0, int(usable // plan["per_block_bytes"]) - 1)  # scratch
-    streams = blocks // plan["blocks_per_request"]
+    # prefix sharing amortizes the shared head ONCE across every stream;
+    # each stream then costs its UNIQUE blocks (the same
+    # request_unique_blocks split serving admission charges).  With no
+    # sharing, unique == blocks_per_request and this is the classic bound.
+    shared = int(plan.get("shared_prefix_blocks", 0))
+    unique = int(plan.get("unique_blocks_per_request",
+                          plan["blocks_per_request"]))
+    streams = max(0, blocks - shared) // max(1, unique)
     return {"budget_bytes": int(budget_bytes), "safety": safety,
             "usable_pool_bytes": max(0, int(usable)),
             "allocatable_blocks": blocks,
             "blocks_per_request": plan["blocks_per_request"],
+            "shared_prefix_blocks": shared,
+            "unique_blocks_per_request": unique,
             "max_streams": streams}
 
 
@@ -512,6 +558,14 @@ def main(argv=None):
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--kv-bits", type=int, default=16, choices=(8, 16))
     ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--prompt-tokens", type=int, default=None,
+                    help="per-request prompt length for --max-streams "
+                         "(default: one block)")
+    ap.add_argument("--shared-prefix-tokens", type=int, default=0,
+                    help="tokens of common prompt prefix served from the "
+                         "radix cache (serving.prefix_cache): the shared "
+                         "head is charged ONCE, each stream pays only "
+                         "its unique blocks")
     ap.add_argument("--weight-gb", type=float, default=0.0,
                     help="resident weight bytes to subtract from the "
                          "--max-streams budget")
@@ -541,15 +595,24 @@ def main(argv=None):
             n_layer=args.layers, n_head=args.heads, head_dim=args.head_dim,
             max_seq=args.max_seq, block_size=args.block_size,
             kv_bits=args.kv_bits, max_new_tokens=args.max_new,
-            weight_bytes=int(args.weight_gb * GIB))
+            weight_bytes=int(args.weight_gb * GIB),
+            prompt_tokens=args.prompt_tokens,
+            shared_prefix_tokens=args.shared_prefix_tokens)
         ms = max_streams(plan, args.budget_gb * GIB)
         out = {"plan": plan, **ms}
         if args.json:
             print(json.dumps(out, indent=2))
         else:
+            shared_note = ""
+            if ms["shared_prefix_blocks"]:
+                shared_note = (
+                    f" ({ms['shared_prefix_blocks']} shared prefix "
+                    f"block(s) charged once, "
+                    f"{ms['unique_blocks_per_request']} unique/stream)")
             print(f"ds_mem — serving capacity at {args.budget_gb:.1f} GB "
                   f"HBM:\n  per-block {plan['per_block_bytes']} B, "
-                  f"{ms['blocks_per_request']} block(s)/request\n"
+                  f"{ms['blocks_per_request']} block(s)/request"
+                  f"{shared_note}\n"
                   f"  max concurrent streams: {ms['max_streams']}")
         return 0
 

@@ -57,6 +57,7 @@ from ..utils.retry import RetryPolicy
 from .findings import Finding
 
 INTERLEAVE_VIOLATION = "DSTPU320"
+PREFIX_INTERLEAVE_VIOLATION = "DSTPU321"   # prefix-sharing refcount races
 
 
 class StepClock:
@@ -360,6 +361,208 @@ def migration_scenario():
     return {"name": "kv-migration", "build": build, "events": events}
 
 
+def prefix_sharing_scenario():
+    """Prefix-sharing refcount protocol explorer (``DSTPU321``).
+
+    The radix cache (docs/serving.md#prefix-sharing) adds a third class
+    of holder to every KV block — the cache's own reference, beside the
+    owning stream and any co-tenant readers — and its events race in
+    production exactly like the router's: a publish (at seat or at
+    finish), a co-tenant attach taking shares, a finish decref'ing, an
+    eviction pass under pool pressure, a cache clear at close.  This
+    scenario drives the REAL :class:`~..inference.paged_kv.BlockAllocator`
+    + :class:`~..inference.paged_kv.PrefixIndex` (no model, no router)
+    through every ordering of that alphabet — 6 events, 720 orderings —
+    and asserts the refcount contracts:
+
+    - **no torn refcount** — no ordering raises a double free, an
+      incref-of-free, or a free-of-scratch from legal protocol calls;
+    - **no reclaim-under-reader** — eviction and clear never physically
+      release a block a live tenant still holds;
+    - **cache lists only live blocks** — the index never maps a key to
+      a block whose refcount dropped to zero;
+    - **pool conservation** — after settle (finish both tenants, clear
+      the cache) every block is back on the free list and the logical
+      refcount sum is zero.
+
+    Tenant ``b``'s attach is CONDITIONAL on what the ordering already
+    made visible: after publish it takes the shared prefix via
+    ``match`` + ``incref``; before publish (or after a clear) it
+    degrades to a fully private allocation — both legal, both checked.
+    """
+    from ..inference import paged_kv as pk
+
+    BS = 4
+    PROMPT_A = tuple(range(1, 13))                  # 3 full blocks
+    PROMPT_B = PROMPT_A[:8] + (91, 92, 93, 94)      # shares 2, diverges
+
+    def _live_held(w):
+        held = set()
+        for name in ("a", "b"):
+            t = w[name]
+            if not t["done"] and t["blocks"]:
+                held.update(t["blocks"])
+        return held
+
+    def _inv(w, label):
+        # invariants re-checked after EVERY event, so a violation names
+        # the event that introduced it, not the settle that found it
+        alloc, idx = w["alloc"], w["idx"]
+        for name in ("a", "b"):
+            t = w[name]
+            if t["done"] or not t["blocks"]:
+                continue
+            for b in t["blocks"]:
+                if not alloc.is_allocated(b):
+                    w["violations"].append(
+                        f"{label}: live tenant {name!r} block {b} was "
+                        f"reclaimed out from under it")
+        for b in list(idx._by_block):
+            if alloc.refcount(b) < 1:
+                w["violations"].append(
+                    f"{label}: cache lists block {b} with refcount 0")
+
+    def _publish(w, label):
+        # index tenant a's full prompt blocks (publish-at-seat, or the
+        # publish-at-finish the settle/finish path replays)
+        t = w["a"]
+        if t["published"] or t["done"]:
+            return
+        parent = None
+        for i in range(len(t["prompt"]) // BS):
+            chunk = t["prompt"][i * BS:(i + 1) * BS]
+            try:
+                key = w["idx"].insert(parent, chunk, t["blocks"][i])
+            except ValueError as e:
+                w["violations"].append(
+                    f"{label}: unexpected refcount fault on insert: {e}")
+                return
+            if key is None:     # broken chain after a racing clear: legal
+                break
+            parent = key
+        t["published"] = True
+        _inv(w, label)
+
+    def _attach_b(w, label):
+        t = w["b"]
+        if t["blocks"] is not None:
+            return
+        limit = (len(PROMPT_B) - 1) // BS       # the write-safety clamp
+        m = w["idx"].match(PROMPT_B, BS, limit_blocks=limit)
+        shared = list(m["blocks"])
+        need = len(PROMPT_B) // BS - len(shared)
+        try:
+            w["alloc"].incref(shared)
+        except ValueError as e:
+            w["violations"].append(
+                f"{label}: incref of matched prefix failed: {e}")
+            return
+        fresh = w["alloc"].alloc(need)
+        if fresh is None:
+            w["violations"].append(
+                f"{label}: pool exhausted attaching tenant b "
+                f"(free={w['alloc'].free_blocks}, need={need})")
+            w["alloc"].free(shared)
+            return
+        t["blocks"] = shared + fresh
+        t["shared"] = len(shared)
+        _inv(w, label)
+
+    def _finish(w, name, label):
+        t = w[name]
+        if t["done"] or t["blocks"] is None:
+            return
+        if name == "a":
+            _publish(w, label)      # the engine publishes before freeing
+        try:
+            w["alloc"].free(t["blocks"])
+        except ValueError as e:
+            w["violations"].append(
+                f"{label}: torn refcount freeing tenant {name!r}: {e}")
+        t["done"] = True
+        _inv(w, label)
+
+    def build(workdir):
+        alloc = pk.BlockAllocator(10)           # 9 allocatable
+        idx = pk.PrefixIndex(alloc)
+        w = {"alloc": alloc, "idx": idx, "violations": [],
+             "a": {"prompt": PROMPT_A, "blocks": alloc.alloc(3),
+                   "published": False, "done": False},
+             "b": {"prompt": PROMPT_B, "blocks": None, "shared": 0,
+                   "done": False}}
+        return w
+
+    def ev_publish_a(w):
+        _publish(w, "publish-a")
+
+    def ev_attach_b(w):
+        _attach_b(w, "attach-b")
+
+    def ev_finish_a(w):
+        _finish(w, "a", "finish-a")
+
+    def ev_finish_b(w):
+        _finish(w, "b", "finish-b")
+
+    def ev_evict(w):
+        held = _live_held(w)
+        released = w["idx"].evict(3)
+        for b in released:
+            if b in held:
+                w["violations"].append(
+                    f"evict-pressure: eviction released block {b} a "
+                    f"live tenant still holds")
+        _inv(w, "evict-pressure")
+
+    def ev_clear(w):
+        held = _live_held(w)
+        try:
+            _, released = w["idx"].clear()
+        except ValueError as e:
+            w["violations"].append(
+                f"clear-cache: torn refcount clearing the index: {e}")
+            return
+        for b in released:
+            if b in held:
+                w["violations"].append(
+                    f"clear-cache: clear released block {b} a live "
+                    f"tenant still holds")
+        _inv(w, "clear-cache")
+
+    def settle(w):
+        # finish whatever the ordering left live, then drop the cache
+        if w["b"]["blocks"] is None:
+            _attach_b(w, "settle")
+        _finish(w, "a", "settle")
+        _finish(w, "b", "settle")
+        ev_clear(w)
+
+    def check(w):
+        viol = list(w["violations"])
+        alloc, idx = w["alloc"], w["idx"]
+        if alloc.used_blocks or alloc.free_blocks != alloc.num_blocks - 1:
+            viol.append(
+                f"pool not conserved after settle: used="
+                f"{alloc.used_blocks} free={alloc.free_blocks} of "
+                f"{alloc.num_blocks - 1}")
+        if alloc.logical_blocks:
+            viol.append(f"{alloc.logical_blocks} logical refcount(s) "
+                        f"survive settle — a holder never let go")
+        if len(idx):
+            viol.append(f"{len(idx)} cache entr(ies) survive clear")
+        return viol
+
+    events = [("publish-a", ev_publish_a),
+              ("attach-b", ev_attach_b),
+              ("finish-a", ev_finish_a),
+              ("finish-b", ev_finish_b),
+              ("evict-pressure", ev_evict),
+              ("clear-cache", ev_clear)]
+    return {"name": "prefix-sharing", "build": build, "events": events,
+            "settle": settle, "check": check,
+            "rule": PREFIX_INTERLEAVE_VIOLATION}
+
+
 # -------------------------------------------------------------- explore
 def _settle(w, max_iters=64):
     """Post-scenario service: the surviving replicas answer their
@@ -455,6 +658,9 @@ def explore(scenario=None, max_permutations=None, workdir=None):
     is a reproducer, not a shrug."""
     scenario = scenario or crash_handoff_scenario()
     labels = [lbl for lbl, _ in scenario["events"]]
+    settle = scenario.get("settle", _settle)
+    check = scenario.get("check", _check)
+    rule = scenario.get("rule", INTERLEAVE_VIOLATION)
     own_tmp = workdir is None
     if own_tmp:
         workdir = tempfile.mkdtemp(prefix="dstpu-interleave-")
@@ -470,10 +676,10 @@ def explore(scenario=None, max_permutations=None, workdir=None):
                 os.path.join(workdir, f"perm-{explored:05d}"))
             for _, fn in perm:
                 fn(w)
-            _settle(w)
-            for v in _check(w):
+            settle(w)
+            for v in check(w):
                 findings.append(Finding(
-                    INTERLEAVE_VIOLATION, "error",
+                    rule, "error",
                     f"[{' -> '.join(order)}] {v}",
                     eqn_path=f"interleave/{scenario['name']}",
                     extra={"order": order, "scenario": scenario["name"]}))

@@ -44,11 +44,19 @@ from . import Rule, register
 
 KV_BLOCK_FSM = {
     "name": "kv-block",
-    "states": ("free", "allocated", "quarantined"),
+    "states": ("free", "allocated", "quarantined", "shared", "cow"),
     "initial": "free",
     "transitions": {
         "free": ("allocated",),
-        "allocated": ("free", "quarantined"),
+        # prefix-cache sharing (PR 19): a second holder (co-tenant or the
+        # cache itself) promotes allocated -> shared; the block is
+        # read-only until every extra holder drops (shared -> allocated)
+        # or a diverging writer clones it (shared -> cow -> allocated,
+        # the writer's fresh PRIVATE copy).  Scrub/quarantine is legal
+        # only from the sole-owner state — never while shared.
+        "allocated": ("free", "quarantined", "shared"),
+        "shared": ("allocated", "cow"),
+        "cow": ("allocated",),
         # quarantined blocks are scrubbed, then returned to the free list
         "quarantined": ("free",),
     },
@@ -105,11 +113,17 @@ STATE_BINDINGS = {
 PROTECTED_ATTRS = {
     "_free": ("BlockAllocator",),        # allocator free list
     "_in_use": ("BlockAllocator",),      # allocator live-block set
+    "_refs": ("BlockAllocator",),        # per-block refcounts (sharing)
+    "_entries": ("PrefixIndex",),        # radix cache: key -> entry
+    "_by_block": ("PrefixIndex",),       # radix cache: block -> key
+    "_lru": ("PrefixIndex",),            # radix cache eviction order
     "_buf": ("RequestJournal",),         # journal append buffer
     "assigned": ("_ReplicaState", "_place", "_record_result", "_handoff"),
     # slot block tables: _restore_stream is the migration-era second
-    # admission path (seats a restored slot), a peer of _start
-    "_tables": ("__init__", "_start", "_finish", "_restore_stream"),
+    # admission path (seats a restored slot) and _start_shared the
+    # prefix-cache-hit seat — peers of _start
+    "_tables": ("__init__", "_start", "_start_shared", "_finish",
+                "_restore_stream"),
     "blocks": ("__init__",),             # per-sequence block list (_Slot)
 }
 

@@ -28,6 +28,10 @@ What it catches (each a typed :class:`~..findings.Finding`):
 - **DSTPU315 scrub-while-referenced** — scrubbing/poisoning a block a
   DIFFERENT live sequence still reads (the refcount check the radix
   prefix cache needs; ROADMAP item 1).
+- **DSTPU316 scrub-while-shared** — scrubbing/re-zeroing a block the
+  prefix cache (or a second co-tenant) still holds a read-only
+  reference to: the kv-block FSM allows quarantine only from the
+  sole-owner ``allocated`` state, never from ``shared``.
 
 Arming (OFF by default, resolution highest-wins):
 ``deepspeed --sanitize`` (launcher) -> env ``DSTPU_SANITIZE`` -> config
@@ -43,7 +47,7 @@ from .findings import Finding
 from .lint.lifecycle import KV_BLOCK_FSM, REQUEST_FSM  # noqa: F401
 
 # shadow block states — the kv-block FSM's states, verbatim
-FREE, ALLOCATED, QUARANTINED = KV_BLOCK_FSM["states"]
+FREE, ALLOCATED, QUARANTINED, SHARED, COW = KV_BLOCK_FSM["states"]
 
 DOUBLE_FREE = "DSTPU310"
 USE_AFTER_FREE = "DSTPU311"
@@ -51,9 +55,11 @@ LEAK_AT_CLOSE = "DSTPU312"
 SCRATCH_WRITE = "DSTPU313"
 DOUBLE_SERVE = "DSTPU314"
 SCRUB_REFERENCED = "DSTPU315"
+SCRUB_SHARED = "DSTPU316"
 
 SANITIZER_CODES = (DOUBLE_FREE, USE_AFTER_FREE, LEAK_AT_CLOSE,
-                   SCRATCH_WRITE, DOUBLE_SERVE, SCRUB_REFERENCED)
+                   SCRATCH_WRITE, DOUBLE_SERVE, SCRUB_REFERENCED,
+                   SCRUB_SHARED)
 
 
 def env_enabled():
@@ -95,7 +101,8 @@ class ShadowSanitizer:
         self.scratch_block = int(scratch_block)
         self.halt = bool(halt)
         self.shadow = {b: FREE for b in range(self.num_blocks)}
-        self.refs = {}          # block id -> uid of the sequence holding it
+        self.refs = {}          # block id -> SET of uids referencing it
+        self.cache_blocks = set()   # blocks the prefix cache holds a ref on
         self.attached = {}      # uid -> list of block ids in its table
         self.served = set()     # uids whose result left the engine
         self.findings = []
@@ -150,7 +157,8 @@ class ShadowSanitizer:
                            f"{b}, which the shadow says is free — "
                            f"use-after-free", block=b, uid=uid)
                 continue
-            self.refs[b] = uid
+            self.refs.setdefault(b, set()).add(uid)
+            self._reshade(b)
         self.attached[uid] = blocks
 
     def on_detach(self, uid):
@@ -159,8 +167,73 @@ class ShadowSanitizer:
         self.checks += 1
         uid = int(uid)
         for b in self.attached.pop(uid, ()):
-            if self.refs.get(b) == uid:
-                del self.refs[b]
+            holders = self.refs.get(b)
+            if holders is not None:
+                holders.discard(uid)
+                if not holders:
+                    del self.refs[b]
+            self._reshade(b)
+
+    # ------------------------------------------------- sharing helpers
+    def _holder_count(self, b):
+        return len(self.refs.get(b, ())) + (1 if b in self.cache_blocks
+                                            else 0)
+
+    def _other_holder(self, b, uid):
+        """A live uid other than ``uid`` referencing ``b`` (or None)."""
+        for h in self.refs.get(b, ()):
+            if uid is None or h != int(uid):
+                return h
+        return None
+
+    def _reshade(self, b):
+        """Recompute ALLOCATED vs SHARED from the holder count (the
+        kv-block FSM's allocated <-> shared edges)."""
+        state = self.shadow.get(b, FREE)
+        if state in (FREE, QUARANTINED):
+            return
+        self.shadow[b] = SHARED if self._holder_count(b) >= 2 \
+            else ALLOCATED
+
+    def on_share(self, blocks, uid=None):
+        """The prefix cache took a read-only reference on ``blocks``
+        (insert at finish, or a restore re-established sharing)."""
+        self.checks += 1
+        for b in blocks:
+            b = int(b)
+            if self.shadow.get(b, FREE) in (FREE, QUARANTINED):
+                self._emit(USE_AFTER_FREE,
+                           f"prefix cache taking a reference on block "
+                           f"{b} whose shadow state is "
+                           f"{self.shadow.get(b)!r}", block=b, uid=uid)
+                continue
+            self.cache_blocks.add(b)
+            self._reshade(b)
+
+    def on_unshare(self, blocks):
+        """The prefix cache dropped its reference (eviction or
+        clear)."""
+        self.checks += 1
+        for b in blocks:
+            self.cache_blocks.discard(int(b))
+            self._reshade(int(b))
+
+    def on_cow(self, src, dst, uid=None):
+        """Copy-on-write: ``uid`` diverged inside shared block ``src``
+        and received the fresh private clone ``dst`` (kv-block FSM
+        shared -> cow -> allocated for the writer's copy)."""
+        self.checks += 1
+        src, dst = int(src), int(dst)
+        if self.shadow.get(src, FREE) == FREE:
+            self._emit(USE_AFTER_FREE,
+                       f"copy-on-write from block {src}, which the "
+                       f"shadow says is free", block=src, uid=uid)
+        if self.shadow.get(dst, FREE) != ALLOCATED:
+            self._emit(USE_AFTER_FREE,
+                       f"copy-on-write into block {dst} whose shadow "
+                       f"state is {self.shadow.get(dst)!r} — the clone "
+                       f"must be a fresh private allocation",
+                       block=dst, uid=uid)
 
     def on_quarantine(self, blocks, uid=None):
         """Blocks poisoned/quarantined (kv-block FSM allocated ->
@@ -168,9 +241,18 @@ class ShadowSanitizer:
         self.checks += 1
         for b in blocks:
             b = int(b)
-            holder = self.refs.get(b)
-            if holder is not None and uid is not None \
-                    and holder != int(uid):
+            if self.shadow.get(b, FREE) == SHARED \
+                    or b in self.cache_blocks:
+                self._emit(SCRUB_SHARED,
+                           f"quarantining block {b} while shared "
+                           f"(holders: uids "
+                           f"{sorted(self.refs.get(b, ()))}, cache="
+                           f"{b in self.cache_blocks}) — quarantine is "
+                           f"legal only from the sole-owner "
+                           f"'allocated' state", block=b, uid=uid)
+                continue
+            holder = self._other_holder(b, uid)
+            if holder is not None:
                 self._emit(SCRUB_REFERENCED,
                            f"quarantining block {b} still referenced by "
                            f"live uid {holder} (quarantine requested "
@@ -182,13 +264,23 @@ class ShadowSanitizer:
 
     def on_scrub(self, blocks, uid=None):
         """Blocks being scrubbed before returning to the pool.
-        Scrubbing a block ANOTHER live sequence still reads is the
-        refcount violation the prefix cache must never commit."""
+        Scrubbing a block ANOTHER live sequence (or the prefix cache)
+        still reads is the refcount violation sharing must never
+        commit."""
         self.checks += 1
         for b in blocks:
             b = int(b)
-            holder = self.refs.get(b)
-            if holder is not None and (uid is None or holder != int(uid)):
+            if self.shadow.get(b, FREE) == SHARED \
+                    or b in self.cache_blocks:
+                self._emit(SCRUB_SHARED,
+                           f"scrubbing block {b} while shared (holders: "
+                           f"uids {sorted(self.refs.get(b, ()))}, "
+                           f"cache={b in self.cache_blocks}) — its K/V "
+                           f"would be zeroed under other tenants",
+                           block=b, uid=uid)
+                continue
+            holder = self._other_holder(b, uid)
+            if holder is not None:
                 self._emit(SCRUB_REFERENCED,
                            f"scrubbing block {b} while live uid "
                            f"{holder} still references it — its K/V "
@@ -197,7 +289,8 @@ class ShadowSanitizer:
 
     def on_free(self, blocks, uid=None):
         """Blocks returned to the free list (kv-block FSM allocated/
-        quarantined -> free)."""
+        quarantined -> free).  With sharing armed the allocator only
+        reports blocks whose refcount actually hit zero here."""
         self.checks += 1
         for b in blocks:
             b = int(b)
@@ -207,8 +300,13 @@ class ShadowSanitizer:
                            f"double free of block {b} (shadow already "
                            f"says free)", block=b, uid=uid)
                 continue
-            holder = self.refs.get(b)
-            if holder is not None and (uid is None or holder != int(uid)):
+            if b in self.cache_blocks:
+                self._emit(USE_AFTER_FREE,
+                           f"freeing block {b} the prefix cache still "
+                           f"holds — cached prefixes would decode from "
+                           f"a reused block", block=b, uid=uid)
+            holder = self._other_holder(b, uid)
+            if holder is not None:
                 self._emit(USE_AFTER_FREE,
                            f"freeing block {b} still referenced by live "
                            f"uid {holder} — its table row would decode "
@@ -236,7 +334,8 @@ class ShadowSanitizer:
         self.checks += 1
         leaked = sorted(b for b, s in self.shadow.items() if s != FREE)
         if leaked:
-            holders = {b: self.refs.get(b) for b in leaked}
+            holders = {b: sorted(self.refs[b]) for b in leaked
+                       if self.refs.get(b)}
             self._emit(LEAK_AT_CLOSE,
                        f"{len(leaked)} block(s) still "
                        f"allocated/quarantined at close: {leaked[:16]}"
@@ -247,9 +346,13 @@ class ShadowSanitizer:
 
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
-        live = sum(1 for s in self.shadow.values() if s == ALLOCATED)
+        live = sum(1 for s in self.shadow.values()
+                   if s in (ALLOCATED, SHARED))
+        shared = sum(1 for s in self.shadow.values() if s == SHARED)
         return {"checks": self.checks, "findings": len(self.findings),
-                "live_blocks": live, "served_uids": len(self.served)}
+                "live_blocks": live, "shared_blocks": shared,
+                "cache_blocks": len(self.cache_blocks),
+                "served_uids": len(self.served)}
 
 
 def describe(config_enabled=False, halt=True) -> dict:
@@ -266,5 +369,6 @@ def describe(config_enabled=False, halt=True) -> dict:
                           ("double-free", "use-after-free",
                            "leak-at-close", "scratch-block-write",
                            "uid-double-serve",
-                           "scrub-while-referenced"))),
+                           "scrub-while-referenced",
+                           "scrub-while-shared"))),
     }

@@ -263,6 +263,35 @@ def kv_snapshot_report():
     print(f"wire format ........... {eff.get('wire_format')}")
 
 
+def prefix_cache_report():
+    """Resolved multi-tenant prefix-sharing policy
+    (docs/serving.md#prefix-sharing): the ``serving.prefix_cache``
+    block as a serving engine built in this environment would resolve
+    it — off by default, radix COW cache over the paged pool when
+    armed (decode jaxpr byte-identical either way)."""
+    from .inference.serving import describe_prefix_cache
+
+    print("-" * 64)
+    print("KV prefix sharing (config `serving.prefix_cache`):")
+    print("-" * 64)
+    pol = _safe(lambda: describe_prefix_cache())
+    if not isinstance(pol, dict):
+        print(f"policy ................ {pol}")
+        return
+    eff = pol if pol.get("enabled") else pol.get("defaults_when_armed", {})
+    print(f"enabled ............... {pol.get('enabled')} "
+          "(off by default; jaxpr-identical when armed)")
+    print(f"hash .................. {eff.get('hash')}")
+    print(f"copy-on-write ......... {eff.get('cow')}")
+    print(f"eviction .............. {eff.get('eviction')}")
+    print(f"capacity .............. {eff.get('capacity')}")
+    print(f"min prefix blocks ..... {eff.get('min_prefix_blocks')}")
+    print(f"cached-block cap ...... {eff.get('max_blocks')} "
+          "(0 = evict under pool pressure only)")
+    print("capacity query ........ ds_mem --max-streams "
+          "--shared-prefix-tokens N")
+
+
 def sanitize_report():
     """Resolved lifecycle shadow-sanitizer policy
     (docs/static-analysis.md#sanitizer): the DSTPU_SANITIZE env
@@ -295,6 +324,7 @@ def main():
     monitor_report()
     router_report()
     kv_snapshot_report()
+    prefix_cache_report()
     sanitize_report()
     debug_report()
 

@@ -6,6 +6,7 @@ this repo's production-traffic addition (docs/serving.md)."""
 
 from .engine import InferenceEngine
 from .serving import (ServingConfig, ServingEngine, SpeculativeConfig,
+                      PrefixCacheConfig, describe_prefix_cache,
                       Request, ServingError, QueueFullError,
                       ServingStalledError, CircuitOpenError,
                       OK, SHED, DEADLINE, POISONED, OUTCOMES)
@@ -14,7 +15,8 @@ from .router import (ReplicaRouter, RouterConfig, ReplicaHandle,
                      HEALTHY, SUSPECT, DRAINING, DEAD)
 
 __all__ = ["InferenceEngine", "ServingEngine", "ServingConfig",
-           "SpeculativeConfig", "Request",
+           "SpeculativeConfig", "PrefixCacheConfig",
+           "describe_prefix_cache", "Request",
            "ServingError", "QueueFullError", "ServingStalledError",
            "CircuitOpenError", "OK", "SHED", "DEADLINE", "POISONED",
            "OUTCOMES",

@@ -61,6 +61,15 @@ class BlockAllocator:
     Allocation is all-or-nothing (a request either gets every block its
     admission math asked for, or is left queued); ``free`` returns
     blocks for reuse in LIFO order so hot blocks stay hot.
+
+    Every in-use block carries a **refcount** (PR 19, prefix sharing):
+    ``alloc`` hands a block out at refcount 1, each additional holder —
+    a co-tenant reading a shared prefix, or the :class:`PrefixIndex`'s
+    own cache reference — goes through :meth:`incref`, and ``free``
+    *decrements*: a block returns to the free list only when its last
+    holder lets go.  ``free`` therefore returns the list of block ids
+    it actually released, so callers (and the shadow sanitizer's
+    ``on_free``) see physical releases, never logical decrefs.
     """
 
     def __init__(self, num_blocks: int):
@@ -69,6 +78,7 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         self._free = list(range(self.num_blocks - 1, SCRATCH_BLOCK, -1))
         self._in_use = set()
+        self._refs = {}     # block id -> holder count (in-use blocks only)
 
     @property
     def free_blocks(self) -> int:
@@ -76,7 +86,19 @@ class BlockAllocator:
 
     @property
     def used_blocks(self) -> int:
+        """UNIQUE blocks checked out (physical residency)."""
         return len(self._in_use)
+
+    @property
+    def shared_blocks(self) -> int:
+        """Blocks with two or more holders (kv-block FSM ``shared``)."""
+        return sum(1 for c in self._refs.values() if c >= 2)
+
+    @property
+    def logical_blocks(self) -> int:
+        """Sum of refcounts — what residency WOULD cost without
+        sharing; ``logical - used`` is the pool's sharing dividend."""
+        return sum(self._refs.values())
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
@@ -87,21 +109,41 @@ class BlockAllocator:
         code never guesses at the free list's contents."""
         return b in self._in_use
 
+    def refcount(self, b: int) -> int:
+        """Holder count of ``b`` (0 when free)."""
+        return self._refs.get(b, 0)
+
     def alloc(self, n: int):
         """``n`` block ids, or None when the pool cannot serve them."""
         if n < 1 or n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
         self._in_use.update(out)
+        for b in out:
+            self._refs[b] = 1
         return out
 
+    def incref(self, blocks):
+        """Add one holder to each of ``blocks`` (kv-block FSM allocated
+        -> shared).  Only checked-out blocks can gain holders — an
+        incref of a free block would resurrect reclaimed storage."""
+        blocks = list(blocks)
+        for b in blocks:
+            if b not in self._in_use:
+                raise ValueError(
+                    f"incref of block {b} which is not in use — only "
+                    "allocated blocks can be shared")
+        for b in blocks:
+            self._refs[b] += 1
+
     def free(self, blocks):
-        """Return blocks to the free list.  Rejections are real
-        exceptions, not asserts: a double free or a free of the reserved
-        scratch block is silent pool corruption (two tenants writing one
-        block) and must fail under ``python -O`` too — the DSTPU3xx
-        lifecycle audit's kv-block FSM says only 'allocated' blocks may
-        return to 'free'."""
+        """Drop one holder from each block; return the ids actually
+        RELEASED to the free list (refcount hit zero).  Rejections are
+        real exceptions, not asserts: a double free or a free of the
+        reserved scratch block is silent pool corruption (two tenants
+        writing one block) and must fail under ``python -O`` too — the
+        DSTPU3xx lifecycle audit's kv-block FSM says only 'allocated'
+        blocks may return to 'free'."""
         blocks = list(blocks)
         seen = set()
         for b in blocks:
@@ -114,9 +156,249 @@ class BlockAllocator:
                     f"double free of block {b} (not in use; kv-block "
                     "FSM allows free only from 'allocated')")
             seen.add(b)
+        released = []
         for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] > 0:
+                continue
+            del self._refs[b]
             self._in_use.discard(b)
             self._free.append(b)
+            released.append(b)
+        return released
+
+
+# ------------------------------------------------- prefix cache (radix)
+def block_key(parent_key, tokens) -> str:
+    """Chained content hash of one FULL token block: SHA-256 over the
+    parent block's key bytes + this block's int32 token bytes.  The
+    chaining makes the key position-dependent — two identical token
+    blocks under different prefixes hash apart — so one flat dict IS a
+    radix tree: looking up block i's key implies every ancestor block
+    matched.  Keys are adapter-neutral by construction: only token ids
+    enter the hash, so any state that changes the K/V for the same
+    tokens (a LoRA adapter, a different model) must key a separate
+    PrefixIndex."""
+    h = hashlib.sha256()
+    if parent_key is not None:
+        h.update(parent_key.encode("ascii"))
+    h.update(np.ascontiguousarray(np.asarray(tokens, np.int32)).tobytes())
+    return h.hexdigest()
+
+
+class PrefixIndex:
+    """Block-granular radix cache over a :class:`BlockAllocator`.
+
+    Maps chained content keys (:func:`block_key`) of FULL prompt blocks
+    to pool block ids holding their K/V.  The index owns ONE refcount
+    on every block it lists (taken via ``allocator.incref`` at insert,
+    dropped via ``allocator.free`` at evict), so a cached block
+    survives its inserting sequence and is reclaimed only when both
+    the cache and every live reader have let go.
+
+    Collision discipline: the full token content of each block rides in
+    the entry and every lookup compares it — a SHA-256 collision (or a
+    test forcing one) degrades to a cache MISS, never to serving
+    another prefix's K/V.
+
+    Eviction is LRU over **leaf** entries (no cached children) whose
+    block has no live reader (refcount exactly 1 — the cache's own);
+    peeling leaves repeatedly reclaims whole cold chains while a hot
+    chain's interior blocks stay pinned by their children.
+    """
+
+    def __init__(self, allocator: "BlockAllocator", *, max_blocks: int = 0):
+        self.allocator = allocator
+        self.max_blocks = int(max_blocks)   # 0 = pool-pressure-only
+        self._entries = {}   # key -> {block, tokens, parent, children}
+        self._by_block = {}  # block id -> key
+        self._lru = {}       # key -> None; dict order = LRU (oldest first)
+        self.hits = 0            # full-block lookup hits
+        self.lookups = 0         # full-block lookup attempts
+        self.collisions = 0      # hash matched, token content did not
+        self.inserted = 0
+        self.evicted = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._entries)
+
+    def holds(self, block: int) -> bool:
+        """True while the cache holds its reference on ``block``."""
+        return int(block) in self._by_block
+
+    def _touch(self, key):
+        self._lru.pop(key, None)
+        self._lru[key] = None
+
+    # ------------------------------------------------------------ match
+    def match(self, tokens, block_size: int, limit_blocks=None):
+        """Longest cached prefix of ``tokens`` at block granularity.
+
+        Walks full ``block_size``-token chunks down the radix chain,
+        content-verifying every hit.  Returns a dict:
+
+        - ``blocks``: pool block ids of the matched prefix, in order
+          (NOT incref'd — the caller decides to take the share);
+        - ``keys``: their chain keys (parents for a later insert);
+        - ``donor``: ``(block_id, shared_tokens)`` for copy-on-write
+          when the first unmatched chunk shares ``shared_tokens >= 1``
+          leading tokens with a cached sibling, else None.
+
+        ``limit_blocks`` caps the match (the caller's write-safety
+        clamp: positions the sequence will still WRITE must land in
+        private blocks)."""
+        tokens = np.asarray(tokens, np.int64).tolist()
+        bs = int(block_size)
+        nb_full = len(tokens) // bs
+        if limit_blocks is not None:
+            nb_full = min(nb_full, max(0, int(limit_blocks)))
+        blocks, keys = [], []
+        parent = None
+        stopped_i = 0
+        for i in range(nb_full):
+            chunk = tuple(tokens[i * bs:(i + 1) * bs])
+            key = block_key(parent, chunk)
+            self.lookups += 1
+            ent = self._entries.get(key)
+            if ent is None:
+                stopped_i = i
+                break
+            if ent["tokens"] != chunk:
+                # hash collision: full-content check demotes to a miss
+                self.collisions += 1
+                stopped_i = i
+                break
+            self.hits += 1
+            self._touch(key)
+            blocks.append(ent["block"])
+            keys.append(key)
+            parent = key
+            stopped_i = i + 1
+        donor = None
+        # COW donor: a cached child of the last matched parent whose
+        # content shares >= 1 leading token with our divergent chunk
+        chunk = tuple(tokens[stopped_i * bs:(stopped_i + 1) * bs])
+        if chunk:
+            best = 0
+            for ck in self._children(parent):
+                ent = self._entries.get(ck)
+                if ent is None:
+                    continue
+                j = 0
+                for a, b in zip(ent["tokens"], chunk):
+                    if a != b:
+                        break
+                    j += 1
+                # j may equal len(chunk): a clamped or tail chunk whose
+                # cached sibling matches it fully still COWs (the
+                # caller re-ingests only the write-clamped positions)
+                if 0 < j and j > best:
+                    best, donor = j, (ent["block"], j)
+        return {"blocks": blocks, "keys": keys, "donor": donor}
+
+    def _children(self, parent_key):
+        if parent_key is None:
+            return [k for k, e in self._entries.items()
+                    if e["parent"] is None]
+        ent = self._entries.get(parent_key)
+        return sorted(ent["children"]) if ent else []
+
+    # ----------------------------------------------------------- insert
+    def insert(self, parent_key, tokens, block: int):
+        """Index ``block`` (holding the K/V of full block ``tokens``
+        chained under ``parent_key``) and take the cache's refcount on
+        it.  Returns the chain key, or None when the entry was not
+        inserted (true hash collision — first writer wins, content
+        check keeps lookups safe — or an uncachable block).
+
+        A key that already exists with the SAME content dedupes: the
+        existing entry (and its block) stays authoritative, the
+        caller's physical block keeps only its own holders."""
+        block = int(block)
+        if block == SCRATCH_BLOCK:
+            return None
+        tokens = tuple(np.asarray(tokens, np.int64).tolist())
+        key = block_key(parent_key, tokens)
+        ent = self._entries.get(key)
+        if ent is not None:
+            if ent["tokens"] != tokens:
+                self.collisions += 1
+                return None
+            self._touch(key)
+            return key
+        if parent_key is not None and parent_key not in self._entries:
+            return None     # parent evicted mid-walk: chain is broken
+        if self.max_blocks > 0 and len(self._entries) >= self.max_blocks:
+            if not self.evict(1 + len(self._entries) - self.max_blocks):
+                return None     # everything referenced: nothing to evict
+        self.allocator.incref([block])
+        self._entries[key] = {"block": block, "tokens": tokens,
+                              "parent": parent_key, "children": set()}
+        self._by_block[block] = key
+        if parent_key is not None:
+            self._entries[parent_key]["children"].add(key)
+        self._touch(key)
+        self.inserted += 1
+        return key
+
+    # ---------------------------------------------------------- evict
+    def _drop_entry(self, key):
+        ent = self._entries.pop(key)
+        self._lru.pop(key, None)
+        self._by_block.pop(ent["block"], None)
+        if ent["parent"] is not None:
+            par = self._entries.get(ent["parent"])
+            if par is not None:
+                par["children"].discard(key)
+        return ent
+
+    def evict(self, want: int = 1):
+        """Reclaim up to ``want`` cached blocks, LRU-first, restricted
+        to LEAF entries with no live reader (refcount exactly 1 — the
+        cache's own reference).  A referenced block is NEVER reclaimed.
+        Returns the pool block ids actually released."""
+        released = []
+        progress = True
+        while len(released) < int(want) and progress:
+            progress = False
+            for key in list(self._lru):
+                ent = self._entries.get(key)
+                if ent is None or ent["children"]:
+                    continue
+                if self.allocator.refcount(ent["block"]) != 1:
+                    continue    # a live sequence still reads it
+                self._drop_entry(key)
+                released.extend(self.allocator.free([ent["block"]]))
+                self.evicted += 1
+                progress = True
+                break
+        return released
+
+    def clear(self):
+        """Drop every cache reference (engine close / pool teardown).
+        Returns ``(dropped, released)``: all block ids the cache held,
+        and the subset physically released (no surviving holder)."""
+        dropped = list(self._by_block)
+        released = []
+        for key in list(self._entries):
+            ent = self._entries.pop(key)
+            self._lru.pop(key, None)
+            self._by_block.pop(ent["block"], None)
+            released.extend(self.allocator.free([ent["block"]]))
+        return dropped, released
+
+    # ----------------------------------------------------------- stats
+    def stats(self) -> dict:
+        return {"entries": len(self._entries),
+                "hits": self.hits, "lookups": self.lookups,
+                "hit_rate": (self.hits / self.lookups
+                             if self.lookups else 0.0),
+                "collisions": self.collisions,
+                "inserted": self.inserted, "evicted": self.evicted}
 
 
 # ------------------------------------------------------------- device pool

@@ -295,6 +295,65 @@ def describe_kv_snapshot(value=None) -> dict:
     return kvs.describe()
 
 
+# ------------------------------------------- prefix sharing config (PR 19)
+@dataclasses.dataclass
+class PrefixCacheConfig:
+    """The ``serving.prefix_cache`` block (docs/serving.md#prefix-
+    sharing): block-granular copy-on-write radix cache over the paged
+    pool.  Off by default.  Entirely host-side bookkeeping — block
+    tables are runtime operands of the compiled decode step, so the
+    decode jaxpr is byte-identical armed vs off, and outputs are
+    token-identical to the unshared path (the suffix-only prefill
+    replays the prompt through the SAME decode executable and samples
+    the first token at the same ``fold_in(seed, 0)`` index)."""
+    max_blocks: int = 0        # cached-block cap; 0 = evict only under
+    #                            pool pressure (admission's retry path)
+    min_prefix_blocks: int = 1  # smallest full-block match worth sharing
+
+    def __post_init__(self):
+        assert self.max_blocks >= 0, \
+            f"prefix_cache.max_blocks must be >= 0, got {self.max_blocks}"
+        assert self.min_prefix_blocks >= 1, \
+            f"prefix_cache.min_prefix_blocks must be >= 1, " \
+            f"got {self.min_prefix_blocks}"
+
+    @classmethod
+    def from_value(cls, v):
+        """None/False → off; True → defaults; dict → the JSON block."""
+        if not v:
+            return None
+        if v is True:
+            return cls()
+        if isinstance(v, cls):
+            return v
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(v) - known
+        if unknown:
+            raise ValueError(
+                f"unknown serving.prefix_cache keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        return cls(**v)
+
+    def describe(self) -> dict:
+        return {"enabled": True, "max_blocks": self.max_blocks,
+                "min_prefix_blocks": self.min_prefix_blocks,
+                "hash": "chained sha256 over int32 token blocks, "
+                        "full-content verified (collision -> miss)",
+                "cow": "first divergent token (private block clone)",
+                "eviction": "LRU over unreferenced leaf entries only",
+                "capacity": "admission charges unique blocks "
+                            "(analysis/capacity.request_unique_blocks)"}
+
+
+def describe_prefix_cache(value=None) -> dict:
+    """Resolved prefix-sharing policy for ``bin/ds_report``."""
+    pc = PrefixCacheConfig.from_value(value)
+    if pc is None:
+        return {"enabled": False,
+                "defaults_when_armed": PrefixCacheConfig().describe()}
+    return pc.describe()
+
+
 @dataclasses.dataclass
 class ServingConfig:
     """Knobs for one serving deployment (docs/serving.md has the
@@ -352,6 +411,15 @@ class ServingConfig:
     # "verify": "full"}.  Needs journal_dir (images live beside the
     # journal); restore-first crash handoff reads them via the router.
     kv_snapshot: Any = None
+    # ---- prefix sharing (docs/serving.md#prefix-sharing) ----
+    # None/false = off; true = defaults; or the JSON block
+    # {"max_blocks": 0, "min_prefix_blocks": 1}.  Copy-on-write radix
+    # cache over the paged pool: co-batched and successive requests
+    # share the KV blocks of a common prompt prefix, prefill skips
+    # every shared block, and admission charges UNIQUE blocks.  Outputs
+    # stay token-identical to the unshared path and the compiled decode
+    # step is byte-identical on/off.
+    prefix_cache: Any = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServingConfig":
@@ -407,6 +475,17 @@ class _Slot:
         # speculative-decode acceptance accounting (per request)
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # ---- prefix sharing (docs/serving.md#prefix-sharing) ----
+        # pending is None on the plain path; a prefix-hit slot seats
+        # with the not-yet-ingested prompt tail here and replays it
+        # through the decode step (teacher-forced), so TTFT collapses
+        # to the new-suffix cost without a second prefill executable
+        self.pending: Optional[List[int]] = None
+        self.shared_blocks = 0          # leading blocks borrowed read-only
+        self.shared_keys: List[str] = []  # their radix chain (insert parents)
+        # restored-from-image KV is wire-precision, not prefill output:
+        # never publish it into the prefix cache
+        self.wire_kv = False
 
 
 class ServingEngine:
@@ -512,6 +591,18 @@ class ServingEngine:
         # (see _warm_restore_path for why it cannot run here)
         self._kv_warm_pending = self.kvs is not None
 
+        # prefix sharing (docs/serving.md#prefix-sharing): block-granular
+        # COW radix cache over the paged pool.  Host-side bookkeeping
+        # only — the decode jaxpr is byte-identical armed vs off
+        # (--audit-step decode with the cache armed proves it).
+        self.prefix = PrefixCacheConfig.from_value(config.prefix_cache)
+        self._prefix_index = None
+        if self.prefix is not None:
+            self._prefix_index = pk.PrefixIndex(
+                self.allocator, max_blocks=self.prefix.max_blocks)
+            logger.info("serving: prefix cache ARMED "
+                        f"({self.prefix.describe()})")
+
         S = config.batch_slots
         self._slots: List[Optional[_Slot]] = [None] * S
         self._snap_last = np.zeros((S,), np.int32)  # ngen at last snapshot
@@ -542,6 +633,7 @@ class ServingEngine:
         self._decode = None
         self._prefills = {}       # bucket length → CachedStep
         self._blockset = None     # jitted poison/scrub scatter (lazy)
+        self._blockcopy = None    # jitted COW block clone (lazy)
         self._preflight_done = False
 
         # ---- resilience state (docs/serving.md#resilience) ----
@@ -561,6 +653,12 @@ class ServingEngine:
         # free and not counted on either side)
         self._spec_proposed_total = 0
         self._spec_accepted_total = 0
+        # prefix-sharing accounting (counted once per SEATED request)
+        self._prefix_requests_total = 0
+        self._prefix_hits_total = 0
+        self._prefix_shared_blocks_total = 0
+        self._prefix_cow_total = 0
+        self._prefix_evicted_total = 0
         self._breaker_open = False
         self._forensic_path = None
         self._draining = False
@@ -669,9 +767,13 @@ class ServingEngine:
         block cost at the default generation length, concurrent-request
         bound."""
         c = self.config
-        per_req = pk.blocks_needed(
-            min(self.max_seq, c.block_size + c.max_new_tokens), c.block_size)
-        return {
+        # the ONE function every capacity owner shares (admission here,
+        # ds_mem serving_plan/max_streams, the ledger split) — PR 19
+        from ..analysis.capacity import request_unique_blocks
+        ub = request_unique_blocks(
+            prompt_tokens=c.block_size, max_new_tokens=c.max_new_tokens,
+            block_size=c.block_size, max_seq=self.max_seq)
+        out = {
             "batch_slots": c.batch_slots,
             "block_size": c.block_size,
             "num_blocks": self.num_blocks,
@@ -679,9 +781,17 @@ class ServingEngine:
             "capacity_tokens": pk.capacity_tokens(self.pool),
             "pool_bytes": pk.pool_bytes(self.pool),
             "kv_bits": c.kv_bits,
-            "blocks_per_request_at_defaults": per_req,
+            "blocks_per_request_at_defaults": ub["total_blocks"],
             "free_blocks": self.allocator.free_blocks,
         }
+        if self._prefix_index is not None:
+            # admission counts UNIQUE blocks when the cache is armed —
+            # surface the sharing split next to the classic math
+            out["unique_blocks_in_use"] = self.allocator.used_blocks
+            out["shared_blocks"] = self.allocator.shared_blocks
+            out["logical_blocks"] = self.allocator.logical_blocks
+            out["prefix_cached_blocks"] = self._prefix_index.cached_blocks
+        return out
 
     # ------------------------------------------------------------ preflight
     def preflight_memory(self) -> Optional[dict]:
@@ -1123,34 +1233,103 @@ class ServingEngine:
             if not free:
                 return
             new = req.max_new_tokens       # resolved >= 1 by submit()
-            nb = pk.blocks_needed(len(req.tokens) + new, c.block_size)
-            blocks = self.allocator.alloc(nb)
-            if blocks is None:
+            share = self._prefix_match(req)
+            ns = share["ns"] if share is not None else 0
+            # the unified capacity math (analysis/capacity.py): the SAME
+            # function ds_mem's serving_plan/--max-streams and the
+            # memory ledger use — admission charges UNIQUE blocks only
+            from ..analysis.capacity import request_unique_blocks
+            ub = request_unique_blocks(
+                prompt_tokens=len(req.tokens), max_new_tokens=new,
+                block_size=c.block_size,
+                shared_prefix_tokens=ns * c.block_size)
+            assert ub["shared_blocks"] == ns   # same clamp by construction
+            fresh = self._alloc_blocks(ub["unique_blocks"], uid=req.uid)
+            if fresh is None:
                 return
-            if self._sanitizer is not None:
-                self._sanitizer.on_alloc(blocks, uid=req.uid)
+            if ns:
+                # borrow the cached prefix read-only: one refcount per
+                # co-tenant on top of the cache's own reference
+                self.allocator.incref(share["blocks"])
+                blocks = list(share["blocks"]) + fresh
+            else:
+                blocks = fresh
             self.queue.popleft()
             if self.journal is not None:
                 self.journal.admit(req.uid)
             slot = free[0]
+            self._prefix_requests_total += (
+                1 if self._prefix_index is not None else 0)
             try:
-                self._start(slot, req, blocks, new)
+                self._start(slot, req, blocks, new, share=share)
             except Exception:
                 # a prefill that dies mid-dispatch (device OOM, a
                 # poisoned executable) must not leak the blocks: free
                 # them unless _start already seated the slot (the slot
                 # owns them then) or already returned them itself (the
-                # quarantine-at-prefill path).  InjectedCrash is a
+                # quarantine-at-prefill path).  The guard is keyed on
+                # the FRESH blocks — shared ones stay allocated under
+                # the cache's reference either way; free() decrefs our
+                # borrow exactly once and reports only truly-released
+                # ids to the sanitizer.  InjectedCrash is a
                 # BaseException on purpose — a simulated kill skips
                 # this cleanup exactly like a real one would.
                 s = self._slots[slot]
                 if ((s is None or s.blocks is not blocks)
                         and all(self.allocator.is_allocated(b)
-                                for b in blocks)):
-                    self.allocator.free(blocks)
+                                for b in fresh)):
+                    released = self.allocator.free(blocks)
                     if self._sanitizer is not None:
-                        self._sanitizer.on_free(blocks, uid=req.uid)
+                        self._sanitizer.on_free(released, uid=req.uid)
                 raise
+
+    def _prefix_match(self, req: Request) -> Optional[dict]:
+        """Clamped radix lookup for one admission.  ``ns`` is capped at
+        ``(T-1)//block_size``: the final prompt token (and everything the
+        decode step will ever WRITE) must land in a PRIVATE block —
+        writing a shared block would corrupt every co-tenant.  Returns
+        None on a miss (or when the hit is below ``min_prefix_blocks``
+        and there is no same-parent COW donor)."""
+        if self._prefix_index is None:
+            return None
+        if len(self._prefix_index) == 0:
+            return None
+        c = self.config
+        T = int(len(req.tokens))
+        limit = (T - 1) // c.block_size
+        m = self._prefix_index.match(req.tokens, c.block_size,
+                                     limit_blocks=limit)
+        ns = len(m["blocks"])
+        donor = m["donor"]
+        if ns >= self.prefix.min_prefix_blocks:
+            return {"ns": ns, "blocks": m["blocks"], "keys": m["keys"],
+                    "donor": donor}
+        if ns == 0 and donor is not None:
+            # root-level COW: no full block matched, but a cached first
+            # block shares a leading run of tokens
+            return {"ns": 0, "blocks": [], "keys": [], "donor": donor}
+        # a sub-threshold chain cannot keep its donor (the donor's copy
+        # is only correct ON TOP of the matched chain) — full miss
+        return None
+
+    def _alloc_blocks(self, n: int, uid=None) -> Optional[List[int]]:
+        """Allocator front-end for admission/restore: on exhaustion,
+        evict unreferenced prefix-cache entries (LRU, leaf-first) and
+        retry once.  Eviction can never reclaim a block a live stream
+        still references — the cache only releases refcount-1 entries."""
+        blocks = self.allocator.alloc(n)
+        if blocks is None and self._prefix_index is not None:
+            shortfall = n - self.allocator.free_blocks
+            evicted = self._prefix_index.evict(max(1, shortfall))
+            if evicted:
+                self._prefix_evicted_total += len(evicted)
+                if self._sanitizer is not None:
+                    self._sanitizer.on_unshare(evicted)
+                    self._sanitizer.on_free(evicted)
+                blocks = self.allocator.alloc(n)
+        if blocks is not None and self._sanitizer is not None:
+            self._sanitizer.on_alloc(blocks, uid=uid)
+        return blocks
 
     def _step_estimate_s(self) -> Optional[float]:
         """PER-TOKEN wall estimate for predictive deadline shedding:
@@ -1172,7 +1351,8 @@ class ServingEngine:
             est = est / max(1.0, self._spec_rate_ema)
         return est
 
-    def _start(self, slot: int, req: Request, blocks: List[int], new: int):
+    def _start(self, slot: int, req: Request, blocks: List[int], new: int,
+               share: Optional[dict] = None):
         fault.site("serving.prefill")
         tr = self._traces.get(req.uid)
         m_admit = time.monotonic() if tr is not None else 0.0
@@ -1180,6 +1360,9 @@ class ServingEngine:
             # queue wait ends the instant this request is seated
             self._trace_span(req.uid, "queue_wait", tr["m0"],
                              m_admit - tr["m0"])
+        if share is not None:
+            self._start_shared(slot, req, blocks, new, share)
+            return
         c = self.config
         T = int(len(req.tokens))
         bucket = pk.blocks_needed(T, c.block_size) * c.block_size
@@ -1208,9 +1391,9 @@ class ServingEngine:
             if self._sanitizer is not None:
                 self._sanitizer.on_scrub(blocks, uid=req.uid)
             self._set_blocks(blocks, poison=False)
-            self.allocator.free(blocks)
+            released = self.allocator.free(blocks)
             if self._sanitizer is not None:
-                self._sanitizer.on_free(blocks, uid=req.uid)
+                self._sanitizer.on_free(released, uid=req.uid)
             logger.warning(
                 f"serving: request {req.uid} QUARANTINED at prefill — "
                 f"non-finite logits; typed '{POISONED}' result "
@@ -1244,10 +1427,89 @@ class ServingEngine:
             # the poison rides the data, exactly like real KV corruption).
             # Only a slot that will actually decode is poisoned: a
             # request finishing at prefill frees its blocks above, and
-            # they must go back clean.
+            # they must go back clean.  A chaos-poisoned slot is NOT
+            # published below — its NaN'd prompt blocks must never be
+            # served to another tenant.
             if self._sanitizer is not None:
                 self._sanitizer.on_quarantine(blocks, uid=req.uid)
             self._set_blocks(blocks, poison=True)
+        elif self._prefix_index is not None:
+            # publish the full PROMPT blocks immediately: decode writes
+            # land strictly above the prompt, so these blocks are final
+            # — and requests admitted in this SAME wave (co-batched)
+            # can already share them, not just successive traffic
+            self._prefix_insert(s)
+
+    def _start_shared(self, slot: int, req: Request, blocks: List[int],
+                      new: int, share: dict):
+        """Seat a prefix-HIT request without running prefill.  The
+        shared leading blocks already hold the prompt's K/V; the
+        remaining prompt tail is INGESTED through the compiled decode
+        step (teacher-forced: each step writes one prompt position's
+        K/V and its sample is discarded) until the final prompt token,
+        whose sample — at the same ``fold_in(seed, 0)`` index the
+        prefill would have used — IS the first generated token.  TTFT
+        therefore collapses to the new-suffix cost, and the output
+        stream is token-identical to the unshared path."""
+        c = self.config
+        bs = c.block_size
+        T = int(len(req.tokens))
+        ns = share["ns"]
+        prompt = [int(t) for t in np.asarray(req.tokens)]
+        pos0 = ns * bs                  # first position without K/V yet
+        donor = share["donor"]
+        if donor is not None:
+            # copy-on-write: a cached sibling block shares the leading
+            # j tokens of our first DIVERGENT block — clone it into our
+            # first private block and skip ingesting the copied run.
+            # j is clamped so position T-1 is always re-ingested (its
+            # decode step produces the first token's logits).
+            db, j = donor
+            j = min(int(j), T - 1 - pos0)
+            if j > 0:
+                self._copy_block(db, blocks[ns])
+                self._prefix_cow_total += 1
+                if self._sanitizer is not None:
+                    self._sanitizer.on_cow(db, blocks[ns], uid=req.uid)
+                pos0 += j
+        s = _Slot(req, blocks, T, new)
+        s.pending = prompt[pos0 + 1:]
+        s.shared_blocks = ns
+        s.shared_keys = list(share["keys"])
+        self._slots[slot] = s
+        self._tables[slot] = 0
+        self._tables[slot, :len(blocks)] = blocks
+        if self._sanitizer is not None:
+            self._sanitizer.on_attach(req.uid, blocks)
+        self._lengths[slot] = pos0
+        self._toks[slot] = prompt[pos0]
+        self._seeds[slot] = req.seed
+        self._ngen[slot] = 0            # no token emitted yet
+        self._temps[slot] = req.temperature
+        self._flags[slot] = req.do_sample
+        self._prefix_hits_total += 1
+        self._prefix_shared_blocks_total += ns
+        if fault.poison_uid(req.uid):
+            # logit_nan chaos: poison only the PRIVATE blocks — the
+            # shared prefix has co-tenants (and the cache) reading it
+            priv = blocks[ns:]
+            if self._sanitizer is not None:
+                self._sanitizer.on_quarantine(priv, uid=req.uid)
+            self._set_blocks(priv, poison=True)
+
+    def _copy_block(self, src: int, dst: int):
+        """Jitted whole-block clone for COW (every layer, K and V and
+        the int8 scales).  A separate tiny executable — the decode step
+        itself is untouched, so its jaxpr stays byte-identical with the
+        cache armed."""
+        if self._blockcopy is None:
+            def copier(pool, s, d):
+                return {k: v.at[:, d].set(v[:, s]) for k, v in pool.items()}
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._blockcopy = jax.jit(copier, donate_argnums=donate)
+        with jax.set_mesh(self.engine.mesh):
+            self.pool = self._blockcopy(self.pool, jnp.int32(src),
+                                        jnp.int32(dst))
 
     # ---------------------- KV snapshot/restore (docs/serving.md#kv-migration)
     def _snapshot_slot(self, slot: int) -> str:
@@ -1278,7 +1540,12 @@ class ServingEngine:
                 "do_sample": bool(s.req.do_sample),
                 "num_blocks": len(s.blocks),
                 "block_size": int(self.config.block_size),
-                "kv_bits": int(self.config.kv_bits)}}
+                "kv_bits": int(self.config.kv_bits),
+                # prefix sharing: the image is SELF-CONTAINED (every
+                # block exported once, shared or not) — the count is
+                # observability, not a restore dependency; the restorer
+                # re-establishes sharing against its own LOCAL index
+                "shared_blocks": int(s.shared_blocks)}}
         final = pk.save_block_image(sdir, f"snap-{ngen:06d}", image, meta)
         keep = self.kvs.keep_n if self.kvs is not None else 1
         atomic.rotate_checkpoints(sdir, keep, level="size")
@@ -1446,24 +1713,63 @@ class ServingEngine:
         free = [i for i, sl in enumerate(self._slots) if sl is None]
         if not free:
             raise KVRestoreError("no free slot for restore")
-        blocks = self.allocator.alloc(nb)
-        if blocks is None:
+        # prefix sharing across migration: the image is self-contained,
+        # but when the SURVIVOR's own radix index already holds the
+        # prompt's leading blocks, re-establish sharing instead of
+        # importing duplicate copies.  Restore may share every full
+        # PROMPT block (decode resumes at >= prompt_len, so its writes
+        # can never land in a shared block).  No local match degrades
+        # LOUDLY to a full private import — never a torn refcount.
+        ns = 0
+        shared: List[int] = []
+        if self._prefix_index is not None and len(self._prefix_index):
+            m = self._prefix_index.match(prompt, self.config.block_size,
+                                         limit_blocks=prompt.size
+                                         // self.config.block_size)
+            shared, ns = m["blocks"], len(m["blocks"])
+            if ns:
+                logger.info(
+                    f"serving: restore of uid {req.uid} re-established "
+                    f"prefix sharing over {ns}/{nb} block(s)")
+            else:
+                logger.warning(
+                    f"serving: restore of uid {req.uid} found no local "
+                    f"prefix match — degrading to a full private import "
+                    f"({nb} block(s) duplicated)")
+        fresh = self._alloc_blocks(nb - ns, uid=req.uid)
+        if fresh is None:
             raise KVRestoreError(
-                f"allocator cannot serve {nb} block(s) "
+                f"allocator cannot serve {nb - ns} block(s) "
                 f"({self.allocator.free_blocks} free)")
-        if self._sanitizer is not None:
-            # imported blocks enter the shadow FSM owned-and-referenced,
-            # exactly like an admit (DSTPU31x)
-            self._sanitizer.on_alloc(blocks, uid=req.uid)
+        if ns:
+            self.allocator.incref(shared)
+            self._prefix_shared_blocks_total += ns
+        blocks = list(shared) + fresh
         slot = free[0]
         try:
             fault.site("serving.crash_during_restore")
             with jax.set_mesh(self.engine.mesh):
-                self.pool = pk.import_block_image(
-                    self.pool, blocks, image, pad_to=self.nb_max)
+                if ns:
+                    # import only the private tail of the image; the
+                    # shared head's K/V is already resident (per-block
+                    # digests still verify — they are per-block)
+                    sub = dict(image,
+                               k=image["k"][:, ns:], v=image["v"][:, ns:],
+                               k_scale=image["k_scale"][:, ns:],
+                               v_scale=image["v_scale"][:, ns:],
+                               block_sha256=list(image["block_sha256"])[ns:])
+                    self.pool = pk.import_block_image(
+                        self.pool, fresh, sub, pad_to=self.nb_max)
+                else:
+                    self.pool = pk.import_block_image(
+                        self.pool, blocks, image, pad_to=self.nb_max)
             s = _Slot(req, blocks, int(prompt.size), new)
             s.out_tokens = list(out_tokens)
             s.hist.extend(out_tokens)
+            s.shared_blocks = ns
+            # wire-precision KV (and a partially image-sourced stream)
+            # never publishes into the prefix cache at finish
+            s.wire_kv = True
             self._slots[slot] = s
             self._tables[slot] = 0
             self._tables[slot, :len(blocks)] = blocks
@@ -1476,14 +1782,18 @@ class ServingEngine:
             # process — so the blocks must go home or this engine leaks
             # them for its whole remaining life (DSTPU312 at close).  A
             # real kill doesn't care either way: the allocator dies with
-            # the process.
+            # the process.  free() decrefs the shared borrow and
+            # releases the fresh blocks EXACTLY once (guarded on the
+            # fresh ids — the cache's own reference keeps shared blocks
+            # allocated), so a mid-restore crash can never tear a
+            # refcount.
             sl = self._slots[slot]
             if ((sl is None or sl.blocks is not blocks)
                     and all(self.allocator.is_allocated(b)
-                            for b in blocks)):
-                self.allocator.free(blocks)
+                            for b in fresh)):
+                released = self.allocator.free(blocks)
                 if self._sanitizer is not None:
-                    self._sanitizer.on_free(blocks, uid=req.uid)
+                    self._sanitizer.on_free(released, uid=req.uid)
             raise
         # decode resumes where the snapshot stopped: lengths trails
         # out_tokens by the one token whose KV the NEXT step writes
@@ -1558,18 +1868,32 @@ class ServingEngine:
             self._snapshot_slot_safe(slot)
         if outcome == POISONED:
             # quarantine eviction: scrub the non-finite rows out of the
-            # blocks BEFORE they return to the free list
+            # blocks BEFORE they return to the free list.  Only SOLE-
+            # OWNER blocks are scrubbed — a shared prefix block has
+            # live co-tenants (or the cache) reading it, and poison can
+            # only ever land in private blocks (the decode step writes
+            # nothing below the private boundary; attempting the shared
+            # scrub anyway is exactly what DSTPU316 catches)
+            scrub = [b for b in s.blocks
+                     if self.allocator.refcount(b) == 1]
             if self._sanitizer is not None:
-                # scrub-while-referenced is checked against OTHER live
-                # sequences — the shadow's refcount gate (the check the
-                # radix prefix cache will inherit)
-                self._sanitizer.on_scrub(s.blocks, uid=s.req.uid)
-            self._set_blocks(s.blocks, poison=False)
+                self._sanitizer.on_scrub(scrub, uid=s.req.uid)
+            if scrub:
+                self._set_blocks(scrub, poison=False)
+        elif not s.wire_kv and self._prefix_index is not None:
+            # publish this request's fully-WRITTEN prompt+output blocks
+            # into the radix cache (the cache takes its own refcount)
+            # BEFORE our release below — restored-from-image slots never
+            # publish (their KV is wire-precision, not prefill output)
+            self._prefix_insert(s)
         if self._sanitizer is not None:
             self._sanitizer.on_detach(s.req.uid)
-        self.allocator.free(s.blocks)
+        # free() decrefs; only ids that actually dropped to zero are
+        # RELEASED (cache/co-tenant-held blocks stay live) — the shadow
+        # sanitizer must see exactly the released set
+        released = self.allocator.free(s.blocks)
         if self._sanitizer is not None:
-            self._sanitizer.on_free(s.blocks, uid=s.req.uid)
+            self._sanitizer.on_free(released, uid=s.req.uid)
         rec = self.results[s.req.uid]
         rec["tokens"] = list(s.out_tokens)
         rec["outcome"] = outcome
@@ -1613,6 +1937,33 @@ class ServingEngine:
         self._ngen[slot] = 0
         self._temps[slot] = 1.0
         self._flags[slot] = False
+
+    def _prefix_insert(self, s: _Slot):
+        """Publish one finishing request's fully-written KV blocks into
+        the radix cache.  Block ``i`` is insertable iff every one of its
+        positions has real K/V: the last emitted token's KV is never
+        written (the step that would write it never ran), so the
+        writable frontier is ``prompt_len + len(out) - 1``.  Leading
+        shared blocks dedupe onto their existing entries; a same-content
+        race with another tenant's freshly-published block dedupes too
+        (our copy simply stays private and is released below)."""
+        bs = self.config.block_size
+        written = s.prompt_len + len(s.out_tokens) - 1
+        toks = s.hist                    # prompt + emitted tokens
+        parent = None
+        newly: List[int] = []
+        for i in range(written // bs):
+            b = s.blocks[i]
+            held_before = self._prefix_index.holds(b)
+            key = self._prefix_index.insert(parent, toks[i * bs:(i + 1) * bs],
+                                            b)
+            if key is None:              # collision or capped — stop chain
+                break
+            if not held_before and self._prefix_index.holds(b):
+                newly.append(b)
+            parent = key
+        if newly and self._sanitizer is not None:
+            self._sanitizer.on_share(newly, uid=s.req.uid)
 
     def _evict_poisoned(self, slot: int):
         s = self._slots[slot]
@@ -1707,8 +2058,19 @@ class ServingEngine:
                                      axis=1)
                 for i in active:
                     s = self._slots[i]
-                    toks_win[i, 1:] = ngram_draft(
-                        s.hist[-DRAFT_WINDOW:], spec.k, spec.ngram)
+                    if s.pending:
+                        # prompt ingestion (prefix sharing): draft
+                        # columns carry the next prompt tokens, teacher-
+                        # forced, so one window step writes up to k+1
+                        # prompt positions' K/V.  Any remaining columns
+                        # keep the repeated current token — they write
+                        # junk past the prompt, masked and rewritten
+                        # when decode reaches those positions.
+                        fill = s.pending[:spec.k]
+                        toks_win[i, 1:1 + len(fill)] = fill
+                    else:
+                        toks_win[i, 1:] = ngram_draft(
+                            s.hist[-DRAFT_WINDOW:], spec.k, spec.ngram)
         t0 = time.perf_counter()
         m_step = time.monotonic()      # decode-step span base (tracing)
         with jax.set_mesh(self.engine.mesh):
@@ -1757,6 +2119,32 @@ class ServingEngine:
                     # one span per decode step this request was live in
                     self._trace_span(s.req.uid, "decode", m_step, dt,
                                      step=self._steps)
+                if s.pending:
+                    # prompt ingestion (prefix sharing): the committed
+                    # columns wrote prompt K/V — their samples are
+                    # DISCARDED.  Advance stops one token short of the
+                    # prompt end: the step where pending is empty has
+                    # the final prompt token as its operand, and its
+                    # column-0 sample (key fold_in(seed, 0), ngen still
+                    # 0) IS the first generated token — the same index
+                    # the prefill path samples, so outputs stay token-
+                    # identical to the unshared path.
+                    W = out.shape[1]
+                    rem = len(s.pending)
+                    adv = W if rem >= W else rem
+                    if nonfin[i, :adv].any():
+                        self._evict_poisoned(i)
+                        continue
+                    self._lengths[i] += adv
+                    self._toks[i] = s.pending[adv - 1]
+                    del s.pending[:adv]
+                    dl = self.results[s.req.uid]["deadline"]
+                    if dl is not None and now >= dl:
+                        self._finish(i, outcome=DEADLINE)
+                    continue
+                was_ingest = s.pending is not None   # [] = final step
+                if was_ingest:
+                    s.pending = None
                 a = int(accept_len[i])
                 # emission plan: walk the accepted window until poison /
                 # eos / max_new truncates it (side-effect-free, so the
@@ -1797,6 +2185,14 @@ class ServingEngine:
                     self._spec_accepted_total += used
                 s.out_tokens.extend(plan)
                 s.hist.extend(plan)
+                if was_ingest and plan:
+                    # first token of a prefix-HIT request: TTFT stamps
+                    # here (the plain path stamps it at prefill) — by
+                    # construction one decode step after the suffix
+                    # finished ingesting, i.e. the new-suffix cost
+                    rec = self.results[s.req.uid]
+                    if rec["t_first"] is None:
+                        rec["t_first"] = now
                 if poisoned_here:
                     self._evict_poisoned(i)
                     continue
@@ -1915,6 +2311,24 @@ class ServingEngine:
             counters["migrated_streams_total"] = self._kv_migrated_total
             counters["migration_fallbacks_total"] = self._kv_fallback_total
         gauges = {}
+        if self._prefix_index is not None:
+            # prefix-sharing pressure (docs/serving.md#prefix-sharing):
+            # hit rate of admissions against the radix cache, and the
+            # fraction of logical blocks that are physically unique —
+            # ds_bench_diff classifies prefix_hit_rate higher-better and
+            # unique_block_frac lower-better
+            counters["prefix_hits_total"] = self._prefix_hits_total
+            counters["prefix_cow_total"] = self._prefix_cow_total
+            counters["prefix_evicted_total"] = self._prefix_evicted_total
+            gauges["prefix_hit_rate"] = round(
+                self._prefix_hits_total
+                / max(1, self._prefix_requests_total), 4)
+            logical = self.allocator.logical_blocks
+            gauges["unique_block_frac"] = round(
+                self.allocator.used_blocks / max(1, logical), 4)
+            scalars["shared_blocks"] = self.allocator.shared_blocks
+            scalars["prefix_cached_blocks"] = \
+                self._prefix_index.cached_blocks
         # windowed error rate from the outcome counters (the SLO
         # engine's error-budget series, docs/monitoring.md#slo-tracking):
         # bad/total over the terminal outcomes since the last EMISSION —
@@ -2228,6 +2642,13 @@ class ServingEngine:
         self._kv_tokens_saved_total = 0
         self._kv_restore_ms = []
         self._traces_emitted = 0
+        # prefix-sharing counters reset; the CACHE itself is kept (warm
+        # prefixes are the bench's measured state, not its warmup noise)
+        self._prefix_requests_total = 0
+        self._prefix_hits_total = 0
+        self._prefix_shared_blocks_total = 0
+        self._prefix_cow_total = 0
+        self._prefix_evicted_total = 0
         self._recent = RingBuffer(max(1, int(self.config.poison_window)))
 
     def stats(self) -> dict:
@@ -2282,6 +2703,20 @@ class ServingEngine:
             if self.kvs is not None:
                 kv["policy"] = self.kvs.describe()
             out["kv_snapshot"] = kv
+        if self._prefix_index is not None:
+            out["prefix_cache"] = {
+                "requests": self._prefix_requests_total,
+                "requests_hit": self._prefix_hits_total,
+                "hit_rate": round(
+                    self._prefix_hits_total
+                    / max(1, self._prefix_requests_total), 4),
+                "shared_blocks_attached": self._prefix_shared_blocks_total,
+                "cow_copies": self._prefix_cow_total,
+                "evicted_blocks": self._prefix_evicted_total,
+                "unique_blocks_in_use": self.allocator.used_blocks,
+                "logical_blocks": self.allocator.logical_blocks,
+                "index": self._prefix_index.stats(),
+                "policy": self.prefix.describe()}
         return out
 
     def compile_report(self):
@@ -2300,6 +2735,13 @@ class ServingEngine:
             # a drain failure (wedged backend, armed crash site) must not
             # leak the pool/executables/journal fd: teardown runs anyway
             self.drain()
+            if self._prefix_index is not None:
+                # the cache's references are deliberate, not leaks:
+                # release them BEFORE the shadow leak check below
+                dropped, released = self._prefix_index.clear()
+                if self._sanitizer is not None:
+                    self._sanitizer.on_unshare(dropped)
+                    self._sanitizer.on_free(released)
             if self._sanitizer is not None:
                 # after a clean drain every block must be home —
                 # anything still allocated is a leak (DSTPU312)
@@ -2321,6 +2763,7 @@ class ServingEngine:
             self._decode = None
             self._prefills.clear()
             self._blockset = None
+            self._blockcopy = None
             self.pool = None
             if self._owns_monitor:
                 self.monitor.close()

@@ -339,10 +339,22 @@ def attribute_serving(srv) -> MemoryLedger:
         total = pk.pool_bytes(pool)
         per_block = total // max(1, srv.num_blocks)
         used = srv.allocator.used_blocks
-        led.add("hbm", PAGED_KV_POOL, total,
-                blocks=srv.num_blocks, used_blocks=used,
-                request_blocks_bytes=used * per_block,
-                free_blocks=srv.allocator.free_blocks)
+        detail = dict(blocks=srv.num_blocks, used_blocks=used,
+                      request_blocks_bytes=used * per_block,
+                      free_blocks=srv.allocator.free_blocks)
+        if getattr(srv, "_prefix_index", None) is not None:
+            # prefix sharing (docs/serving.md#prefix-sharing): the
+            # shared/unique split — `used` above already counts UNIQUE
+            # physical blocks; `logical` is what the same traffic would
+            # cost without sharing (sum of refcounts)
+            detail.update(
+                unique_blocks=used,
+                shared_blocks=srv.allocator.shared_blocks,
+                logical_blocks=srv.allocator.logical_blocks,
+                prefix_cached_blocks=srv._prefix_index.cached_blocks,
+                shared_saved_bytes=(srv.allocator.logical_blocks - used)
+                * per_block)
+        led.add("hbm", PAGED_KV_POOL, total, **detail)
     fns = (srv._decode, *srv._prefills.values())
     # weights are immutable for a serving engine's lifetime: latched
     # with the other static terms so the periodic hot-loop pass never

@@ -760,9 +760,12 @@ def test_lifecycle_specs_well_formed():
         assert set(fsm["transitions"]) == states
         for frm, tos in fsm["transitions"].items():
             assert set(tos) <= states, (fsm["name"], frm)
-    assert (sz.FREE, sz.ALLOCATED, sz.QUARANTINED) \
+    assert (sz.FREE, sz.ALLOCATED, sz.QUARANTINED, sz.SHARED, sz.COW) \
         == lc.KV_BLOCK_FSM["states"]
     assert lc.REPLICA_FSM["transitions"]["DEAD"] == ()   # terminal
+    # sharing edges (PR 19): quarantine only from sole-owner allocated
+    assert "quarantined" not in lc.KV_BLOCK_FSM["transitions"]["shared"]
+    assert lc.KV_BLOCK_FSM["transitions"]["cow"] == ("allocated",)
 
 
 def test_stale_suppression_warns():

@@ -225,7 +225,7 @@ def test_admit_prefill_exception_frees_blocks(tiny, devices):
                                              sanitize=True))
     before = srv.allocator.free_blocks
 
-    def boom(slot, req, blocks, new):
+    def boom(slot, req, blocks, new, **kw):
         raise RuntimeError("poisoned prefill")
 
     srv._start = boom
