@@ -1350,6 +1350,283 @@ def measure_serving_migration_chaos(*, replicas=3, streams=9, prompt_len=24,
     }
 
 
+def measure_serving_disagg_longmix(*, long_streams=3, short_streams=5,
+                                   long_prompt=56, short_prompt=6,
+                                   new_tokens=32, batch_slots=4,
+                                   block_size=8, timeout_s=300,
+                                   cache_dir=None):
+    """Prefill/decode disaggregation rung (docs/serving.md#disaggregation):
+    a long+short prompt mix served TWICE over identical traffic —
+
+    - **mixed phase**: one classic engine; every long-prompt admission
+      runs bucketed prefill inside the shared step loop, so co-batched
+      decoding streams eat the prefill stall as inter-token latency;
+    - **disaggregated phase**: a ``role=prefill`` engine publishes each
+      stream's paged-KV block image through the transfer queue and a
+      ``role=decode`` engine seats it restore-first and decodes at
+      steady cadence — prefill never preempts a decode step.
+
+    Each engine is timed on its OWN busy clock (per-step wall attributed
+    to the tokens that step emitted), modelling dedicated role workers:
+    queue-wait while the OTHER engine computes is not decode latency.
+    Headlines: ``decode_cadence_p99_ms`` (inter-token p99, the metric
+    the role split exists to flatten), ``ttft_ms``, and the honest
+    per-handoff cost — ``handoff_ms`` (publish + restore) and
+    ``handoff_bytes`` per stream.  Both phases must be token-identical
+    (sampling is a pure function of ``(seed, token_index)``, so the
+    handoff edge cannot perturb it)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+    from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
+                                         Request, OK)
+    from deepspeed_tpu.inference.transfer import TRANSFERRED
+
+    cap = new_tokens + 1
+    cfg = GPT2Config(vocab_size=256, max_seq=96, n_embd=64, n_layer=4,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(31)
+    # shorts first, longs landing between them: the longs' prefills hit
+    # while the shorts are mid-decode — the preemption the mixed phase
+    # must pay and the disaggregated phase must not
+    plens = []
+    s_left, l_left = short_streams, long_streams
+    while s_left or l_left:
+        if s_left:
+            plens.append(short_prompt)
+            s_left -= 1
+        if l_left:
+            plens.append(long_prompt)
+            l_left -= 1
+    specs = [(rng.integers(0, 256, (p,)), 700 + i, (i % 2 == 0), 0.8)
+             for i, p in enumerate(plens)]
+
+    def _reqs():
+        return [Request(tokens=tok.copy(), max_new_tokens=new_tokens,
+                        seed=seed, do_sample=ds, temperature=temp, uid=i)
+                for i, (tok, seed, ds, temp) in enumerate(specs)]
+
+    def _scan(eng, busy, st, fresh):
+        # attribute this step's busy-clock advance to the tokens it
+        # emitted: first token = TTFT (fresh engines only — a restored
+        # stream's prefill-side tokens are the OTHER engine's credit),
+        # later tokens = inter-token gaps
+        for s in eng._slots:
+            if s is None:
+                continue
+            uid, n = int(s.req.uid), len(s.out_tokens)
+            if uid not in st["seen"]:
+                st["seen"][uid] = 0 if fresh else n
+                st["last"][uid] = busy
+                if fresh and n > 0:
+                    st["ttft"][uid] = busy
+                    st["seen"][uid] = n
+                continue
+            k = st["seen"][uid]
+            if n > k:
+                if k == 0 and fresh:
+                    st["ttft"][uid] = busy
+                else:
+                    dt_ms = (busy - st["last"][uid]) * 1e3 / (n - k)
+                    st["gaps"].extend([dt_ms] * (n - k))
+                st["last"][uid] = busy
+                st["seen"][uid] = n
+
+    def _pcts(gaps):
+        if not gaps:
+            return None
+        a = np.asarray(gaps, np.float64)
+        return {"p50": round(float(np.percentile(a, 50)), 3),
+                "p99": round(float(np.percentile(a, 99)), 3),
+                "max": round(float(a.max()), 3), "n": int(a.size)}
+
+    def _mk(role=None, journal_dir=None, transfer=None):
+        return ServingEngine(
+            model=model, params=params, compile_cache=cache_dir,
+            config=ServingConfig(batch_slots=batch_slots,
+                                 block_size=block_size,
+                                 max_new_tokens=cap, kv_bits=8,
+                                 preflight=False,
+                                 **({"role": role, "journal_dir": journal_dir,
+                                     "transfer": transfer} if role else {})))
+
+    def _warm_reqs():
+        # one request per prefill bucket (long + short) so every
+        # executable — bucketed prefill, fused decode, and on the role
+        # pair the publish/restore path — compiles OUTSIDE the measured
+        # window; compile time is a one-time cost, not decode cadence
+        return [Request(tokens=np.arange(long_prompt) % 256,
+                        max_new_tokens=2, seed=1, uid=900001),
+                Request(tokens=np.arange(short_prompt) % 256,
+                        max_new_tokens=2, seed=2, uid=900002)]
+
+    def _phase_mixed():
+        eng = _mk()
+        try:
+            eng.run(_warm_reqs())
+            eng.reset_stats()
+            uids = [eng.submit(r) for r in _reqs()]
+            st = {"seen": {}, "last": {}, "ttft": {}, "gaps": []}
+            busy, steps = 0.0, 0
+            deadline = time.monotonic() + timeout_s / 2
+            while any(eng.results[u]["outcome"] is None for u in uids):
+                t0 = time.perf_counter()
+                eng.step()
+                busy += time.perf_counter() - t0
+                _scan(eng, busy, st, fresh=True)
+                steps += 1
+                if time.monotonic() > deadline or steps > 20_000:
+                    break
+            res = {u: dict(eng.results[u]) for u in uids}
+            return {"results": res, "busy_s": busy, "steps": steps,
+                    "ttft": st["ttft"], "gaps": st["gaps"]}
+        finally:
+            eng.close()
+
+    def _phase_disagg(root):
+        qdir = os.path.join(root, "xferq")
+        pre = _mk("prefill", os.path.join(root, "prefill"),
+                  {"dir": qdir, "max_pending": 64})
+        dec = _mk("decode", os.path.join(root, "decode"), {"dir": qdir})
+        try:
+            def _done(u):
+                dr = dec.results.get(u)
+                if dr is not None and dr["outcome"] is not None:
+                    return True
+                pr = pre.results.get(u)
+                return (pr is not None and pr["outcome"] is not None
+                        and pr["outcome"] != TRANSFERRED)
+
+            # warm the WHOLE handoff pipeline (prefill buckets, publish,
+            # claim+restore, fused decode) before the measured window
+            wuids = [pre.submit(r) for r in _warm_reqs()]
+            deadline = time.monotonic() + timeout_s / 4
+            while not all(_done(u) for u in wuids):
+                pre.step()
+                dec.step()
+                if time.monotonic() > deadline:
+                    break
+            pre.reset_stats()
+            dec.reset_stats()
+
+            uids = [pre.submit(r) for r in _reqs()]
+            pst = {"seen": {}, "last": {}, "ttft": {}, "gaps": []}
+            dst = {"seen": {}, "last": {}, "ttft": {}, "gaps": []}
+            pre_busy, dec_busy, steps = 0.0, 0.0, 0
+            deadline = time.monotonic() + timeout_s / 2
+            while not all(_done(u) for u in uids):
+                t0 = time.perf_counter()
+                pre.step()
+                pre_busy += time.perf_counter() - t0
+                _scan(pre, pre_busy, pst, fresh=True)
+                for u in uids:
+                    # a published slot retires in its admitting step,
+                    # before any scan sees it: the transferred outcome
+                    # IS the first-token stamp on the prefill clock
+                    r = pre.results.get(u)
+                    if r is not None and r["outcome"] is not None:
+                        pst["ttft"].setdefault(u, pre_busy)
+                t0 = time.perf_counter()
+                dec.step()
+                dec_busy += time.perf_counter() - t0
+                _scan(dec, dec_busy, dst, fresh=False)
+                steps += 1
+                if time.monotonic() > deadline or steps > 20_000:
+                    break
+            res = {}
+            for u in uids:
+                dr = dec.results.get(u)
+                pr = pre.results.get(u)
+                res[u] = dict(dr if dr is not None
+                              and dr["outcome"] is not None else pr)
+            pre_stats, dec_stats = pre.stats(), dec.stats()
+            return {"results": res, "steps": steps,
+                    "prefill_busy_s": pre_busy, "decode_busy_s": dec_busy,
+                    "ttft": pst["ttft"], "gaps": dst["gaps"],
+                    "pre_stats": pre_stats, "dec_stats": dec_stats}
+        finally:
+            pre.close()
+            dec.close()
+
+    mixed = _phase_mixed()
+    root = tempfile.mkdtemp(prefix="serving-disagg-")
+    try:
+        dis = _phase_disagg(root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    n = len(specs)
+    mism = sum(
+        1 for u in range(n)
+        if mixed["results"][u]["outcome"] == OK
+        and dis["results"][u]["outcome"] == OK
+        and list(mixed["results"][u]["tokens"])
+        != list(dis["results"][u]["tokens"]))
+    tr = dis["pre_stats"].get("transfer") or {}
+    kv = dis["dec_stats"].get("kv_snapshot") or {}
+    pub = (tr.get("handoff_ms") or {})
+    rst = (kv.get("restore_ms") or {})
+    transferred = int(tr.get("published_by_this_engine", 0))
+    handoff = {
+        "publish_mean_ms": pub.get("mean"), "publish_max_ms": pub.get("max"),
+        "restore_mean_ms": rst.get("mean"), "restore_max_ms": rst.get("max"),
+        "per_stream_handoff_ms": (
+            round(pub.get("mean", 0.0) + rst.get("mean", 0.0), 3)
+            if pub and rst else None),
+        "handoff_bytes_total": int(tr.get(
+            "published_bytes_by_this_engine", 0)),
+        "handoff_bytes_per_stream": (
+            int(tr.get("published_bytes_by_this_engine", 0) // transferred)
+            if transferred else None)}
+    m_p, d_p = _pcts(mixed["gaps"]), _pcts(dis["gaps"])
+    m_ttft, d_ttft = mixed["ttft"], dis["ttft"]
+
+    def _ttft_ms(tt):
+        return (round(float(np.median([v * 1e3 for v in tt.values()])), 3)
+                if tt else None)
+
+    out = {
+        "streams": n, "long_prompt": long_prompt,
+        "short_prompt": short_prompt, "new_tokens": new_tokens,
+        "batch_slots": batch_slots, "kv_bits": 8,
+        "mixed": {
+            "decode_cadence_p99_ms": (m_p or {}).get("p99"),
+            "decode_cadence_ms": m_p, "ttft_p50_ms": _ttft_ms(m_ttft),
+            "busy_s": round(mixed["busy_s"], 3), "steps": mixed["steps"],
+            "outcomes": _outcome_counts(mixed["results"])},
+        "disaggregated": {
+            "decode_cadence_p99_ms": (d_p or {}).get("p99"),
+            "decode_cadence_ms": d_p, "ttft_p50_ms": _ttft_ms(d_ttft),
+            "prefill_busy_s": round(dis["prefill_busy_s"], 3),
+            "decode_busy_s": round(dis["decode_busy_s"], 3),
+            "steps": dis["steps"],
+            "outcomes": _outcome_counts(dis["results"]),
+            "transferred_streams": transferred,
+            "migrated_streams": kv.get("migrated_streams", 0),
+            "migration_fallbacks": kv.get("migration_fallbacks", 0),
+            "backpressure_degraded": tr.get("backpressure_degraded", 0)},
+        "handoff": handoff,
+        "token_mismatches": mism,
+        "token_identical": mism == 0,
+        "disagg_p99_better": (
+            d_p["p99"] < m_p["p99"] if m_p and d_p else None),
+    }
+    return out
+
+
+def _outcome_counts(results):
+    out = {}
+    for rec in results.values():
+        out[str(rec["outcome"])] = out.get(str(rec["outcome"]), 0) + 1
+    return out
+
+
 def measure_serving_shared_prefix(*, users=6, preamble_len=48, suffix_len=6,
                                   new_tokens=16, batch_slots=4, block_size=8,
                                   num_blocks=21, ttft_slo_ms=5000.0,
@@ -2228,6 +2505,20 @@ def main():
             extra["serving_migration_chaos"] = {"error": str(e)[:160]}
     else:
         extra["serving_migration_chaos"] = {"skipped": "time budget"}
+
+    # disaggregation rung (docs/serving.md#disaggregation): the same
+    # long+short prompt mix served mixed vs role-split (prefill worker
+    # publishing paged-KV block images through the transfer queue to a
+    # pure-decode worker) — decode inter-token p99 must flatten, with
+    # the honest per-handoff publish+restore cost reported
+    if left() > 4 * 60:
+        try:
+            extra["serving_disagg_longmix"] = \
+                measure_serving_disagg_longmix(cache_dir=cache_dir)
+        except Exception as e:
+            extra["serving_disagg_longmix"] = {"error": str(e)[:160]}
+    else:
+        extra["serving_disagg_longmix"] = {"skipped": "time budget"}
 
     # prefix-sharing rung (docs/serving.md#prefix-sharing): the
     # shared-preamble mix served with the copy-on-write radix cache

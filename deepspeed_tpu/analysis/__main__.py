@@ -347,15 +347,19 @@ def _audit_serving_lifecycle():
       about a clean run);
     - **interleaving sweeps** — the full 720-ordering
       :func:`~.interleave.crash_handoff_scenario` permutation sweep
-      over the real router, and the 720-ordering
-      :func:`~.interleave.prefix_sharing_scenario` refcount sweep over
-      the real allocator + radix cache, must report zero violations."""
+      over the real router, the 720-ordering
+      :func:`~.interleave.disagg_handoff_scenario` prefill→decode
+      handoff sweep (publish/announce/torn-publish/crash racing), and
+      the 720-ordering :func:`~.interleave.prefix_sharing_scenario`
+      refcount sweep over the real allocator + radix cache, must all
+      report zero violations."""
     import numpy as np
     import jax
     import jax.numpy as jnp
     from .findings import Finding
     from . import sanitize
-    from .interleave import explore, prefix_sharing_scenario
+    from .interleave import (explore, disagg_handoff_scenario,
+                             prefix_sharing_scenario)
     from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
     from deepspeed_tpu.inference import (ServingEngine, ServingConfig,
                                          Request)
@@ -391,6 +395,9 @@ def _audit_serving_lifecycle():
     seeded(sanitize.SCRUB_SHARED,
            lambda s: (s.on_alloc([3]), s.on_share([3]),
                       s.on_scrub([3], uid=1)))
+    seeded(sanitize.DOUBLE_IMPORT,
+           lambda s: (s.on_alloc([2, 3]),
+                      s.on_import([3], uid=1, resident=[2])))
 
     # ---- jaxpr parity + token identity: armed vs off ----------------
     cfg = GPT2Config(vocab_size=64, max_seq=32, n_embd=32, n_layer=2,
@@ -431,6 +438,25 @@ def _audit_serving_lifecycle():
                 f"--audit-step serving-lifecycle: uid {uid} tokens "
                 f"differ armed vs off — the sanitizer perturbed the "
                 f"computation", eqn_path="sanitize/token-identity"))
+    # roles armed (docs/serving.md#disaggregation): the whole handoff
+    # is host-side file I/O — a decode-role worker with the transfer
+    # queue armed must trace the SAME decode step as the mixed engine
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="dstpu-disagg-") as td:
+        srv = ServingEngine(
+            model=model, params=params,
+            config=ServingConfig(role="decode",
+                                 transfer={"dir": td}, **scfg))
+        srv._build_decode()
+        jx_role = str(jax.make_jaxpr(srv._decode)(*srv._decode_args()))
+        srv.close()
+    if jx_role != jx_off:
+        findings.append(Finding(
+            "DSTPU201", "error",
+            "--audit-step serving-lifecycle: arming serving.role/"
+            "transfer CHANGED the traced decode step (jaxpr decode-"
+            "role != mixed) — the transfer plane must stay host-side "
+            "file I/O", eqn_path="transfer/jaxpr-equality"))
     san_stats = stats_on.get("sanitizer") or {}
     if san_stats.get("findings", 0):
         findings.append(Finding(
@@ -446,7 +472,8 @@ def _audit_serving_lifecycle():
             eqn_path="sanitize/clean-run"))
 
     # ---- interleaving sweeps ----------------------------------------
-    for report in (explore(), explore(prefix_sharing_scenario())):
+    for report in (explore(), explore(disagg_handoff_scenario()),
+                   explore(prefix_sharing_scenario())):
         if not report["ok"]:
             findings.extend(report["findings"])
         if report["explored"] != report["total_permutations"]:

@@ -82,6 +82,12 @@ LOWER_BETTER_MIGRATION = ("migration_fallbacks",)
 # means the radix cache is deduplicating LESS of the co-tenant KV
 # (prefix_hit_rate gates the other direction via HIGHER_BETTER)
 LOWER_BETTER_PREFIX = ("unique_block_frac",)
+# disaggregation family (docs/serving.md#disaggregation): the per-stream
+# handoff cost (publish + seat + restore) and the decode-side
+# inter-token p99 the role split exists to flatten — both explicit here
+# even though the _ms suffix rule would catch them: the rung's headline
+# metrics must never silently drop to informational under a rename
+LOWER_BETTER_DISAGG = ("handoff_ms", "decode_cadence_p99_ms")
 # exact count contracts where ZERO is the baseline by design: any
 # growth regresses even though a relative band cannot gate it (the
 # zero-baseline report-never-regress policy below is for
@@ -99,7 +105,7 @@ def classify(key: str):
     for name in (LOWER_BETTER + LOWER_BETTER_BYTES + LOWER_BETTER_MEM
                  + LOWER_BETTER_SLO + LOWER_BETTER_ROUTER
                  + LOWER_BETTER_SANITIZE + LOWER_BETTER_MIGRATION
-                 + LOWER_BETTER_PREFIX):
+                 + LOWER_BETTER_PREFIX + LOWER_BETTER_DISAGG):
         if name in k:
             return "lower"
     if k.endswith(LOWER_BETTER_SUFFIX):

@@ -50,6 +50,7 @@ import numpy as np
 
 from ..checkpoint import atomic
 from ..inference import journal as jr
+from ..inference import transfer as xfer
 from ..inference.router import (ReplicaRouter, ReplicaHandle, RouterConfig,
                                 HEALTHY, DRAINING)
 from ..inference.serving import Request, OK, stream_snapshot_dir
@@ -102,7 +103,7 @@ class ScriptedReplica(ReplicaHandle):
             os.makedirs(self._jdir, exist_ok=True)
 
     # ------------------------------------------------ handle interface
-    def submit(self, req, snapshot_dir=None):
+    def submit(self, req, snapshot_dir=None, seat=None):
         self.inbox.append(req)
         if snapshot_dir is not None:
             # resolve the restore EAGERLY, like submit_restored: seat
@@ -176,6 +177,18 @@ class ScriptedReplica(ReplicaHandle):
         if self._journal is None:
             self._journal = jr.RequestJournal(self._jdir, clock=self._clock)
         self._journal.finish(int(uid), outcome, list(tokens))
+
+    def journal_transfer(self, uid, entry, gen, seat):
+        """Durably journal a publish exactly like a prefill worker's
+        ``_publish_slot``: the eager ``transfer`` record first, then the
+        ``transferred`` finish that retires the slot — so a recovering
+        router sees the handoff, never a pending uid with lost work."""
+        assert self._jdir is not None, f"replica {self.name} has no journal"
+        if self._journal is None:
+            self._journal = jr.RequestJournal(self._jdir, clock=self._clock)
+        self._journal.transfer(int(uid), entry, gen, 0, 0.0, seat=seat)
+        self._journal.finish(int(uid), xfer.TRANSFERRED, None)
+        self._journal.flush()
 
 
 class _AuditedRouter(ReplicaRouter):
@@ -359,6 +372,138 @@ def migration_scenario():
               ("break-restore-b", ev_break_restore_b),
               ("journal-finish-a", ev_journal_finish_a)]
     return {"name": "kv-migration", "build": build, "events": events}
+
+
+def disagg_handoff_scenario():
+    """The prefill→decode handoff event alphabet
+    (docs/serving.md#disaggregation): replica ``a`` plays the prefill
+    worker for one of its streams — it commits a transfer entry through
+    the real stage/manifest/rename protocol, journals the ``transfer``
+    record + ``transferred`` finish (the durability order
+    ``_publish_slot`` guarantees), and retires the stream from its own
+    inbox.  The handoff can then reach the router two racing ways: the
+    poll-surface ``kind=transfer`` record (possibly LATE, from the
+    grave), or the crash path — ``a`` dies and ``_handoff`` must seat
+    the uid from ``find_transfer_entry`` instead of adopting the
+    prefill side's partial state.  A SECOND, poisoned entry is staged
+    but never committed (torn publish), and a journaled finish of an
+    unrelated uid races everything.  6 events → 720 orderings.
+
+    On top of the base contracts the migration oracles carry over: the
+    no-stale-tokens ledger proves the decode side resumes AT the seat
+    position (never re-emitting the prefill worker's tokens), and the
+    torn entry is never seated at all."""
+
+    def build(workdir):
+        clock = StepClock(1000.0)
+        ledger = []
+        a = ScriptedReplica("a", clock, journal_root=workdir,
+                            ledger=ledger)
+        b = ScriptedReplica("b", clock, ledger=ledger)
+        cfg = RouterConfig(
+            suspect_after_s=1.0, dead_after_s=4.0,
+            probe_retry=RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                                    max_delay_s=0.2, jitter_mode="full",
+                                    seed=7, sleep=lambda s: None),
+            monitor_interval=1)
+        router = _AuditedRouter([a, b], cfg, clock=clock)
+        uids = [router.submit(Request(tokens=np.arange(4) % 64,
+                                      max_new_tokens=2, seed=i))
+                for i in range(3)]
+        router.pump()                       # deterministic placement
+        a_uids = sorted(router._replicas["a"].assigned)
+        assert len(a_uids) >= 2, \
+            "scenario assumes replica a took the transfer AND the " \
+            "journaled-finish stream"
+        return {"router": router, "clock": clock, "a": a, "b": b,
+                "uids": uids, "a_uids": a_uids, "token_fn": _token_fn,
+                "ledger": ledger, "snap_pos": {},
+                "xfer_entry": None, "announced": False}
+
+    def ev_pump(w):
+        w["router"].pump()
+
+    def _announce(w):
+        # the poll surface of a publish: the SAME record LocalReplica
+        # /ProcessReplica.poll translate a transferred outcome into
+        uid = w["a_uids"][0]
+        w["a"]._answers.append({"kind": "transfer", "uid": uid,
+                                "entry": w["xfer_entry"],
+                                "seat": w["xfer_seat"], "gen": 1,
+                                "bytes": 0})
+        w["announced"] = True
+
+    def ev_publish_a(w):
+        # the prefill worker finishes prefill + first token and commits
+        # the handoff: entry on disk (atomic), journal records durable,
+        # stream retired from the local inbox — only a LIVE replica
+        # publishes (the engine died with the process otherwise)
+        if w["a"].exited or w["xfer_entry"] is not None:
+            return
+        uid = w["a_uids"][0]
+        pos = 1
+        qdir = xfer.transfer_dir(w["a"].journal_dir)
+        tag = f"xfer-{uid:08d}-{pos:06d}"
+        stage = atomic.stage_path(qdir, tag)
+        os.makedirs(stage, exist_ok=True)
+        with open(os.path.join(stage, "stream.json"), "w") as f:
+            json.dump({"uid": uid, "pos": pos,
+                       "prefix": w["token_fn"](uid)[:pos]}, f)
+        seat = {"uid": uid, "gen": pos,
+                "first_token": w["token_fn"](uid)[0]}
+        atomic.write_manifest(stage, meta={"global_steps": pos,
+                                           "kind": "kv_transfer",
+                                           "seat": seat})
+        atomic.commit_staged(qdir, tag)
+        w["xfer_entry"] = os.path.join(qdir, tag)
+        w["xfer_seat"] = seat
+        w["snap_pos"][uid] = pos
+        w["a"].journal_transfer(uid, w["xfer_entry"], pos, seat)
+        w["a"].inbox = [r for r in w["a"].inbox if int(r.uid) != uid]
+
+    def ev_torn_publish_a(w):
+        # crash mid-publish: staged, no manifest, no rename — invisible
+        # to find_valid_tags/find_transfer_entry.  Poisoned content: if
+        # any path ever seats it, the token-identity oracle screams
+        uid = w["a_uids"][0]
+        qdir = xfer.transfer_dir(w["a"].journal_dir)
+        stage = atomic.stage_path(qdir, f"xfer-{uid:08d}-{2:06d}")
+        os.makedirs(stage, exist_ok=True)
+        with open(os.path.join(stage, "stream.json"), "w") as f:
+            json.dump({"uid": uid, "pos": 1, "prefix": [999]}, f)
+
+    def ev_announce_transfer_a(w):
+        # the publish reaches the router via poll — legal even frozen or
+        # dead (a late answer from the corpse is exactly the set-once
+        # dedup case); meaningless before the publish exists
+        if w["xfer_entry"] is None or w["announced"]:
+            return
+        _announce(w)
+
+    def ev_crash_a(w):
+        w["a"].exited = True
+
+    def ev_journal_finish_a(w):
+        uid = w["a_uids"][-1]
+        w["a"].journal_finish(uid, w["token_fn"](uid))
+
+    def settle(w):
+        # a committed publish ALWAYS reaches the router eventually: by
+        # the poll surface (inject it now if the ordering skipped it) or
+        # by _handoff's find_transfer_entry after the crash — both in
+        # the same settle, the second arrival must dedup
+        if w["xfer_entry"] is not None and not w["announced"]:
+            _announce(w)
+        _settle(w)
+
+    events = [("pump", ev_pump),
+              ("publish-a", ev_publish_a),
+              ("torn-publish-a", ev_torn_publish_a),
+              ("announce-transfer-a", ev_announce_transfer_a),
+              ("crash-a", ev_crash_a),
+              ("journal-finish-a", ev_journal_finish_a)]
+    return {"name": "disagg-handoff", "build": build, "events": events,
+            "settle": settle}
 
 
 def prefix_sharing_scenario():

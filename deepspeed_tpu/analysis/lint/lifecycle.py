@@ -64,16 +64,23 @@ KV_BLOCK_FSM = {
 
 REQUEST_FSM = {
     "name": "request-uid",
-    "states": ("submitted", "queued", "placed", "journaled", "completed",
-               "popped"),
+    "states": ("submitted", "queued", "placed", "journaled", "transferred",
+               "completed", "popped"),
     "initial": "submitted",
     "transitions": {
         # shed/deadline-at-admit may complete a uid from any pre-placed
         # state; results are set once, then popped exactly once
         "submitted": ("queued", "completed"),
         "queued": ("placed", "completed"),
-        "placed": ("journaled", "completed"),
-        "journaled": ("completed",),
+        # disaggregation (docs/serving.md#disaggregation): a prefill
+        # worker retires the uid with the TRANSFERRED outcome — the
+        # handoff edge, not a terminal answer; the decode side (or the
+        # router's recompute fallback) completes it.  transferred ->
+        # placed is the re-seat: the stream is admitted again on the
+        # decode worker through the restore path.
+        "placed": ("journaled", "transferred", "completed"),
+        "journaled": ("transferred", "completed"),
+        "transferred": ("placed", "completed"),
         "completed": ("popped",),
         "popped": (),
     },
@@ -118,7 +125,8 @@ PROTECTED_ATTRS = {
     "_by_block": ("PrefixIndex",),       # radix cache: block -> key
     "_lru": ("PrefixIndex",),            # radix cache eviction order
     "_buf": ("RequestJournal",),         # journal append buffer
-    "assigned": ("_ReplicaState", "_place", "_record_result", "_handoff"),
+    "assigned": ("_ReplicaState", "_place", "_record_result", "_handoff",
+                 "_seat_transfer"),
     # slot block tables: _restore_stream is the migration-era second
     # admission path (seats a restored slot) and _start_shared the
     # prefix-cache-hit seat — peers of _start
@@ -150,7 +158,8 @@ TERMINAL_FIELDS = ("outcome", "tokens", "t_done")
 
 SCOPE_DIR = "deepspeed_tpu/inference/"
 _SCOPE_FILES = ("inference/router.py", "inference/serving.py",
-                "inference/journal.py", "inference/paged_kv.py")
+                "inference/journal.py", "inference/paged_kv.py",
+                "inference/transfer.py")
 
 
 def _norm(relpath):

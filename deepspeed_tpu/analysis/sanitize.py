@@ -32,6 +32,11 @@ What it catches (each a typed :class:`~..findings.Finding`):
   prefix cache (or a second co-tenant) still holds a read-only
   reference to: the kv-block FSM allows quarantine only from the
   sole-owner ``allocated`` state, never from ``shared``.
+- **DSTPU317 double-import** — a restore imported a private copy of a
+  prompt block the PrefixIndex already holds resident: the correct
+  path increfs-and-shares the resident block (restore re-share,
+  docs/serving.md#disaggregation); importing a duplicate is silent
+  pool waste that admission then double-charges.
 
 Arming (OFF by default, resolution highest-wins):
 ``deepspeed --sanitize`` (launcher) -> env ``DSTPU_SANITIZE`` -> config
@@ -56,10 +61,11 @@ SCRATCH_WRITE = "DSTPU313"
 DOUBLE_SERVE = "DSTPU314"
 SCRUB_REFERENCED = "DSTPU315"
 SCRUB_SHARED = "DSTPU316"
+DOUBLE_IMPORT = "DSTPU317"
 
 SANITIZER_CODES = (DOUBLE_FREE, USE_AFTER_FREE, LEAK_AT_CLOSE,
                    SCRATCH_WRITE, DOUBLE_SERVE, SCRUB_REFERENCED,
-                   SCRUB_SHARED)
+                   SCRUB_SHARED, DOUBLE_IMPORT)
 
 
 def env_enabled():
@@ -314,6 +320,35 @@ class ShadowSanitizer:
                            holder=holder)
             self.shadow[b] = FREE
 
+    def on_import(self, blocks, uid=None, resident=()):
+        """A restore imported wire K/V into the fresh private ``blocks``
+        (disaggregated handoff or crash migration).  ``resident`` is
+        the engine's evidence list: cache-resident prompt blocks the
+        restore imported a DUPLICATE of instead of incref-and-sharing —
+        non-empty means the re-share path regressed (DSTPU317).  An
+        imported block that the shadow says the cache holds is the same
+        defect caught from the other side: wire bytes would overwrite a
+        cached prefix under its readers."""
+        self.checks += 1
+        resident = [int(b) for b in resident]
+        if resident:
+            self._emit(DOUBLE_IMPORT,
+                       f"restore of uid {uid} imported private "
+                       f"duplicate(s) of {len(resident)} prefix-cache-"
+                       f"resident block(s) {resident[:16]} — the restore "
+                       f"path must incref-and-share resident prefixes, "
+                       f"not re-import them", blocks=resident[:64],
+                       uid=uid)
+        for b in blocks:
+            b = int(b)
+            if b in self.cache_blocks:
+                self._emit(DOUBLE_IMPORT,
+                           f"restore of uid {uid} imported wire K/V "
+                           f"into block {b}, which the prefix cache "
+                           f"still holds — cached readers would decode "
+                           f"the imported stream's bytes", block=b,
+                           uid=uid)
+
     # ------------------------------------------------------- uid hooks
     def on_serve(self, uid):
         """A result left the engine (request-uid FSM completed ->
@@ -370,5 +405,6 @@ def describe(config_enabled=False, halt=True) -> dict:
                            "leak-at-close", "scratch-block-write",
                            "uid-double-serve",
                            "scrub-while-referenced",
-                           "scrub-while-shared"))),
+                           "scrub-while-shared",
+                           "double-import"))),
     }

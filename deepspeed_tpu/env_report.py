@@ -231,6 +231,8 @@ def router_report():
     print(f"deadline_ms ........... {pol['deadline_ms'] or 'disabled'}")
     print(f"max_outstanding ....... "
           f"{pol['max_outstanding'] or 'unbounded'}")
+    print(f"role pools ............ "
+          f"{pol.get('roles') or 'none (every replica mixed)'}")
     print("observe with .......... ds_router <dir1> <dir2> ... [--once]")
 
 
@@ -261,6 +263,39 @@ def kv_snapshot_report():
           "(manifest + per-block sha256)")
     print(f"handoff ............... {eff.get('handoff')}")
     print(f"wire format ........... {eff.get('wire_format')}")
+
+
+def transfer_report():
+    """Resolved prefill/decode disaggregation policy
+    (docs/serving.md#disaggregation): the ``serving.role`` /
+    ``serving.transfer`` pair as a serving engine built in this
+    environment would resolve them — mixed role with the transfer
+    queue off by default, byte-identical to pre-role behavior."""
+    from .inference.transfer import ROLES, describe_transfer
+
+    print("-" * 64)
+    print("Prefill/decode disaggregation (config `serving.role` / "
+          "`serving.transfer`):")
+    print("-" * 64)
+    pol = _safe(lambda: describe_transfer())
+    if not isinstance(pol, dict):
+        print(f"policy ................ {pol}")
+        return
+    eff = pol if pol.get("enabled") else pol.get("defaults_when_armed", {})
+    print("role .................. mixed (default; one of "
+          f"{', '.join(ROLES)})")
+    print(f"transfer queue ........ {pol.get('enabled')} "
+          "(armed automatically for prefill/decode roles)")
+    print(f"dir ................... {eff.get('dir') or '<journal_dir>/kv_transfer'}")
+    print(f"max_pending ........... {eff.get('max_pending')} "
+          "(backpressure: prefill degrades to local decode)")
+    print(f"keep_n ................ {eff.get('keep_n')} "
+          "(GC bound on committed entries)")
+    print(f"verify ................ {eff.get('verify')} "
+          "(manifest + per-block sha256)")
+    print(f"wire format ........... {eff.get('wire_format')}")
+    print("router pools .......... fresh->prefill by queue depth, "
+          "transfers->decode by free blocks, degrade-to-mixed")
 
 
 def prefix_cache_report():
@@ -324,6 +359,7 @@ def main():
     monitor_report()
     router_report()
     kv_snapshot_report()
+    transfer_report()
     prefix_cache_report()
     sanitize_report()
     debug_report()

@@ -26,8 +26,12 @@ protocol established):
 
 Record kinds: ``submit`` (full request spec — enough to reconstruct the
 ``Request``), ``admit``, ``finish`` (outcome + generated tokens),
-``requeue`` (a recovered engine re-queued this uid), ``shutdown``
-(clean drain marker).
+``requeue`` (a recovered engine re-queued this uid), ``transfer`` (a
+prefill worker published this stream's KV block image + seat record to
+the transfer queue — docs/serving.md#disaggregation; flushed eagerly,
+BEFORE the ``transferred`` finish, so a crash between them leaves a
+findable entry, never a silently-lost handoff), ``restore`` (a
+restore-first admission outcome), ``shutdown`` (clean drain marker).
 """
 
 import json
@@ -107,6 +111,18 @@ class RequestJournal:
 
     def requeue(self, uid):
         self.record("requeue", uid=int(uid))
+
+    def transfer(self, uid, entry, gen, nbytes, publish_ms, seat=None):
+        """A stream's KV image was PUBLISHED to the transfer queue:
+        journal the handoff and flush NOW — the seat record must be
+        durable before the ``transferred`` finish retires the slot, so
+        a crash in between leaves a recoverable handoff (the router's
+        ``find_transfer_entry`` path), never a lost uid."""
+        self.record("transfer", uid=int(uid), entry=str(entry),
+                    gen=int(gen), bytes=int(nbytes),
+                    publish_ms=float(publish_ms),
+                    seat=dict(seat) if seat else None)
+        self.flush()
 
     def shutdown(self, clean=True, pending=0):
         self.record("shutdown", clean=bool(clean), pending=int(pending))
@@ -232,8 +248,12 @@ def replay(dirpath):
     """Fold a journal back into recovery state.
 
     Returns ``{"pending": [submit-record dicts, journal order],
-    "finished": {uid: finish-record}, "max_uid": int,
-    "clean_shutdown": bool, "torn_lines": int, "foreign_lines": int}``.
+    "finished": {uid: finish-record}, "transferred": {uid:
+    transfer-record}, "max_uid": int, "clean_shutdown": bool,
+    "torn_lines": int, "foreign_lines": int}``.  ``transferred`` maps
+    every uid whose newest handoff record survives — a recovering
+    router seats those from their committed transfer entries instead of
+    adopting the prefill side's partial tokens as answers.
     ``pending`` holds every submitted uid without a finish record —
     submitted-but-queued and in-flight alike (a crash loses the
     distinction, and both re-run identically).
@@ -252,8 +272,9 @@ def replay(dirpath):
     crash."""
     path = os.path.join(dirpath, JOURNAL_FILE)
     rotated = os.path.join(dirpath, ROTATED_FILE)
-    state = {"pending": [], "finished": {}, "max_uid": -1,
-             "clean_shutdown": False, "torn_lines": 0, "foreign_lines": 0}
+    state = {"pending": [], "finished": {}, "transferred": {},
+             "max_uid": -1, "clean_shutdown": False,
+             "torn_lines": 0, "foreign_lines": 0}
     if os.path.isfile(rotated):
         records, torn, foreign = _parse_lines(_read_lines(rotated))
         state["torn_lines"] += torn
@@ -277,6 +298,8 @@ def replay(dirpath):
             uid = int(rec.get("uid", -1))
             submitted.pop(uid, None)
             state["finished"][uid] = rec
+        elif kind == "transfer":
+            state["transferred"][int(rec.get("uid", -1))] = rec
         elif kind == "shutdown":
             state["clean_shutdown"] = bool(rec.get("clean", False))
             continue

@@ -186,6 +186,21 @@ def block_key(parent_key, tokens) -> str:
     return h.hexdigest()
 
 
+def prefix_block_keys(tokens, block_size: int) -> list:
+    """Chained :func:`block_key` sequence over every FULL block of a
+    token prefix — the content identity a transfer seat record carries
+    so the decode side can VERIFY a local radix match against the
+    prefill side's view before re-sharing (two engines hashing the same
+    tokens produce the same chain by construction)."""
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    bs = int(block_size)
+    keys, parent = [], None
+    for i in range(toks.size // bs):
+        parent = block_key(parent, toks[i * bs:(i + 1) * bs])
+        keys.append(parent)
+    return keys
+
+
 class PrefixIndex:
     """Block-granular radix cache over a :class:`BlockAllocator`.
 

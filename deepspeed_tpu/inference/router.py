@@ -42,6 +42,20 @@ Design (each piece reuses a proven subsystem rather than inventing one):
   deadline enforcement at the router itself, so a shrunken fleet
   degrades with typed ``SHED``/``DEADLINE`` outcomes on the monitor bus
   instead of unbounded queueing.
+- **role pools (disaggregation)** — replicas may declare a serving
+  role (``mixed`` / ``prefill`` / ``decode`` —
+  docs/serving.md#disaggregation).  Fresh requests route to the
+  healthy PREFILL pool by queue depth; a prefill worker's
+  ``transferred`` outcome carries a committed transfer entry
+  (``inference/transfer.py``) that the router seats onto the DECODE
+  pool by free-block count through the same restore-first path the
+  crash handoff uses.  An empty or unhealthy role pool degrades to
+  mixed (then to any healthy replica) with a
+  ``degraded_placements`` counter — never a stall.  The PR-16
+  guarantees hold across the new edge: a prefill worker killed
+  mid-transfer recovers through its journal AND its committed
+  transfer entries (``transfer.find_transfer_entry``), set-once
+  dedup suppresses the late copy.
 
 Three replica shapes share the router logic: in-process engines
 (:class:`LocalReplica` — unit tests, single-host serving), subprocess
@@ -73,6 +87,7 @@ from ..monitor.fleet import (FleetFollower, FleetView, ReplicaView,
 from ..utils.logging import logger
 from ..utils.retry import RetryPolicy
 from . import journal as jr
+from . import transfer as xfer
 from .serving import (Request, QueueFullError, ServingError,
                       OK, SHED, DEADLINE, stream_snapshot_dir)
 
@@ -101,6 +116,9 @@ class RouterConfig:
     deadline_ms: Optional[float] = None   # router-level latency budget
     max_outstanding: int = 0         # admission shed bound (0 = unbounded)
     monitor_interval: int = 8        # emit router telemetry every N pumps
+    # role override map name -> mixed|prefill|decode; unset names keep
+    # the role the handle itself reports (docs/serving.md#disaggregation)
+    roles: Optional[Dict[str, str]] = None
 
     def resolved_probe_retry(self) -> RetryPolicy:
         # FULL jitter (AWS-style): many routers probing one wedged
@@ -125,6 +143,7 @@ class RouterConfig:
             "slo_burn_drain": self.slo_burn_drain,
             "deadline_ms": self.deadline_ms,
             "max_outstanding": self.max_outstanding,
+            "roles": dict(self.roles or {}),
         }
 
 
@@ -135,17 +154,21 @@ class ReplicaHandle:
     (subprocess worker, directory protocol), test fakes."""
 
     name: str = "?"
+    role: str = "mixed"          # mixed | prefill | decode
 
-    def submit(self, req: Request, snapshot_dir: Optional[str] = None):
+    def submit(self, req: Request, snapshot_dir: Optional[str] = None,
+               seat: Optional[dict] = None):
         """Place one request on this replica (must journal it durably
         before acknowledging, where a journal exists).  When
         ``snapshot_dir`` names a committed KV block image of the stream
         (docs/serving.md#kv-migration), the replica should attempt
         restore-first admission (``ServingEngine.submit_restored``) and
-        fall back to plain recompute on any image defect.  In-process
-        handles return the restore outcome dict synchronously;
-        subprocess handles return ``None`` and report the outcome
-        through their journal's ``restore`` record."""
+        fall back to plain recompute on any image defect; ``seat`` is
+        the transfer seat record (disaggregation) the restore path
+        verifies the image against — the stale-handoff guard.
+        In-process handles return the restore outcome dict
+        synchronously; subprocess handles return ``None`` and report
+        the outcome through their journal's ``restore`` record."""
         raise NotImplementedError
 
     def poll(self) -> List[dict]:
@@ -189,14 +212,16 @@ class LocalReplica(ReplicaHandle):
     def __init__(self, name: str, engine, clock=time.time):
         self.name = name
         self.engine = engine
+        self.role = getattr(engine, "role", "mixed")
         self._clock = clock
         self._hb = clock()
         self._submitted = set()
 
-    def submit(self, req: Request, snapshot_dir: Optional[str] = None):
+    def submit(self, req: Request, snapshot_dir: Optional[str] = None,
+               seat: Optional[dict] = None):
         out = None
         if snapshot_dir is not None:
-            out = self.engine.submit_restored(req, snapshot_dir)
+            out = self.engine.submit_restored(req, snapshot_dir, seat=seat)
         else:
             self.engine.submit(req)
         self._submitted.add(req.uid)
@@ -212,9 +237,21 @@ class LocalReplica(ReplicaHandle):
             rec = self.engine.results.get(uid)
             if rec is not None and rec["outcome"] is not None:
                 rec = self.engine.pop_result(uid)
+                self._submitted.discard(uid)
+                if rec["outcome"] == xfer.TRANSFERRED:
+                    # a prefill worker's terminal outcome is a HANDOFF,
+                    # not an answer: surface the committed transfer
+                    # entry + seat record so the router seats it on the
+                    # decode pool
+                    xres = self.engine.pop_transfer(uid) or {}
+                    out.append({"kind": "transfer", "uid": uid,
+                                "entry": xres.get("entry"),
+                                "seat": xres.get("seat"),
+                                "gen": xres.get("gen"),
+                                "bytes": xres.get("bytes")})
+                    continue
                 out.append({"uid": uid, "outcome": rec["outcome"],
                             "tokens": rec["tokens"]})
-                self._submitted.discard(uid)
         return out
 
     def heartbeat(self) -> Optional[float]:
@@ -226,8 +263,16 @@ class LocalReplica(ReplicaHandle):
 
     def load(self) -> dict:
         st = self.engine.stats()
-        return {"queued": len(self.engine.queue),
-                "active": st["pending"] - len(self.engine.queue)}
+        out = {"queued": len(self.engine.queue),
+               "active": st["pending"] - len(self.engine.queue)}
+        alloc = getattr(self.engine, "allocator", None)
+        if alloc is not None:
+            # the decode-pool seating signal: a restored stream lands
+            # where the paged pool has the most room
+            out["free_blocks"] = int(alloc.free_blocks)
+        out["slots_free"] = max(
+            0, int(self.engine.config.batch_slots) - out["active"])
+        return out
 
     def stop(self):
         self.engine.drain()
@@ -255,9 +300,10 @@ class ProcessReplica(ReplicaHandle):
     - ``stop`` — graceful-shutdown request; ``ready`` — worker is up.
     """
 
-    def __init__(self, name: str, root: str, proc=None):
+    def __init__(self, name: str, root: str, proc=None, role: str = "mixed"):
         self.name = name
         self.root = root
+        self.role = role             # must match the worker spec's role
         self.proc = proc             # subprocess.Popen | None
         self.inbox = os.path.join(root, INBOX_DIR)
         self._jdir = os.path.join(root, "journal")
@@ -265,7 +311,8 @@ class ProcessReplica(ReplicaHandle):
         self._offset = 0             # journal tail position
         os.makedirs(self.inbox, exist_ok=True)
 
-    def submit(self, req: Request, snapshot_dir: Optional[str] = None):
+    def submit(self, req: Request, snapshot_dir: Optional[str] = None,
+               seat: Optional[dict] = None):
         spec = {"uid": int(req.uid),
                 "tokens": [int(t) for t in np.asarray(req.tokens).ravel()],
                 "max_new_tokens": (None if req.max_new_tokens is None
@@ -277,6 +324,8 @@ class ProcessReplica(ReplicaHandle):
             # restore-first hint: the worker attempts submit_restored
             # and reports the outcome via its journal's restore record
             spec["snapshot_dir"] = snapshot_dir
+        if seat is not None:
+            spec["seat"] = seat      # stale-handoff guard input
         path = os.path.join(self.inbox, f"req-{int(req.uid):08d}.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -303,9 +352,22 @@ class ProcessReplica(ReplicaHandle):
             except ValueError:
                 continue             # foreign matter; replay() will count it
             if rec.get("kind") == "finish":
+                if rec.get("outcome") == xfer.TRANSFERRED:
+                    # the transfer record (journaled just before this
+                    # finish) carries the handoff; surfacing the finish
+                    # too would double-seat the uid
+                    continue
                 out.append({"uid": int(rec["uid"]),
                             "outcome": rec.get("outcome"),
                             "tokens": rec.get("tokens")})
+            elif rec.get("kind") == "transfer":
+                # a prefill worker published this stream's block image:
+                # hand the committed entry + seat record to the router
+                out.append({"kind": "transfer", "uid": int(rec["uid"]),
+                            "entry": rec.get("entry"),
+                            "seat": rec.get("seat"),
+                            "gen": rec.get("gen"),
+                            "bytes": rec.get("bytes")})
             elif rec.get("kind") == "restore":
                 # restore-first outcome report from submit_restored —
                 # the router's migration counters feed on these
@@ -343,6 +405,7 @@ class _ReplicaState:
     def __init__(self, handle: ReplicaHandle):
         self.handle = handle
         self.state = HEALTHY
+        self.role = getattr(handle, "role", "mixed")
         self.since = 0.0
         self.reason = ""
         self.probe_attempt = 0
@@ -368,8 +431,14 @@ class ReplicaRouter:
         self._replicas: Dict[str, _ReplicaState] = {
             r.name: _ReplicaState(r) for r in replicas}
         now = clock()
+        roles = dict(self.config.roles or {})
         for st in self._replicas.values():
             st.since = now
+            st.role = roles.get(st.handle.name, st.role)
+            if st.role not in xfer.ROLES:
+                raise ValueError(
+                    f"replica {st.handle.name!r}: role {st.role!r} not in "
+                    f"{xfer.ROLES} (docs/serving.md#disaggregation)")
         # per-replica monitor streams: the placement/straggler signal.
         # dict name->run_dir, or a list aligned with `replicas`.
         self._fleet: Optional[FleetFollower] = None
@@ -401,6 +470,13 @@ class ReplicaRouter:
         self._recompute_tokens_saved = 0
         self._restore_ms: List[float] = []
         self._handoff_ms: List[float] = []
+        # disaggregation (docs/serving.md#disaggregation): prefill ->
+        # decode seatings across the transfer-queue edge
+        self._transfers_seated = 0
+        self._transfer_seat_fallbacks = 0
+        self._degraded_placements = 0
+        self._seated_entries: Dict[int, str] = {}
+        self._pending_seats = deque()    # (origin name, transfer res)
         self._drain_events: List[dict] = []
         self._dead_events: List[dict] = []
 
@@ -432,8 +508,8 @@ class ReplicaRouter:
         return uid
 
     def _outstanding(self) -> int:
-        return len(self.queue) + sum(len(st.assigned)
-                                     for st in self._replicas.values())
+        return (len(self.queue) + len(self._pending_seats)
+                + sum(len(st.assigned) for st in self._replicas.values()))
 
     # -------------------------------------------------------------- pump
     def pump(self) -> bool:
@@ -447,6 +523,14 @@ class ReplicaRouter:
         for st in list(self._replicas.values()):
             if st.state == DEAD and st.assigned:
                 self._handoff(st, now)
+        if self._pending_seats:
+            # transfers deferred while every decode target was slot-full:
+            # retry before placement so a freed slot admits THIS pump
+            pend, self._pending_seats = self._pending_seats, deque()
+            for name, res in pend:
+                origin = self._replicas.get(name)
+                if origin is not None:
+                    self._seat_transfer(origin, res)
         self._place(now)
         for st in self._replicas.values():
             if st.state != DEAD:
@@ -590,6 +674,9 @@ class ReplicaRouter:
         migration counters.  An engine-side fallback already emitted its
         typed event on the replica's own monitor stream — the router
         only counts it."""
+        if out.get("uid") is not None:
+            # the seated image has been consumed (restored or rejected)
+            xfer.drop_entry(self._seated_entries.pop(int(out["uid"]), None))
         if out.get("restored"):
             self._migrated_streams += 1
             if out.get("uid") is not None:
@@ -621,14 +708,21 @@ class ReplicaRouter:
             self._foreign_recovered += state["foreign_lines"]
             for uid, rec in state["finished"].items():
                 mine = self.results.get(int(uid))
-                if mine is not None and mine["outcome"] is None:
-                    self._adopted_finishes += 1
-                    self._record_result(st, {
-                        "uid": int(uid), "outcome": rec.get("outcome"),
-                        "tokens": rec.get("tokens")})
+                if mine is None or mine["outcome"] is not None:
+                    continue
+                if rec.get("outcome") == xfer.TRANSFERRED:
+                    # journaled as handed off, not served: seat from
+                    # the committed transfer entry (found below from
+                    # the journal dir) instead of adopting the partial
+                    # prefill-side tokens as an answer
+                    self._seat_transfer(st, {"uid": int(uid)})
+                    continue
+                self._adopted_finishes += 1
+                self._record_result(st, {
+                    "uid": int(uid), "outcome": rec.get("outcome"),
+                    "tokens": rec.get("tokens")})
         requeued = migrated = 0
-        targets = [s for s in self._replicas.values()
-                   if s.state == HEALTHY]
+        targets, _ = self._role_pool("decode", exclude=st)
         for uid in sorted(st.assigned):
             rec = self.results.get(uid)
             if rec is None or rec["outcome"] is not None:
@@ -639,12 +733,23 @@ class ReplicaRouter:
                 # a re-run deserves a fresh budget (the same re-arm the
                 # journal-recovery path applies — serving.py Request)
                 rec["deadline"] = now + self.config.deadline_ms / 1e3
-            snap = self._find_stream_snapshot(jd, uid) if jd else None
+            # restore-first, newest evidence first: a committed
+            # transfer entry (the prefill worker died mid-handoff — the
+            # image + seat record survive the process) beats a cadence
+            # snapshot beats recompute
+            snap = seat = None
+            if jd:
+                snap = xfer.find_transfer_entry(jd, uid)
+                if snap is not None:
+                    seat = self._read_transfer_seat(snap)
+                else:
+                    snap = self._find_stream_snapshot(jd, uid)
             if snap is not None and targets:
                 target = min(targets, key=self._placement_score)
                 try:
                     out = target.handle.submit(rec["request"],
-                                               snapshot_dir=snap)
+                                               snapshot_dir=snap,
+                                               seat=seat)
                 except (QueueFullError, ValueError, ServingError) as e:
                     logger.warning(
                         f"router: restore placement of uid {uid} on "
@@ -702,9 +807,26 @@ class ReplicaRouter:
                 return view
         return None
 
+    def _role_pool(self, want: str, exclude=None):
+        """Healthy placement pool for a role with the degrade chain
+        ``want -> mixed -> any healthy``.  Returns ``(targets,
+        degraded)`` — degraded is True when the fleet HAS ``want``-role
+        replicas but none is currently placeable (empty/unhealthy role
+        pool), i.e. the router is knowingly degrading to mixed rather
+        than stalling the request."""
+        healthy = [st for st in self._replicas.values()
+                   if st.state == HEALTHY and st is not exclude]
+        pool = [st for st in healthy if st.role == want]
+        if pool:
+            return pool, False
+        configured = any(st.role == want for st in self._replicas.values())
+        mixed = [st for st in healthy if st.role == "mixed"]
+        return (mixed or healthy), configured
+
     def _place(self, now):
-        targets = [st for st in self._replicas.values()
-                   if st.state == HEALTHY]
+        # fresh requests go to the PREFILL pool (the mixed pool when no
+        # prefill role exists — byte-identical to the pre-role router)
+        targets, degraded = self._role_pool("prefill")
         while self.queue:
             req = self.queue[0]
             rec = self.results[int(req.uid)]
@@ -728,6 +850,8 @@ class ReplicaRouter:
             rec["replica"] = st.handle.name
             st.assigned.add(int(req.uid))
             self._routed_total += 1
+            if degraded:
+                self._degraded_placements += 1
 
     # ----------------------------------------------------------- results
     def _collect(self, now):
@@ -742,6 +866,12 @@ class ReplicaRouter:
         if res.get("kind") == "restore":
             # a worker's restore-first outcome report, not a finish
             self._note_restore_outcome(res)
+            return
+        if res.get("kind") == "transfer" or \
+                res.get("outcome") == xfer.TRANSFERRED:
+            # a prefill worker's handoff, not an answer: seat the
+            # committed block image onto the decode pool
+            self._seat_transfer(st, res)
             return
         uid = int(res["uid"])
         rec = self.results.get(uid)
@@ -765,6 +895,111 @@ class ReplicaRouter:
         self._finalize(rec, res["outcome"], res["tokens"],
                        f"served by {st.handle.name}")
 
+    def _seat_transfer(self, st: _ReplicaState, res: dict):
+        """Seat one prefill->decode handoff: the stream's committed
+        transfer entry restores onto the decode replica with the most
+        free blocks (degrade chain: decode -> mixed -> any healthy);
+        anything unseatable — entry GC'd/torn, every target refuses —
+        requeues for plain recompute.  Set-once dedup holds: a late
+        transfer for a uid that already resolved (or was re-placed
+        after its publisher was presumed dead) is suppressed, never
+        double-served."""
+        uid = int(res["uid"])
+        rec = self.results.get(uid)
+        if rec is None:
+            self._unknown_results += 1   # e.g. a worker's warmup stream
+            return
+        st.assigned.discard(uid)
+        if rec["outcome"] is not None or \
+                rec["replica"] not in (None, st.handle.name):
+            # resolved, or already recovered onto another replica: the
+            # image is a stale copy of work someone else now owns
+            self._duplicates_suppressed += 1
+            if self.monitor.armed:
+                self.monitor.counter("router_duplicates_suppressed_total",
+                                     self._duplicates_suppressed)
+            return
+        self._drop_queued(uid)           # it may have been requeued
+        entry = res.get("entry")
+        if (not entry or not os.path.isdir(entry)) and \
+                st.handle.journal_dir:
+            # the outbox record was lost (crash between publish and
+            # journal flush) but the committed entry survives on disk
+            entry = xfer.find_transfer_entry(st.handle.journal_dir, uid)
+        seat = res.get("seat") or self._read_transfer_seat(entry)
+        if rec["deadline"] is not None and self._clock() > rec["deadline"]:
+            xfer.drop_entry(entry)
+            self._finalize(rec, DEADLINE, None,
+                           "router deadline while seating transfer")
+            return
+        targets, degraded = self._role_pool("decode", exclude=st)
+        if entry and os.path.isdir(entry) and targets:
+            ready = [t for t in targets if self._has_free_slot(t)]
+            if not ready:
+                # every decode target is momentarily slot-full: seating
+                # now would make submit_restored burn the image on a
+                # recompute fallback — defer to the next pump instead
+                self._pending_seats.append((st.handle.name, res))
+                return
+            target = max(ready, key=self._seat_score)
+            try:
+                out = target.handle.submit(rec["request"],
+                                           snapshot_dir=entry, seat=seat)
+            except (QueueFullError, ValueError, ServingError) as e:
+                logger.warning(
+                    f"router: transfer seating of uid {uid} on "
+                    f"{target.handle.name!r} refused ({e}) — requeueing "
+                    "for recompute")
+            else:
+                rec["replica"] = target.handle.name
+                target.assigned.add(uid)
+                self._routed_total += 1
+                self._transfers_seated += 1
+                if degraded:
+                    self._degraded_placements += 1
+                if self.monitor.armed:
+                    self.monitor.trace(
+                        "kv_transfer_seat", step=self._pumps, uid=uid,
+                        source=st.handle.name, target=target.handle.name,
+                        bytes=int(res.get("bytes") or 0))
+                if out is not None:
+                    # in-process: the image was consumed synchronously
+                    # — drop the entry so the publisher's queue depth
+                    # (its backpressure signal) reflects reality
+                    self._note_restore_outcome(out)
+                    xfer.drop_entry(entry)
+                else:
+                    # subprocess target reads the image later: drop the
+                    # entry when its restore/finish record arrives
+                    self._seated_entries[uid] = entry
+                return
+        self._transfer_seat_fallbacks += 1
+        xfer.drop_entry(entry)           # unseatable: dead weight
+        rec["replica"] = None
+        self.queue.append(rec["request"])
+        self._requeued_total += 1
+
+    def _has_free_slot(self, st: _ReplicaState) -> bool:
+        free = st.handle.load().get("slots_free")
+        return True if free is None else int(free) > 0
+
+    def _seat_score(self, st: _ReplicaState) -> float:
+        """Higher = better decode seat: free paged-KV blocks first
+        (a restored stream needs pool room NOW), least-loaded as the
+        tie-break."""
+        free = float(st.handle.load().get("free_blocks", 0))
+        return free - 1e-3 * self._placement_score(st)
+
+    def _read_transfer_seat(self, entry) -> Optional[dict]:
+        if not entry:
+            return None
+        from ..checkpoint import atomic
+        try:
+            man = atomic.read_manifest(entry)
+            return dict((man.get("meta") or {}).get("seat") or {}) or None
+        except Exception:
+            return None
+
     def _drop_queued(self, uid: int):
         for i, req in enumerate(self.queue):
             if int(req.uid) == uid:
@@ -776,6 +1011,7 @@ class ReplicaRouter:
         rec["tokens"] = tokens
         rec["t_done"] = self._clock()
         rec.pop("request", None)     # the spec is no longer needed
+        xfer.drop_entry(self._seated_entries.pop(int(rec["uid"]), None))
         self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
 
     # --------------------------------------------------------- telemetry
@@ -805,7 +1041,11 @@ class ReplicaRouter:
                       "router_migrated_streams_total":
                           self._migrated_streams,
                       "router_migration_fallbacks_total":
-                          self._migration_fallbacks})
+                          self._migration_fallbacks,
+                      "router_transfers_seated_total":
+                          self._transfers_seated,
+                      "router_degraded_placements_total":
+                          self._degraded_placements})
 
     # ------------------------------------------------------------- drive
     def run(self, requests=None, timeout_s: Optional[float] = None):
@@ -861,8 +1101,8 @@ class ReplicaRouter:
 
     # ------------------------------------------------------------- stats
     def states(self) -> Dict[str, dict]:
-        return {name: {"state": st.state, "since": st.since,
-                       "reason": st.reason,
+        return {name: {"state": st.state, "role": st.role,
+                       "since": st.since, "reason": st.reason,
                        "assigned": len(st.assigned)}
                 for name, st in self._replicas.items()}
 
@@ -883,6 +1123,9 @@ class ReplicaRouter:
             "migrated_streams": self._migrated_streams,
             "migrated_uids": list(self._migrated_uids),
             "migration_fallbacks": self._migration_fallbacks,
+            "transfers_seated": self._transfers_seated,
+            "transfer_seat_fallbacks": self._transfer_seat_fallbacks,
+            "degraded_placements": self._degraded_placements,
             "recompute_tokens_saved": self._recompute_tokens_saved,
             "restore_ms": [round(v, 3) for v in self._restore_ms],
             "drain_events": list(self._drain_events),
@@ -949,6 +1192,8 @@ def replica_worker(spec: dict):
             journal_dir=os.path.join(root, "journal"),
             kv_bits=spec.get("kv_bits", 16),
             kv_snapshot=spec.get("kv_snapshot"),
+            role=spec.get("role", "mixed"),
+            transfer=spec.get("transfer"),
             preflight=False))
     throttle_s = spec.get("throttle_ms", 0) / 1e3
     try:
@@ -965,6 +1210,12 @@ def replica_worker(spec: dict):
             srv.run([Request(tokens=np.arange(wlen) % cfg.vocab_size,
                              max_new_tokens=2, seed=10 ** 6,
                              uid=10 ** 9)])
+            if srv._txq is not None and srv.role == "prefill":
+                # a prefill worker PUBLISHES its warmup stream — drop
+                # the entry so no decode sibling serves a phantom uid
+                claim = srv._txq.claim(uid=10 ** 9)
+                if claim is not None:
+                    srv._txq.done(claim["entry"])
             srv.reset_stats()
         touch_hb()
         open(os.path.join(root, READY_FILE), "w").close()
@@ -989,7 +1240,8 @@ def replica_worker(spec: dict):
                     # restore-first migration: seat the dead sibling's
                     # KV image (or fall back to recompute inside);
                     # journals the submit durably either way
-                    srv.submit_restored(req, snap)
+                    srv.submit_restored(req, snap,
+                                        seat=rspec.get("seat"))
                 else:
                     srv.submit(req)  # journaled durably ...
                 os.unlink(path)      # ... BEFORE the inbox entry dies

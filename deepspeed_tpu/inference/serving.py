@@ -93,8 +93,11 @@ SHED = "shed"             # dropped by the overload policy before serving
 DEADLINE = "deadline"     # could not finish by its deadline (at admit or
 #                           mid-decode; mid-decode keeps the partial tokens)
 POISONED = "poisoned"     # quarantined: drove the decode logits non-finite
+TRANSFERRED = "transferred"   # prefill role: handed off to the transfer
+#                               queue — the DECODE worker owns the stream
+#                               now (docs/serving.md#disaggregation)
 
-OUTCOMES = (OK, SHED, DEADLINE, POISONED)
+OUTCOMES = (OK, SHED, DEADLINE, POISONED, TRANSFERRED)
 
 # token the in-graph sentinel forces into a poisoned slot's sample (the
 # value is irrelevant — the scheduler evicts the slot the same step and
@@ -420,6 +423,20 @@ class ServingConfig:
     # stay token-identical to the unshared path and the compiled decode
     # step is byte-identical on/off.
     prefix_cache: Any = None
+    # ---- prefill/decode disaggregation (docs/serving.md#disaggregation) ----
+    # "mixed" (default) = the classic engine, byte-identical to a build
+    # without roles.  "prefill" runs bucketed prefill only and publishes
+    # each stream's paged-KV blocks + seat record on the transfer queue;
+    # "decode" admits from the queue via the KVRestoreError-guarded
+    # restore path and runs pure fused-scan decode at steady cadence.
+    # Either role degrades to mixed per-stream when the queue misbehaves
+    # (backpressure, torn image) — never blocks, never drops.
+    role: str = "mixed"
+    # None/false = off; true = defaults; or the JSON block
+    # {"dir": ..., "max_pending": 64, "keep_n": 128, "verify": "full"}.
+    # The queue dir defaults to <journal_dir>/kv_transfer.  Armed
+    # implicitly by role != "mixed".
+    transfer: Any = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServingConfig":
@@ -486,6 +503,10 @@ class _Slot:
         # restored-from-image KV is wire-precision, not prefill output:
         # never publish it into the prefix cache
         self.wire_kv = False
+        # disaggregation: a prefill-role stream the transfer queue
+        # refused (backpressure / publish failure) decodes LOCALLY —
+        # the per-stream degrade-to-mixed latch
+        self.no_transfer = False
 
 
 class ServingEngine:
@@ -602,6 +623,45 @@ class ServingEngine:
                 self.allocator, max_blocks=self.prefix.max_blocks)
             logger.info("serving: prefix cache ARMED "
                         f"({self.prefix.describe()})")
+
+        # prefill/decode disaggregation (docs/serving.md#disaggregation):
+        # role "mixed" is the classic engine — no queue, no publish, the
+        # compiled decode step byte-identical to a roleless build.  A
+        # role worker needs a queue directory (serving.transfer.dir or
+        # <journal_dir>/kv_transfer).  Everything transfer-shaped is
+        # host-side file I/O: the step jaxpr never changes.
+        from . import transfer as xfer
+        self.role = config.role or "mixed"
+        if self.role not in xfer.ROLES:
+            raise ValueError(
+                f"serving.role must be one of {xfer.ROLES}, "
+                f"got {config.role!r} (docs/serving.md#disaggregation)")
+        self.transfer = xfer.TransferConfig.from_value(config.transfer)
+        if self.role != "mixed" and self.transfer is None:
+            self.transfer = xfer.TransferConfig()
+        self._txq = None
+        if self.transfer is not None:
+            qdir = self.transfer.dir or (
+                xfer.transfer_dir(config.journal_dir)
+                if config.journal_dir else None)
+            if qdir is None:
+                raise ValueError(
+                    "serving.role/transfer needs a queue directory: set "
+                    "serving.transfer.dir or serving.journal_dir (the "
+                    "queue defaults to <journal_dir>/kv_transfer — "
+                    "docs/serving.md#disaggregation)")
+            self._txq = xfer.TransferQueue(qdir, self.transfer)
+            log_dist(
+                f"serving: role={self.role} transfer queue at {qdir} "
+                f"(max_pending={self.transfer.max_pending} "
+                f"keep_n={self.transfer.keep_n})", ranks=[0])
+        # transfer accounting (this engine's own publishes/claims; the
+        # queue object carries the directory-level totals)
+        self._transfers_total = 0
+        self._transfer_bytes_total = 0
+        self._transfer_backpressure_total = 0
+        self._transfer_pub_ms: List[float] = []
+        self._transfer_outbox: Dict[int, dict] = {}
 
         S = config.batch_slots
         self._slots: List[Optional[_Slot]] = [None] * S
@@ -1599,7 +1659,8 @@ class ServingEngine:
         except OSError:  # dstpu: disable=DSTPU002 (non-empty root is the signal)
             pass
 
-    def submit_restored(self, req: Request, snapshot_dir: str) -> dict:
+    def submit_restored(self, req: Request, snapshot_dir: str,
+                        seat: Optional[dict] = None) -> dict:
         """Restore-first admission for a migrated stream: journal the
         request durably on THIS engine (its submit record lives on the
         dead replica's journal, not here), then try to seat it directly
@@ -1613,6 +1674,11 @@ class ServingEngine:
         attempt) and never duplicated (either seated OR queued, never
         both).
 
+        ``seat`` (disaggregation): the transfer queue's seat record —
+        carries the prefill worker's claimed generation (the stale-
+        handoff guard), first token, and prefix-cache block hashes the
+        restore verifies before re-sharing.
+
         Returns ``{"uid", "restored", "restore_ms", "tokens_saved",
         "reason"}`` (``reason`` set on fallback)."""
         uid = self.submit(req, _requeue=True)
@@ -1623,7 +1689,7 @@ class ServingEngine:
         t0 = time.perf_counter()
         reason, saved = None, 0
         try:
-            saved = self._restore_stream(req, snapshot_dir)
+            saved = self._restore_stream(req, snapshot_dir, seat=seat)
             restored = True
         except (pk.BlockImageError, KVRestoreError) as e:
             restored, reason = False, str(e)
@@ -1675,14 +1741,18 @@ class ServingEngine:
             self.pool = pk.import_block_image(
                 self.pool, [pk.SCRATCH_BLOCK], warm, pad_to=self.nb_max)
 
-    def _restore_stream(self, req: Request, snapshot_dir: str) -> int:
+    def _restore_stream(self, req: Request, snapshot_dir: str,
+                        seat: Optional[dict] = None) -> int:
         """Seat ``req`` directly from a committed image: verify manifest
         + per-block digests, allocate fresh blocks, scatter the image
         into the pool, and resume decode at the snapshot's exact
         position.  Returns the recompute tokens saved (prompt prefill +
         already-emitted decode steps).  Raises
         :class:`KVRestoreError`/:class:`pk.BlockImageError` on any
-        defect — :meth:`submit_restored` owns the fallback."""
+        defect — :meth:`submit_restored` owns the fallback.  With a
+        transfer ``seat`` the image must be at least as deep as the
+        seat's claimed generation and agree on the first sampled token
+        (the stale-handoff guard, satellite fix)."""
         # a survivor restores even when it doesn't snapshot itself
         kvs = self.kvs or KVSnapshotConfig()
         image, meta = pk.load_block_image(snapshot_dir, verify=kvs.verify)
@@ -1700,6 +1770,24 @@ class ServingEngine:
         out_tokens = [int(t) for t in stream["out_tokens"]]
         if not out_tokens:
             raise KVRestoreError("snapshot holds no emitted tokens")
+        if seat:
+            # stale-handoff guard (satellite fix): a transfer image whose
+            # generation predates the seat record is an OLDER publish of
+            # the same uid (a re-published entry superseded it) — seating
+            # it would silently rewind the stream.  Fall back to
+            # recompute (typed migration_fallback) instead.
+            seat_gen = int(seat.get("gen", 0) or 0)
+            if len(out_tokens) < seat_gen:
+                raise KVRestoreError(
+                    f"stale transfer image: image generation "
+                    f"{len(out_tokens)} predates the seat record's "
+                    f"gen {seat_gen} (stale-handoff guard)")
+            first = seat.get("first_token")
+            if first is not None and int(first) != out_tokens[0]:
+                raise KVRestoreError(
+                    f"transfer image's first token {out_tokens[0]} "
+                    f"differs from the seat record's {int(first)} — "
+                    f"image and seat are not the same publish")
         if int(stream["block_size"]) != self.config.block_size:
             raise KVRestoreError(
                 f"snapshot block_size {stream['block_size']} != pool "
@@ -1722,11 +1810,29 @@ class ServingEngine:
         # LOUDLY to a full private import — never a torn refcount.
         ns = 0
         shared: List[int] = []
+        resident: List[int] = []    # cache-resident prompt blocks the
+        #                             import is about to DUPLICATE —
+        #                             DSTPU317 evidence; empty on the
+        #                             correct incref-and-share path
         if self._prefix_index is not None and len(self._prefix_index):
             m = self._prefix_index.match(prompt, self.config.block_size,
                                          limit_blocks=prompt.size
                                          // self.config.block_size)
             shared, ns = m["blocks"], len(m["blocks"])
+            if ns and seat and seat.get("prefix_keys") is not None:
+                # the seat's chained block hashes are a pure function of
+                # the prompt tokens — the local radix chain MUST agree.
+                # A disagreement means the seat (or the index) is
+                # corrupt: refuse the share, import privately, and let
+                # the sanitizer call the duplication out (DSTPU317).
+                want = list(seat["prefix_keys"])[:ns]
+                if list(m["keys"]) != want:
+                    logger.warning(
+                        f"serving: restore of uid {req.uid}: seat "
+                        f"record's prefix keys disagree with the local "
+                        f"radix chain over {ns} block(s) — refusing the "
+                        f"share, importing privately")
+                    resident, shared, ns = list(shared), [], 0
             if ns:
                 logger.info(
                     f"serving: restore of uid {req.uid} re-established "
@@ -1775,6 +1881,12 @@ class ServingEngine:
             self._tables[slot, :len(blocks)] = blocks
             if self._sanitizer is not None:
                 self._sanitizer.on_attach(req.uid, blocks)
+                # DSTPU317 (satellite fix): a restore that imports a
+                # private copy of a prompt block the PrefixIndex already
+                # holds is silent pool waste — the shadow sanitizer
+                # makes it a lint failure
+                self._sanitizer.on_import(fresh, uid=req.uid,
+                                          resident=resident)
         except BaseException:
             # UNLIKE _admit's prefill edge, cleanup runs for
             # BaseException here too: a failed restore leaves the
@@ -1816,6 +1928,194 @@ class ServingEngine:
             # of decoding past the budget
             self._finish(slot)
         return int(prompt.size) + len(out_tokens)
+
+    # ---------------------------------------------- prefill/decode handoff
+    # (docs/serving.md#disaggregation) — everything below is host-side
+    # file I/O over the TransferQueue; the compiled decode step never
+    # sees any of it (--audit-step disagg proves jaxpr equality).
+
+    def _seat_record(self, slot: int) -> dict:
+        """The handoff's control-plane half: everything the decode
+        worker needs to SEAT the stream without recomputing — the
+        sampled first token, lengths, the RNG fold position (``gen``:
+        sampling resumes at ``fold_in(seed, gen)``), and the prompt's
+        chained prefix-block hashes so the decode side re-SHARES
+        resident prefixes instead of re-importing them.  ``stream`` is
+        the same block the restore path reads from any snapshot — a
+        transfer entry IS a restorable image."""
+        s = self._slots[slot]
+        c = self.config
+        dl = s.req.deadline_ms
+        if dl is not None and dl == float("inf"):
+            dl = "inf"          # the journal's JSON spelling
+        return {
+            "uid": int(s.req.uid),
+            "gen": len(s.out_tokens),
+            "first_token": int(s.out_tokens[0]),
+            "prompt_len": int(s.prompt_len),
+            "max_new_tokens": int(s.max_new),
+            "seed": int(s.req.seed),
+            "temperature": float(s.req.temperature),
+            "do_sample": bool(s.req.do_sample),
+            "deadline_ms": dl,
+            "block_size": int(c.block_size),
+            "kv_bits": int(c.kv_bits),
+            "prefix_keys": pk.prefix_block_keys(s.req.tokens,
+                                                c.block_size),
+            "stream": {
+                "uid": int(s.req.uid),
+                "prompt": [int(t) for t in np.asarray(s.req.tokens)],
+                "out_tokens": [int(t) for t in s.out_tokens],
+                "max_new_tokens": int(s.max_new),
+                "seed": int(s.req.seed),
+                "temperature": float(s.req.temperature),
+                "do_sample": bool(s.req.do_sample),
+                "num_blocks": len(s.blocks),
+                "block_size": int(c.block_size),
+                "kv_bits": int(c.kv_bits),
+                "shared_blocks": int(s.shared_blocks)}}
+
+    def _publish_slot(self, slot: int) -> dict:
+        """Export one prefill-finished slot's KV blocks as a block image,
+        commit image + seat record on the transfer queue (one atomic
+        publish), journal the handoff, and retire the slot with the
+        typed ``TRANSFERRED`` outcome — the decode worker owns the
+        stream now.  Raises to :meth:`_publish_transfers` on any
+        refusal; the caller degrades the slot to local decode."""
+        s = self._slots[slot]
+        uid = int(s.req.uid)
+        gen = len(s.out_tokens)
+        with jax.set_mesh(self.engine.mesh):
+            image = pk.export_block_image(
+                self.pool, s.blocks, quant_block=self.config.kv_quant_block)
+        seat = self._seat_record(slot)
+        pub = self._txq.publish(uid, gen, image, seat)
+        self._transfers_total += 1
+        self._transfer_bytes_total += int(pub["bytes"])
+        self._transfer_pub_ms.append(float(pub["publish_ms"]))
+        out = {"kind": "transfer", "uid": uid, "entry": pub["entry"],
+               "gen": gen, "bytes": int(pub["bytes"]),
+               "publish_ms": float(pub["publish_ms"]),
+               "seat": {k: v for k, v in seat.items() if k != "stream"}}
+        self._transfer_outbox[uid] = out
+        if self.monitor.armed:
+            # the handoff trace span: per-transfer bytes + publish
+            # latency on the bus (docs/monitoring.md)
+            self.monitor.trace("kv_transfer", step=self._steps, uid=uid,
+                               gen=gen, bytes=out["bytes"],
+                               publish_ms=out["publish_ms"],
+                               entry=os.path.basename(pub["entry"]))
+        if self.journal is not None:
+            # the router's poll channel for subprocess replicas
+            # (ProcessReplica tails it); flushes eagerly — the seat must
+            # be durable before the TRANSFERRED finish retires the uid
+            self.journal.transfer(uid, pub["entry"], gen, out["bytes"],
+                                  out["publish_ms"], seat=out["seat"])
+        self._finish(slot, outcome=TRANSFERRED)
+        return out
+
+    def _publish_transfers(self):
+        """Prefill role: hand every prefill-finished slot off through the
+        transfer queue.  A slot qualifies once its first token is
+        sampled (``ngen >= 1``; a prefix-hit slot still ingesting has
+        ``ngen == 0`` and publishes a later step) unless it is restored
+        wire-KV (a stream seated HERE decodes here) or degrade-latched.
+        Any refusal — backpressure, a publish defect, chaos poison —
+        degrades that ONE stream to local mixed decode: the prefill
+        worker never blocks and never drops.  Returns the number of
+        streams handed off (the scheduler's progress evidence)."""
+        from . import transfer as xfer
+        published = 0
+        for i, s in enumerate(self._slots):
+            if (s is None or s.wire_kv or s.no_transfer
+                    or int(self._ngen[i]) < 1):
+                continue
+            if fault.poison_uid(s.req.uid):
+                # chaos-poisoned prefill output stays LOCAL: the next
+                # decode step quarantines it here (typed POISONED) —
+                # publishing known-poison would just move the quarantine
+                # across the wire
+                s.no_transfer = True
+                continue
+            try:
+                self._publish_slot(i)
+                published += 1
+            except xfer.TransferBackpressureError as e:
+                s.no_transfer = True
+                self._transfer_backpressure_total += 1
+                logger.warning(
+                    f"serving: transfer of uid {s.req.uid} hit queue "
+                    f"backpressure ({e}); degrading to local decode")
+            except Exception as e:
+                s.no_transfer = True
+                logger.warning(
+                    f"serving: transfer publish of uid {s.req.uid} "
+                    f"failed ({e}); degrading to local decode")
+        return published
+
+    def pop_transfer(self, uid: int) -> Optional[dict]:
+        """Take ownership of one published handoff record (``{"entry",
+        "seat", "bytes", ...}``) — the router's poll channel for
+        in-process replicas."""
+        return self._transfer_outbox.pop(int(uid), None)
+
+    def admit_next_transfer(self) -> Optional[dict]:
+        """Decode role: exclusively claim the oldest committed queue
+        entry and seat it through :meth:`submit_restored` (restore-
+        first; ANY defect — torn image, stale seat, no capacity —
+        degrades to the plain recompute queue with a typed
+        ``migration_fallback``).  Returns ``submit_restored``'s dict
+        (or a fallback-shaped one), None when nothing is pending."""
+        if self._txq is None:
+            return None
+        claim = self._txq.claim()
+        if claim is None:
+            return None
+        seat = claim.get("seat") or {}
+        stream = seat.get("stream") or {}
+        if not stream:
+            # unreadable manifest: nothing to rebuild a Request from.
+            # Drop the entry — the PRODUCER's journal still holds the
+            # uid; zero-loss across the edge is the router's guarantee.
+            logger.warning(
+                f"serving: claimed transfer entry {claim['tag']} carries "
+                f"no stream metadata; dropping it")
+            self._txq.done(claim["entry"])
+            return {"uid": seat.get("uid"), "restored": False,
+                    "restore_ms": 0.0, "tokens_saved": 0,
+                    "reason": "claimed entry carries no stream metadata"}
+        dl = seat.get("deadline_ms")
+        if dl == "inf":
+            dl = float("inf")
+        req = Request(tokens=np.asarray(stream["prompt"], np.int32),
+                      max_new_tokens=int(stream["max_new_tokens"]),
+                      temperature=float(stream.get("temperature", 1.0)),
+                      do_sample=bool(stream.get("do_sample", False)),
+                      seed=int(stream.get("seed", 0)),
+                      uid=int(stream["uid"]), deadline_ms=dl)
+        try:
+            out = self.submit_restored(req, claim["entry"], seat=seat)
+        except ValueError as e:
+            # duplicate uid (a superseded re-publish of a stream this
+            # engine already owns) or a request that no longer fits:
+            # the entry is dead weight either way
+            logger.warning(
+                f"serving: claimed transfer entry {claim['tag']} "
+                f"rejected ({e}); dropping it")
+            self._txq.done(claim["entry"])
+            return {"uid": req.uid, "restored": False, "restore_ms": 0.0,
+                    "tokens_saved": 0, "reason": str(e)}
+        self._txq.done(claim["entry"])
+        return out
+
+    def _admit_transfers(self):
+        """Decode role: seat queued handoffs into free slots, one claim
+        per free slot per step (admission-bounded, like ``_admit``).  A
+        restore fallback lands its request on the recompute queue, which
+        this same step's ``_admit`` picks up — degrade-to-mixed."""
+        while any(sl is None for sl in self._slots):
+            if self.admit_next_transfer() is None:
+                return
 
     def _set_blocks(self, blocks: List[int], poison: bool):
         """Pool edit over a block list, outside the decode step:
@@ -2029,11 +2329,27 @@ class ServingEngine:
         fault.site("serving.step")
         mon = self.monitor
         mon.begin_step()
+        if self._txq is not None and self.role == "decode":
+            # BEFORE _admit: a restore fallback re-queues its request,
+            # and this same step's admission must pick it up (otherwise
+            # the livelock guard below would see a queued request no
+            # admission pass ever looked at)
+            with mon.span("kv_transfer"):
+                self._admit_transfers()
         with mon.span("admit"):
             self._admit()
+        published = 0
+        if self._txq is not None and self.role == "prefill":
+            # AFTER _admit: slots seated by this step's prefill publish
+            # immediately — the handoff adds zero decode-step latency
+            with mon.span("kv_transfer"):
+                published = self._publish_transfers()
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
-            if self.queue and not self._draining:
+            if self.queue and not self._draining and not published:
+                # a prefill worker that just PUBLISHED its whole batch
+                # made progress — empty slots + a queued backlog is its
+                # steady state, not a livelock
                 # livelock guard: requests are waiting, EVERY slot is
                 # free, and admission still seated nothing — spinning a
                 # hot no-op step() forever would hide the bug; raise
@@ -2311,6 +2627,20 @@ class ServingEngine:
             counters["migrated_streams_total"] = self._kv_migrated_total
             counters["migration_fallbacks_total"] = self._kv_fallback_total
         gauges = {}
+        if self._txq is not None:
+            # disaggregation handoff telemetry (docs/serving.md
+            # #disaggregation): per-edge bytes/latency plus the queue
+            # depth the router's placement reads
+            counters["kv_transfers_total"] = self._transfers_total
+            counters["transfer_bytes_total"] = self._transfer_bytes_total
+            counters["transfer_backpressure_total"] = \
+                self._transfer_backpressure_total
+            counters["transfer_claimed_total"] = self._txq.claimed_total
+            scalars["transfer_queue_depth"] = self._txq.depth()
+            if self._transfer_pub_ms:
+                gauges["handoff_ms"] = round(
+                    sum(self._transfer_pub_ms)
+                    / len(self._transfer_pub_ms), 3)
         if self._prefix_index is not None:
             # prefix-sharing pressure (docs/serving.md#prefix-sharing):
             # hit rate of admissions against the radix cache, and the
@@ -2641,6 +2971,10 @@ class ServingEngine:
         self._kv_fallback_total = 0
         self._kv_tokens_saved_total = 0
         self._kv_restore_ms = []
+        self._transfers_total = 0
+        self._transfer_bytes_total = 0
+        self._transfer_backpressure_total = 0
+        self._transfer_pub_ms = []
         self._traces_emitted = 0
         # prefix-sharing counters reset; the CACHE itself is kept (warm
         # prefixes are the bench's measured state, not its warmup noise)
@@ -2703,6 +3037,20 @@ class ServingEngine:
             if self.kvs is not None:
                 kv["policy"] = self.kvs.describe()
             out["kv_snapshot"] = kv
+        if self._txq is not None:
+            tr = dict(self._txq.stats())
+            tr["role"] = self.role
+            tr["published_by_this_engine"] = self._transfers_total
+            tr["published_bytes_by_this_engine"] = \
+                self._transfer_bytes_total
+            tr["backpressure_degraded"] = \
+                self._transfer_backpressure_total
+            if self._transfer_pub_ms:
+                tr["handoff_ms"] = {
+                    "mean": round(sum(self._transfer_pub_ms)
+                                  / len(self._transfer_pub_ms), 3),
+                    "max": round(max(self._transfer_pub_ms), 3)}
+            out["transfer"] = tr
         if self._prefix_index is not None:
             out["prefix_cache"] = {
                 "requests": self._prefix_requests_total,

@@ -1,0 +1,328 @@
+"""Prefill/decode transfer queue (docs/serving.md#disaggregation).
+
+The disaggregated serving plane (ROADMAP #2(b)) splits one mixed
+``ServingEngine`` into role workers: a PREFILL worker runs bucketed
+prefill only and publishes each finished stream's paged-KV blocks as a
+PR-18 block image plus a **seat record** (sampled first token, lengths,
+RNG fold position, prefix-cache block hashes); a DECODE worker admits
+those images through the ``KVRestoreError``-guarded restore path and
+runs pure fused-scan decode at steady cadence.  This module is the
+**data plane** between them: a directory-based queue of committed block
+images with the crash-consistency discipline of ``checkpoint/atomic.py``
+— stage, manifest, publish rename — so a torn publish is *detectable,
+never claimable*, exactly like a torn checkpoint.
+
+Layout (satellite fix: transfer images get their OWN namespace — the
+per-uid ``kv_snapshots/`` tree is cadence-snapshot retention, this is a
+queue)::
+
+    <dir>/kv_transfer/
+        xfer-<uid:08d>-<gen:06d>/        committed entry (image + manifest)
+        xfer-<uid:08d>-<gen:06d>.tmp/    torn publish (never listed)
+        claimed/<tag>/                   claimed by a decode worker
+
+Semantics:
+
+- **atomic commit** — ``publish`` stages ``image.npz``/``image.json``
+  and commits via manifest + rename (``paged_kv.save_block_image``); a
+  reader only ever sees fully-committed entries (``find_valid_tags``).
+- **torn-image rejection** — a staged-but-uncommitted entry is
+  invisible to ``pending``/``claim``; a committed-but-corrupt one fails
+  its per-block sha256 at ``load_block_image`` and the decode side
+  degrades to recompute with a typed ``migration_fallback``.
+- **LRU bound + backpressure** — at ``max_pending`` committed entries,
+  ``publish`` raises :class:`TransferBackpressureError` (the decode
+  side lags; the prefill worker degrades that stream to local mixed
+  decode — never blocks, never drops).
+- **keep_n GC** — ``gc()`` (run on every publish) rotates the oldest
+  committed entries beyond ``keep_n`` out (``rotate_checkpoints``), so
+  a busy prefill worker whose consumer died cannot grow the directory
+  unbounded.  A GC'd entry is NOT a lost request: the uid still lives
+  in the router's result table and re-decodes from scratch
+  (``migration_fallback``) when its image is gone.
+- **exclusive claim** — ``claim`` moves an entry into ``claimed/`` with
+  one atomic rename, so two decode workers polling the same queue can
+  never double-admit an image.
+
+Everything here is host-side file I/O: the compiled decode step never
+sees any of it (the PR-9 contract — jaxpr byte-identical with roles
+armed).
+"""
+
+import os
+import shutil
+import time
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+from ..checkpoint import atomic
+from ..utils.logging import logger
+from . import paged_kv as pk
+
+# the transfer namespace under a journal/run dir — a sibling of
+# KV_SNAPSHOT_DIR, never mixed with per-uid cadence snapshots
+KV_TRANSFER_DIR = "kv_transfer"
+CLAIMED_DIR = "claimed"
+
+ROLES = ("mixed", "prefill", "decode")
+TRANSFERRED = "transferred"     # terminal outcome on the PREFILL worker
+
+
+class TransferError(Exception):
+    """A transfer-queue defect (bad entry, bad config)."""
+
+
+class TransferBackpressureError(TransferError):
+    """``publish`` refused: the queue is at ``max_pending`` committed
+    entries — the decode side lags and the prefill worker must degrade
+    (local decode), not block and not drop."""
+
+
+@dataclass
+class TransferConfig:
+    """``serving.transfer`` (docs/config-json.md): the transfer-queue
+    policy a role-split engine resolves.  ``dir`` defaults to
+    ``<journal_dir>/kv_transfer`` when unset."""
+    dir: Optional[str] = None     # queue root (overrides journal_dir)
+    max_pending: int = 64         # backpressure bound (committed entries)
+    keep_n: int = 128             # GC bound (oldest entries rotate out)
+    verify: str = "full"          # restore verification: full | manifest
+
+    @classmethod
+    def from_value(cls, v: Any) -> Optional["TransferConfig"]:
+        if not v:
+            return None
+        if v is True:
+            return cls()
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, dict):
+            unknown = set(v) - {f for f in cls.__dataclass_fields__}
+            if unknown:
+                raise ValueError(
+                    f"serving.transfer: unknown key(s) {sorted(unknown)} "
+                    f"(docs/config-json.md)")
+            return cls(**v)
+        raise ValueError(
+            f"serving.transfer wants a bool/dict/TransferConfig, "
+            f"got {type(v).__name__}")
+
+    def describe(self) -> dict:
+        return {"enabled": True, "dir": self.dir,
+                "max_pending": int(self.max_pending),
+                "keep_n": int(self.keep_n), "verify": self.verify,
+                "wire_format": "paged-KV block image "
+                               "(int8 + per-block scales, sha256)"}
+
+
+def describe_transfer(value: Any = None) -> dict:
+    """Resolved ``serving.transfer`` policy for ``ds_report`` — off by
+    default, with the defaults an armed config would get."""
+    cfg = TransferConfig.from_value(value)
+    if cfg is None:
+        return {"enabled": False,
+                "defaults_when_armed": TransferConfig().describe()}
+    return cfg.describe()
+
+
+def transfer_dir(root: str) -> str:
+    """The queue namespace under a journal/run dir."""
+    return os.path.join(root, KV_TRANSFER_DIR)
+
+
+def _tag(uid: int, gen: int) -> str:
+    return f"xfer-{int(uid):08d}-{int(gen):06d}"
+
+
+def _tag_uid(tag: str) -> Optional[int]:
+    try:
+        return int(tag.split("-")[1])
+    except (IndexError, ValueError):
+        return None
+
+
+def find_transfer_entry(journal_root: str, uid: int) -> Optional[str]:
+    """Newest committed transfer entry for ``uid`` under a replica's
+    journal dir, or None — the router's restore-first handoff uses this
+    when a prefill worker dies mid-transfer (the committed image
+    survives the process; ``find_valid_tags`` skips torn ones)."""
+    qdir = transfer_dir(journal_root)
+    if not os.path.isdir(qdir):
+        return None
+    tags = [t for t in atomic.find_valid_tags(qdir) if _tag_uid(t) == int(uid)]
+    if not tags:
+        return None
+    return os.path.join(qdir, sorted(tags)[-1])
+
+
+class TransferQueue:
+    """Directory-based prefill→decode handoff queue (module docstring).
+
+    One instance per role worker, all pointed at the same directory:
+    the prefill side ``publish``es, the decode side ``claim``s/``done``s.
+    Multi-process safe by construction — commit is a manifest + rename,
+    claim is a rename, GC never touches the newest valid entry."""
+
+    def __init__(self, dirpath: str, config: Optional[TransferConfig] = None):
+        self.cfg = config or TransferConfig()
+        self.dir = dirpath
+        os.makedirs(self.dir, exist_ok=True)
+        self.published_total = 0
+        self.published_bytes_total = 0
+        self.backpressure_total = 0
+        self.gc_dropped_total = 0
+        self.claimed_total = 0
+
+    # ------------------------------------------------------------ producer
+    def publish(self, uid: int, gen: int, image: dict, seat: dict) -> dict:
+        """Commit one stream's block image + seat record as a queue
+        entry.  Raises :class:`TransferBackpressureError` at the
+        ``max_pending`` bound BEFORE writing anything.  Returns
+        ``{"entry", "tag", "bytes", "publish_ms"}``."""
+        depth = len(self.pending())
+        if depth >= self.cfg.max_pending:
+            self.backpressure_total += 1
+            raise TransferBackpressureError(
+                f"transfer queue at max_pending={self.cfg.max_pending} "
+                f"({depth} committed entr(ies) unclaimed) — decode side "
+                f"lags; degrade to local decode")
+        t0 = time.perf_counter()
+        tag = _tag(uid, gen)
+        meta = {
+            # atomic.py's newest-first ordering key: publish time in ms,
+            # NOT the decode position — entries of different uids must
+            # rotate oldest-published-first under keep_n GC (gen values
+            # of unrelated streams are not comparable)
+            "global_steps": int(time.time() * 1e3),
+            "kind": "kv_transfer",
+            "seat": dict(seat),
+            # the restore path reads the stream block verbatim — a
+            # transfer entry IS a restorable snapshot, same wire format
+            "stream": dict(seat.get("stream") or {}),
+        }
+        final = pk.save_block_image(self.dir, tag, image, meta)
+        nbytes = _entry_bytes(final)
+        self.published_total += 1
+        self.published_bytes_total += nbytes
+        self.gc()
+        return {"entry": final, "tag": tag, "bytes": nbytes,
+                "publish_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+
+    def gc(self) -> int:
+        """keep_n retention over committed entries (oldest first, the
+        newest valid entry always survives — ``rotate_checkpoints``'s
+        own guarantee) plus orphaned staging dirs.  Returns the number
+        of entries dropped."""
+        before = set(atomic.find_valid_tags(self.dir))
+        if len(before) > self.cfg.keep_n:
+            atomic.rotate_checkpoints(self.dir, self.cfg.keep_n,
+                                      level="size")
+            after = set(atomic.find_valid_tags(self.dir))
+            dropped = len(before) - len(after)
+            if dropped > 0:
+                self.gc_dropped_total += dropped
+                logger.warning(
+                    f"transfer queue: GC dropped {dropped} unclaimed "
+                    f"entr(ies) beyond keep_n={self.cfg.keep_n} — their "
+                    f"streams re-decode from scratch if still wanted "
+                    f"(typed migration_fallback)")
+            return max(0, dropped)
+        return 0
+
+    # ------------------------------------------------------------ consumer
+    def pending(self) -> List[str]:
+        """Committed, unclaimed entry tags in FIFO (publish) order —
+        torn publishes are invisible by construction."""
+        tags = atomic.find_valid_tags(self.dir)
+
+        def order(tag):
+            try:
+                return (os.path.getmtime(
+                    os.path.join(self.dir, tag, atomic.MANIFEST_FILE)), tag)
+            except OSError:
+                return (float("inf"), tag)
+        return sorted(tags, key=order)
+
+    def depth(self) -> int:
+        return len(self.pending())
+
+    def claim(self, uid: Optional[int] = None) -> Optional[dict]:
+        """Exclusively claim the oldest committed entry (or the oldest
+        for ``uid``): one atomic rename into ``claimed/`` — two decode
+        workers on the same directory can never double-admit.  Returns
+        ``{"entry", "tag", "seat"}`` or None when nothing is pending."""
+        for tag in self.pending():
+            if uid is not None and _tag_uid(tag) != int(uid):
+                continue
+            src = os.path.join(self.dir, tag)
+            dst_root = os.path.join(self.dir, CLAIMED_DIR)
+            os.makedirs(dst_root, exist_ok=True)
+            dst = os.path.join(dst_root, tag)
+            try:
+                os.rename(src, dst)
+            except OSError:
+                continue            # a sibling won the race — next entry
+            seat = {}
+            try:
+                man = atomic.read_manifest(dst)
+                seat = dict((man.get("meta") or {}).get("seat") or {})
+            except Exception as e:
+                logger.warning(
+                    f"transfer queue: claimed entry {tag} has an "
+                    f"unreadable manifest ({e}); restore will reject it")
+            self.claimed_total += 1
+            return {"entry": dst, "tag": tag, "seat": seat}
+        return None
+
+    def done(self, entry: str):
+        """Drop a claimed (or still-queued) entry after its restore
+        resolved — restored or fallen back, the image is dead weight."""
+        drop_entry(entry)
+
+    # --------------------------------------------------------- observability
+    def residency(self) -> dict:
+        """Bytes + entry count resident in the queue directory (pending
+        AND claimed-but-unresolved) — the ds_mem ledger's queue line.
+        Bounded by keep_n, so the walk stays cheap on the hot loop."""
+        entries, nbytes = 0, 0
+        for root in (self.dir, os.path.join(self.dir, CLAIMED_DIR)):
+            if not os.path.isdir(root):
+                continue
+            for name in os.listdir(root):
+                p = os.path.join(root, name)
+                if name == CLAIMED_DIR or not os.path.isdir(p):
+                    continue
+                entries += 1
+                nbytes += _entry_bytes(p)
+        return {"entries": entries, "bytes": nbytes}
+
+    def stats(self) -> dict:
+        return {"published": self.published_total,
+                "published_bytes": self.published_bytes_total,
+                "backpressure": self.backpressure_total,
+                "gc_dropped": self.gc_dropped_total,
+                "claimed": self.claimed_total,
+                "queue_depth": self.depth(),
+                "policy": self.cfg.describe()}
+
+
+def drop_entry(entry: Optional[str]):
+    """Remove one consumed entry directory (restored, fallen back, or
+    abandoned) — the router's seating path uses this without holding a
+    :class:`TransferQueue` on the publisher's directory."""
+    if entry and os.path.isdir(entry):
+        shutil.rmtree(entry, ignore_errors=True)
+
+
+def _entry_bytes(path: str) -> int:
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return 0                # entry dropped under us (racing done/GC)
+    total = 0
+    for name in names:
+        try:
+            total += os.path.getsize(os.path.join(path, name))
+        except OSError:
+            continue            # file consumed mid-walk — skip, not fatal
+    return total

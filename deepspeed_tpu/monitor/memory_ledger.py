@@ -57,6 +57,7 @@ HOST_MOMENTS = "host_adam_moments"
 H2D_STAGING = "h2d_staging"
 NVME_SWAP_BUFFERS = "nvme_swap_buffers"
 COMPILE_CACHE = "compile_cache"
+KV_TRANSFER = "kv_transfer_queue"
 RESIDUAL = "residual"
 
 SPACES = ("hbm", "host", "disk")
@@ -371,6 +372,15 @@ def attribute_serving(srv) -> MemoryLedger:
     if cache_term:
         led.add("disk", COMPILE_CACHE, cache_term[0],
                 entries=cache_term[1])
+    txq = getattr(srv, "_txq", None)
+    if txq is not None:
+        # disaggregation queue residency (docs/serving.md#disaggregation):
+        # committed-but-unclaimed block images are DISK a role worker
+        # owns — keep_n-bounded, but a dead decode pool shows up here
+        # long before the GC warning fires
+        res = txq.residency()
+        led.add("disk", KV_TRANSFER, res["bytes"],
+                entries=res["entries"], role=getattr(srv, "role", "mixed"))
     return led
 
 

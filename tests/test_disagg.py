@@ -1,0 +1,591 @@
+"""Prefill/decode disaggregation (docs/serving.md#disaggregation).
+
+Layers under test, bottom up:
+
+- **transfer queue** (`inference/transfer.py`): atomic publish/claim/
+  done round-trip with FIFO ordering and exclusive claim, torn publishes
+  invisible to `pending`/`claim`/`find_transfer_entry`, backpressure
+  raised BEFORE any bytes hit disk, keep_n GC bounds the directory;
+- **journal**: the `transfer` record is durable before the
+  `transferred` finish and `replay()` surfaces it (the router's
+  crash-recovery channel);
+- **serving engine**: the token-identity oracle — a prefill+decode pair
+  handing off through the queue matches the mixed engine token for
+  token (sampled streams included, arrival order permuted), queue-full
+  backpressure degrades to local decode without losing identity, the
+  stale-handoff guard turns tampered seats into typed
+  migration_fallbacks, the restore re-SHARES cache-resident prefix
+  blocks (DSTPU317 clean), and arming roles leaves the traced decode
+  step byte-identical;
+- **router**: role pools seat transfers on the decode worker, a prefill
+  replica killed mid-transfer (published but never announced) loses
+  nothing and duplicates nothing, and a dead prefill pool degrades to
+  any healthy replica;
+- **tooling**: the bounded interleaving sweep over the disagg handoff,
+  ds_bench_diff classification of the handoff metrics (CLI smoke in
+  both directions), and ds_report's resolved role/transfer policy.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.checkpoint import atomic
+from deepspeed_tpu.inference import journal as jr
+from deepspeed_tpu.inference import paged_kv as pk
+from deepspeed_tpu.inference import transfer as xfer
+from deepspeed_tpu.inference.serving import (ServingEngine, ServingConfig,
+                                             Request, TRANSFERRED)
+from deepspeed_tpu.models.gpt2 import GPT2, GPT2Config
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = GPT2Config(vocab_size=64, max_seq=64, n_embd=32, n_layer=2,
+                     n_head=4, embd_pdrop=0.0, attn_pdrop=0.0,
+                     resid_pdrop=0.0, attention_impl="jnp")
+    model = GPT2(cfg, dtype=jnp.float32)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+LONG = list(range(1, 25))          # 24 tokens: 3 full blocks at bs=8
+LONG2 = list(range(30, 54))
+SHORT = [40, 41, 42, 43, 44]
+SHORT2 = [7, 9, 11]
+
+# (uid, prompt, max_new, do_sample, seed): a long+short mix with both
+# greedy and sampled streams — the identity oracle must hold for all
+MIX = [(0, LONG, 12, False, 3), (1, SHORT, 12, True, 5),
+       (2, LONG2, 12, True, 9), (3, SHORT2, 12, False, 11)]
+
+
+def _cfg(journal_dir=None, **kw):
+    return ServingConfig(batch_slots=2, block_size=8, max_new_tokens=16,
+                         kv_bits=8, journal_dir=journal_dir,
+                         preflight=False, **kw)
+
+
+def _reqs(specs):
+    return [Request(tokens=np.asarray(toks, np.int32), max_new_tokens=mnt,
+                    do_sample=samp, temperature=0.9, seed=seed, uid=uid)
+            for uid, toks, mnt, samp, seed in specs]
+
+
+def _oracle_tokens(model, params, root, specs):
+    srv = ServingEngine(model=model, params=params,
+                        config=_cfg(os.path.join(root, "oracle")))
+    try:
+        out = srv.run(_reqs(specs))
+        return {u: list(r["tokens"]) for u, r in out.items()}
+    finally:
+        srv.close()
+
+
+def _drive_pair(pre, dec, uids, max_steps=400):
+    """Step a prefill+decode pair until every uid is terminal on one
+    side (TRANSFERRED on the prefill worker is not terminal — the
+    decode worker owns the stream)."""
+    def done(u):
+        rd = dec.results.get(u)
+        if rd is not None and rd["outcome"] is not None:
+            return True
+        rp = pre.results.get(u)
+        return (rp is not None and rp["outcome"] is not None
+                and rp["outcome"] != TRANSFERRED)
+    for _ in range(max_steps):
+        pre.step()
+        dec.step()
+        if all(done(u) for u in uids):
+            return
+    pytest.fail("disaggregated pair did not finish within the step cap")
+
+
+# ===================================================================
+# transfer queue: publish/claim/done, torn publish, backpressure, GC
+# ===================================================================
+
+def _int8_pool(num_blocks=6, rng=None):
+    rng = rng or np.random.default_rng(3)
+    pool = pk.init_pool(2, num_blocks, 8, 4, 8, jnp.float32, kv_bits=8)
+    filled = {}
+    for name in ("k", "v"):
+        filled[name] = jnp.asarray(rng.integers(
+            -127, 128, pool[name].shape, dtype=np.int8))
+        sname = f"{name}_scale"
+        filled[sname] = jnp.asarray(rng.uniform(
+            0.01, 1.0, pool[sname].shape).astype(np.float32))
+    return dict(pool, **filled)
+
+
+def _img():
+    return pk.export_block_image(_int8_pool(), [2, 4])
+
+
+def _seat(uid, gen=1, first=3):
+    return {"uid": uid, "gen": gen, "first_token": first,
+            "stream": {"uid": uid}}
+
+
+def test_transfer_queue_publish_claim_done(tmp_path):
+    root = str(tmp_path)
+    q = xfer.TransferQueue(xfer.transfer_dir(root))
+    q.publish(5, 1, _img(), _seat(5))
+    q.publish(7, 1, _img(), _seat(7, first=9))
+    assert q.depth() == 2
+    assert q.pending() == ["xfer-00000005-000001", "xfer-00000007-000001"]
+    assert xfer.find_transfer_entry(root, 5) == \
+        os.path.join(q.dir, "xfer-00000005-000001")
+
+    got = q.claim()
+    assert got["tag"] == "xfer-00000005-000001"
+    assert got["seat"]["uid"] == 5 and got["seat"]["first_token"] == 3
+    # exclusive claim: the entry moved into claimed/ — a second worker
+    # polling the same directory can never double-admit it
+    assert q.depth() == 1
+    assert os.path.isdir(got["entry"])
+    assert xfer.CLAIMED_DIR in got["entry"]
+    img, meta = pk.load_block_image(got["entry"])
+    assert pk.verify_block_image(img) == []
+    assert meta["kind"] == "kv_transfer"
+
+    q.done(got["entry"])
+    assert not os.path.isdir(got["entry"])
+    assert q.claim()["seat"]["uid"] == 7
+    assert q.claim() is None
+    st = q.stats()
+    assert st["published"] == 2 and st["claimed"] == 2
+    assert st["queue_depth"] == 0 and st["backpressure"] == 0
+
+
+def test_transfer_queue_torn_publish_invisible(tmp_path):
+    root = str(tmp_path)
+    q = xfer.TransferQueue(xfer.transfer_dir(root))
+    # a torn publish: staged dir, payload present, never committed
+    torn = os.path.join(q.dir, "xfer-00000008-000001.tmp")
+    os.makedirs(torn)
+    open(os.path.join(torn, "image.npz"), "wb").write(b"half an image")
+    # a half publish the other way: dir without a manifest
+    half = os.path.join(q.dir, "xfer-00000009-000001")
+    os.makedirs(half)
+    open(os.path.join(half, "image.npz"), "wb").write(b"no manifest")
+
+    assert q.pending() == []
+    assert q.claim() is None
+    assert xfer.find_transfer_entry(root, 8) is None
+    assert xfer.find_transfer_entry(root, 9) is None
+
+    q.publish(9, 2, _img(), _seat(9))      # a later COMMITTED publish
+    assert q.pending() == ["xfer-00000009-000002"]
+    assert xfer.find_transfer_entry(root, 9).endswith(
+        "xfer-00000009-000002")
+
+
+def test_transfer_queue_backpressure_raises_before_write(tmp_path):
+    q = xfer.TransferQueue(xfer.transfer_dir(str(tmp_path)),
+                           xfer.TransferConfig(max_pending=1))
+    q.publish(1, 1, _img(), _seat(1))
+    with pytest.raises(xfer.TransferBackpressureError):
+        q.publish(2, 1, _img(), _seat(2))
+    # refused BEFORE writing: one committed entry, no staging leftovers
+    assert q.pending() == ["xfer-00000001-000001"]
+    assert not [n for n in os.listdir(q.dir) if n.endswith(".tmp")]
+    assert q.stats()["backpressure"] == 1
+
+
+def test_transfer_queue_keep_n_gc(tmp_path):
+    root = str(tmp_path)
+    q = xfer.TransferQueue(xfer.transfer_dir(root),
+                           xfer.TransferConfig(keep_n=2, max_pending=64))
+    for uid in range(4):
+        q.publish(uid, 1, _img(), _seat(uid))
+        time.sleep(0.002)       # strictly increasing publish-time keys
+    assert q.depth() == 2
+    assert q.gc_dropped_total == 2
+    # oldest entries rotated out, newest survive
+    assert xfer.find_transfer_entry(root, 0) is None
+    assert xfer.find_transfer_entry(root, 3) is not None
+
+
+def test_journal_transfer_record_survives_replay(tmp_path):
+    jdir = str(tmp_path / "j")
+    j = jr.RequestJournal(jdir)
+    req = Request(tokens=np.arange(4, dtype=np.int32), max_new_tokens=4,
+                  seed=1, uid=9)
+    j.submit(req)
+    j.transfer(9, "/q/xfer-00000009-000003", 3, 123, 1.5,
+               seat={"gen": 3, "first_token": 2})
+    j.finish(9, TRANSFERRED, None)
+    j.flush()
+    state = jr.replay(jdir)
+    rec = state["transferred"][9]
+    assert rec["entry"] == "/q/xfer-00000009-000003"
+    assert rec["gen"] == 3 and rec["seat"]["first_token"] == 2
+    assert state["finished"][9]["outcome"] == TRANSFERRED
+    assert not state["pending"]
+
+
+# ===================================================================
+# serving engine: the token-identity oracle and its degradation edges
+# ===================================================================
+
+def test_disagg_pair_token_identical_to_mixed(tiny, tmp_path):
+    """The acceptance oracle: a prefill worker handing every stream off
+    through the queue to a decode worker produces EXACTLY the mixed
+    engine's tokens — long+short mix, greedy and sampled, and the
+    arrival order permuted (determinism is per-stream `fold_in(seed,
+    index)`, so placement cannot leak into sampling)."""
+    model, params = tiny
+    oracle = _oracle_tokens(model, params, str(tmp_path), MIX)
+
+    qdir = str(tmp_path / "xferq")
+    pre = ServingEngine(model=model, params=params,
+                        config=_cfg(str(tmp_path / "pre"), role="prefill",
+                                    transfer={"dir": qdir}))
+    dec = ServingEngine(model=model, params=params,
+                        config=_cfg(str(tmp_path / "dec"), role="decode",
+                                    transfer={"dir": qdir}))
+    by_uid = {r.uid: r for r in _reqs(MIX)}
+    for uid in (2, 0, 3, 1):          # permuted arrivals
+        pre.submit(by_uid[uid])
+    _drive_pair(pre, dec, list(by_uid))
+
+    for uid in by_uid:
+        assert pre.results[uid]["outcome"] == TRANSFERRED
+        assert dec.results[uid]["outcome"] == "ok"
+        assert list(dec.results[uid]["tokens"]) == oracle[uid], \
+            f"uid {uid} diverged across the handoff"
+
+    pst = pre.stats()["transfer"]
+    assert pst["role"] == "prefill"
+    assert pst["published_by_this_engine"] == 4
+    assert pst["published_bytes_by_this_engine"] > 0
+    assert pst["handoff_ms"]["mean"] > 0
+    assert pst["backpressure_degraded"] == 0
+    dst = dec.stats()
+    assert dst["transfer"]["role"] == "decode"
+    assert dst["transfer"]["claimed"] == 4
+    assert dst["transfer"]["queue_depth"] == 0
+    assert dst["kv_snapshot"]["migrated_streams"] == 4
+    assert dst["kv_snapshot"]["migration_fallbacks"] == 0
+    pre.close()
+    dec.close()
+
+
+def test_prefill_backpressure_degrades_to_local_decode(tiny, tmp_path):
+    """max_pending=1 with no consumer: the first stream publishes, the
+    rest hit backpressure and decode LOCALLY (mixed behaviour, token-
+    identical) — the worker never blocks and never drops.  A decode
+    worker arriving late still drains the one queued handoff."""
+    model, params = tiny
+    specs = [(0, LONG, 8, True, 5), (1, SHORT, 8, False, 3),
+             (2, SHORT2, 8, True, 9)]
+    oracle = _oracle_tokens(model, params, str(tmp_path), specs)
+
+    qdir = str(tmp_path / "xferq")
+    pre = ServingEngine(model=model, params=params,
+                        config=_cfg(str(tmp_path / "pre"), role="prefill",
+                                    transfer={"dir": qdir,
+                                              "max_pending": 1}))
+    out = pre.run(_reqs(specs))
+    outcomes = sorted(r["outcome"] for r in out.values())
+    assert outcomes == ["ok", "ok", TRANSFERRED]
+    for uid, rec in out.items():
+        if rec["outcome"] == "ok":      # locally-decoded under pressure
+            assert list(rec["tokens"]) == oracle[uid]
+    assert pre.stats()["transfer"]["backpressure_degraded"] == 2
+
+    xferred = [u for u, r in out.items() if r["outcome"] == TRANSFERRED]
+    dec = ServingEngine(model=model, params=params,
+                        config=_cfg(str(tmp_path / "dec"), role="decode",
+                                    transfer={"dir": qdir}))
+    for _ in range(200):
+        dec.step()
+        if dec.results.get(xferred[0], {}).get("outcome") is not None:
+            break
+    assert list(dec.results[xferred[0]]["tokens"]) == oracle[xferred[0]]
+    assert dec.stats()["kv_snapshot"]["migrated_streams"] == 1
+    pre.close()
+    dec.close()
+
+
+def test_stale_handoff_guard_typed_fallback(tiny, tmp_path):
+    """A seat record newer than its image (a superseded publish) or
+    disagreeing on the first sampled token must NOT seat — seating it
+    would silently rewind or fork the stream.  Both tampers fall back
+    to recompute with a typed migration_fallback, token-identical."""
+    model, params = tiny
+    specs = [(5, SHORT, 8, True, 21), (6, LONG, 8, True, 23)]
+    pre = ServingEngine(model=model, params=params,
+                        config=_cfg(str(tmp_path / "pre"), role="prefill",
+                                    transfer={"dir": str(tmp_path / "q")}))
+    out = pre.run(_reqs(specs))
+    assert all(r["outcome"] == TRANSFERRED for r in out.values())
+    pub5, pub6 = pre.pop_transfer(5), pre.pop_transfer(6)
+    pre.close()
+
+    b = ServingEngine(model=model, params=params,
+                      config=_cfg(str(tmp_path / "b")))
+    # oracle on the same engine: sampling is a function of (seed,
+    # index), never of uid — uids 95/96 replay the exact streams
+    oracle = {u: list(r["tokens"]) for u, r in b.run(_reqs(
+        [(95, SHORT, 8, True, 21), (96, LONG, 8, True, 23)])).items()}
+
+    r5, r6 = _reqs(specs)
+    stale = dict(pub5["seat"], gen=pub5["seat"]["gen"] + 7)
+    got5 = b.submit_restored(r5, pub5["entry"], seat=stale)
+    assert not got5["restored"] and "stale" in got5["reason"]
+    forked = dict(pub6["seat"],
+                  first_token=(pub6["seat"]["first_token"] + 1) % 64)
+    got6 = b.submit_restored(r6, pub6["entry"], seat=forked)
+    assert not got6["restored"] and "first token" in got6["reason"]
+
+    while any(b.results[u]["outcome"] is None for u in (5, 6)):
+        b.step()
+    assert list(b.results[5]["tokens"]) == oracle[95]
+    assert list(b.results[6]["tokens"]) == oracle[96]
+    assert b.stats()["kv_snapshot"]["migration_fallbacks"] == 2
+    b.close()
+
+
+def test_restore_shares_resident_prefix_blocks(tiny, tmp_path):
+    """Satellite fix: a decode-side restore whose prompt blocks are
+    already prefix-cache-resident must incref-and-share them, not
+    import private duplicates — the armed sanitizer (DSTPU317 halts on
+    a double-import) stays silent and the stream stays identical."""
+    model, params = tiny
+    pre = ServingEngine(model=model, params=params,
+                        config=_cfg(str(tmp_path / "pre"), role="prefill",
+                                    transfer={"dir": str(tmp_path / "q")}))
+    out = pre.run(_reqs([(2, LONG, 8, True, 5)]))
+    assert out[2]["outcome"] == TRANSFERRED
+    pub = pre.pop_transfer(2)
+    pre.close()
+
+    b = ServingEngine(model=model, params=params,
+                      config=_cfg(str(tmp_path / "b"), prefix_cache=True,
+                                  sanitize=True))
+    b.run(_reqs([(1, LONG, 8, False, 3)]))       # cache the prompt blocks
+    oracle = list(b.run(_reqs([(9, LONG, 8, True, 5)]))[9]["tokens"])
+    shared_before = b.stats()["prefix_cache"]["shared_blocks_attached"]
+
+    got = b.submit_restored(_reqs([(2, LONG, 8, True, 5)])[0],
+                            pub["entry"], seat=pub["seat"])
+    assert got["restored"]
+    while b.results[2]["outcome"] is None:
+        b.step()
+    assert list(b.results[2]["tokens"]) == oracle
+    shared_after = b.stats()["prefix_cache"]["shared_blocks_attached"]
+    assert shared_after - shared_before >= 3, \
+        "restore imported private copies of cache-resident prompt blocks"
+    assert b.stats()["sanitizer"]["findings"] == 0
+    b.close()
+
+
+def test_sanitizer_flags_double_import(tmp_path):
+    """DSTPU317 from both sides: importing a duplicate of a resident
+    prefix block, and importing wire bytes INTO a block the cache still
+    holds.  The clean share path adds nothing."""
+    from deepspeed_tpu.analysis.sanitize import (ShadowSanitizer,
+                                                 DOUBLE_IMPORT)
+    san = ShadowSanitizer(8, halt=False)
+    san.on_alloc([2, 3], uid=1)
+    san.on_import([3], uid=1, resident=[2])
+    assert [f.rule for f in san.findings] == [DOUBLE_IMPORT]
+    assert "incref-and-share" in san.findings[0].message
+
+    san.cache_blocks.add(4)
+    san.on_alloc([4], uid=2)
+    san.on_import([4], uid=2)
+    assert [f.rule for f in san.findings] == [DOUBLE_IMPORT] * 2
+
+    san.on_alloc([5], uid=3)
+    san.on_import([5], uid=3, resident=[])       # the correct path
+    assert len(san.findings) == 2
+
+
+def test_disagg_roles_jaxpr_identical(tiny, tmp_path):
+    """Arming a role + transfer queue must leave the TRACED decode step
+    byte-identical: the whole handoff is host-side file I/O, never
+    program content (the --audit-step disagg contract)."""
+    model, params = tiny
+
+    def jaxpr_text(sub, **kw):
+        srv = ServingEngine(model=model, params=params,
+                            config=_cfg(str(tmp_path / sub), **kw))
+        srv._build_decode()
+        jx = str(jax.make_jaxpr(srv._decode)(*srv._decode_args()))
+        srv.close()
+        return jx
+
+    plain = jaxpr_text("plain")
+    assert plain == jaxpr_text("dec", role="decode",
+                               transfer={"dir": str(tmp_path / "q")})
+    assert plain == jaxpr_text("pre", role="prefill",
+                               transfer={"dir": str(tmp_path / "q2")})
+
+
+# ===================================================================
+# router: role pools, mid-transfer crash, degrade-to-any-healthy
+# ===================================================================
+
+def test_router_role_pools_and_mid_transfer_crash(tiny, tmp_path):
+    from deepspeed_tpu.inference.router import (ReplicaRouter,
+                                                RouterConfig, LocalReplica,
+                                                DEAD)
+    model, params = tiny
+    specs = MIX[:3] + [(7, SHORT, 8, True, 31), (8, SHORT2, 8, False, 33)]
+    oracle = _oracle_tokens(model, params, str(tmp_path), specs)
+
+    # router topology: each role worker owns its queue dir (the
+    # <journal_dir>/kv_transfer default) and the ROUTER is the control
+    # plane moving entries prefill -> decode — a shared directory would
+    # race the decode worker's autonomous claim against the router's
+    # explicit seating
+    pre = ServingEngine(model=model, params=params,
+                        config=_cfg(str(tmp_path / "pre"), role="prefill",
+                                    transfer=True))
+    dec = ServingEngine(model=model, params=params,
+                        config=_cfg(str(tmp_path / "dec"), role="decode",
+                                    transfer=True))
+    router = ReplicaRouter([LocalReplica("pre", pre),
+                            LocalReplica("dec", dec)],
+                           config=RouterConfig())
+    assert router.states()["pre"]["role"] == "prefill"
+    assert router.states()["dec"]["role"] == "decode"
+
+    # phase 1: fresh requests land on the prefill pool and every stream
+    # crosses the queue to the decode worker — token-identical
+    for r in _reqs(MIX[:3]):
+        router.submit(r)
+    out = router.run(timeout_s=120)
+    for uid, _, _, _, _ in MIX[:3]:
+        assert out[uid]["outcome"] == "ok"
+        assert list(out[uid]["tokens"]) == oracle[uid]
+    assert router.stats()["transfers_seated"] == 3
+
+    # phase 2: the crash edge — uid 7 is published (entry committed,
+    # journal flushed) but the router NEVER polls the announcement:
+    # the prefill worker dies first.  The handoff must find the
+    # committed entry via the journal and seat it exactly once.
+    req7 = _reqs(specs)[3]
+    uid = router.submit(req7)
+    st = router._replicas["pre"]
+    st.handle.submit(req7)               # place by hand: no pump, so the
+    router.queue.clear()                 # outbox is never drained
+    router.results[uid]["replica"] = "pre"
+    st.assigned.add(uid)
+    for _ in range(20):
+        pre.step()
+        if pre.results[uid]["outcome"] == TRANSFERRED:
+            break
+    assert pre.results[uid]["outcome"] == TRANSFERRED
+    router._set_state(st, DEAD, router._clock(), "test kill mid-transfer")
+    out = router.run(timeout_s=120)
+    assert out[uid]["outcome"] == "ok"
+    assert list(out[uid]["tokens"]) == oracle[uid]
+
+    # phase 3: prefill pool dead — placement degrades to any healthy
+    # replica (the decode worker serves it mixed) rather than stalling
+    router.submit(_reqs(specs)[4])
+    out = router.run(timeout_s=120)
+    assert out[8]["outcome"] == "ok"
+    assert list(out[8]["tokens"]) == oracle[8]
+
+    s = router.stats()
+    assert s["transfers_seated"] == 4
+    assert s["transfer_seat_fallbacks"] == 0
+    assert s["migration_fallbacks"] == 0
+    assert s["degraded_placements"] >= 1
+    assert s["lost"] == 0
+    # the dead prefill's journaled transfer answer still surfaces after
+    # the handoff seated uid 7 — set-once dedup suppresses it, exactly
+    # once: suppression is the mechanism behind zero duplicate answers
+    assert s["duplicates_suppressed"] == 1
+    router.close()
+
+
+def test_interleave_disagg_scenario_bounded_sweep():
+    """A bounded slice of the --audit-step sweep: the disagg handoff
+    scenario (publish, torn publish, announce, prefill crash) holds the
+    zero-loss/zero-dup/no-stale-tokens oracles across orderings."""
+    from deepspeed_tpu.analysis.interleave import (explore,
+                                                   disagg_handoff_scenario)
+    rep = explore(disagg_handoff_scenario(), max_permutations=48)
+    assert rep["explored"] == 48
+    assert rep["ok"], [str(f) for f in rep["findings"][:3]]
+
+
+# ===================================================================
+# tooling: bench_diff classification + CLI, ds_report policy echo
+# ===================================================================
+
+def test_bench_diff_classifies_disagg_metrics(tmp_path, capsys):
+    from deepspeed_tpu.analysis.bench_diff import classify, compare, main
+    assert classify("handoff_ms") == "lower"
+    assert classify("decode_cadence_p99_ms") == "lower"
+    assert classify("per_stream_handoff_ms") == "lower"
+
+    base = {"serving_disagg_longmix": {
+        "disaggregated": {"decode_cadence_p99_ms": 5.0},
+        "handoff": {"per_stream_handoff_ms": 20.0}}}
+    worse = {"serving_disagg_longmix": {
+        "disaggregated": {"decode_cadence_p99_ms": 12.0},
+        "handoff": {"per_stream_handoff_ms": 45.0}}}
+    better = {"serving_disagg_longmix": {
+        "disaggregated": {"decode_cadence_p99_ms": 2.0},
+        "handoff": {"per_stream_handoff_ms": 9.0}}}
+    res = compare(base, worse)
+    assert {r["path"] for r in res["regressions"]} == {
+        "serving_disagg_longmix.disaggregated.decode_cadence_p99_ms",
+        "serving_disagg_longmix.handoff.per_stream_handoff_ms"}
+    res = compare(base, better)
+    assert not res["regressions"]
+    assert {r["verdict"] for r in res["rows"]} == {"improved"}
+
+    # CLI smoke, both directions (the gate bench trajectories ride on)
+    paths = {}
+    for name, doc in (("base", base), ("worse", worse),
+                      ("better", better)):
+        p = str(tmp_path / f"{name}.json")
+        json.dump(doc, open(p, "w"))
+        paths[name] = p
+    assert main([paths["base"], paths["worse"]]) == 1
+    assert main([paths["base"], paths["better"]]) == 0
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_describe_transfer_and_report(capsys):
+    off = xfer.describe_transfer(None)
+    assert off["enabled"] is False
+    assert off["defaults_when_armed"]["max_pending"] == 64
+    on = xfer.describe_transfer({"max_pending": 4, "keep_n": 9})
+    assert on["enabled"] and on["max_pending"] == 4 and on["keep_n"] == 9
+    with pytest.raises(ValueError, match="unknown key"):
+        xfer.describe_transfer({"bogus": 1})
+
+    from deepspeed_tpu.env_report import transfer_report
+    transfer_report()
+    text = capsys.readouterr().out
+    assert "transfer queue" in text
+    assert "prefill" in text and "decode" in text
+    assert "degrade-to-mixed" in text
+
+
+def test_role_config_validation(tiny, tmp_path):
+    model, params = tiny
+    with pytest.raises(ValueError, match="serving.role"):
+        ServingEngine(model=model, params=params,
+                      config=_cfg(str(tmp_path / "j"), role="bogus"))
+    # a role worker needs a queue directory from somewhere
+    with pytest.raises(ValueError, match="queue directory"):
+        ServingEngine(model=model, params=params,
+                      config=_cfg(None, role="prefill"))
+    with pytest.raises(ValueError, match="unknown key"):
+        ServingEngine(model=model, params=params,
+                      config=_cfg(str(tmp_path / "j2"),
+                                  transfer={"max_depth": 4}))
